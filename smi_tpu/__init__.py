@@ -1,0 +1,100 @@
+"""smi_tpu — a TPU-native streaming message interface.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of SMI
+(Streaming Message Interface, SC'19): an MPI-like communication model for
+accelerator kernels where transient point-to-point channels (``Push``/``Pop``)
+and collectives (``Bcast``/``Reduce``/``Scatter``/``Gather``) are addressed by
+logical *ports* and overlap with pipelined computation.
+
+Where the reference implementation (``/root/reference``) synthesizes an
+on-FPGA packet-switched NoC over QSFP serial links, this framework maps the
+same programming model onto TPUs idiomatically:
+
+- the device *mesh* + named axes replace ranks and the routing NoC
+  (XLA routes over the ICI torus; reference: ``codegen/routing_table.py``),
+- masked ``jax.lax.ppermute`` inside ``shard_map`` replaces the CK_S/CK_R
+  P2P path (reference: ``codegen/templates/{cks,ckr}.cl``),
+- XLA collectives (``psum``/``all_gather``/``psum_scatter``) replace the
+  per-port collective support kernels (reference: ``codegen/templates/
+  {bcast,reduce,scatter,gather}.cl``),
+- Pallas kernels with overlapped remote DMA replace streaming-into-pipeline
+  semantics (reference: the concurrent bridge kernels of
+  ``examples/kernels/stencil_smi.cl:236-386``),
+- a CPU fake-mesh ``jax.jit`` path replaces the Intel FPGA emulator for
+  hardware-free testing (reference: ``CMakeLists.txt:188-191``).
+
+Public API (mirrors ``include/smi.h``; see each submodule for details)::
+
+    import smi_tpu as smi
+
+    prog = smi.Program([smi.Push(0, "float"), smi.Pop(0, "float")])
+    comm = smi.make_communicator(n_devices=8)
+
+    @smi.smi_kernel(comm)
+    def app(ctx, x):
+        ch = ctx.open_send_channel(N, "float", dst=1, port=0)
+        ctx.push(ch, x)
+        ...
+"""
+
+from smi_tpu.ops.types import (
+    SmiDtype,
+    SmiOp,
+    SMI_ADD,
+    SMI_MAX,
+    SMI_MIN,
+    dtype_to_jnp,
+)
+from smi_tpu.ops.operations import (
+    SmiOperation,
+    Push,
+    Pop,
+    Broadcast,
+    Reduce,
+    Scatter,
+    Gather,
+    OP_REGISTRY,
+)
+from smi_tpu.ops.program import Program, Device, ProgramMapping, allocate_ports
+from smi_tpu.ops.serialization import (
+    parse_program,
+    serialize_program,
+    parse_topology_file,
+)
+from smi_tpu.parallel.mesh import (
+    Communicator,
+    make_communicator,
+    mesh_from_topology,
+)
+from smi_tpu.parallel.context import SmiContext, smi_kernel
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SmiDtype",
+    "SmiOp",
+    "SMI_ADD",
+    "SMI_MAX",
+    "SMI_MIN",
+    "dtype_to_jnp",
+    "SmiOperation",
+    "Push",
+    "Pop",
+    "Broadcast",
+    "Reduce",
+    "Scatter",
+    "Gather",
+    "OP_REGISTRY",
+    "Program",
+    "Device",
+    "ProgramMapping",
+    "allocate_ports",
+    "parse_program",
+    "serialize_program",
+    "parse_topology_file",
+    "Communicator",
+    "make_communicator",
+    "mesh_from_topology",
+    "SmiContext",
+    "smi_kernel",
+]

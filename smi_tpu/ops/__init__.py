@@ -1,0 +1,10 @@
+"""Substrate-neutral operation/port/program model.
+
+This is the front half of the SMI "compiler": the taxonomy of communication
+operations, the per-rank program metadata, and its JSON wire format. It is
+deliberately independent of JAX so it can be unit-tested without devices and
+consumed by the native (C++) manifest tooling.
+
+Reference parity: ``codegen/ops.py``, ``codegen/program.py``,
+``codegen/serialization.py``.
+"""

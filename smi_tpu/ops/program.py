@@ -1,0 +1,198 @@
+"""Per-rank program metadata: validation and stream allocation.
+
+Reference parity: ``codegen/program.py``. A *program* is the set of
+communication operations one rank executes. The reference validates port
+uniqueness, then round-robins each op's hardware ports across the FPGA's 4
+physical QSFP channels per usage class (``codegen/program.py:53-80``,
+``codegen/notes.txt``). On TPU the physical substrate is the ICI torus and
+XLA does the physical routing, but the allocation layer survives with a new
+meaning: logical ports are assigned to a small number of *streams* —
+independent communication contexts that the runtime may overlap (concurrent
+collectives on distinct ports land on distinct streams, mirroring
+``multi_collectives.cl``'s overlap guarantee).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from smi_tpu.ops.operations import (
+    ALL_STREAM_KEYS,
+    COLLECTIVE_FAMILIES,
+    P2P_FAMILIES,
+    SmiOperation,
+)
+
+#: Streams per device. The reference has 4 physical QSFP channels per FPGA
+#: (``codegen/program.py:9``); a TPU v4/v5 chip likewise has up to 6 ICI
+#: links but collective overlap is bounded in practice — 4 keeps the
+#: allocation semantics aligned with the reference test suite.
+STREAMS_PER_DEVICE = 4
+
+
+def round_robin(values: Sequence, index: int, size: int) -> List:
+    """``values[index::size]`` — reference ``codegen/utils.py:5-10``."""
+    return list(values[index::size])
+
+
+class PortConflict(ValueError):
+    """Two operations of one family claim the same logical port."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Device:
+    """A physical device slot: host node + index on that node.
+
+    Reference ``FPGA`` (``codegen/program.py``), addressed "node:index"
+    (e.g. ``fpga-0015:1``). On TPU, node = host, index = local chip index.
+    """
+
+    node: str
+    index: int
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        return (self.node, self.index)
+
+    def __str__(self) -> str:
+        return f"{self.node}:{self.index}"
+
+    @classmethod
+    def parse(cls, text: str) -> "Device":
+        """Parse ``node:index``. The index component may be a bare integer
+        (``host-a:1``) or carry a device-name prefix as in the reference's
+        topology files (``fpga-0001:acl1`` → index 1)."""
+        node, _, idx = text.rpartition(":")
+        if not node:
+            raise ValueError(f"device must be 'node:index', got {text!r}")
+        digits = "".join(ch for ch in idx if ch.isdigit())
+        if not digits:
+            raise ValueError(f"device index must contain digits, got {text!r}")
+        return cls(node=node, index=int(digits))
+
+
+class Program:
+    """A validated set of operations plus communication tuning flags.
+
+    Flags mirror the reference codegen CLI (``codegen/main.py:40-43``):
+
+    - ``consecutive_reads``: reference CK fairness bound (``READS_LIMIT``,
+      ``templates/device.cl:13-14``); on TPU it bounds how many chunks a
+      streamed transfer may burst before yielding the stream.
+    - ``max_ranks``: upper bound on communicator size the program is
+      compiled for (sizes buffers in the reference; sizes masks here).
+    - ``p2p_rendezvous``: reference credit-based rendezvous vs eager
+      protocol (``templates/push.cl:21-31``); on TPU, True bounds in-flight
+      chunks of a streamed P2P transfer to the channel's pipeline depth
+      (back-pressure), False streams eagerly.
+    """
+
+    def __init__(
+        self,
+        operations: Sequence[SmiOperation],
+        consecutive_reads: int = 8,
+        max_ranks: int = 8,
+        p2p_rendezvous: bool = True,
+    ):
+        self.operations: Tuple[SmiOperation, ...] = tuple(operations)
+        self.consecutive_reads = consecutive_reads
+        self.max_ranks = max_ranks
+        self.p2p_rendezvous = p2p_rendezvous
+        self._validate()
+        self._allocation = allocate_ports(self.operations)
+
+    def _validate(self) -> None:
+        """Port-uniqueness rules (``codegen/program.py:37-50``).
+
+        Within the P2P family, each (family, port) must be unique — a rank
+        cannot Push twice on one port — and within each collective family a
+        port may appear once.
+        """
+        seen: Dict[Tuple[str, int], SmiOperation] = {}
+        for op in self.operations:
+            key = (op.family, op.port)
+            if key in seen:
+                raise PortConflict(
+                    f"duplicate {op.family} operation at port {op.port}: "
+                    f"{seen[key]} vs {op}"
+                )
+            seen[key] = op
+
+    @property
+    def logical_port_count(self) -> int:
+        """Number of distinct logical ports (sizes routing tables)."""
+        if not self.operations:
+            return 0
+        return max(op.port for op in self.operations) + 1
+
+    def operations_of_family(self, *families: str) -> List[SmiOperation]:
+        fams = families or (P2P_FAMILIES + COLLECTIVE_FAMILIES)
+        return [op for op in self.operations if op.family in fams]
+
+    def find(self, family: str, port: int) -> Optional[SmiOperation]:
+        for op in self.operations:
+            if op.family == family and op.port == port:
+                return op
+        return None
+
+    def stream_of(self, op: SmiOperation, stream_key: str) -> int:
+        """Which stream this op's ``stream_key`` usage was assigned to."""
+        return self._allocation[(op.family, op.port, stream_key)]
+
+    @property
+    def allocation(self) -> Dict[Tuple[str, int, str], int]:
+        return dict(self._allocation)
+
+
+def allocate_ports(
+    operations: Sequence[SmiOperation],
+    num_streams: int = STREAMS_PER_DEVICE,
+) -> Dict[Tuple[str, int, str], int]:
+    """Round-robin op stream-usages onto ``num_streams`` streams per class.
+
+    Reference semantics (``codegen/program.py:53-80``, ``codegen/notes.txt``
+    "round-robin channel distribution"): for each usage class independently,
+    ops are sorted deterministically and dealt onto streams 0..N-1 in turn,
+    so concurrent operations spread across physical resources.
+
+    Returns ``{(family, port, stream_key): stream_index}``.
+    """
+    allocation: Dict[Tuple[str, int, str], int] = {}
+    for stream_key in ALL_STREAM_KEYS:
+        users = sorted(
+            (op for op in operations if op.uses_stream(stream_key)),
+            key=lambda op: (op.family, op.port),
+        )
+        for i, op in enumerate(users):
+            allocation[(op.family, op.port, stream_key)] = i % num_streams
+    return allocation
+
+
+@dataclasses.dataclass
+class ProgramMapping:
+    """Which program each device runs (SPMD: all the same; MPMD: differ).
+
+    Reference: the routing file's ``"fpgas"`` program map
+    (``codegen/serialization.py:65-109``), which lets e.g. the bandwidth
+    benchmark run a sender program on rank 0 and a receiver program on
+    rank 1 (``microbenchmarks/kernels/bandwidth.json``).
+    """
+
+    programs: List[Program]
+    device_to_program: Dict[Device, Program]
+
+    def program_for(self, device: Device) -> Program:
+        return self.device_to_program[device]
+
+    @property
+    def devices(self) -> List[Device]:
+        """Deterministic rank order: sorted by (node, index).
+
+        Reference: ``codegen/routing.py:61-69`` sorts by the same key so
+        rank numbering is reproducible across runs.
+        """
+        return sorted(self.device_to_program, key=lambda d: d.key)
+
+    def rank_of(self, device: Device) -> int:
+        return self.devices.index(device)

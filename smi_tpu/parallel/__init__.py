@@ -1,0 +1,9 @@
+"""TPU data plane: mesh/communicator, P2P channels, collectives, routing.
+
+This package is the substrate half of the framework: the reference's
+generated NoC (CK_S/CK_R routing kernels + per-op support kernels,
+``codegen/templates/``) is replaced by a ``jax.sharding.Mesh`` with XLA
+collectives and masked ``ppermute`` inside ``shard_map``; the routing-table
+machinery survives as a capability tier that maps logical ports onto mesh
+neighbourhoods.
+"""

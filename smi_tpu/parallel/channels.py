@@ -1,0 +1,198 @@
+"""Transient point-to-point streaming channels (Push/Pop).
+
+Reference parity: ``include/smi/{push,pop,channel_descriptor}.h`` and the
+generated ``templates/{push,pop}.cl``. A reference channel is opened per
+message with ``SMI_Open_{send,receive}_channel(count, dtype, peer, port,
+comm)``; ``SMI_Push``/``SMI_Pop`` then move one element per call through the
+NoC, with a credit-based rendezvous bounding in-flight packets.
+
+TPU re-design — one SPMD collective instead of two endpoint loops:
+
+- Opening a channel is metadata only (:class:`P2PChannel`), as in the
+  reference where opens build a descriptor (``push.cl:52-66``).
+- The Push loop + NoC hop + Pop loop collapse into ``transfer()``: a masked
+  ``lax.ppermute`` over the communicator axis, which every rank of the SPMD
+  program executes. At ``dst`` it returns the message; at every other rank
+  it returns zeros. XLA lowers this to a direct ICI send/recv — the CK_S/
+  CK_R routing tables have no equivalent because the torus routes itself.
+- *Streaming* semantics — SMI's defining feature, where the consumer runs
+  while the message is still arriving — survive as ``stream()``: the
+  message moves in ``pipeline_packets``-sized chunks under ``lax.scan`` and
+  a consumer function is applied per chunk, so transfer of chunk *k+1*
+  overlaps the consumer of chunk *k*. The channel's buffer size
+  ("asynchronicity degree", ``rewrite.py:26-33``) sets the chunk size,
+  playing exactly its reference role of pipelining depth.
+- ``p2p_rendezvous=False`` (eager, reference ``templates/push.cl:21-31``
+  compiled out) sends the whole message in one ppermute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from smi_tpu.ops.types import SmiDtype, dtype_to_jnp, elements_per_packet
+from smi_tpu.ops.operations import pipeline_depth_packets
+from smi_tpu.parallel.mesh import Communicator
+
+
+@dataclasses.dataclass(frozen=True)
+class P2PChannel:
+    """Descriptor of one transient P2P message channel.
+
+    Mirrors ``SMI_Channel`` (``include/smi/channel_descriptor.h:17-31``):
+    message element count, the two endpoint ranks, the logical port, and the
+    pipelining depth. ``src``/``dst`` must be Python ints (they become the
+    static ``ppermute`` permutation, as the reference's ranks become static
+    routing-table entries).
+    """
+
+    comm: Communicator
+    port: int
+    src: int
+    dst: int
+    count: int
+    dtype: SmiDtype = SmiDtype.FLOAT
+    buffer_size: Optional[int] = None  # elements; None = default depth
+    rendezvous: bool = True
+
+    def __post_init__(self):
+        object.__setattr__(self, "dtype", SmiDtype.parse(self.dtype))
+        size = self.comm.size
+        for name, r in (("src", self.src), ("dst", self.dst)):
+            if not (0 <= r < size):
+                raise ValueError(f"{name}={r} out of range for comm size {size}")
+        if self.src == self.dst:
+            raise ValueError("src and dst must differ for a P2P channel")
+        if self.count <= 0:
+            raise ValueError(f"message count must be positive, got {self.count}")
+
+    @property
+    def jnp_dtype(self):
+        return dtype_to_jnp(self.dtype)
+
+    @property
+    def chunk_elements(self) -> int:
+        """Elements per in-flight chunk.
+
+        buffer_size elements → whole packets (rounded as the reference
+        rounds, ``rewrite.py:26-33``) → elements. Never below one packet.
+        """
+        packets = pipeline_depth_packets(self.buffer_size, self.dtype)
+        return packets * elements_per_packet(self.dtype)
+
+    # ------------------------------------------------------------------
+    # Collective implementations (must be traced by ALL ranks)
+    # ------------------------------------------------------------------
+
+    def _perm(self) -> Sequence[Tuple[int, int]]:
+        return [(self.src, self.dst)]
+
+    def _axis(self):
+        names = self.comm.axis_names
+        if len(names) != 1:
+            raise NotImplementedError(
+                "P2P channels address ranks on a single communicator axis; "
+                "use comm.subcomm(axis) for multi-axis meshes"
+            )
+        return names[0]
+
+    def _check_length(self, data: jax.Array) -> None:
+        if data.shape[0] != self.count:
+            raise ValueError(
+                f"message length {data.shape[0]} != channel count {self.count}"
+            )
+
+    def transfer(self, data: jax.Array) -> jax.Array:
+        """Fused Push+Pop: send ``data`` (valid at ``src``) to ``dst``.
+
+        Every rank calls this at the same program point (SPMD); the rank
+        holding the payload is ``src``. Returns the message at ``dst`` and
+        zeros elsewhere — the reference's non-participants simply never see
+        the packets (``ckr.cl:50-60``); here they see a zero buffer.
+        """
+        data = jnp.asarray(data, self.jnp_dtype)
+        self._check_length(data)
+        return lax.ppermute(data, self._axis(), self._perm())
+
+    def stream(
+        self,
+        data: jax.Array,
+        consumer: Optional[Callable] = None,
+        init_carry=None,
+    ):
+        """Streamed transfer: move the message chunk-by-chunk.
+
+        With no ``consumer`` this behaves like :meth:`transfer` but bounds
+        in-flight data to one chunk (the rendezvous protocol's role,
+        ``push.cl:21-31``). With a ``consumer(carry, chunk) -> carry``, the
+        consumer is applied to each received chunk *inside the scan*, so
+        XLA can overlap the ppermute of chunk k+1 with consumer compute of
+        chunk k — the TPU expression of SMI's compute-while-receiving.
+
+        Returns ``(received, carry)`` where ``received`` is the reassembled
+        message (valid at ``dst``).
+        """
+        data = jnp.asarray(data, self.jnp_dtype)
+        self._check_length(data)
+        if not self.rendezvous:
+            out = self.transfer(data)
+            if consumer is not None:
+                carry = consumer(init_carry, out)
+                return out, carry
+            return out, init_carry
+
+        axis, perm = self._axis(), self._perm()
+
+        def step(carry, chunk_data):
+            received = lax.ppermute(chunk_data, axis, perm)
+            if consumer is not None:
+                carry = consumer(carry, received)
+            return carry, received
+
+        chunk = min(self.chunk_elements, self.count)
+        n_full = self.count // chunk
+        tail = self.count - n_full * chunk
+
+        carry = init_carry
+        parts = []
+        if n_full:
+            chunks = data[: n_full * chunk].reshape(
+                (n_full, chunk) + data.shape[1:]
+            )
+            carry, received = lax.scan(step, carry, chunks)
+            parts.append(
+                received.reshape((n_full * chunk,) + data.shape[1:])
+            )
+        if tail:
+            # The remainder moves as one short chunk *outside* the scan so
+            # the consumer only ever sees real message elements — no
+            # zero-padding leaks into non-additive reductions.
+            carry, tail_received = step(carry, data[n_full * chunk:])
+            parts.append(tail_received)
+        received = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        return received, carry
+
+
+def ring_shift(
+    x: jax.Array,
+    comm: Communicator,
+    offset: int = 1,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """Shift ``x`` to rank ``(r + offset) % size`` along a comm axis.
+
+    The TPU analog of the reference's rank-pipeline pattern
+    (``microbenchmarks/kernels/pipeline.cl:16-31``): each rank pops from
+    rank-1 and pushes to rank+1. One ``ppermute`` with the full ring
+    permutation rides neighbour ICI links.
+    """
+    name = axis_name or comm.axis_names[0]
+    n = comm.mesh.shape[name]
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return lax.ppermute(x, name, perm)

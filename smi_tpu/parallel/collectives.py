@@ -1,0 +1,137 @@
+"""Rooted collectives: Bcast, Reduce, Scatter, Gather.
+
+Reference parity: ``include/smi/{bcast,reduce,scatter,gather}.h`` and the
+per-port support kernels ``templates/{bcast,reduce,scatter,gather}.cl``.
+Reference semantics to preserve:
+
+- every collective takes an arbitrary *root* rank and a logical *port*;
+- Reduce supports ADD/MAX/MIN (``include/smi/reduce_operations.h``);
+- collectives on distinct ports may run concurrently without interference
+  (``microbenchmarks/kernels/multi_collectives.cl``);
+- only the root observes Reduce/Gather results, only non-roots receive
+  Scatter slices of the root's buffer.
+
+TPU re-design: each op is one XLA collective over the communicator axis —
+the always-running support kernels, ready-to-receive handshakes and credit
+windows (``bcast.cl:18-33``, ``reduce.cl:13-32``) have no equivalent
+because XLA's collectives are internally flow-controlled. Rooted-ness is
+expressed by masking: a broadcast is a ``psum`` of the value masked to the
+root (one all-reduce, which XLA lowers to an ICI-optimal pattern); rooted
+results are masked to zeros off-root so program behaviour matches the
+reference's "non-participants never see the data". The *port* selects the
+stream assignment from the program model (distinct ports → independent
+collectives XLA is free to overlap; there is no false serialization
+because the ops share no data dependencies).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from smi_tpu.ops.types import SmiOp
+from smi_tpu.parallel.mesh import Communicator
+
+
+def _axis(comm: Communicator) -> str:
+    if len(comm.axis_names) != 1:
+        raise NotImplementedError(
+            "rooted collectives run over a single communicator axis; "
+            "use comm.subcomm(axis) on multi-axis meshes"
+        )
+    return comm.axis_names[0]
+
+
+def _is_root(comm: Communicator, root: int) -> jax.Array:
+    return comm.rank() == root
+
+
+def bcast(x: jax.Array, comm: Communicator, root: int = 0,
+          port: Optional[int] = None) -> jax.Array:
+    """One-to-all: every rank returns the root's ``x``.
+
+    Reference: ``SMI_Bcast`` (``bcast.h:43-63``); the root's support kernel
+    unicasts a copy per rank (``bcast.cl:36-43``) — here a single masked
+    all-reduce whose only non-zero contribution is the root's value, which
+    XLA lowers to a bandwidth-optimal ICI broadcast.
+    """
+    del port  # metadata only: distinct ports are independent by dataflow
+    mask = _is_root(comm, root)
+    contrib = jnp.where(mask, x, jnp.zeros_like(x))
+    return lax.psum(contrib, _axis(comm))
+
+
+def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
+           root: int = 0, port: Optional[int] = None,
+           all_ranks: bool = False) -> jax.Array:
+    """All-to-one reduction with ADD/MAX/MIN.
+
+    Reference: ``SMI_Reduce`` (``reduce.h:18-76``): every rank contributes,
+    only the root receives the result (zeros elsewhere here). With
+    ``all_ranks=True`` behaves as an allreduce (no masking) — the fused
+    Reduce+Bcast idiom of kmeans (``kmeans_smi.cl:132-190``) without the
+    second collective.
+    """
+    del port
+    op = SmiOp.parse(op)
+    name = _axis(comm)
+    if op is SmiOp.ADD:
+        out = lax.psum(x, name)
+    elif op is SmiOp.MAX:
+        out = lax.pmax(x, name)
+    else:
+        out = lax.pmin(x, name)
+    if all_ranks:
+        return out
+    return jnp.where(_is_root(comm, root), out, jnp.zeros_like(out))
+
+
+def allreduce(x: jax.Array, comm: Communicator,
+              op: Union[str, SmiOp] = SmiOp.ADD) -> jax.Array:
+    """Reduce + Bcast in one collective (convenience; no reference analog
+    because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``)."""
+    return reduce(x, comm, op=op, all_ranks=True)
+
+
+def scatter(x: jax.Array, comm: Communicator, root: int = 0,
+            port: Optional[int] = None) -> jax.Array:
+    """Root distributes contiguous slices; rank r returns slice r.
+
+    Reference: ``SMI_Scatter`` (``scatter.h:49-72``) — the root splits its
+    ``size * count`` buffer and streams one ``count``-slice per rank
+    (``scatter.cl:46-91``, including the root's self-copy). Here the root's
+    masked buffer goes through one ``psum_scatter``: each rank receives
+    only its own slice, so the data volume on ICI matches the reference's
+    per-destination unicasts instead of a full broadcast.
+
+    ``x`` must have leading dimension ``size * count`` (valid at root).
+    """
+    del port
+    size = comm.size
+    if x.shape[0] % size != 0:
+        raise ValueError(
+            f"scatter buffer leading dim {x.shape[0]} not divisible by "
+            f"comm size {size}"
+        )
+    contrib = jnp.where(_is_root(comm, root), x, jnp.zeros_like(x))
+    return lax.psum_scatter(contrib, _axis(comm), scatter_dimension=0,
+                            tiled=True)
+
+
+def gather(x: jax.Array, comm: Communicator, root: int = 0,
+           port: Optional[int] = None, all_ranks: bool = False) -> jax.Array:
+    """Root collects contiguous slices; returns ``size * count`` at root.
+
+    Reference: ``SMI_Gather`` (``gather.h:47-68``) — the root pulls each
+    contributor's ``count`` elements in rank order (``gather.cl:47-99``).
+    Here one ``all_gather`` rides ICI and the result is masked off-root
+    (or kept everywhere with ``all_ranks=True``).
+    """
+    del port
+    out = lax.all_gather(x, _axis(comm), axis=0, tiled=True)
+    if all_ranks:
+        return out
+    return jnp.where(_is_root(comm, root), out, jnp.zeros_like(out))

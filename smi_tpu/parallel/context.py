@@ -1,0 +1,166 @@
+"""User-facing kernel context: the TPU analog of ``include/smi.h``.
+
+A reference SMI kernel receives an ``SMI_Comm`` and calls the channel API;
+here a user function decorated with :func:`smi_kernel` runs per-shard under
+``jax.shard_map`` and receives an :class:`SmiContext` exposing the same
+surface: rank/size, open+push/pop channels, and rooted collectives.
+
+Example (the bandwidth microbenchmark's shape,
+``microbenchmarks/kernels/bandwidth_0.cl:11-33``)::
+
+    comm = smi.make_communicator(8)
+
+    @smi.smi_kernel(comm, in_specs=P("smi"), out_specs=P("smi"))
+    def app(ctx, x):
+        ch = ctx.open_channel(port=0, src=0, dst=1, count=N, dtype="float")
+        received = ctx.transfer(ch, x)       # Push at src, Pop at dst
+        return jnp.where(ctx.rank() == 1, received, x)
+
+MPMD under SPMD: the reference runs different bitstreams per rank
+(``bandwidth.json``'s program map); here rank divergence is expressed with
+``jnp.where``/``lax.cond`` on ``ctx.rank()`` inside one SPMD program — the
+collectives themselves are traced unconditionally by every rank, which is
+what makes them legal under SPMD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from smi_tpu.ops.program import Program
+from smi_tpu.ops.types import SmiDtype, SmiOp
+from smi_tpu.parallel import collectives as _coll
+from smi_tpu.parallel.channels import P2PChannel, ring_shift
+from smi_tpu.parallel.mesh import Communicator
+
+
+@dataclasses.dataclass(frozen=True)
+class SmiContext:
+    """Per-shard handle passed to smi kernels.
+
+    Carries the communicator and optionally the validated program metadata
+    (port allocation, rendezvous flag — ``codegen/program.py``); channel
+    opens consult the program when present so tuning knobs declared in
+    program JSON apply without repeating them at call sites.
+    """
+
+    comm: Communicator
+    program: Optional[Program] = None
+
+    # -- communicator (include/smi/communicator.h) ---------------------
+    def rank(self) -> jax.Array:
+        return self.comm.rank()
+
+    @property
+    def size(self) -> int:
+        return self.comm.size
+
+    # -- P2P channels (include/smi/{push,pop}.h) ------------------------
+    def open_channel(
+        self,
+        port: int,
+        src: int,
+        dst: int,
+        count: int,
+        dtype: Union[str, SmiDtype] = "float",
+        buffer_size: Optional[int] = None,
+    ) -> P2PChannel:
+        """Open a transient P2P channel (both endpoints' open in one).
+
+        Replaces the ``SMI_Open_send_channel``/``SMI_Open_receive_channel``
+        pair (``push.h:19-48``/``pop.h:20-39``): under SPMD a single
+        descriptor serves both ends. ``buffer_size`` is the asynchronicity
+        degree (``_ad`` variants) in elements.
+        """
+        rendezvous = True
+        if self.program is not None:
+            rendezvous = self.program.p2p_rendezvous
+            declared = self.program.find("push", port) or self.program.find("pop", port)
+            if declared is not None and buffer_size is None:
+                buffer_size = declared.buffer_size
+        return P2PChannel(
+            comm=self.comm,
+            port=port,
+            src=src,
+            dst=dst,
+            count=count,
+            dtype=dtype,
+            buffer_size=buffer_size,
+            rendezvous=rendezvous,
+        )
+
+    def transfer(self, channel: P2PChannel, data: jax.Array) -> jax.Array:
+        """Fused Push(all elements)+Pop: message at dst, zeros elsewhere."""
+        return channel.transfer(data)
+
+    def stream(self, channel: P2PChannel, data: jax.Array,
+               consumer: Optional[Callable] = None, init_carry=None):
+        """Chunked streaming transfer with optional per-chunk consumer."""
+        return channel.stream(data, consumer=consumer, init_carry=init_carry)
+
+    def ring_shift(self, x: jax.Array, offset: int = 1,
+                   axis_name: Optional[str] = None) -> jax.Array:
+        return ring_shift(x, self.comm, offset=offset, axis_name=axis_name)
+
+    # -- collectives (include/smi/{bcast,reduce,scatter,gather}.h) -----
+    def bcast(self, x, root: int = 0, port: Optional[int] = None):
+        return _coll.bcast(x, self.comm, root=root, port=port)
+
+    def reduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD, root: int = 0,
+               port: Optional[int] = None, all_ranks: bool = False):
+        return _coll.reduce(x, self.comm, op=op, root=root, port=port,
+                            all_ranks=all_ranks)
+
+    def allreduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD):
+        return _coll.allreduce(x, self.comm, op=op)
+
+    def scatter(self, x, root: int = 0, port: Optional[int] = None):
+        return _coll.scatter(x, self.comm, root=root, port=port)
+
+    def gather(self, x, root: int = 0, port: Optional[int] = None,
+               all_ranks: bool = False):
+        return _coll.gather(x, self.comm, root=root, port=port,
+                            all_ranks=all_ranks)
+
+
+def smi_kernel(
+    comm: Communicator,
+    in_specs=None,
+    out_specs=None,
+    program: Optional[Program] = None,
+    check_vma: bool = False,
+):
+    """Decorator: run ``fn(ctx, *args)`` per-shard over the communicator.
+
+    The TPU analog of launching an SMI kernel with its communicator arg
+    (``templates/host_hlslib.cl:87-89`` hands ``SMI_Comm`` to app kernels).
+    ``in_specs``/``out_specs`` are ``PartitionSpec``s as for
+    ``jax.shard_map``; defaults replicate.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if in_specs is None:
+        in_specs = P()
+    if out_specs is None:
+        out_specs = P()
+
+    ctx = SmiContext(comm=comm, program=program)
+
+    def decorator(fn: Callable) -> Callable:
+        def shard_fn(*args):
+            return fn(ctx, *args)
+
+        mapped = jax.shard_map(
+            shard_fn,
+            mesh=comm.mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+        return jax.jit(mapped)
+
+    return decorator

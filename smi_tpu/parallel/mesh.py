@@ -1,0 +1,150 @@
+"""Communicator = device mesh + named axes.
+
+Reference parity: ``include/smi/communicator.h`` — ``SMI_Comm`` is a
+``{rank, size}`` pair produced by the generated ``SmiInit_<program>()``
+(``codegen/templates/host_hlslib.cl:87-89``). On TPU the communicator is a
+``jax.sharding.Mesh``: *size* is the mesh extent, *rank* is the flattened
+``lax.axis_index`` inside ``shard_map``, and "initialising the NoC" is
+simply constructing the mesh — XLA owns physical routing over ICI.
+
+Multi-dimensional meshes are first-class (the stencil app uses a 2-D
+(PX, PY) mesh, reference ``examples/include/stencil.h.in:32-38``): a
+communicator carries an ordered tuple of axis names and exposes a
+flattened rank over all of them, row-major, matching the deterministic
+rank assignment of ``codegen/routing.py:61-69``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from smi_tpu.ops.serialization import Topology
+
+DEFAULT_AXIS = "smi"
+
+
+@dataclasses.dataclass(frozen=True)
+class Communicator:
+    """An SMI communicator over a JAX mesh.
+
+    ``axis_names`` are the mesh axes this communicator spans, in row-major
+    significance order (first axis is the slowest-varying in the flattened
+    rank). ``SMI_Comm_rank``/``SMI_Comm_size`` analogs are :meth:`rank`
+    (traced, shard_map-only) and :attr:`size` (static).
+
+    ``topology``, when built from a topology file, keeps the parsed link
+    list and MPMD program map available to the routing layer and to
+    program-aware dispatch (``mpmd_dispatch``).
+    """
+
+    mesh: Mesh
+    axis_names: Tuple[str, ...] = (DEFAULT_AXIS,)
+    topology: Optional[Topology] = dataclasses.field(
+        default=None, compare=False
+    )
+
+    def __post_init__(self):
+        for name in self.axis_names:
+            if name not in self.mesh.axis_names:
+                raise ValueError(
+                    f"axis {name!r} not in mesh axes {self.mesh.axis_names}"
+                )
+
+    @property
+    def size(self) -> int:
+        """Total ranks (``SMI_Comm_size``, ``communicator.h:26-31``)."""
+        return int(
+            math.prod(self.mesh.shape[name] for name in self.axis_names)
+        )
+
+    @property
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return tuple(self.mesh.shape[name] for name in self.axis_names)
+
+    def rank(self) -> jax.Array:
+        """Flattened rank of the calling shard (``SMI_Comm_rank``).
+
+        Only valid inside ``shard_map`` over this communicator's axes.
+        """
+        r = jax.lax.axis_index(self.axis_names[0])
+        for name in self.axis_names[1:]:
+            r = r * self.mesh.shape[name] + jax.lax.axis_index(name)
+        return r
+
+    def coords(self) -> Tuple[jax.Array, ...]:
+        """Per-axis coordinates of the calling shard (traced)."""
+        return tuple(jax.lax.axis_index(name) for name in self.axis_names)
+
+    @property
+    def spec(self) -> P:
+        """PartitionSpec sharding the leading dim over all comm axes."""
+        return P(self.axis_names)
+
+    @property
+    def replicated(self) -> P:
+        return P()
+
+    def subcomm(self, *axis_names: str) -> "Communicator":
+        """Communicator over a subset of axes (rows/columns of the mesh)."""
+        return Communicator(
+            mesh=self.mesh, axis_names=tuple(axis_names), topology=self.topology
+        )
+
+    def program_of_rank(self, rank: int):
+        """The program rank ``rank`` runs under MPMD (None if no topology)."""
+        if self.topology is None:
+            return None
+        device = self.topology.mapping.devices[rank]
+        return self.topology.mapping.program_for(device)
+
+
+def make_communicator(
+    n_devices: Optional[int] = None,
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+    devices=None,
+) -> Communicator:
+    """Build a communicator from the available devices.
+
+    ``shape``/``axis_names`` give a multi-dimensional mesh (e.g. ``(2, 4)``
+    with ``("x", "y")`` for the stencil's process grid); the default is a
+    1-D mesh named ``"smi"`` over ``n_devices`` (all devices if omitted).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        n = n_devices if n_devices is not None else len(devices)
+        shape = (n,)
+    if axis_names is None:
+        axis_names = (
+            (DEFAULT_AXIS,) if len(shape) == 1
+            else tuple(f"smi{i}" for i in range(len(shape)))
+        )
+    n_total = math.prod(shape)
+    if n_total > len(devices):
+        raise ValueError(
+            f"mesh shape {tuple(shape)} needs {n_total} devices, "
+            f"have {len(devices)}"
+        )
+    dev_array = np.array(devices[:n_total]).reshape(shape)
+    mesh = Mesh(dev_array, tuple(axis_names))
+    return Communicator(mesh=mesh, axis_names=tuple(axis_names))
+
+
+def mesh_from_topology(topology: Topology, devices=None) -> Communicator:
+    """Build a communicator whose rank order follows a topology file.
+
+    Devices in the topology are ranked deterministically by ``(node,
+    index)`` (``codegen/routing.py:61-69``) and mapped onto the first N JAX
+    devices in that order. The physical link list and MPMD program map are
+    kept on the communicator (``.topology``) for the routing layer
+    (port→neighbour assignment) and program-aware dispatch.
+    """
+    base = make_communicator(n_devices=len(topology.devices), devices=devices)
+    return dataclasses.replace(base, topology=topology)

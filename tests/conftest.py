@@ -1,0 +1,59 @@
+"""Test harness: 8 virtual CPU devices = the reference's emulator mode.
+
+The reference tests run 8 MPI ranks against the Intel FPGA CPU emulator
+with strict channel depths (``test/CMakeLists.txt:46-50``,
+``CMakeLists.txt:188-191``). Here the same tier is JAX's CPU backend with
+``--xla_force_host_platform_device_count=8``: every test traces the exact
+``shard_map``/collective code path that runs on TPU — no host-loop cheats —
+so tests transfer to hardware.
+
+Must run before any ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Force the CPU backend even when the environment points JAX at a TPU
+# (tests are the hardware-free tier; bench.py uses the real chip). The env
+# var alone is not enough here: site customization may import jax at
+# interpreter startup, capturing JAX_PLATFORMS before this file runs, so
+# the config is also updated post-import (backends init lazily).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# The SMI surface includes a 'double' dtype (include/smi/data_types.h);
+# emulator-tier tests exercise it with real float64.
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devices = jax.devices()
+    assert len(devices) >= 8, (
+        "emulator tier needs 8 virtual devices; got "
+        f"{len(devices)} — was jax imported before conftest set XLA_FLAGS?"
+    )
+    return devices[:8]
+
+
+@pytest.fixture(scope="session")
+def comm8(eight_devices):
+    import smi_tpu as smi
+
+    return smi.make_communicator(8, devices=eight_devices)
+
+
+@pytest.fixture(scope="session")
+def comm2(eight_devices):
+    import smi_tpu as smi
+
+    return smi.make_communicator(2, devices=eight_devices)

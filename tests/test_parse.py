@@ -1,0 +1,130 @@
+"""Serialization round-trip + topology parsing tests.
+
+Reference: ``codegen/tests/test_parse.py`` — program JSON and routing-file
+parsing, including reference-format device keys (``fpga-0001:acl0``).
+"""
+
+import json
+
+import pytest
+
+from smi_tpu.ops.operations import Push, Pop, Reduce, Broadcast
+from smi_tpu.ops.program import Device, Program
+from smi_tpu.ops.serialization import (
+    parse_operation,
+    parse_program,
+    parse_topology_file,
+    serialize_operation,
+    serialize_program,
+)
+from smi_tpu.ops.types import SmiOp
+
+
+def test_operation_round_trip():
+    ops = [
+        Push(0, "float", buffer_size=100),
+        Pop(1, "double"),
+        Reduce(2, "int", op=SmiOp.MAX),
+        Broadcast(3, "char"),
+    ]
+    for op in ops:
+        assert parse_operation(serialize_operation(op)) == op
+
+
+def test_program_round_trip():
+    prog = Program(
+        [Push(0, "float"), Pop(1, "short", buffer_size=64)],
+        consecutive_reads=5,
+        max_ranks=16,
+        p2p_rendezvous=False,
+    )
+    restored = parse_program(serialize_program(prog))
+    assert restored.operations == prog.operations
+    assert restored.consecutive_reads == 5
+    assert restored.max_ranks == 16
+    assert restored.p2p_rendezvous is False
+
+
+def test_parse_reduce_defaults_to_add():
+    op = parse_operation({"type": "reduce", "port": 1, "data_type": "float"})
+    assert op.op is SmiOp.ADD
+
+
+def test_parse_unknown_type_rejected():
+    with pytest.raises(ValueError):
+        parse_operation({"type": "sendrecv", "port": 0})
+
+
+TOPOLOGY = {
+    "fpgas": {
+        "fpga-0001:acl0": "rank0",
+        "fpga-0001:acl1": "rank1",
+        "fpga-0002:acl0": "rank1",
+    },
+    "connections": {
+        "fpga-0001:acl0:ch2": "fpga-0001:acl1:ch3",
+        "fpga-0001:acl0:ch1": "fpga-0002:acl0:ch0",
+    },
+}
+
+
+def test_parse_topology_reference_format():
+    progs = {"rank0": Program([Push(0)]), "rank1": Program([Pop(0)])}
+    topo = parse_topology_file(json.dumps(TOPOLOGY), programs=progs)
+
+    assert [str(d) for d in topo.devices] == [
+        "fpga-0001:0",
+        "fpga-0001:1",
+        "fpga-0002:0",
+    ]
+    # connections are bidirectional (serialization.py:91-107)
+    a = (Device("fpga-0001", 0), 2)
+    b = (Device("fpga-0001", 1), 3)
+    assert topo.connections[a] == b
+    assert topo.connections[b] == a
+
+    d0 = Device("fpga-0001", 0)
+    assert topo.mapping.program_for(d0) is progs["rank0"]
+    assert topo.mapping.rank_of(d0) == 0
+
+    nbrs = topo.neighbours(d0)
+    assert nbrs == [
+        (1, Device("fpga-0002", 0), 0),
+        (2, Device("fpga-0001", 1), 3),
+    ]
+
+
+def test_parse_topology_missing_program_rejected():
+    with pytest.raises(KeyError):
+        parse_topology_file(json.dumps(TOPOLOGY), programs={})
+
+
+def test_parse_topology_ignore_programs():
+    topo = parse_topology_file(json.dumps(TOPOLOGY), ignore_programs=True)
+    assert len(topo.devices) == 3
+
+
+def test_parse_topology_duplicate_endpoint_rejected():
+    bad = dict(TOPOLOGY)
+    bad["connections"] = {
+        "a:0:ch0": "b:0:ch0",
+        "c:0:ch1": "b:0:ch0",
+    }
+    with pytest.raises(ValueError):
+        parse_topology_file(json.dumps(bad), ignore_programs=True)
+
+
+def test_parse_reference_nested_reduce_args():
+    # the reference nests the operator as args.op_type
+    # (codegen/serialization.py:30-38, ops.py:172-174)
+    op = parse_operation(
+        {"type": "reduce", "port": 2, "data_type": "float",
+         "args": {"op_type": "max"}}
+    )
+    assert op.op is SmiOp.MAX
+
+
+def test_parse_missing_data_type_defaults_to_int():
+    # reference default (codegen/serialization.py:22)
+    op = parse_operation({"type": "push", "port": 0})
+    assert op.dtype.value == "int"

@@ -1,0 +1,111 @@
+"""Program validation + stream allocation tests.
+
+Reference: ``codegen/tests/test_program.py`` — channel allocation round-robin
+and port-conflict detection.
+"""
+
+import pytest
+
+from smi_tpu.ops.operations import (
+    Broadcast,
+    Gather,
+    IN_CTRL,
+    IN_DATA,
+    OUT_CTRL,
+    OUT_DATA,
+    Pop,
+    Push,
+    Reduce,
+    Scatter,
+)
+from smi_tpu.ops.program import (
+    Device,
+    PortConflict,
+    Program,
+    ProgramMapping,
+    allocate_ports,
+    round_robin,
+)
+
+
+def test_round_robin():
+    vals = list(range(10))
+    assert round_robin(vals, 0, 4) == [0, 4, 8]
+    assert round_robin(vals, 3, 4) == [3, 7]
+
+
+def test_duplicate_push_port_rejected():
+    with pytest.raises(PortConflict):
+        Program([Push(0), Push(0)])
+
+
+def test_duplicate_collective_port_rejected():
+    with pytest.raises(PortConflict):
+        Program([Broadcast(2), Broadcast(2)])
+
+
+def test_push_pop_same_port_allowed():
+    # two ends of one channel (program.py:37-50)
+    prog = Program([Push(0), Pop(0)])
+    assert prog.logical_port_count == 1
+
+
+def test_distinct_families_share_port():
+    prog = Program([Broadcast(0), Reduce(0), Scatter(0), Gather(0)])
+    assert prog.logical_port_count == 1
+
+
+def test_logical_port_count_is_max_plus_one():
+    prog = Program([Push(0), Pop(5)])
+    assert prog.logical_port_count == 6
+
+
+def test_allocation_round_robins_per_stream():
+    ops = [Push(i) for i in range(6)]
+    alloc = allocate_ports(ops, num_streams=4)
+    # six pushes use OUT_DATA: dealt 0,1,2,3,0,1
+    assert [alloc[("push", i, OUT_DATA)] for i in range(6)] == [0, 1, 2, 3, 0, 1]
+    # and IN_CTRL (credits) with the same deal
+    assert [alloc[("push", i, IN_CTRL)] for i in range(6)] == [0, 1, 2, 3, 0, 1]
+
+
+def test_allocation_classes_independent():
+    # pushes use OUT_DATA, pops use IN_DATA: each class deals from stream 0
+    ops = [Push(0), Push(1), Pop(2), Pop(3)]
+    alloc = allocate_ports(ops, num_streams=4)
+    assert alloc[("push", 0, OUT_DATA)] == 0
+    assert alloc[("push", 1, OUT_DATA)] == 1
+    assert alloc[("pop", 2, IN_DATA)] == 0
+    assert alloc[("pop", 3, IN_DATA)] == 1
+    assert alloc[("pop", 2, OUT_CTRL)] == 0
+
+
+def test_allocation_deterministic_order():
+    a = allocate_ports([Push(3), Push(1), Push(2)])
+    b = allocate_ports([Push(1), Push(2), Push(3)])
+    assert a == b
+
+
+def test_reduce_accumulation_lanes():
+    assert Reduce(0, "float").accumulation_lanes == 4
+    assert Reduce(0, "double").accumulation_lanes == 4
+    assert Reduce(0, "int").accumulation_lanes == 1
+
+
+def test_device_parse():
+    assert Device.parse("node-1:3") == Device("node-1", 3)
+    assert Device.parse("fpga-0001:acl1") == Device("fpga-0001", 1)
+    with pytest.raises(ValueError):
+        Device.parse("no-colon")
+
+
+def test_program_mapping_rank_order():
+    pa, pb = Program([Push(0)]), Program([Pop(0)])
+    d = {
+        Device("b", 0): pb,
+        Device("a", 1): pa,
+        Device("a", 0): pa,
+    }
+    mapping = ProgramMapping(programs=[pa, pb], device_to_program=d)
+    assert [str(x) for x in mapping.devices] == ["a:0", "a:1", "b:0"]
+    assert mapping.rank_of(Device("b", 0)) == 2

@@ -1,0 +1,52 @@
+"""Unit tests for dtype/packet math (reference: codegen/tests/test_utils.py
+and the constants of include/smi/network_message.h)."""
+
+import pytest
+
+from smi_tpu.ops.types import (
+    PACKET_PAYLOAD_BYTES,
+    SmiDtype,
+    SmiOp,
+    buffer_size_to_packets,
+    elements_per_packet,
+)
+
+
+def test_elements_per_packet():
+    # 28-byte payload (network_message.h:27-37)
+    assert elements_per_packet("int") == 7
+    assert elements_per_packet("float") == 7
+    assert elements_per_packet("double") == 3
+    assert elements_per_packet("char") == 28
+    assert elements_per_packet("short") == 14
+
+
+def test_packet_payload_constant():
+    assert PACKET_PAYLOAD_BYTES == 28
+
+
+def test_buffer_size_rounding_matches_reference():
+    # rewrite.py:26-33: ceil to packets then ceil to multiple of 8
+    assert buffer_size_to_packets(1, "float") == 8
+    assert buffer_size_to_packets(7, "float") == 8       # exactly 1 packet
+    assert buffer_size_to_packets(57, "float") == 16     # 9 packets -> 16
+    assert buffer_size_to_packets(2048, "double") == 688  # 683 packets -> 688
+    assert buffer_size_to_packets(8 * 28, "char") == 8
+
+
+def test_buffer_size_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        buffer_size_to_packets(0, "float")
+
+
+def test_dtype_parse():
+    assert SmiDtype.parse("float") is SmiDtype.FLOAT
+    assert SmiDtype.parse(SmiDtype.INT) is SmiDtype.INT
+    with pytest.raises(ValueError):
+        SmiDtype.parse("complex")
+
+
+def test_reduce_op_parse():
+    assert SmiOp.parse("add") is SmiOp.ADD
+    assert SmiOp.parse("max") is SmiOp.MAX
+    assert SmiOp.parse("min") is SmiOp.MIN
