@@ -87,8 +87,11 @@ class SmiOperation:
     def elements_per_chunk(self) -> int:
         return elements_per_packet(self.dtype)
 
-    def uses_stream(self, key: str) -> bool:
-        return key in self.STREAMS
+    def streams(self, rendezvous: bool = True) -> FrozenSet[str]:
+        """Virtual streams this op occupies (``codegen/ops.py:82-92``:
+        P2P ops drop their flow-control stream under the eager protocol)."""
+        del rendezvous
+        return self.STREAMS
 
     # Identity used for validation: ops conflict if same family+port.
     @property
@@ -103,6 +106,9 @@ class Push(SmiOperation):
     NAME = "push"
     STREAMS = frozenset({OUT_DATA, IN_CTRL})  # data out, credits back in
 
+    def streams(self, rendezvous: bool = True) -> FrozenSet[str]:
+        return self.STREAMS if rendezvous else frozenset({OUT_DATA})
+
 
 @dataclasses.dataclass(frozen=True)
 class Pop(SmiOperation):
@@ -110,6 +116,9 @@ class Pop(SmiOperation):
 
     NAME = "pop"
     STREAMS = frozenset({IN_DATA, OUT_CTRL})
+
+    def streams(self, rendezvous: bool = True) -> FrozenSet[str]:
+        return self.STREAMS if rendezvous else frozenset({IN_DATA})
 
 
 @dataclasses.dataclass(frozen=True)

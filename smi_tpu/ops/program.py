@@ -20,6 +20,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from smi_tpu.ops.operations import (
     ALL_STREAM_KEYS,
     COLLECTIVE_FAMILIES,
+    IN_CTRL,
+    IN_DATA,
+    OUT_CTRL,
+    OUT_DATA,
     P2P_FAMILIES,
     SmiOperation,
 )
@@ -40,7 +44,7 @@ class PortConflict(ValueError):
     """Two operations of one family claim the same logical port."""
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, order=True)
 class Device:
     """A physical device slot: host node + index on that node.
 
@@ -94,30 +98,45 @@ class Program:
         consecutive_reads: int = 8,
         max_ranks: int = 8,
         p2p_rendezvous: bool = True,
+        num_streams: int = STREAMS_PER_DEVICE,
     ):
-        self.operations: Tuple[SmiOperation, ...] = tuple(operations)
+        # Canonical port order for the exposed tuple (the reference sorts at
+        # init, codegen/program.py:103). allocate_ports owns the deal-order
+        # invariant and re-sorts defensively for direct callers; on this
+        # already-sorted input that re-sort is O(n).
+        self.operations: Tuple[SmiOperation, ...] = tuple(
+            sorted(operations, key=lambda op: op.port)
+        )
         self.consecutive_reads = consecutive_reads
         self.max_ranks = max_ranks
         self.p2p_rendezvous = p2p_rendezvous
+        self.num_streams = num_streams
         self._validate()
-        self._allocation = allocate_ports(self.operations)
+        self._allocation = allocate_ports(
+            self.operations, num_streams=num_streams,
+            p2p_rendezvous=p2p_rendezvous,
+        )
 
     def _validate(self) -> None:
-        """Port-uniqueness rules (``codegen/program.py:37-50``).
+        """Port-uniqueness per stream class (``codegen/program.py:37-50``).
 
-        Within the P2P family, each (family, port) must be unique — a rank
-        cannot Push twice on one port — and within each collective family a
-        port may appear once.
+        Two ops may not claim the same logical port within one stream
+        class: Push(0)+Push(0) conflict on out-data, and Push(0)+
+        Broadcast(0) conflict too (the broadcast also sends on port 0) —
+        while Push(0)+Pop(0), two ends of one channel, touch disjoint
+        classes and are fine.
         """
-        seen: Dict[Tuple[str, int], SmiOperation] = {}
-        for op in self.operations:
-            key = (op.family, op.port)
-            if key in seen:
-                raise PortConflict(
-                    f"duplicate {op.family} operation at port {op.port}: "
-                    f"{seen[key]} vs {op}"
-                )
-            seen[key] = op
+        for key in ALL_STREAM_KEYS:
+            seen: Dict[int, SmiOperation] = {}
+            for op in self.operations:
+                if key not in op.streams(self.p2p_rendezvous):
+                    continue
+                if op.port in seen:
+                    raise PortConflict(
+                        f"port {op.port} claimed twice on stream class "
+                        f"{key!r}: {seen[op.port]} vs {op}"
+                    )
+                seen[op.port] = op
 
     @property
     def logical_port_count(self) -> int:
@@ -138,35 +157,66 @@ class Program:
 
     def stream_of(self, op: SmiOperation, stream_key: str) -> int:
         """Which stream this op's ``stream_key`` usage was assigned to."""
-        return self._allocation[(op.family, op.port, stream_key)]
+        return self._allocation.stream_of[(op.family, op.port, stream_key)]
 
     @property
     def allocation(self) -> Dict[Tuple[str, int, str], int]:
-        return dict(self._allocation)
+        return dict(self._allocation.stream_of)
+
+    def stream_allocations(self, stream: int) -> List[Tuple[str, int, str]]:
+        """Ordered (family, port, key) usages dealt to one stream — the
+        reference's ``get_channel_allocations`` (``program.py:113-114``).
+        Order is load-bearing: ingress tables number local op slots by it.
+        """
+        return list(self._allocation.per_stream.get(stream, ()))
+
+
+@dataclasses.dataclass
+class Allocation:
+    """Result of dealing stream-usages onto streams."""
+
+    stream_of: Dict[Tuple[str, int, str], int]
+    per_stream: Dict[int, List[Tuple[str, int, str]]]
+
+
+#: Combined deal order per direction (``codegen/notes.txt`` "Data and
+#: control hardware ports are combined (in this order) and then
+#: distributed"; ``codegen/program.py:58-80``).
+OUT_KEYS = (OUT_DATA, OUT_CTRL)
+IN_KEYS = (IN_DATA, IN_CTRL)
 
 
 def allocate_ports(
     operations: Sequence[SmiOperation],
     num_streams: int = STREAMS_PER_DEVICE,
-) -> Dict[Tuple[str, int, str], int]:
-    """Round-robin op stream-usages onto ``num_streams`` streams per class.
+    p2p_rendezvous: bool = True,
+) -> Allocation:
+    """Deal op stream-usages onto ``num_streams`` streams, reference-style.
 
-    Reference semantics (``codegen/program.py:53-80``, ``codegen/notes.txt``
-    "round-robin channel distribution"): for each usage class independently,
-    ops are sorted deterministically and dealt onto streams 0..N-1 in turn,
-    so concurrent operations spread across physical resources.
-
-    Returns ``{(family, port, stream_key): stream_index}``.
+    Per direction (out/in), the data usages of all ops (in port order) are
+    concatenated with the control usages, and that combined list is dealt
+    round-robin: usage *i* lands on stream ``i % num_streams``. This exactly
+    reproduces the reference's channel distribution
+    (``codegen/program.py:53-80``) so stream indices — and therefore the
+    routing tables derived from them — match bit-for-bit.
     """
-    allocation: Dict[Tuple[str, int, str], int] = {}
-    for stream_key in ALL_STREAM_KEYS:
-        users = sorted(
-            (op for op in operations if op.uses_stream(stream_key)),
-            key=lambda op: (op.family, op.port),
-        )
-        for i, op in enumerate(users):
-            allocation[(op.family, op.port, stream_key)] = i % num_streams
-    return allocation
+    ops_sorted = sorted(operations, key=lambda op: op.port)
+    stream_of: Dict[Tuple[str, int, str], int] = {}
+    per_stream: Dict[int, List[Tuple[str, int, str]]] = {
+        s: [] for s in range(num_streams)
+    }
+    for direction in (OUT_KEYS, IN_KEYS):
+        combined = [
+            (op.family, op.port, key)
+            for key in direction
+            for op in ops_sorted
+            if key in op.streams(p2p_rendezvous)
+        ]
+        for i, usage in enumerate(combined):
+            stream = i % num_streams
+            stream_of[usage] = stream
+            per_stream[stream].append(usage)
+    return Allocation(stream_of=stream_of, per_stream=per_stream)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,475 @@
+"""Routing layer: topology graph, egress/ingress tables, load balancing.
+
+Reference parity: ``codegen/routing.py`` + ``codegen/routing_table.py``.
+The reference compiles, per FPGA and per physical channel, two lookup
+tables that drive its packet-switched NoC:
+
+- the CKS (egress) table maps ``(dst_rank, port)`` to {0 = out the wire,
+  1 = deliver locally, 2+k = hand to the k-th sibling channel}, built from
+  all-pairs shortest paths and then *balanced* so equal-cost routes spread
+  across QSFP links by occupancy (``routing_table.py:150-202``);
+- the CKR (ingress) table maps ``(port, data|control)`` to {0 = bounce to
+  egress, 1+k = sibling ingress, N+j = j-th local op slot}
+  (``routing_table.py:205-234``).
+
+On TPU, XLA routes over the ICI torus and none of this is needed for
+correctness — but the layer is kept at full fidelity because (a) it is the
+reference's most heavily unit-tested component, (b) its binary artifacts
+feed the native C++ host runtime exactly as the reference's tables feed
+``LoadRoutingTable`` (``include/utils/smi_utils.hpp:24-39``), and (c) the
+balanced egress decision tells the TPU runtime which mesh *neighbour* a
+logical port should prefer (``egress_link_toward``), informing how P2P
+ports map onto ICI directions.
+
+Table entry encodings are kept bit-identical to the reference so table
+files interoperate with reference-format loaders.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import networkx
+
+from smi_tpu.ops.operations import IN_CTRL, IN_DATA, OUT_CTRL, OUT_DATA
+from smi_tpu.ops.program import Device, Program
+from smi_tpu.ops.serialization import Topology
+
+#: Edge weights (``codegen/program.py:7-8``): hopping between devices is
+#: two orders costlier than moving between links inside one device.
+COST_INTER_DEVICE = 100
+COST_INTRA_DEVICE = 1
+
+#: Links (physical channels) per device (``CHANNELS_PER_FPGA = 4``).
+LINKS_PER_DEVICE = 4
+
+#: Egress table target codes (``routing_table.py:9-10,125-140``).
+EGRESS_WIRE = 0    # leave the device through this link's physical wire
+EGRESS_LOCAL = 1   # deliver to this link's ingress side (same device)
+# 2 + sibling_index(...)  = forward to a sibling link's egress
+
+
+class NoRouteFound(Exception):
+    """No path exists between two devices in the topology graph."""
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Link:
+    """One physical link endpoint of a device."""
+
+    device: Device
+    index: int
+
+    def __str__(self) -> str:
+        return f"{self.device}:ch{self.index}"
+
+
+def sibling_index(source: int, target: int) -> int:
+    """Index of ``target`` among a device's links with ``source`` skipped.
+
+    The inter-link forwarding convention (``codegen/program.py:163-169``):
+    a link never addresses itself, so sibling numbering omits it.
+    """
+    if source == target:
+        raise ValueError("a link has no sibling index for itself")
+    return target if target < source else target - 1
+
+
+@dataclasses.dataclass
+class RoutingContext:
+    """Topology graph + all-pairs shortest paths + ranked devices.
+
+    Reference: ``codegen/common.py`` ``RoutingContext{graph, routes,
+    fpgas}`` built by ``create_routing_context`` (``routing.py:18-24``).
+    """
+
+    graph: networkx.Graph
+    paths: Dict[Link, Dict[Link, List[Link]]]
+    devices: List[Device]
+    links_per_device: int = LINKS_PER_DEVICE
+    topology: Optional[Topology] = None
+
+    def rank_of(self, device: Device) -> int:
+        return self.devices.index(device)
+
+    def links(self, device: Device) -> List[Link]:
+        return [Link(device, i) for i in range(self.links_per_device)]
+
+
+def build_routing_context(
+    topology: Topology, links_per_device: int = LINKS_PER_DEVICE
+) -> RoutingContext:
+    """Build the weighted link graph and solve all-pairs shortest paths.
+
+    Inter-device edges come from the topology's connection list; every
+    device's links are additionally fully meshed at intra-device cost
+    (``routing.py:49-54``) — the analog of the CK interconnect.
+    """
+    graph = networkx.Graph()
+    devices = topology.devices
+    known = set(devices)
+    for device in devices:
+        for link in (Link(device, i) for i in range(links_per_device)):
+            graph.add_node(link)
+    for (src_dev, src_l), (dst_dev, dst_l) in topology.connections.items():
+        for dev in (src_dev, dst_dev):
+            # fail loudly on pass-through devices absent from the program
+            # map, as the reference does (codegen/routing.py:38 KeyError)
+            if dev not in known:
+                raise KeyError(
+                    f"device {dev} appears in connections but has no "
+                    f"program mapping"
+                )
+        graph.add_edge(
+            Link(src_dev, src_l), Link(dst_dev, dst_l), weight=COST_INTER_DEVICE
+        )
+    for device in devices:
+        for a in range(links_per_device):
+            for b in range(a + 1, links_per_device):
+                graph.add_edge(
+                    Link(device, a), Link(device, b), weight=COST_INTRA_DEVICE
+                )
+    paths = dict(networkx.all_pairs_dijkstra_path(graph, weight="weight"))
+    return RoutingContext(
+        graph=graph, paths=paths, devices=devices,
+        links_per_device=links_per_device, topology=topology,
+    )
+
+
+def _check_stream_count(ctx: RoutingContext, program: Program) -> None:
+    """Stream indices double as link indices in the tables; a mismatch
+    would silently alias forward codes with local-slot codes."""
+    if program.num_streams != ctx.links_per_device:
+        raise ValueError(
+            f"program allocated over {program.num_streams} streams but the "
+            f"routing context has {ctx.links_per_device} links per device; "
+            f"they must match"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Egress (CKS-equivalent) tables
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EgressTable:
+    """``(dst_rank, port) -> target code`` for one link."""
+
+    n_ranks: int
+    n_ports: int
+    data: List[List[int]] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.data:
+            self.data = [
+                [EGRESS_WIRE] * self.n_ports for _ in range(self.n_ranks)
+            ]
+
+    def __getitem__(self, key: Tuple[int, int]) -> int:
+        rank, port = key
+        return self.data[rank][port]
+
+    def __setitem__(self, key: Tuple[int, int], value: int) -> None:
+        rank, port = key
+        self.data[rank][port] = value
+
+    def flat(self) -> List[int]:
+        return [v for row in self.data for v in row]
+
+
+def _paths_to_device(
+    ctx: RoutingContext, link: Link, dst: Device
+) -> List[List[Link]]:
+    """All shortest full paths (source link included) from ``link`` to the
+    links of ``dst``, deterministically ordered (``routing_table.py:108-122``
+    analog; the source stays on the path so device-hop counting matches the
+    reference's ``path_fpga_length``)."""
+    routes = ctx.paths.get(link, {})
+    found = [
+        path
+        for target, path in routes.items()
+        if target.device == dst and len(path) > 1
+    ]
+    if not found:
+        raise NoRouteFound(f"no route from {link} to {dst}")
+    found.sort(key=lambda p: (len(p), [(l.device.key, l.index) for l in p]))
+    return found
+
+
+def _devices_on_path(path: Sequence[Link]) -> int:
+    return len({l.device for l in path})
+
+
+def _first_hop_code(link: Link, path: Sequence[Link]) -> int:
+    """Encode a full path's first hop as an egress target code."""
+    hop = path[1]
+    if hop.device != link.device:
+        return EGRESS_WIRE
+    return 2 + sibling_index(link.index, hop.index)
+
+
+def _exit_link(link: Link, path: Sequence[Link]) -> Link:
+    """The local link through which this full path leaves the device."""
+    hop = path[1]
+    return link if hop.device != link.device else hop
+
+
+def egress_tables(
+    device: Device, ctx: RoutingContext, program: Program
+) -> Dict[Link, EgressTable]:
+    """Build the per-link egress tables for one device, two-pass.
+
+    Pass 1 (``routing_table.py:186-191``): route every (dst, port) along
+    the plain shortest path (inter-link hops included in the cost).
+
+    Pass 2 (``routing_table.py:193-202``): for the ports actually
+    allocated to each link's outgoing streams, re-decide among all routes
+    that are equally short in *device* hops, picking the least-occupied
+    exit link — spreading traffic across the device's wires.
+    """
+    _check_stream_count(ctx, program)
+    n_ranks = len(ctx.devices)
+    n_ports = program.logical_port_count
+    links = ctx.links(device)
+    tables = {link: EgressTable(n_ranks, n_ports) for link in links}
+    occupancy = {link: 0 for link in links}
+
+    for dst in ctx.devices:
+        for link in links:
+            if dst == device:
+                code = EGRESS_LOCAL
+            else:
+                best = _paths_to_device(ctx, link, dst)[0]  # shortest, det.
+                code = _first_hop_code(link, best)
+            rank = ctx.rank_of(dst)
+            for port in range(n_ports):
+                tables[link][rank, port] = code
+
+    for dst in ctx.devices:
+        if dst == device:
+            continue
+        rank = ctx.rank_of(dst)
+        for link in links:
+            for family, port, key in _outgoing_allocations(program, link.index):
+                candidates = _paths_to_device(ctx, link, dst)
+                fewest_devs = min(_devices_on_path(p) for p in candidates)
+                short = [
+                    p for p in candidates if _devices_on_path(p) == fewest_devs
+                ]
+                # group by exit link, pick least occupied (tie: shortest,
+                # then lowest link index — routing_table.py:166-168)
+                by_exit: Dict[Link, List[List[Link]]] = {}
+                for p in short:
+                    by_exit.setdefault(_exit_link(link, p), []).append(p)
+                exit_link = min(
+                    by_exit,
+                    key=lambda e: (
+                        occupancy[e],
+                        min(len(p) for p in by_exit[e]),
+                        e.index,
+                    ),
+                )
+                if exit_link == link:
+                    code = EGRESS_WIRE
+                else:
+                    code = 2 + sibling_index(link.index, exit_link.index)
+                tables[link][rank, port] = code
+                occupancy[exit_link] += 1
+    return tables
+
+
+def _outgoing_allocations(
+    program: Program, link_index: int
+) -> List[Tuple[str, int, str]]:
+    """(family, port, key) triples whose outgoing stream is this link, in
+    deal order (``program.py:116-117`` ``get_channel_allocations_with_prefix``)."""
+    return [
+        usage
+        for usage in program.stream_allocations(link_index)
+        if usage[2] in (OUT_DATA, OUT_CTRL)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Ingress (CKR-equivalent) tables
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class IngressTable:
+    """``(port, data|control) -> target code`` for one link, flattened as
+    ``[port0_data, port0_ctrl, port1_data, ...]`` (``ckr.cl:54``)."""
+
+    data: List[int]
+
+    def flat(self) -> List[int]:
+        return list(self.data)
+
+
+def ingress_table(
+    link: Link, ctx: RoutingContext, program: Program
+) -> IngressTable:
+    """Build one link's ingress table.
+
+    Codes (``routing_table.py:205-225``): 0 = hand back to the egress side
+    (packet not consumed here — used both for foreign packets and ports
+    with no local consumer); 1 + sibling = forward to a sibling link's
+    ingress; ``links_per_device + j`` = deliver to the j-th local op slot
+    served by this link.
+    """
+    _check_stream_count(ctx, program)
+    n = ctx.links_per_device
+    consumers: Dict[Tuple[int, str], int] = {}
+    for (family, port, key), stream in program.allocation.items():
+        if key in (IN_DATA, IN_CTRL):
+            consumers[(port, key)] = stream
+
+    # slot numbering follows the deal order of this link's allocations
+    # (routing_table.py:223-225 uses the channel allocation list order)
+    local_slots = [
+        (port, key)
+        for (family, port, key) in program.stream_allocations(link.index)
+        if key in (IN_DATA, IN_CTRL)
+    ]
+
+    table: List[int] = []
+    for port in range(program.logical_port_count):
+        for key in (IN_DATA, IN_CTRL):
+            stream = consumers.get((port, key))
+            if stream is None:
+                table.append(0)
+            elif stream != link.index:
+                table.append(1 + sibling_index(link.index, stream))
+            else:
+                table.append(n + local_slots.index((port, key)))
+    return IngressTable(table)
+
+
+# ---------------------------------------------------------------------------
+# Serialization + neighbour queries
+# ---------------------------------------------------------------------------
+
+
+def serialize_table(flat: Sequence[int], width: int = 1) -> bytes:
+    """Little-endian fixed-width bytes (``routing_table.py:57-63``)."""
+    fmt = {1: "<B", 2: "<H", 4: "<I"}[width]
+    return b"".join(struct.pack(fmt, v) for v in flat)
+
+
+def deserialize_table(raw: bytes, width: int = 1) -> List[int]:
+    fmt = {1: "<B", 2: "<H", 4: "<I"}[width]
+    size = struct.calcsize(fmt)
+    return [
+        struct.unpack(fmt, raw[i : i + size])[0]
+        for i in range(0, len(raw), size)
+    ]
+
+
+def write_routing_tables(
+    directory, topology: Topology, ctx: Optional[RoutingContext] = None
+) -> None:
+    """Emit the binary table files for every device and link.
+
+    File naming matches the reference host loader
+    (``include/utils/smi_utils.hpp:24-39``): ``cks-rank{r}-channel{c}``
+    for egress, ``ckr-rank{r}-channel{c}`` for ingress.
+    """
+    import os
+
+    if ctx is None:
+        ctx = build_routing_context(topology)
+    os.makedirs(directory, exist_ok=True)
+    for device in ctx.devices:
+        program = topology.mapping.program_for(device)
+        rank = ctx.rank_of(device)
+        etables = egress_tables(device, ctx, program)
+        for link in ctx.links(device):
+            with open(
+                os.path.join(directory, f"cks-rank{rank}-channel{link.index}"),
+                "wb",
+            ) as f:
+                f.write(serialize_table(etables[link].flat()))
+            with open(
+                os.path.join(directory, f"ckr-rank{rank}-channel{link.index}"),
+                "wb",
+            ) as f:
+                f.write(
+                    serialize_table(ingress_table(link, ctx, program).flat())
+                )
+
+
+def egress_link_toward(
+    src: Device,
+    dst: Device,
+    ctx: RoutingContext,
+    program: Optional[Program] = None,
+    port: int = 0,
+    stream_key: str = OUT_DATA,
+) -> Tuple[int, Device]:
+    """Which local wire leaves ``src`` toward ``dst``, and the neighbouring
+    device on its far end.
+
+    With a ``program``, the answer follows the *balanced* egress tables for
+    the given logical port: the port's packets enter the link its
+    ``stream_key`` usage was dealt to, then forward codes are chased from
+    link to link until a wire exit — exactly the journey a packet takes
+    through the reference's CK_S chain (``cks.cl:55-71``). This is the
+    TPU-side consumer of the routing layer: a logical port's preferred ICI
+    direction is the neighbour its balanced route exits through.
+
+    Without a ``program`` the plain shortest-path exit is returned.
+    """
+    if program is not None:
+        tables = egress_tables(src, ctx, program)
+        rank = ctx.rank_of(dst)
+        usage = next(
+            (
+                (family, p, key)
+                for (family, p, key) in program.allocation
+                if p == port and key == stream_key
+            ),
+            None,
+        )
+        if usage is None:
+            raise ValueError(
+                f"port {port} has no {stream_key} usage in the program"
+            )
+        link = Link(src, program.allocation[usage])
+        seen = set()
+        while True:
+            if link in seen:
+                raise NoRouteFound(
+                    f"forwarding cycle at {link} routing to {dst}"
+                )
+            seen.add(link)
+            code = tables[link][rank, port]
+            if code == EGRESS_WIRE:
+                break
+            if code == EGRESS_LOCAL:
+                raise ValueError(f"{dst} is the local device")
+            sib = code - 2
+            nxt = sib if sib < link.index else sib + 1
+            link = Link(src, nxt)
+        if ctx.topology is None or (src, link.index) not in ctx.topology.connections:
+            raise NoRouteFound(
+                f"link {link} has no physical wire in the topology"
+            )
+        peer_dev, _peer_link = ctx.topology.connections[(src, link.index)]
+        return link.index, peer_dev
+
+    best: Optional[List[Link]] = None
+    best_link: Optional[Link] = None
+    for link in ctx.links(src):
+        try:
+            path = _paths_to_device(ctx, link, dst)[0]
+        except NoRouteFound:
+            continue
+        if best is None or len(path) < len(best):
+            best, best_link = path, _exit_link(link, path)
+    if best is None or best_link is None:
+        raise NoRouteFound(f"no route from {src} to {dst}")
+    remote = next(l for l in best if l.device != src)
+    return best_link.index, remote.device

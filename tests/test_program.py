@@ -50,9 +50,15 @@ def test_push_pop_same_port_allowed():
     assert prog.logical_port_count == 1
 
 
-def test_distinct_families_share_port():
-    prog = Program([Broadcast(0), Reduce(0), Scatter(0), Gather(0)])
-    assert prog.logical_port_count == 1
+def test_push_broadcast_same_port_rejected():
+    # both claim out-data port 0 (reference test_allocation_fail)
+    with pytest.raises(PortConflict):
+        Program([Push(0), Broadcast(0)])
+
+
+def test_collectives_on_distinct_ports_allowed():
+    prog = Program([Broadcast(0), Reduce(1), Scatter(2), Gather(3)])
+    assert prog.logical_port_count == 4
 
 
 def test_logical_port_count_is_max_plus_one():
@@ -62,22 +68,46 @@ def test_logical_port_count_is_max_plus_one():
 
 def test_allocation_round_robins_per_stream():
     ops = [Push(i) for i in range(6)]
-    alloc = allocate_ports(ops, num_streams=4)
+    alloc = allocate_ports(ops, num_streams=4).stream_of
     # six pushes use OUT_DATA: dealt 0,1,2,3,0,1
     assert [alloc[("push", i, OUT_DATA)] for i in range(6)] == [0, 1, 2, 3, 0, 1]
     # and IN_CTRL (credits) with the same deal
     assert [alloc[("push", i, IN_CTRL)] for i in range(6)] == [0, 1, 2, 3, 0, 1]
 
 
-def test_allocation_classes_independent():
-    # pushes use OUT_DATA, pops use IN_DATA: each class deals from stream 0
-    ops = [Push(0), Push(1), Pop(2), Pop(3)]
-    alloc = allocate_ports(ops, num_streams=4)
-    assert alloc[("push", 0, OUT_DATA)] == 0
-    assert alloc[("push", 1, OUT_DATA)] == 1
-    assert alloc[("pop", 2, IN_DATA)] == 0
-    assert alloc[("pop", 3, IN_DATA)] == 1
-    assert alloc[("pop", 2, OUT_CTRL)] == 0
+def test_allocation_matches_reference_combined_deal():
+    """The reference's exact 5-op distribution
+    (codegen/tests/test_program.py test_allocation_channel_to_ports)."""
+    prog = Program([Push(0), Pop(0), Push(1), Push(2), Pop(2)])
+    assert prog.stream_allocations(0) == [
+        ("push", 0, OUT_DATA),
+        ("pop", 2, OUT_CTRL),
+        ("pop", 0, IN_DATA),
+        ("push", 2, IN_CTRL),
+    ]
+    assert prog.stream_allocations(1) == [
+        ("push", 1, OUT_DATA),
+        ("pop", 2, IN_DATA),
+    ]
+    assert prog.stream_allocations(2) == [
+        ("push", 2, OUT_DATA),
+        ("push", 0, IN_CTRL),
+    ]
+    assert prog.stream_allocations(3) == [
+        ("pop", 0, OUT_CTRL),
+        ("push", 1, IN_CTRL),
+    ]
+    # get_channel_for_port_key analogs (reference test_allocation_get_channel)
+    assert prog.allocation[("push", 0, OUT_DATA)] == 0
+    assert prog.allocation[("pop", 0, OUT_CTRL)] == 3
+    assert prog.allocation[("push", 2, OUT_DATA)] == 2
+
+
+def test_allocation_eager_drops_control_streams():
+    prog = Program([Push(0), Pop(0)], p2p_rendezvous=False)
+    assert ("pop", 0, OUT_CTRL) not in prog.allocation
+    assert ("push", 0, IN_CTRL) not in prog.allocation
+    assert prog.allocation[("push", 0, OUT_DATA)] == 0
 
 
 def test_allocation_deterministic_order():
