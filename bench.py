@@ -1,0 +1,76 @@
+"""Headline benchmark: distributed-stencil throughput per chip.
+
+Runs the flagship workload (4-point Jacobi with halo machinery engaged —
+BASELINE.json north star config, 8192x8192 float32) on the available TPU
+and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` compares against the reference hardware's per-device
+stencil roofline: the SMI paper's FPGA design computes a 16-wide vector
+per cycle at Fmax 480 MHz (``examples/CMakeLists.txt:5-7`` W=16,
+``CMakeLists.txt:9`` SMI_FMAX=480), i.e. 7.68e9 cell updates/s/FPGA peak.
+The repo publishes no measured numbers (BASELINE.md), so the documented
+peak is the baseline denominator.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_CELLS_PER_SEC_PER_DEVICE = 16 * 480e6  # W=16 @ 480 MHz
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from smi_tpu.models import stencil
+    from smi_tpu.parallel.mesh import make_communicator
+
+    devices = jax.devices()
+    n = len(devices)
+    # factor the device count into the squarest (px, py) grid
+    px = max(d for d in range(1, int(n**0.5) + 1) if n % d == 0)
+    py = n // px
+
+    x = y = 8192
+    iters = 256  # large enough to amortize dispatch/readback overhead
+    comm = make_communicator(
+        shape=(px, py), axis_names=("sx", "sy"), devices=devices
+    )
+    fn = stencil.make_stencil_fn(comm, iterations=iters)
+    grid = jnp.asarray(stencil.initial_grid(x, y))
+
+    def timed_run():
+        """One timed run; the scalar readback forces completion — on
+        tunneled backends block_until_ready alone resolves before the
+        computation finishes."""
+        t0 = time.perf_counter()
+        out = fn(grid)
+        np.asarray(jnp.sum(out))
+        return time.perf_counter() - t0
+
+    timed_run()  # compile + warm up
+
+    best = min(timed_run() for _ in range(3))
+
+    cells_per_sec = (x * y * iters) / best
+    per_chip = cells_per_sec / n
+    print(
+        json.dumps(
+            {
+                "metric": "stencil_8192x8192_cells_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "cells/s/chip",
+                "vs_baseline": round(
+                    per_chip / REFERENCE_CELLS_PER_SEC_PER_DEVICE, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Applications: the workloads the reference ships as ``examples/``.
+
+Not neural models — SMI's "model zoo" is three HPC kernels exercising the
+three communication patterns (SURVEY §2.7/§2.10):
+
+- :mod:`smi_tpu.models.stencil` — 4-point Jacobi with 2-D halo exchange
+  (spatial/sequence parallelism; the performance north star),
+- :mod:`smi_tpu.models.gesummv` — distributed GESUMMV, operator split
+  across two ranks with a streamed combine (tensor parallelism),
+- :mod:`smi_tpu.models.kmeans` — data-parallel K-means with Reduce+Bcast
+  collectives inside the iteration loop (data parallelism).
+
+Each module carries a pure-numpy reference implementation used by the
+tests, as the reference verifies against serial CPU code
+(``stencil_smi.cpp:33-46``) and OpenBLAS (``gesummv_smi.cpp:300-301``).
+"""

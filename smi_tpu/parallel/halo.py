@@ -1,0 +1,111 @@
+"""Halo exchange for multi-dimensional domain decomposition.
+
+Reference parity: the stencil application's bridge kernels
+(``examples/kernels/stencil_smi.cl:236-386``) — eight ``Convert{Send,
+Receive}{Top,Bottom,Left,Right}`` kernels that stream one-deep halos
+between the four grid neighbours over SMI P2P ports 0-3, concurrently with
+compute. This is the reference's expression of spatial (sequence-like)
+parallelism: a large domain scaled across devices with nearest-neighbour
+exchange (SURVEY §5.7).
+
+TPU re-design: the process grid is a real 2-D mesh axis pair and each halo
+is one non-wrapping masked ``lax.ppermute`` along its axis — four shifts
+riding four ICI directions simultaneously, which XLA schedules in parallel
+because they have no data dependencies. Edge ranks receive zeros (the
+reference's edge bridges simply have no peer to pop from).
+
+The same primitive with wrap-around (``ring=True``) is the ring-attention/
+context-parallel schedule step (SURVEY §2.10: ring `ppermute` schedules).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from smi_tpu.parallel.mesh import Communicator
+
+
+def shift_along(
+    x: jax.Array,
+    axis_name: str,
+    n: int,
+    direction: int,
+    ring: bool = False,
+) -> jax.Array:
+    """Move ``x`` to the rank ``direction`` steps up the axis.
+
+    ``direction=+1`` sends towards higher ranks (rank r receives r-1's
+    data); ``-1`` the opposite. Without ``ring``, edge ranks receive
+    zeros; with it, the permutation wraps (the pipeline/ring pattern,
+    ``pipeline.cl:16-31``).
+    """
+    if direction not in (1, -1):
+        raise ValueError(f"direction must be +1 or -1, got {direction}")
+    if ring:
+        perm = [(i, (i + direction) % n) for i in range(n)]
+    elif direction == 1:
+        perm = [(i, i + 1) for i in range(n - 1)]
+    else:
+        perm = [(i + 1, i) for i in range(n - 1)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+class Halos(NamedTuple):
+    """Received one-deep halo slabs around a 2-D block (zeros at domain
+    edges). Shapes: top/bottom ``(h, w)`` with ``h`` = halo depth,
+    left/right ``(H, h)``."""
+
+    top: jax.Array
+    bottom: jax.Array
+    left: jax.Array
+    right: jax.Array
+
+
+def halo_exchange_2d(
+    block: jax.Array,
+    comm: Communicator,
+    depth: int = 1,
+    ring: bool = False,
+) -> Halos:
+    """Exchange ``depth``-deep halos with the four 2-D mesh neighbours.
+
+    ``comm`` must span two axes ``(row_axis, col_axis)``; ``block`` is this
+    rank's ``(H, W)`` tile of the global grid, laid out so that the rank at
+    row-coordinate ``r`` holds global rows ``[r*H, (r+1)*H)`` (the
+    reference's block decomposition, ``stencil.h.in:32-38``).
+
+    Returns the four neighbour slabs: ``top`` is the last ``depth`` rows of
+    the block above, etc. All four transfers are independent ppermutes —
+    XLA overlaps them across ICI directions, the analog of the reference's
+    eight concurrently-running bridge kernels.
+    """
+    if len(comm.axis_names) != 2:
+        raise ValueError(
+            f"halo_exchange_2d needs a 2-axis communicator, got axes "
+            f"{comm.axis_names}"
+        )
+    row_axis, col_axis = comm.axis_names
+    nrow = comm.mesh.shape[row_axis]
+    ncol = comm.mesh.shape[col_axis]
+
+    top = shift_along(block[-depth:, :], row_axis, nrow, +1, ring)
+    bottom = shift_along(block[:depth, :], row_axis, nrow, -1, ring)
+    left = shift_along(block[:, -depth:], col_axis, ncol, +1, ring)
+    right = shift_along(block[:, :depth], col_axis, ncol, -1, ring)
+    return Halos(top=top, bottom=bottom, left=left, right=right)
+
+
+def pad_with_halos(block: jax.Array, halos: Halos, depth: int = 1) -> jax.Array:
+    """Assemble the ``(H+2d, W+2d)`` padded tile (corners zero)."""
+    h, w = block.shape
+    padded = jnp.zeros((h + 2 * depth, w + 2 * depth), block.dtype)
+    padded = lax.dynamic_update_slice(padded, block, (depth, depth))
+    padded = lax.dynamic_update_slice(padded, halos.top, (0, depth))
+    padded = lax.dynamic_update_slice(padded, halos.bottom, (h + depth, depth))
+    padded = lax.dynamic_update_slice(padded, halos.left, (depth, 0))
+    padded = lax.dynamic_update_slice(padded, halos.right, (depth, w + depth))
+    return padded

@@ -253,24 +253,25 @@ def egress_tables(
             continue
         rank = ctx.rank_of(dst)
         for link in links:
-            for family, port, key in _outgoing_allocations(program, link.index):
-                candidates = _paths_to_device(ctx, link, dst)
-                fewest_devs = min(_devices_on_path(p) for p in candidates)
-                short = [
-                    p for p in candidates if _devices_on_path(p) == fewest_devs
-                ]
-                # group by exit link, pick least occupied (tie: shortest,
-                # then lowest link index — routing_table.py:166-168)
-                by_exit: Dict[Link, List[List[Link]]] = {}
-                for p in short:
-                    by_exit.setdefault(_exit_link(link, p), []).append(p)
+            usages = _outgoing_allocations(program, link.index)
+            if not usages:
+                continue
+            # candidate grouping depends only on (link, dst): hoist it out
+            # of the per-usage loop (only occupancy changes inside)
+            candidates = _paths_to_device(ctx, link, dst)
+            fewest_devs = min(_devices_on_path(p) for p in candidates)
+            by_exit: Dict[Link, int] = {}  # exit link -> min hop count
+            for p in candidates:
+                if _devices_on_path(p) != fewest_devs:
+                    continue
+                e = _exit_link(link, p)
+                by_exit[e] = min(by_exit.get(e, len(p)), len(p))
+            for family, port, key in usages:
+                # pick least occupied (tie: shortest, then lowest link
+                # index — routing_table.py:166-168)
                 exit_link = min(
                     by_exit,
-                    key=lambda e: (
-                        occupancy[e],
-                        min(len(p) for p in by_exit[e]),
-                        e.index,
-                    ),
+                    key=lambda e: (occupancy[e], by_exit[e], e.index),
                 )
                 if exit_link == link:
                     code = EGRESS_WIRE
@@ -408,6 +409,7 @@ def egress_link_toward(
     program: Optional[Program] = None,
     port: int = 0,
     stream_key: str = OUT_DATA,
+    tables: Optional[Dict[Link, EgressTable]] = None,
 ) -> Tuple[int, Device]:
     """Which local wire leaves ``src`` toward ``dst``, and the neighbouring
     device on its far end.
@@ -420,10 +422,13 @@ def egress_link_toward(
     TPU-side consumer of the routing layer: a logical port's preferred ICI
     direction is the neighbour its balanced route exits through.
 
-    Without a ``program`` the plain shortest-path exit is returned.
+    Without a ``program`` the plain shortest-path exit is returned. Pass
+    precomputed ``tables`` (from :func:`egress_tables`) when querying many
+    ports of one device — rebuilding them per call is O(devices² · ports).
     """
     if program is not None:
-        tables = egress_tables(src, ctx, program)
+        if tables is None:
+            tables = egress_tables(src, ctx, program)
         rank = ctx.rank_of(dst)
         usage = next(
             (
