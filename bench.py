@@ -40,7 +40,13 @@ def main():
     comm = make_communicator(
         shape=(px, py), axis_names=("sx", "sy"), devices=devices
     )
-    fn = stencil.make_stencil_fn(comm, iterations=iters)
+    from smi_tpu.kernels import stencil as kstencil
+
+    block_h, block_w = x // px, y // py
+    if kstencil.pallas_supported(block_h, block_w, jnp.float32):
+        fn = kstencil.make_fused_stencil_fn(comm, iters, x, y)
+    else:
+        fn = stencil.make_stencil_fn(comm, iterations=iters)
     grid = jnp.asarray(stencil.initial_grid(x, y))
 
     def timed_run():

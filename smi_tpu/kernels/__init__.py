@@ -1,0 +1,16 @@
+"""Pallas TPU kernels: the framework's hand-written hot paths.
+
+The reference's performance comes from always-running HLS kernels that
+stream packets concurrently with compute (``codegen/templates/*.cl``); the
+TPU analog is Pallas kernels that fuse multi-pass jnp pipelines into
+single VMEM-resident passes and overlap DMA/ICI traffic with compute:
+
+- :mod:`smi_tpu.kernels.stencil` — fused Jacobi sweep (halo patch +
+  4-point average + Dirichlet mask in one pass over the block),
+- :mod:`smi_tpu.kernels.ring` — ring collectives via
+  ``make_async_remote_copy`` (explicit ICI RDMA, double-buffered, with
+  neighbour-barrier + slot-credit flow control).
+
+Every kernel has a jnp fallback for unaligned shapes/odd dtypes, and is
+tested in interpreter mode on the CPU fake mesh.
+"""

@@ -1,0 +1,255 @@
+"""Ring collectives as explicit ICI RDMA Pallas kernels.
+
+Reference parity: the CK_S/CK_R NoC moves packets neighbour-to-neighbour
+over serial links with credit flow control (``codegen/templates/cks.cl``,
+``ckr.cl``); chain/ring topologies are the routing substrate of the
+microbenchmarks (``test/p2p/p2p.json``, ``bandwidth.json``). On TPU the
+same neighbour streaming is ``pltpu.make_async_remote_copy`` over ICI,
+double-buffered so the send of chunk *k* overlaps the integration of
+chunk *k-1* — XLA's built-in collectives do this internally; these
+kernels exist for the cases where the schedule must be explicit (fusing
+compute into collective steps, the basis for ring-attention-style
+overlap) and as the framework's own collective implementation tier.
+
+All kernels are written per-shard (called inside ``shard_map`` over one
+mesh axis) and run compiled on TPU or interpreted on the CPU fake mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+from smi_tpu.parallel.mesh import Communicator
+
+
+def _neighbour_barrier(me, n: int, axis_name: str):
+    """Block until both ring neighbours entered the kernel, so no RDMA
+    lands in a buffer that is still being initialized."""
+    del axis_name
+    barrier = pltpu.get_barrier_semaphore()
+    nn = jnp.int32(n)  # keep arithmetic in int32 even under jax_enable_x64
+    left = lax.rem(me - 1 + nn, nn)
+    right = lax.rem(me + 1, nn)
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=left,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    pltpu.semaphore_signal(
+        barrier, inc=1, device_id=right,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    pltpu.semaphore_wait(barrier, 2)
+
+
+def _grant_slot(credit_sem, slot, me, n: int):
+    """Tell the left neighbour (the writer into our comm_buf) that
+    ``slot`` is free to be overwritten."""
+    left = lax.rem(me - 1 + jnp.int32(n), jnp.int32(n))
+    pltpu.semaphore_signal(
+        credit_sem.at[slot], inc=1, device_id=left,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+
+
+def _ring_all_gather_kernel(
+    x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
+    *, axis_name: str, n: int, flow_control: bool
+):
+    """Each device forwards the chunk it most recently received to its
+    right neighbour; after n-1 steps everyone holds every chunk.
+
+    Flow control: a writer may only RDMA into a remote slot after the
+    remote granted it (credit semaphore) — slot 1 is granted at start
+    (empty), and each slot is re-granted once its content has been
+    forwarded onward (send complete). Without this a fast rank could
+    clobber a slow neighbour's unsent chunk; the interpret-mode tests
+    run ranks sequentially and cannot catch that race."""
+    me = lax.axis_index(axis_name)
+    chunk = x_ref.shape[0]
+    if flow_control:
+        _neighbour_barrier(me, n, axis_name)
+    o_ref[pl.ds(me * chunk, chunk), ...] = x_ref[...]
+    comm_buf[0] = x_ref[...]
+    if flow_control:
+        _grant_slot(credit_sem, 1, me, n)  # slot 1 starts empty
+
+    def step(s, _):
+        nn = jnp.int32(n)
+        src_rank = lax.rem(me - s - 1 + nn, nn)  # whose chunk arrives now
+        dst = lax.rem(me + 1, nn)
+        slot, nslot = s % 2, (s + 1) % 2
+        if flow_control:
+            # wait until the remote says its slot `nslot` is reusable
+            pltpu.semaphore_wait(credit_sem.at[nslot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[slot],
+            dst_ref=comm_buf.at[nslot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[nslot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        if flow_control:
+            # our slot `slot` has now been sent onward: grant it upstream
+            _grant_slot(credit_sem, slot, me, n)
+        o_ref[pl.ds(src_rank * chunk, chunk), ...] = comm_buf[nslot]
+        return ()
+
+    lax.fori_loop(0, n - 1, step, ())
+
+
+def ring_all_gather(
+    x: jax.Array,
+    axis_name: str,
+    n: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """All-gather ``x`` (this shard's chunk) along a ring.
+
+    Call inside ``shard_map``; returns the ``(n * chunk, ...)`` gathered
+    array on every rank. Equivalent to ``lax.all_gather(..., tiled=True)``
+    but with an explicit neighbour-ring schedule.
+    """
+    chunk = x.shape[0]
+    out_shape = jax.ShapeDtypeStruct((n * chunk,) + x.shape[1:], x.dtype)
+    # Interpret mode executes ranks sequentially and does not implement
+    # remote semaphore signals; the credit protocol is only live (and only
+    # needed) in compiled multi-chip execution.
+    kernel = functools.partial(
+        _ring_all_gather_kernel, axis_name=axis_name, n=n,
+        flow_control=not interpret,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + x.shape, x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            collective_id=0, has_side_effects=True
+        ),
+        interpret=interpret,
+    )(x)
+
+
+def _ring_all_reduce_kernel(
+    x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
+    *, axis_name: str, n: int, flow_control: bool
+):
+    """Circulating-partial ring reduce: every rank simultaneously streams
+    its running partial to its right neighbour and folds its own
+    contribution into what arrives; after n-1 hops every rank holds the
+    full sum (each via a rotated association order)."""
+    me = lax.axis_index(axis_name)
+    if flow_control:
+        _neighbour_barrier(me, n, axis_name)
+    comm_buf[0] = x_ref[...]
+    if flow_control:
+        _grant_slot(credit_sem, 1, me, n)
+
+    # After step s each rank's live slot holds the sum of the s+2
+    # contributions x_{me-s-1} + ... + x_{me}; after n-1 steps that is the
+    # full sum on every rank simultaneously (each accumulated a rotated
+    # association order).
+    def step(s, _):
+        slot, nslot = s % 2, (s + 1) % 2
+        dst = lax.rem(me + 1, jnp.int32(n))
+        if flow_control:
+            pltpu.semaphore_wait(credit_sem.at[nslot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[slot],
+            dst_ref=comm_buf.at[nslot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[nslot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        if flow_control:
+            _grant_slot(credit_sem, slot, me, n)
+        comm_buf[nslot] = comm_buf[nslot] + x_ref[...]
+        return ()
+
+    lax.fori_loop(0, n - 1, step, ())
+    final_slot = (n - 1) % 2
+    o_ref[...] = comm_buf[final_slot]
+
+
+def ring_all_reduce(
+    x: jax.Array,
+    axis_name: str,
+    n: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Sum-all-reduce along a ring with explicit neighbour RDMA.
+
+    Each rank's partial sum makes a full circuit: after ``n-1`` hops every
+    rank has accumulated all ``n`` contributions (each rank accumulates a
+    rotated order, so sums match up to float reassociation).
+    """
+    kernel = functools.partial(
+        _ring_all_reduce_kernel, axis_name=axis_name, n=n,
+        flow_control=not interpret,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + x.shape, x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            collective_id=1, has_side_effects=True
+        ),
+        interpret=interpret,
+    )(x)
+
+
+def make_ring_all_gather(comm: Communicator, interpret: bool = False):
+    """Jitted wrapper: sharded input chunks → replicated gathered array."""
+    axis = comm.axis_names[0]
+    n = comm.size
+
+    def shard(x):
+        return ring_all_gather(x, axis, n, interpret=interpret)
+
+    return jax.jit(
+        jax.shard_map(
+            shard, mesh=comm.mesh, in_specs=P(axis), out_specs=P(None),
+            check_vma=False,
+        )
+    )
+
+
+def make_ring_all_reduce(comm: Communicator, interpret: bool = False):
+    axis = comm.axis_names[0]
+    n = comm.size
+
+    def shard(x):
+        return ring_all_reduce(x[0], axis, n, interpret=interpret)[None]
+
+    return jax.jit(
+        jax.shard_map(
+            shard, mesh=comm.mesh, in_specs=P(axis), out_specs=P(axis),
+            check_vma=False,
+        )
+    )

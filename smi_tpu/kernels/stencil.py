@@ -1,0 +1,234 @@
+"""Fused Jacobi sweep: one Pallas pass per iteration.
+
+The jnp sweep in :mod:`smi_tpu.models.stencil` materializes a padded tile
+(five ``dynamic_update_slice``s) plus the average and the boundary mask —
+roughly seven memory passes per iteration. This kernel does the whole
+sweep in a single read + write of the block:
+
+- the block is read stripe-by-stripe with a one-step software pipeline:
+  stripe *i* is prefetched while stripe *i-1* (held in VMEM scratch) is
+  computed, so each stripe's vertical neighbours are its own rolled rows
+  plus one boundary row from the neighbouring stripes (no overlapping
+  fetches, all blocks sublane-aligned);
+- horizontal neighbours use an in-register ``pltpu.roll`` with the
+  neighbour columns patched in from the exchanged halos;
+- the Dirichlet boundary mask is computed from global coordinates
+  (scalar-prefetched shard offsets) and applied in the same pass.
+
+Halo exchange stays outside the kernel (four masked ``ppermute``s of edge
+slabs — O(W) bytes, negligible next to the O(H·W) sweep), mirroring the
+reference's split between bridge kernels and the compute pipeline
+(``stencil_smi.cl:9-18`` vs ``:236-386``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from smi_tpu.parallel.halo import halo_exchange_2d
+from smi_tpu.parallel.mesh import Communicator
+
+#: VMEM budget per stripe buffer; ~6 stripe-sized buffers are live at
+#: once (double-buffered in/out, prev scratch), so keep each ≤2.5 MB.
+STRIPE_BYTES_TARGET = 2_500_000
+
+
+def _pick_tile(h: int, w: int) -> Optional[int]:
+    """Largest divisor of ``h`` that is a multiple of the f32 sublane
+    count (8) and fits the per-stripe VMEM budget."""
+    limit = max(8, STRIPE_BYTES_TARGET // (w * 4))
+    for t in range(min(limit, h), 7, -1):
+        if h % t == 0 and t % 8 == 0:
+            return t
+    return None
+
+
+def pallas_supported(h: int, w: int, dtype) -> bool:
+    return (
+        dtype == jnp.float32
+        and w % 128 == 0
+        and _pick_tile(h, w) is not None
+    )
+
+
+def _sweep_kernel(
+    offs_ref,  # scalar prefetch: [row0, col0] global offsets of this block
+    x_ref,     # (TILE, W) current stripe (one ahead of the one computed)
+    top_ref,   # (1, W) halo row from the block above
+    bottom_ref,  # (1, W) halo row from below
+    left_ref,  # (H, 1) halo column from the left
+    right_ref,  # (H, 1) halo column from the right
+    o_ref,     # (TILE, W) output stripe (for the previous grid step)
+    prev_ref,  # scratch: stripe loaded on the previous step
+    tail_ref,  # scratch: last row of the stripe before that
+    *,
+    tile: int,
+    width: int,
+    gh: int,
+    gw: int,
+):
+    # One-step software pipeline over the grid: at step i we hold stripe
+    # i in x_ref and compute stripe j = i-1 from prev_ref, using
+    # tail_ref (last row of stripe j-1) and x_ref's first row (first row
+    # of stripe j+1) as the vertical boundary neighbours.
+    i = pl.program_id(0)
+    n = pl.num_programs(0) - 1  # number of stripes
+    t, w = tile, width
+    cur = x_ref[...]
+
+    @pl.when(i > 0)
+    def _compute():
+        j = i - 1
+        center = prev_ref[...]
+        row_ids = lax.broadcasted_iota(jnp.int32, (t, w), 0)
+        col_ids = lax.broadcasted_iota(jnp.int32, (t, w), 1)
+
+        up_row = jnp.where(j == 0, top_ref[...], tail_ref[...])  # (1, w)
+        up = jnp.where(row_ids == 0, up_row, pltpu.roll(center, 1, axis=0))
+        down_row = jnp.where(i == n, bottom_ref[...], cur[0:1, :])
+        down = jnp.where(
+            row_ids == t - 1, down_row, pltpu.roll(center, t - 1, axis=0)
+        )
+
+        # Horizontal neighbours: lane roll + halo column patch.
+        left_col = left_ref[pl.ds(j * t, t), :]   # (t, 1)
+        right_col = right_ref[pl.ds(j * t, t), :]
+        lefts = jnp.where(
+            col_ids == 0, left_col, pltpu.roll(center, 1, axis=1)
+        )
+        rights = jnp.where(
+            col_ids == w - 1, right_col, pltpu.roll(center, w - 1, axis=1)
+        )
+
+        avg = 0.25 * (up + down + lefts + rights)
+
+        # Dirichlet: cells on the global boundary hold their value.
+        g_row = offs_ref[0] + j * t + row_ids
+        g_col = offs_ref[1] + col_ids
+        boundary = (
+            (g_row == 0) | (g_row == gh - 1)
+            | (g_col == 0) | (g_col == gw - 1)
+        )
+        o_ref[...] = jnp.where(boundary, center, avg)
+
+    # Rotate the pipeline registers (order matters: tail first).
+    tail_ref[...] = prev_ref[t - 1 : t, :]
+    prev_ref[...] = cur
+
+
+def fused_sweep(
+    block: jax.Array,
+    top: jax.Array,
+    bottom: jax.Array,
+    left: jax.Array,
+    right: jax.Array,
+    row0: jax.Array,
+    col0: jax.Array,
+    gh: int,
+    gw: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """One fused Jacobi sweep over a block given its exchanged halos."""
+    h, w = block.shape
+    tile = _pick_tile(h, w)
+    if tile is None:
+        raise ValueError(f"no valid row tile for block {block.shape}")
+    n = h // tile
+
+    kernel = functools.partial(
+        _sweep_kernel, tile=tile, width=w, gh=gh, gw=gw
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        # one extra step drains the pipeline (stripe j computes at step j+1)
+        grid=(n + 1,),
+        in_specs=[
+            pl.BlockSpec(
+                (tile, w),
+                lambda i, offs: (jnp.minimum(i, n - 1), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, w), lambda i, offs: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, w), lambda i, offs: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile, w),
+            lambda i, offs: (jnp.maximum(i - 1, 0), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((tile, w), jnp.float32),
+            pltpu.VMEM((1, w), jnp.float32),
+        ],
+    )
+    offs = jnp.stack([row0, col0]).astype(jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((h, w), block.dtype),
+        interpret=interpret,
+    )(offs, block, top, bottom, left, right)
+
+
+def jacobi_step_block_fused(
+    block: jax.Array,
+    comm: Communicator,
+    gh: int,
+    gw: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Distributed fused sweep: halo exchange + one-pass kernel."""
+    row_axis, col_axis = comm.axis_names
+    h, w = block.shape
+    halos = halo_exchange_2d(block, comm, depth=1)
+    rx = lax.axis_index(row_axis)
+    cy = lax.axis_index(col_axis)
+    return fused_sweep(
+        block,
+        halos.top,
+        halos.bottom,
+        halos.left,
+        halos.right,
+        rx * h,
+        cy * w,
+        gh,
+        gw,
+        interpret=interpret,
+    )
+
+
+def make_fused_stencil_fn(
+    comm: Communicator, iterations: int, gh: int, gw: int,
+    interpret: bool = False,
+):
+    """Jitted distributed stencil using the fused kernel per sweep."""
+    from jax.sharding import PartitionSpec as P
+
+    row_axis, col_axis = comm.axis_names
+    spec = P(row_axis, col_axis)
+
+    def shard_fn(block):
+        return lax.fori_loop(
+            0,
+            iterations,
+            lambda _, b: jacobi_step_block_fused(
+                b, comm, gh, gw, interpret=interpret
+            ),
+            block,
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=comm.mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+    )

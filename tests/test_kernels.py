@@ -1,0 +1,66 @@
+"""Pallas kernel tests: interpreter mode on the CPU fake mesh.
+
+The fused stencil kernel is additionally compiled for real TPU by
+bench.py; here interpret mode checks numerics on the same code path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import smi_tpu as smi
+from smi_tpu.kernels import ring as kring
+from smi_tpu.kernels import stencil as kstencil
+from smi_tpu.models import stencil
+
+
+def test_fused_stencil_matches_reference_interpret(eight_devices):
+    comm = smi.make_communicator(
+        shape=(2, 2), axis_names=("sx", "sy"), devices=eight_devices
+    )
+    g = stencil.initial_grid(32, 256)
+    g[:, -1] = 2.0
+    fn = kstencil.make_fused_stencil_fn(comm, 4, 32, 256, interpret=True)
+    out = np.asarray(fn(jnp.asarray(g)))
+    ref = stencil.reference_stencil(g, 4)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_fused_stencil_single_rank_interpret(eight_devices):
+    comm = smi.make_communicator(
+        shape=(1, 1), axis_names=("sx", "sy"), devices=eight_devices
+    )
+    g = stencil.initial_grid(16, 128)
+    fn = kstencil.make_fused_stencil_fn(comm, 3, 16, 128, interpret=True)
+    out = np.asarray(fn(jnp.asarray(g)))
+    ref = stencil.reference_stencil(g, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_pallas_supported_gating():
+    assert kstencil.pallas_supported(512, 1024, jnp.float32)
+    assert not kstencil.pallas_supported(512, 1000, jnp.float32)  # lanes
+    assert not kstencil.pallas_supported(7, 128, jnp.float32)     # rows
+    assert not kstencil.pallas_supported(512, 1024, jnp.float64)  # dtype
+
+
+@pytest.mark.parametrize("n", [4, 8])
+def test_ring_all_gather_interpret(eight_devices, n):
+    comm = smi.make_communicator(n, devices=eight_devices)
+    fn = kring.make_ring_all_gather(comm, interpret=True)
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n * 8, 128)
+    out = np.asarray(fn(x))
+    np.testing.assert_array_equal(out, np.asarray(x))
+
+
+def test_ring_all_reduce_interpret(eight_devices):
+    n = 4
+    comm = smi.make_communicator(n, devices=eight_devices)
+    fn = kring.make_ring_all_reduce(comm, interpret=True)
+    x = jnp.arange(n * 8 * 128, dtype=jnp.float32).reshape(n, 8, 128)
+    out = np.asarray(fn(x))
+    expected = np.asarray(x).sum(axis=0)
+    for r in range(n):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-5)
