@@ -1,0 +1,69 @@
+// smi-manifest: extract the communication-op manifest from user sources.
+//
+// Usage: smi-manifest [--no-rendezvous] [--no-validate] FILE...
+//
+// Prints one JSON object per discovered op on stdout (the reference
+// rewriter's protocol, source-rewriter/src/ops/ops.cpp:24-40 consumed by
+// codegen/rewrite.py:36-57) and diagnostics on stderr. Exit status: 0 on
+// success, 1 on scan errors or port-uniqueness violations.
+
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "scanner.h"
+
+int main(int argc, char** argv) {
+  bool rendezvous = true;
+  bool validate = true;
+  std::vector<std::string> files;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    if (arg == "--no-rendezvous") {
+      rendezvous = false;
+    } else if (arg == "--no-validate") {
+      validate = false;
+    } else if (arg == "--help" || arg == "-h") {
+      std::cout << "usage: smi-manifest [--no-rendezvous] [--no-validate] "
+                   "FILE...\n";
+      return 0;
+    } else {
+      files.push_back(arg);
+    }
+  }
+  if (files.empty()) {
+    std::cerr << "smi-manifest: no input files\n";
+    return 1;
+  }
+
+  std::vector<smi::Operation> all_ops;
+  bool failed = false;
+  for (const auto& path : files) {
+    std::ifstream in(path);
+    if (!in) {
+      std::cerr << "smi-manifest: cannot open " << path << "\n";
+      failed = true;
+      continue;
+    }
+    std::ostringstream buf;
+    buf << in.rdbuf();
+    smi::ScanResult result = smi::scan_source(buf.str(), path);
+    for (const auto& err : result.errors) {
+      std::cerr << "smi-manifest: " << err << "\n";
+      failed = true;
+    }
+    all_ops.insert(all_ops.end(), result.ops.begin(), result.ops.end());
+  }
+
+  if (validate) {
+    for (const auto& err : smi::validate_ops(all_ops, rendezvous)) {
+      std::cerr << "smi-manifest: " << err << "\n";
+      failed = true;
+    }
+  }
+
+  std::cout << smi::to_json_lines(all_ops);
+  return failed ? 1 : 0;
+}

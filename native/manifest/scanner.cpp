@@ -1,0 +1,383 @@
+#include "scanner.h"
+
+#include <cctype>
+#include <map>
+#include <set>
+#include <sstream>
+
+namespace smi {
+
+const char* op_kind_name(OpKind k) {
+  switch (k) {
+    case OpKind::Push: return "push";
+    case OpKind::Pop: return "pop";
+    case OpKind::Broadcast: return "broadcast";
+    case OpKind::Reduce: return "reduce";
+    case OpKind::Scatter: return "scatter";
+    case OpKind::Gather: return "gather";
+  }
+  return "?";
+}
+
+namespace {
+
+// ---------------------------------------------------------------------
+// Tokenizer: just enough Python lexing for call-argument extraction —
+// identifiers, numbers, strings, punctuation; comments skipped.
+// ---------------------------------------------------------------------
+
+struct Token {
+  enum Type { Ident, Number, String, Punct, End } type = End;
+  std::string text;
+  int line = 1;
+};
+
+class Lexer {
+ public:
+  explicit Lexer(const std::string& src) : src_(src) {}
+
+  Token next() {
+    skip_ws_and_comments();
+    Token t;
+    t.line = line_;
+    if (pos_ >= src_.size()) return t;  // End
+    char c = src_[pos_];
+    if (std::isalpha(static_cast<unsigned char>(c)) || c == '_') {
+      size_t start = pos_;
+      while (pos_ < src_.size() &&
+             (std::isalnum(static_cast<unsigned char>(src_[pos_])) ||
+              src_[pos_] == '_'))
+        pos_++;
+      t.type = Token::Ident;
+      t.text = src_.substr(start, pos_ - start);
+    } else if (std::isdigit(static_cast<unsigned char>(c))) {
+      size_t start = pos_;
+      while (pos_ < src_.size() &&
+             (std::isalnum(static_cast<unsigned char>(src_[pos_])) ||
+              src_[pos_] == '_' || src_[pos_] == '.'))
+        pos_++;
+      t.type = Token::Number;
+      t.text = src_.substr(start, pos_ - start);
+    } else if (c == '"' || c == '\'') {
+      char quote = c;
+      size_t start = ++pos_;
+      while (pos_ < src_.size() && src_[pos_] != quote) {
+        if (src_[pos_] == '\\') pos_++;
+        pos_++;
+      }
+      t.type = Token::String;
+      t.text = src_.substr(start, pos_ - start);
+      if (pos_ < src_.size()) pos_++;  // closing quote
+    } else {
+      t.type = Token::Punct;
+      t.text = std::string(1, c);
+      pos_++;
+    }
+    return t;
+  }
+
+ private:
+  void skip_ws_and_comments() {
+    while (pos_ < src_.size()) {
+      char c = src_[pos_];
+      if (c == '\n') {
+        line_++;
+        pos_++;
+      } else if (std::isspace(static_cast<unsigned char>(c))) {
+        pos_++;
+      } else if (c == '#') {
+        while (pos_ < src_.size() && src_[pos_] != '\n') pos_++;
+      } else {
+        break;
+      }
+    }
+  }
+
+  const std::string& src_;
+  size_t pos_ = 0;
+  int line_ = 1;
+};
+
+// One parsed call argument: positional index or keyword, literal value.
+struct Arg {
+  std::string keyword;  // empty = positional
+  Token value;          // first token of the value (literal extraction)
+  bool literal = true;  // value is a single literal token
+};
+
+// Parse a balanced argument list starting after '('. Returns tokens
+// consumed; literal extraction only looks at single-token values.
+std::vector<Arg> parse_args(Lexer& lex, Token& tok) {
+  std::vector<Arg> args;
+  int depth = 1;
+  Arg cur;
+  int value_tokens = 0;
+  bool pending_kw = false;
+  std::string last_ident;
+
+  while (depth > 0) {
+    tok = lex.next();
+    if (tok.type == Token::End) break;
+    if (tok.type == Token::Punct) {
+      if (tok.text == "(" || tok.text == "[" || tok.text == "{") {
+        depth++;
+        cur.literal = false;
+        value_tokens++;
+        continue;
+      }
+      if (tok.text == ")" || tok.text == "]" || tok.text == "}") {
+        depth--;
+        if (depth == 0) break;
+        value_tokens++;
+        continue;
+      }
+      if (tok.text == "," && depth == 1) {
+        if (value_tokens > 0) args.push_back(cur);
+        cur = Arg();
+        value_tokens = 0;
+        pending_kw = false;
+        last_ident.clear();
+        continue;
+      }
+      if (tok.text == "=" && depth == 1 && value_tokens == 1 &&
+          !last_ident.empty() && !pending_kw) {
+        cur.keyword = last_ident;
+        cur.value = Token();
+        value_tokens = 0;
+        pending_kw = true;
+        continue;
+      }
+      cur.literal = false;
+      value_tokens++;
+      continue;
+    }
+    // Ident / Number / String
+    if (value_tokens == 0) {
+      cur.value = tok;
+      cur.literal = true;
+    } else {
+      cur.literal = false;
+    }
+    if (tok.type == Token::Ident) last_ident = tok.text;
+    value_tokens++;
+  }
+  if (value_tokens > 0) args.push_back(cur);
+  return args;
+}
+
+const std::map<std::string, OpKind> kCallNames = {
+    {"Push", OpKind::Push},
+    {"Pop", OpKind::Pop},
+    {"Broadcast", OpKind::Broadcast},
+    {"Reduce", OpKind::Reduce},
+    {"Scatter", OpKind::Scatter},
+    {"Gather", OpKind::Gather},
+    {"bcast", OpKind::Broadcast},
+    {"reduce", OpKind::Reduce},
+    {"scatter", OpKind::Scatter},
+    {"gather", OpKind::Gather},
+};
+
+const std::set<std::string> kDtypes = {"int", "float", "double", "char",
+                                       "short"};
+const std::set<std::string> kReduceOps = {"add", "max", "min"};
+
+std::optional<long> as_int(const Arg& a) {
+  if (!a.literal || a.value.type != Token::Number) return std::nullopt;
+  try {
+    return std::stol(a.value.text);
+  } catch (...) {
+    return std::nullopt;
+  }
+}
+
+std::optional<std::string> as_string(const Arg& a) {
+  if (!a.literal || a.value.type != Token::String) return std::nullopt;
+  return a.value.text;
+}
+
+const Arg* find_arg(const std::vector<Arg>& args, const std::string& kw,
+                    int positional) {
+  for (const auto& a : args)
+    if (a.keyword == kw) return &a;
+  int pos = 0;
+  for (const auto& a : args) {
+    if (!a.keyword.empty()) continue;
+    if (pos == positional) return &a;
+    pos++;
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+ScanResult scan_source(const std::string& source,
+                       const std::string& filename) {
+  ScanResult result;
+  Lexer lex(source);
+  Token tok = lex.next();
+
+  while (tok.type != Token::End) {
+    if (tok.type != Token::Ident) {
+      tok = lex.next();
+      continue;
+    }
+    std::string name = tok.text;
+    int call_line = tok.line;
+    Token after = lex.next();
+    bool is_call =
+        after.type == Token::Punct && after.text == "(";
+
+    auto handle = [&](OpKind kind, const std::vector<Arg>& args) {
+      Operation op;
+      op.kind = kind;
+      op.line = call_line;
+      bool is_ctor = std::isupper(static_cast<unsigned char>(name[0]));
+
+      const Arg* port_arg =
+          is_ctor ? find_arg(args, "port", 0) : find_arg(args, "port", -1);
+      if (port_arg == nullptr) {
+        // context collectives: port is keyword-only and optional
+        if (!is_ctor) return;  // collective without explicit port: skip
+        result.errors.push_back(filename + ":" +
+                                std::to_string(call_line) + ": " + name +
+                                " call without a port argument");
+        return;
+      }
+      auto port = as_int(*port_arg);
+      if (!port) {
+        // ports must be compile-time constants, as in the reference
+        // (source-rewriter/src/ops/utils.cpp:5-48)
+        result.errors.push_back(
+            filename + ":" + std::to_string(call_line) + ": " + name +
+            " port is not an integer literal");
+        return;
+      }
+      op.port = static_cast<int>(*port);
+
+      if (const Arg* d = find_arg(args, is_ctor ? "dtype" : "dtype",
+                                  is_ctor ? 1 : -1)) {
+        if (auto ds = as_string(*d)) {
+          if (kDtypes.count(*ds) == 0) {
+            result.errors.push_back(filename + ":" +
+                                    std::to_string(call_line) +
+                                    ": unknown dtype '" + *ds + "'");
+            return;
+          }
+          op.dtype = *ds;
+        }
+      }
+      if (const Arg* b = find_arg(args, "buffer_size", is_ctor ? 2 : -1)) {
+        if (auto bi = as_int(*b)) op.buffer_size = *bi;
+      }
+      if (kind == OpKind::Reduce) {
+        if (const Arg* o = find_arg(args, "op", -1)) {
+          if (auto os = as_string(*o)) {
+            if (kReduceOps.count(*os)) op.reduce_op = *os;
+          }
+        }
+      }
+      result.ops.push_back(op);
+    };
+
+    if (is_call) {
+      auto it = kCallNames.find(name);
+      if (it != kCallNames.end()) {
+        std::vector<Arg> args = parse_args(lex, tok);
+        handle(it->second, args);
+        tok = lex.next();
+        continue;
+      }
+      if (name == "open_channel" || name == "open_send_channel" ||
+          name == "open_receive_channel") {
+        std::vector<Arg> args = parse_args(lex, tok);
+        // a channel open declares both endpoints' ops at that port
+        const Arg* port_arg = find_arg(args, "port", 0);
+        auto port = port_arg ? as_int(*port_arg) : std::nullopt;
+        if (!port) {
+          result.errors.push_back(filename + ":" +
+                                  std::to_string(call_line) +
+                                  ": open_channel port is not an integer "
+                                  "literal");
+        } else {
+          Operation op;
+          op.port = static_cast<int>(*port);
+          op.line = call_line;
+          if (const Arg* d = find_arg(args, "dtype", -1))
+            if (auto ds = as_string(*d)) op.dtype = *ds;
+          if (const Arg* b = find_arg(args, "buffer_size", -1))
+            if (auto bi = as_int(*b)) op.buffer_size = *bi;
+          if (name != "open_receive_channel") {
+            op.kind = OpKind::Push;
+            result.ops.push_back(op);
+          }
+          if (name != "open_send_channel") {
+            op.kind = OpKind::Pop;
+            result.ops.push_back(op);
+          }
+        }
+        tok = lex.next();
+        continue;
+      }
+    }
+    tok = after;
+  }
+  return result;
+}
+
+std::vector<std::string> validate_ops(const std::vector<Operation>& ops,
+                                      bool p2p_rendezvous) {
+  // stream classes per op kind (ops.py channel_usage analog)
+  std::vector<std::string> errors;
+  const char* classes[4] = {"out_data", "out_ctrl", "in_data", "in_ctrl"};
+  for (int c = 0; c < 4; c++) {
+    std::map<int, const Operation*> seen;
+    for (const auto& op : ops) {
+      bool uses = false;
+      switch (op.kind) {
+        case OpKind::Push:
+          uses = (c == 0) || (p2p_rendezvous && c == 3);
+          break;
+        case OpKind::Pop:
+          uses = (c == 2) || (p2p_rendezvous && c == 1);
+          break;
+        default:
+          uses = true;  // collectives use all four classes
+      }
+      if (!uses) continue;
+      auto it = seen.find(op.port);
+      if (it != seen.end()) {
+        errors.push_back(
+            std::string("port ") + std::to_string(op.port) +
+            " claimed twice on stream class " + classes[c] + " (" +
+            op_kind_name(it->second->kind) + " line " +
+            std::to_string(it->second->line) + " vs " +
+            op_kind_name(op.kind) + " line " + std::to_string(op.line) +
+            ")");
+      } else {
+        seen[op.port] = &op;
+      }
+    }
+  }
+  return errors;
+}
+
+std::string to_json_lines(const std::vector<Operation>& ops) {
+  std::ostringstream out;
+  for (const auto& op : ops) {
+    out << "{\"type\": \"" << op_kind_name(op.kind)
+        << "\", \"port\": " << op.port << ", \"data_type\": \"" << op.dtype
+        << "\", \"buffer_size\": ";
+    if (op.buffer_size)
+      out << *op.buffer_size;
+    else
+      out << "null";
+    out << ", \"args\": {";
+    if (op.kind == OpKind::Reduce)
+      out << "\"op_type\": \"" << op.reduce_op << "\"";
+    out << "}, \"line\": " << op.line << "}\n";
+  }
+  return out.str();
+}
+
+}  // namespace smi

@@ -1,0 +1,55 @@
+// Manifest scanner: extracts SMI communication-op call sites from user
+// program sources (Python/JAX) into an op-manifest.
+//
+// Role parity with the reference's Clang source-rewriter
+// (source-rewriter/src/rewrite.cpp + ops/*.cpp): the reference walks the
+// OpenCL AST, extracts {operation, port, data type, buffer size, args}
+// per SMI_* call and prints one JSON object per op on stdout
+// (ops.cpp:24-40), renaming calls to monomorphized symbols. On TPU the
+// renaming half is unnecessary — JAX monomorphizes at trace time — so the
+// tool's job is the analysis half: find the op call sites, require
+// compile-time-constant ports (the reference's const-int extraction,
+// source-rewriter/src/ops/utils.cpp:5-48), and emit the manifest that
+// feeds the Program model and routing tables.
+
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace smi {
+
+enum class OpKind { Push, Pop, Broadcast, Reduce, Scatter, Gather };
+
+const char* op_kind_name(OpKind k);
+
+struct Operation {
+  OpKind kind;
+  int port = -1;
+  std::string dtype = "int";        // reference default (serialization.py:22)
+  std::optional<long> buffer_size;  // elements ("asynchronicity degree")
+  std::string reduce_op = "add";    // reduce only
+  int line = 0;                     // 1-based source line of the call
+};
+
+struct ScanResult {
+  std::vector<Operation> ops;
+  std::vector<std::string> errors;  // non-constant ports, bad dtypes, ...
+};
+
+// Scan one source buffer. `filename` is used in diagnostics only.
+ScanResult scan_source(const std::string& source, const std::string& filename);
+
+// Port-uniqueness validation per stream class, mirroring
+// codegen/program.py:37-50: within {out,in}x{data,ctrl} usage classes a
+// logical port may be claimed once. Returns error strings (empty = valid).
+std::vector<std::string> validate_ops(const std::vector<Operation>& ops,
+                                      bool p2p_rendezvous = true);
+
+// Serialize ops as JSON lines (one object per op), the rewriter's stdout
+// protocol (source-rewriter/src/ops/ops.cpp:24-40).
+std::string to_json_lines(const std::vector<Operation>& ops);
+
+}  // namespace smi

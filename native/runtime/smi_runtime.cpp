@@ -1,0 +1,90 @@
+#include "smi_runtime.h"
+
+#include <chrono>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string table_path(const char* dir, const char* kind, int rank,
+                       int channel) {
+  // file naming parity: include/utils/smi_utils.hpp:24-39
+  return std::string(dir) + "/" + kind + "-rank" + std::to_string(rank) +
+         "-channel" + std::to_string(channel);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* smi_runtime_version() { return "smi_tpu-runtime 0.1.0"; }
+
+int64_t smi_time_usecs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t smi_time_nsecs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int32_t smi_load_routing_table(const char* dir, const char* kind,
+                               int32_t rank, int32_t channel, uint8_t* out,
+                               int32_t capacity) {
+  std::string path = table_path(dir, kind, rank, channel);
+  FILE* f = std::fopen(path.c_str(), "rb");
+  if (f == nullptr) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (size < 0) {
+    std::fclose(f);
+    return -1;
+  }
+  if (size > capacity) {
+    std::fclose(f);
+    return -2;
+  }
+  size_t read = std::fread(out, 1, static_cast<size_t>(size), f);
+  std::fclose(f);
+  if (read != static_cast<size_t>(size)) return -1;
+  return static_cast<int32_t>(size);
+}
+
+int32_t smi_store_routing_table(const char* dir, const char* kind,
+                                int32_t rank, int32_t channel,
+                                const uint8_t* data, int32_t count) {
+  std::string path = table_path(dir, kind, rank, channel);
+  FILE* f = std::fopen(path.c_str(), "wb");
+  if (f == nullptr) return -1;
+  size_t written = std::fwrite(data, 1, static_cast<size_t>(count), f);
+  std::fclose(f);
+  return written == static_cast<size_t>(count) ? 0 : -1;
+}
+
+int32_t smi_bootstrap_rank(const char* dir, int32_t rank, int32_t channels,
+                           int32_t max_ranks) {
+  if (channels <= 0 || max_ranks <= 0) return -1;
+  std::vector<uint8_t> buf(1 << 20);
+  int32_t ports = -1;
+  for (int c = 0; c < channels; c++) {
+    int32_t cks = smi_load_routing_table(dir, "cks", rank, c, buf.data(),
+                                         static_cast<int32_t>(buf.size()));
+    if (cks <= 0 || cks % max_ranks != 0) return -1;
+    int32_t cks_ports = cks / max_ranks;
+    int32_t ckr = smi_load_routing_table(dir, "ckr", rank, c, buf.data(),
+                                         static_cast<int32_t>(buf.size()));
+    // ckr table is 2 entries (data|ctrl) per logical port
+    // (codegen/notes.txt "CKR routing table")
+    if (ckr < 0 || ckr != 2 * cks_ports) return -1;
+    if (ports == -1) ports = cks_ports;
+    if (ports != cks_ports) return -1;
+  }
+  return ports;
+}
+
+}  // extern "C"

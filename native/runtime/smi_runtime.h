@@ -1,0 +1,48 @@
+// smi_runtime: native host runtime support library.
+//
+// C++ equivalent of the reference's host-side native layer
+// (include/utils/smi_utils.hpp — LoadRoutingTable, kChannelsPerRank;
+// include/utils/utils.hpp — microsecond/nanosecond timers; plus the
+// table staging that the generated SmiInit_<program> performs,
+// codegen/templates/host_hlslib.cl:20-38). Exposed as a C ABI so the
+// Python side binds via ctypes (no pybind11 in the image).
+
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+
+// Library/version info ---------------------------------------------------
+const char* smi_runtime_version();
+
+// Timing (include/utils/utils.hpp:10-23 parity) --------------------------
+int64_t smi_time_usecs();
+int64_t smi_time_nsecs();
+
+// Routing table IO -------------------------------------------------------
+// Tables are little-endian fixed-width unsigned entries, one file per
+// (kind, rank, channel) named "{kind}-rank{r}-channel{c}"
+// (include/utils/smi_utils.hpp:24-39). Returns the entry count, or -1 on
+// IO error, or -2 if the buffer is too small (required size is written
+// nowhere; call with a larger buffer).
+int32_t smi_load_routing_table(const char* dir, const char* kind,
+                               int32_t rank, int32_t channel,
+                               uint8_t* out, int32_t capacity);
+
+// Write `count` single-byte entries to the table file. Returns 0, or -1
+// on IO error.
+int32_t smi_store_routing_table(const char* dir, const char* kind,
+                                int32_t rank, int32_t channel,
+                                const uint8_t* data, int32_t count);
+
+// Communicator bootstrap -------------------------------------------------
+// The reference's SmiInit returns SMI_Comm{rank, size} after staging
+// tables (host_hlslib.cl:87-89). Here the bootstrap validates that all
+// 2*channels tables for `rank` exist in `dir` and reports the logical
+// port count implied by the cks table size (entries / max_ranks).
+// Returns the port count, or -1 if any table file is missing/invalid.
+int32_t smi_bootstrap_rank(const char* dir, int32_t rank,
+                           int32_t channels, int32_t max_ranks);
+
+}  // extern "C"

@@ -30,11 +30,11 @@ Public API (mirrors ``include/smi.h``; see each submodule for details)::
     prog = smi.Program([smi.Push(0, "float"), smi.Pop(0, "float")])
     comm = smi.make_communicator(n_devices=8)
 
-    @smi.smi_kernel(comm)
+    @smi.smi_kernel(comm, out_specs=P("smi"), program=prog)
     def app(ctx, x):
-        ch = ctx.open_send_channel(N, "float", dst=1, port=0)
-        ctx.push(ch, x)
-        ...
+        ch = ctx.open_channel(port=0, src=0, dst=1, count=N, dtype="float")
+        received = ctx.transfer(ch, x)   # Push at src, Pop at dst, fused
+        return ctx.bcast(received, root=1)[None]
 """
 
 from smi_tpu.ops.types import (

@@ -1,0 +1,19 @@
+"""Communication microbenchmarks.
+
+Reference parity: ``microbenchmarks/`` — bandwidth, latency, injection
+rate, the four collectives, multi-collective overlap, and the rank
+pipeline (``microbenchmarks/CMakeLists.txt:8-27``). Each reference host
+follows one pattern: parse args → init → timed kernel runs → mean/stddev/
+99% CI → ``.dat`` file (``host/bandwidth_benchmark.cpp``); this package
+keeps the pattern and the metric formulas (SURVEY §6) on the JAX data
+plane.
+
+Run ``python -m smi_tpu.benchmarks <name>`` (see ``--help``). On the CPU
+fake mesh the numbers exercise the full code path (the reference's
+emulator benchmarks are equally not performance-meaningful); on real
+multi-chip hardware the same code measures ICI.
+"""
+
+from smi_tpu.benchmarks.micro import BENCHMARKS, run_benchmark
+
+__all__ = ["BENCHMARKS", "run_benchmark"]

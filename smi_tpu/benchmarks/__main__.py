@@ -1,0 +1,74 @@
+"""CLI: ``python -m smi_tpu.benchmarks <name> [--ranks N] [--runs N] ...``
+
+Mirrors the reference benchmark hosts' getopt interface (e.g.
+``bandwidth_benchmark.cpp`` -b/-r/-k flags) with argparse. Add ``--cpu
+--fake-ranks 8`` to run on the emulator-tier fake mesh.
+"""
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="smi_tpu.benchmarks")
+    parser.add_argument("name", help="benchmark name, or 'all'")
+    parser.add_argument("--ranks", type=int, default=None,
+                        help="communicator size (default: all devices)")
+    parser.add_argument("--runs", type=int, default=10)
+    parser.add_argument("--root", type=int, default=None,
+                        help="collective root (collectives only)")
+    parser.add_argument("--elements", type=int, default=None)
+    parser.add_argument("--size-kb", type=int, default=None,
+                        help="bandwidth payload")
+    parser.add_argument("--eager", action="store_true",
+                        help="pipeline: disable rendezvous chunking")
+    parser.add_argument("--out-dir", default=None,
+                        help="write .dat/.json result files here")
+    parser.add_argument("--cpu", action="store_true",
+                        help="force the CPU backend")
+    parser.add_argument("--fake-ranks", type=int, default=None,
+                        help="virtual CPU device count (implies --cpu)")
+    args = parser.parse_args(argv)
+
+    if args.fake_ranks:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.fake_ranks}"
+        ).strip()
+    import jax
+
+    if args.cpu or args.fake_ranks:
+        jax.config.update("jax_platforms", "cpu")
+
+    from smi_tpu.benchmarks.micro import BENCHMARKS, run_benchmark
+    from smi_tpu.parallel.mesh import make_communicator
+
+    comm = make_communicator(n_devices=args.ranks)
+    names = sorted(BENCHMARKS) if args.name == "all" else [args.name]
+    params = {"runs": args.runs}
+    if args.root is not None:
+        params["root"] = args.root
+    if args.elements is not None:
+        params["elements"] = args.elements
+
+    for name in names:
+        p = dict(params)
+        if name == "bandwidth":
+            p.pop("root", None)
+            p.pop("elements", None)
+            if args.size_kb is not None:
+                p["size_kb"] = args.size_kb
+        elif name in ("latency", "injection", "multi_collectives"):
+            p.pop("root", None)
+            if name in ("latency", "injection"):
+                p.pop("elements", None)
+        elif name == "pipeline":
+            p.pop("root", None)
+            p["rendezvous"] = not args.eager
+        run_benchmark(name, comm=comm, out_dir=args.out_dir, **p)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,295 @@
+"""The microbenchmark suite.
+
+Metric formulas follow the reference hosts (SURVEY §6):
+
+- ``bandwidth``: payload bits / transfer time, two concurrent channels
+  (``bandwidth_benchmark.cpp:188-194``, ``bandwidth_0.cl:14-33``);
+- ``latency``: mean RTT/2 of a 1-element ping-pong
+  (``latency_0.cl:10-12``, ``latency_benchmark.cpp:158-175``);
+- ``injection``: time per 1-element message, back-to-back
+  (``injection_rate_benchmark.cpp:150-171``);
+- ``broadcast``/``reduce``/``scatter``/``gather``: N-element rooted
+  collective time vs root placement (``broadcast_benchmark.cpp`` etc.);
+- ``multi_collectives``: overlapped vs serialized broadcasts on distinct
+  ports (``multi_collectives.cl:1-12``);
+- ``pipeline``: R ring hops, rendezvous (chunked) vs eager
+  (``pipeline.cl:9-34``; eager variants
+  ``microbenchmarks/CMakeLists.txt:16-17``).
+
+All benchmarks run the real shard_map/collective code path; completion is
+forced with a scalar readback per timed run.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from smi_tpu.benchmarks.stats import Measurement, timed_samples
+from smi_tpu.parallel.channels import P2PChannel, ring_shift
+from smi_tpu.parallel import collectives as coll
+from smi_tpu.parallel.mesh import Communicator, make_communicator
+
+
+def _force(fn):
+    """Wrap a jitted fn so each call forces completion via readback."""
+
+    def run():
+        np.asarray(fn())
+
+    return run
+
+
+def bench_bandwidth(
+    comm: Communicator, size_kb: int = 512, runs: int = 10, repeats: int = 4
+) -> Measurement:
+    """Two concurrent P2P channels rank0→rank1; payload Gbit/s."""
+    n = max(1, size_kb * 1024 // 4 // 2)  # floats per channel
+    axis = comm.axis_names[0]
+
+    def shard_fn(x):
+        ch0 = P2PChannel(comm=comm, port=0, src=0, dst=1, count=n,
+                         dtype="float", rendezvous=False)
+        ch1 = P2PChannel(comm=comm, port=1, src=0, dst=1, count=n,
+                         dtype="float", rendezvous=False)
+
+        def one(carry, _):
+            a = ch0.transfer(x)
+            b = ch1.transfer(x * 2)
+            return carry + jnp.sum(a) + jnp.sum(b), ()
+
+        total, _ = lax.scan(one, jnp.zeros((), jnp.float32), None,
+                            length=repeats)
+        return total[None]
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm.mesh, in_specs=P(), out_specs=P(axis),
+        check_vma=False,
+    ))
+    x = jnp.ones(n, jnp.float32)
+    samples = timed_samples(_force(lambda: fn(x)), runs)
+    bytes_moved = 2 * n * 4 * repeats
+    gbits = [bytes_moved * 8 / t / 1e9 for t in samples]
+    return Measurement("bandwidth", "Gbit/s", gbits,
+                       {"size_kb": size_kb, "channels": 2,
+                        "repeats": repeats})
+
+
+def bench_latency(
+    comm: Communicator, pingpongs: int = 100, runs: int = 10
+) -> Measurement:
+    """1-element ping-pong rank0↔rank1; half round trip in usec."""
+    axis = comm.axis_names[0]
+
+    def shard_fn(x):
+        fwd = P2PChannel(comm=comm, port=0, src=0, dst=1, count=1,
+                         dtype="int", rendezvous=False)
+        bwd = P2PChannel(comm=comm, port=1, src=1, dst=0, count=1,
+                         dtype="int", rendezvous=False)
+
+        def one(carry, _):
+            there = fwd.transfer(carry)
+            back = bwd.transfer(there + 1)
+            return back, ()
+
+        out, _ = lax.scan(one, x, None, length=pingpongs)
+        return out[None]
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm.mesh, in_specs=P(), out_specs=P(axis),
+        check_vma=False,
+    ))
+    x = jnp.zeros(1, jnp.int32)
+    samples = timed_samples(_force(lambda: fn(x)), runs)
+    usecs = [t / (2 * pingpongs) * 1e6 for t in samples]
+    return Measurement("latency", "usec", usecs, {"pingpongs": pingpongs})
+
+
+def bench_injection(
+    comm: Communicator, messages: int = 100, runs: int = 10
+) -> Measurement:
+    """Back-to-back 1-element sends; per-message overhead in usec."""
+    axis = comm.axis_names[0]
+
+    def shard_fn(x):
+        ch = P2PChannel(comm=comm, port=0, src=0, dst=1, count=1,
+                        dtype="int", rendezvous=False)
+
+        def one(carry, _):
+            got = ch.transfer(carry)
+            return got + carry, ()
+
+        out, _ = lax.scan(one, x, None, length=messages)
+        return out[None]
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm.mesh, in_specs=P(), out_specs=P(axis),
+        check_vma=False,
+    ))
+    x = jnp.ones(1, jnp.int32)
+    samples = timed_samples(_force(lambda: fn(x)), runs)
+    usecs = [t / messages * 1e6 for t in samples]
+    return Measurement("injection", "usec/msg", usecs,
+                       {"messages": messages})
+
+
+def _bench_collective(
+    name: str, comm: Communicator, elements: int, root: int, runs: int,
+    op: Optional[str] = None,
+) -> Measurement:
+    axis = comm.axis_names[0]
+    size = comm.size
+
+    def shard_fn(x):
+        r = comm.rank().astype(x.dtype)
+        if name == "broadcast":
+            out = coll.bcast(x + r, root=root, comm=comm, port=0)
+        elif name == "reduce":
+            out = coll.reduce(x + r, comm, op=op or "add", root=root, port=0)
+        elif name == "scatter":
+            out = coll.scatter(
+                jnp.tile(x, size) + r, comm, root=root, port=0
+            )
+        else:  # gather
+            out = coll.gather(x + r, comm, root=root, port=0)
+        return jnp.sum(out)[None]
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm.mesh, in_specs=P(), out_specs=P(axis),
+        check_vma=False,
+    ))
+    x = jnp.ones(elements, jnp.float32)
+    samples = timed_samples(_force(lambda: fn(x)), runs)
+    usecs = [t * 1e6 for t in samples]
+    return Measurement(
+        f"{name}-root{root}", "usec", usecs,
+        {"elements": elements, "root": root, "ranks": size, "op": op},
+    )
+
+
+def bench_broadcast(comm, elements: int = 65536, root: int = 0, runs: int = 10):
+    return _bench_collective("broadcast", comm, elements, root, runs)
+
+
+def bench_reduce(comm, elements: int = 65536, root: int = 0, runs: int = 10,
+                 op: str = "add"):
+    return _bench_collective("reduce", comm, elements, root, runs, op=op)
+
+
+def bench_scatter(comm, elements: int = 8192, root: int = 0, runs: int = 10):
+    return _bench_collective("scatter", comm, elements, root, runs)
+
+
+def bench_gather(comm, elements: int = 8192, root: int = 0, runs: int = 10):
+    return _bench_collective("gather", comm, elements, root, runs)
+
+
+def bench_multi_collectives(
+    comm: Communicator, elements: int = 16384, runs: int = 10
+) -> Measurement:
+    """Overlap benefit: 3 independent broadcasts on distinct ports vs 3
+    serialized ones (data-dependent chain)."""
+    axis = comm.axis_names[0]
+
+    r1, r2 = 1 % comm.size, 2 % comm.size  # stay valid on tiny comms
+
+    def overlapped(x):
+        a = coll.bcast(x, comm, root=0, port=0)
+        b = coll.bcast(x * 2, comm, root=r1, port=1)
+        c = coll.bcast(x * 3, comm, root=r2, port=2)
+        return (jnp.sum(a) + jnp.sum(b) + jnp.sum(c))[None]
+
+    def serialized(x):
+        a = coll.bcast(x, comm, root=0, port=0)
+        b = coll.bcast(a * 2, comm, root=r1, port=0)  # depends on a
+        c = coll.bcast(b * 3, comm, root=r2, port=0)
+        return jnp.sum(c)[None]
+
+    x = jnp.ones(elements, jnp.float32)
+    results = {}
+    for tag, body in (("overlapped", overlapped), ("serialized", serialized)):
+        fn = jax.jit(jax.shard_map(
+            body, mesh=comm.mesh, in_specs=P(), out_specs=P(axis),
+            check_vma=False,
+        ))
+        samples = timed_samples(_force(lambda: fn(x)), runs)
+        results[tag] = [t * 1e6 for t in samples]
+    # report the overlapped time; serialized mean lands in config
+    m = Measurement("multi_collectives", "usec", results["overlapped"],
+                    {"elements": elements,
+                     "serialized_mean_usec":
+                         sum(results["serialized"]) / runs})
+    return m
+
+
+def bench_pipeline(
+    comm: Communicator, elements: int = 4096, rounds: int = 16,
+    runs: int = 10, rendezvous: bool = True,
+) -> Measurement:
+    """Ring pipeline: every rank forwards to rank+1 for R rounds."""
+    axis = comm.axis_names[0]
+
+    def shard_fn(x):
+        def one(carry, _):
+            if rendezvous:
+                # bounded in-flight: move in default-depth chunks
+                chunk = 112  # 16 packets of float
+                n_chunks = max(1, elements // chunk)
+                parts = carry[: n_chunks * chunk].reshape(n_chunks, -1)
+                _, shifted = lax.scan(
+                    lambda c, part: (c, ring_shift(part, comm)), (), parts
+                )
+                out = jnp.concatenate(
+                    [shifted.reshape(-1), ring_shift(carry[n_chunks * chunk:], comm)]
+                ) if elements % chunk else shifted.reshape(-1)
+            else:
+                out = ring_shift(carry, comm)
+            return out + 1.0, ()
+
+        out, _ = lax.scan(one, x, None, length=rounds)
+        return jnp.sum(out)[None]
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm.mesh, in_specs=P(), out_specs=P(axis),
+        check_vma=False,
+    ))
+    x = jnp.ones(elements, jnp.float32)
+    samples = timed_samples(_force(lambda: fn(x)), runs)
+    usecs = [t / rounds * 1e6 for t in samples]
+    name = "pipeline" if rendezvous else "pipeline-eager"
+    return Measurement(name, "usec/round", usecs,
+                       {"elements": elements, "rounds": rounds,
+                        "rendezvous": rendezvous})
+
+
+BENCHMARKS: Dict[str, Callable] = {
+    "bandwidth": bench_bandwidth,
+    "latency": bench_latency,
+    "injection": bench_injection,
+    "broadcast": bench_broadcast,
+    "reduce": bench_reduce,
+    "scatter": bench_scatter,
+    "gather": bench_gather,
+    "multi_collectives": bench_multi_collectives,
+    "pipeline": bench_pipeline,
+}
+
+
+def run_benchmark(name: str, comm: Optional[Communicator] = None,
+                  out_dir: Optional[str] = None, **params) -> Measurement:
+    if name not in BENCHMARKS:
+        raise KeyError(
+            f"unknown benchmark {name!r}; have {sorted(BENCHMARKS)}"
+        )
+    if comm is None:
+        comm = make_communicator()
+    m = BENCHMARKS[name](comm, **params)
+    print(m.summary())
+    if out_dir:
+        m.write_dat(out_dir)
+    return m

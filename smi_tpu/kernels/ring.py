@@ -245,6 +245,11 @@ def make_ring_all_reduce(comm: Communicator, interpret: bool = False):
     n = comm.size
 
     def shard(x):
+        if x.shape[0] != 1:
+            raise ValueError(
+                f"make_ring_all_reduce expects one row per shard (global "
+                f"leading dim == comm size {n}); got local shape {x.shape}"
+            )
         return ring_all_reduce(x[0], axis, n, interpret=interpret)[None]
 
     return jax.jit(

@@ -80,16 +80,18 @@ def make_gesummv_fn(
         # only zeros (it received nothing).
         return y[None]
 
-    mapped = jax.shard_map(
-        shard_fn,
-        mesh=comm.mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(axis),
-        check_vma=False,
+    mapped = jax.jit(
+        jax.shard_map(
+            shard_fn,
+            mesh=comm.mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(axis),
+            check_vma=False,
+        )
     )
 
     def fn(ab, x):
-        return jax.jit(mapped)(ab, x)[0]  # rank 0's row
+        return mapped(ab, x)[0]  # rank 0's row
 
     return fn
 

@@ -140,10 +140,11 @@ class Program:
 
     @property
     def logical_port_count(self) -> int:
-        """Number of distinct logical ports (sizes routing tables)."""
-        if not self.operations:
-            return 0
-        return max(op.port for op in self.operations) + 1
+        """Number of logical ports (sizes routing tables); minimum 1 as in
+        the reference (``codegen/program.py:107`` ``max(..., default=0)+1``)
+        so even idle MPMD ranks get non-empty tables the bootstrap accepts.
+        """
+        return max((op.port for op in self.operations), default=0) + 1
 
     def operations_of_family(self, *families: str) -> List[SmiOperation]:
         fams = families or (P2P_FAMILIES + COLLECTIVE_FAMILIES)

@@ -46,6 +46,10 @@ def _axis(comm: Communicator) -> str:
 
 
 def _is_root(comm: Communicator, root: int) -> jax.Array:
+    if not (0 <= root < comm.size):
+        raise ValueError(
+            f"root={root} out of range for comm size {comm.size}"
+        )
     return comm.rank() == root
 
 

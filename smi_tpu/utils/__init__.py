@@ -1,0 +1,5 @@
+"""Host-side utilities: native-library bindings, timing, logging.
+
+Reference parity: ``include/utils/`` (the C++ host support layer) plus
+the codegen-side process plumbing (``codegen/rewrite.py``).
+"""

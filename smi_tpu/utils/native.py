@@ -1,0 +1,204 @@
+"""ctypes bindings for the native host runtime + manifest tool driver.
+
+The native pieces mirror the reference's C++ host layer:
+
+- ``libsmi_runtime.so`` — timers and binary routing-table IO
+  (``include/utils/smi_utils.hpp``, ``include/utils/utils.hpp``);
+- ``smi-manifest`` — the source-rewriter-equivalent analysis tool
+  (``source-rewriter/``), driven as a subprocess exactly as the
+  reference's codegen drives its Clang tool (``codegen/rewrite.py:36-57``).
+
+Both are built by ``make -C native`` (or CMake). Every entry point has a
+pure-Python fallback so the framework works before the native build, but
+:func:`native_available` lets callers and tests require the real thing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+import time
+from typing import List, Optional, Sequence
+
+from smi_tpu.ops.operations import SmiOperation, make_operation
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_RUNTIME_SO = os.path.join(_BUILD_DIR, "libsmi_runtime.so")
+_MANIFEST_BIN = os.path.join(_BUILD_DIR, "smi-manifest")
+
+_lib = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_RUNTIME_SO):
+        return None
+    lib = ctypes.CDLL(_RUNTIME_SO)
+    lib.smi_runtime_version.restype = ctypes.c_char_p
+    lib.smi_time_usecs.restype = ctypes.c_int64
+    lib.smi_time_nsecs.restype = ctypes.c_int64
+    lib.smi_load_routing_table.restype = ctypes.c_int32
+    lib.smi_load_routing_table.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+    ]
+    lib.smi_store_routing_table.restype = ctypes.c_int32
+    lib.smi_store_routing_table.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+    ]
+    lib.smi_bootstrap_rank.restype = ctypes.c_int32
+    lib.smi_bootstrap_rank.argtypes = [
+        ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def manifest_tool_available() -> bool:
+    return os.path.exists(_MANIFEST_BIN)
+
+
+def runtime_version() -> str:
+    lib = _load()
+    if lib is None:
+        return "python-fallback"
+    return lib.smi_runtime_version().decode()
+
+
+def time_usecs() -> int:
+    """Monotonic microseconds (``utils.hpp:10-16`` parity)."""
+    lib = _load()
+    if lib is None:
+        return time.monotonic_ns() // 1000
+    return lib.smi_time_usecs()
+
+
+def time_nsecs() -> int:
+    lib = _load()
+    if lib is None:
+        return time.monotonic_ns()
+    return lib.smi_time_nsecs()
+
+
+def load_routing_table(directory: str, kind: str, rank: int,
+                       channel: int) -> List[int]:
+    """Read one binary table file (``smi_utils.hpp:24-39`` parity)."""
+    lib = _load()
+    if lib is None:
+        path = os.path.join(directory, f"{kind}-rank{rank}-channel{channel}")
+        with open(path, "rb") as f:
+            return list(f.read())
+    cap = 1 << 20
+    buf = (ctypes.c_uint8 * cap)()
+    n = lib.smi_load_routing_table(
+        directory.encode(), kind.encode(), rank, channel, buf, cap
+    )
+    if n < 0:
+        raise FileNotFoundError(
+            f"native load of {kind}-rank{rank}-channel{channel} in "
+            f"{directory} failed (code {n})"
+        )
+    return list(buf[:n])
+
+
+def store_routing_table(directory: str, kind: str, rank: int, channel: int,
+                        entries: Sequence[int]) -> None:
+    lib = _load()
+    data = bytes(entries)
+    if lib is None:
+        path = os.path.join(directory, f"{kind}-rank{rank}-channel{channel}")
+        with open(path, "wb") as f:
+            f.write(data)
+        return
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    rc = lib.smi_store_routing_table(
+        directory.encode(), kind.encode(), rank, channel, buf, len(data)
+    )
+    if rc != 0:
+        raise IOError(f"native store of routing table failed (code {rc})")
+
+
+def bootstrap_rank(directory: str, rank: int, channels: int = 4,
+                   max_ranks: int = 8) -> int:
+    """Validate a rank's table set; returns the logical port count.
+
+    The native runtime's ``SmiInit`` analog (``host_hlslib.cl:20-38``):
+    all 2×channels tables must exist and agree on the port count.
+    """
+    lib = _load()
+    if lib is None:
+        ports = None
+        for c in range(channels):
+            cks = load_routing_table(directory, "cks", rank, c)
+            if not cks or len(cks) % max_ranks:
+                raise ValueError(f"bad cks table for rank {rank} ch {c}")
+            p = len(cks) // max_ranks
+            ckr = load_routing_table(directory, "ckr", rank, c)
+            if len(ckr) != 2 * p:
+                raise ValueError(f"bad ckr table for rank {rank} ch {c}")
+            if ports is None:
+                ports = p
+            elif ports != p:
+                raise ValueError("inconsistent port counts across tables")
+        return ports or 0
+    rc = lib.smi_bootstrap_rank(directory.encode(), rank, channels, max_ranks)
+    if rc < 0:
+        raise ValueError(
+            f"bootstrap failed for rank {rank} in {directory} (code {rc})"
+        )
+    return rc
+
+
+def extract_manifest(paths: Sequence[str],
+                     p2p_rendezvous: bool = True,
+                     validate: bool = True) -> List[SmiOperation]:
+    """Run the native manifest tool over user sources.
+
+    Returns the discovered operations; raises ``RuntimeError`` with the
+    tool's diagnostics on validation failure (port conflicts,
+    non-constant ports — the errors the reference rewriter pipeline
+    surfaces at build time).
+    """
+    if not manifest_tool_available():
+        raise FileNotFoundError(
+            f"{_MANIFEST_BIN} not built; run `make -C native`"
+        )
+    cmd = [_MANIFEST_BIN]
+    if not p2p_rendezvous:
+        cmd.append("--no-rendezvous")
+    if not validate:
+        cmd.append("--no-validate")
+    cmd.extend(paths)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    ops = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        data = json.loads(line)
+        kwargs = {}
+        if data["type"] == "reduce":
+            kwargs["op"] = data.get("args", {}).get("op_type", "add")
+        ops.append(
+            make_operation(
+                data["type"], port=data["port"],
+                dtype=data.get("data_type", "int"),
+                buffer_size=data.get("buffer_size"), **kwargs,
+            )
+        )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "smi-manifest failed:\n" + proc.stderr.strip()
+        )
+    return ops

@@ -1,0 +1,50 @@
+"""Microbenchmark harness smoke tests (fake mesh, tiny sizes).
+
+Like the reference's emulator runs, these validate the measurement code
+path and the payload-verifying math, not performance.
+"""
+
+import numpy as np
+import pytest
+
+from smi_tpu.benchmarks.micro import BENCHMARKS, run_benchmark
+from smi_tpu.benchmarks.stats import Measurement
+
+
+def test_all_benchmarks_run(comm8, tmp_path):
+    params = {
+        "bandwidth": {"size_kb": 8, "runs": 2},
+        "latency": {"pingpongs": 4, "runs": 2},
+        "injection": {"messages": 4, "runs": 2},
+        "broadcast": {"elements": 256, "runs": 2},
+        "reduce": {"elements": 256, "runs": 2, "root": 3},
+        "scatter": {"elements": 64, "runs": 2},
+        "gather": {"elements": 64, "runs": 2},
+        "multi_collectives": {"elements": 128, "runs": 2},
+        "pipeline": {"elements": 224, "rounds": 2, "runs": 2},
+    }
+    assert set(params) == set(BENCHMARKS)
+    for name, p in params.items():
+        m = run_benchmark(name, comm=comm8, out_dir=str(tmp_path), **p)
+        assert len(m.samples) == 2
+        assert m.mean > 0
+        assert (tmp_path / f"{m.name}.dat").exists()
+        assert (tmp_path / f"{m.name}.json").exists()
+
+
+def test_pipeline_eager_mode(comm8):
+    m = run_benchmark("pipeline", comm=comm8, elements=112, rounds=2,
+                      runs=2, rendezvous=False)
+    assert m.name == "pipeline-eager"
+
+
+def test_unknown_benchmark_rejected(comm8):
+    with pytest.raises(KeyError, match="unknown benchmark"):
+        run_benchmark("warp-speed", comm=comm8)
+
+
+def test_measurement_stats():
+    m = Measurement("x", "s", [1.0, 2.0, 3.0])
+    assert m.mean == 2.0
+    assert np.isclose(m.stddev, 1.0)
+    assert m.ci99 > 0

@@ -138,3 +138,17 @@ def test_mixed_p2p_and_collective(comm8):
     # sum over ranks of (rank values shifted) = sum 0..7 = 28
     for r in range(8):
         np.testing.assert_allclose(out[r], np.full(4, 28.0))
+
+
+def test_collective_root_out_of_range_rejected(comm8):
+    """Out-of-range roots must raise, not silently return zeros
+    (code-review regression)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    with pytest.raises(ValueError, match="root=8"):
+        @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+        def app(ctx, x):
+            return ctx.bcast(x, root=8)[None]
+
+        app(jnp.zeros(4, jnp.float32))

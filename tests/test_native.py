@@ -1,0 +1,154 @@
+"""Native-layer tests: manifest tool goldens + runtime library bindings.
+
+Reference: ``codegen/tests/test_rewriter.py`` drives the compiled Clang
+tool as a subprocess over fixture sources and asserts the emitted op
+list; here the same tier drives ``smi-manifest``. The runtime tests
+round-trip binary routing tables through the C library and cross-check
+against the Python routing writer.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+import smi_tpu as smi
+from smi_tpu.ops.operations import Push, Pop, Reduce
+from smi_tpu.utils import native
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+
+pytestmark = pytest.mark.skipif(
+    not (native.native_available() and native.manifest_tool_available()),
+    reason="native components not built (run `make -C native`)",
+)
+
+
+# ---------------------------------------------------------------- tool --
+
+
+def run_manifest(tmp_path, source, extra_args=()):
+    src = tmp_path / "prog.py"
+    src.write_text(source)
+    bin_path = os.path.join(NATIVE, "build", "smi-manifest")
+    return subprocess.run(
+        [bin_path, *extra_args, str(src)], capture_output=True, text=True
+    )
+
+
+def test_manifest_extracts_ops(tmp_path):
+    proc = run_manifest(
+        tmp_path,
+        """
+import smi_tpu as smi
+ops = [smi.Push(0, "float", 2048), smi.Pop(0, "float", 2048),
+       smi.Reduce(2, "double", op="max")]
+def app(ctx, x):
+    ch = ctx.open_channel(port=1, src=0, dst=3, count=64, dtype="int")
+    y = ctx.transfer(ch, x)
+    return ctx.bcast(y, root=0, port=3)
+""",
+    )
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    kinds = [l.split('"')[3] for l in lines]
+    assert kinds == ["push", "pop", "reduce", "push", "pop", "broadcast"]
+    assert '"op_type": "max"' in lines[2]
+
+
+def test_manifest_via_python_wrapper(tmp_path):
+    src = tmp_path / "prog.py"
+    src.write_text('ops = [Push(0, "float"), Pop(0, "float")]\n')
+    ops = native.extract_manifest([str(src)])
+    assert ops == [Push(0, "float"), Pop(0, "float")]
+    # the extracted ops build a valid Program directly
+    prog = smi.Program(ops)
+    assert prog.logical_port_count == 1
+
+
+def test_manifest_rejects_duplicate_port(tmp_path):
+    proc = run_manifest(
+        tmp_path, 'a = Push(0, "float")\nb = Push(0, "int")\n'
+    )
+    assert proc.returncode == 1
+    assert "claimed twice" in proc.stderr
+
+
+def test_manifest_rejects_non_literal_port(tmp_path):
+    proc = run_manifest(tmp_path, "p = 3\nx = Push(p)\n")
+    assert proc.returncode == 1
+    assert "not an integer literal" in proc.stderr
+
+
+def test_manifest_rejects_unknown_dtype(tmp_path):
+    proc = run_manifest(tmp_path, 'x = Push(0, "quaternion")\n')
+    assert proc.returncode == 1
+    assert "unknown dtype" in proc.stderr
+
+
+def test_manifest_eager_mode_relaxes_ctrl_conflicts(tmp_path):
+    # Push(0) + Pop-credit collision only exists under rendezvous; two
+    # pushes on distinct ports plus pops are fine either way, but a
+    # Broadcast(0)+Push(0) clash is caught in both modes.
+    proc = run_manifest(
+        tmp_path, 'a = Push(0, "float")\nb = Broadcast(0, "float")\n'
+    )
+    assert proc.returncode == 1
+
+
+def test_manifest_skips_comments_and_strings(tmp_path):
+    proc = run_manifest(
+        tmp_path,
+        '# Push(9, "float")\ns = "Pop(8)"\nx = Push(1, "short")\n',
+    )
+    assert proc.returncode == 0
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1 and '"port": 1' in lines[0]
+
+
+# ------------------------------------------------------------- runtime --
+
+
+def test_runtime_version():
+    assert native.runtime_version().startswith("smi_tpu-runtime")
+
+
+def test_runtime_timers_monotonic():
+    a = native.time_usecs()
+    b = native.time_usecs()
+    assert b >= a
+    assert native.time_nsecs() > 0
+
+
+def test_routing_table_round_trip(tmp_path):
+    entries = [0, 1, 2, 250, 7, 7, 0, 1]
+    native.store_routing_table(str(tmp_path), "cks", 3, 1, entries)
+    loaded = native.load_routing_table(str(tmp_path), "cks", 3, 1)
+    assert loaded == entries
+
+
+def test_load_missing_table_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        native.load_routing_table(str(tmp_path), "cks", 0, 0)
+
+
+def test_bootstrap_against_python_writer(tmp_path):
+    """The C library bootstraps from tables written by the Python routing
+    layer — the cross-language format contract."""
+    from smi_tpu.parallel.routing import write_routing_tables
+    from tests.test_routing import make_topology
+
+    program = smi.Program([Push(0), Pop(0), Push(1), Pop(1)])
+    topo = make_topology({("NA:0", 1): ("NB:0", 1)}, program)
+    write_routing_tables(tmp_path, topo)
+
+    for rank in (0, 1):
+        ports = native.bootstrap_rank(
+            str(tmp_path), rank, channels=4, max_ranks=2
+        )
+        assert ports == 2
+
+
+def test_bootstrap_missing_rank_fails(tmp_path):
+    with pytest.raises(ValueError):
+        native.bootstrap_rank(str(tmp_path), 5, channels=4, max_ranks=2)

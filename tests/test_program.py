@@ -139,3 +139,9 @@ def test_program_mapping_rank_order():
     mapping = ProgramMapping(programs=[pa, pb], device_to_program=d)
     assert [str(x) for x in mapping.devices] == ["a:0", "a:1", "b:0"]
     assert mapping.rank_of(Device("b", 0)) == 2
+
+
+def test_empty_program_has_one_port():
+    # reference: max(..., default=0)+1 (codegen/program.py:107) — idle
+    # MPMD ranks still get non-empty routing tables
+    assert Program([]).logical_port_count == 1
