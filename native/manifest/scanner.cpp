@@ -63,6 +63,7 @@ class Lexer {
       size_t start = ++pos_;
       while (pos_ < src_.size() && src_[pos_] != quote) {
         if (src_[pos_] == '\\') pos_++;
+        if (pos_ < src_.size() && src_[pos_] == '\n') line_++;
         pos_++;
       }
       t.type = Token::String;
@@ -255,8 +256,7 @@ ScanResult scan_source(const std::string& source,
       }
       op.port = static_cast<int>(*port);
 
-      if (const Arg* d = find_arg(args, is_ctor ? "dtype" : "dtype",
-                                  is_ctor ? 1 : -1)) {
+      if (const Arg* d = find_arg(args, "dtype", is_ctor ? 1 : -1)) {
         if (auto ds = as_string(*d)) {
           if (kDtypes.count(*ds) == 0) {
             result.errors.push_back(filename + ":" +
@@ -303,8 +303,18 @@ ScanResult scan_source(const std::string& source,
           Operation op;
           op.port = static_cast<int>(*port);
           op.line = call_line;
-          if (const Arg* d = find_arg(args, "dtype", -1))
-            if (auto ds = as_string(*d)) op.dtype = *ds;
+          if (const Arg* d = find_arg(args, "dtype", -1)) {
+            if (auto ds = as_string(*d)) {
+              if (kDtypes.count(*ds) == 0) {
+                result.errors.push_back(filename + ":" +
+                                        std::to_string(call_line) +
+                                        ": unknown dtype '" + *ds + "'");
+                tok = lex.next();
+                continue;
+              }
+              op.dtype = *ds;
+            }
+          }
           if (const Arg* b = find_arg(args, "buffer_size", -1))
             if (auto bi = as_int(*b)) op.buffer_size = *bi;
           if (name != "open_receive_channel") {

@@ -1,0 +1,285 @@
+"""The build-time command-line toolchain.
+
+Reference parity: ``codegen/main.py`` (click CLI with ``codegen-device``,
+``codegen-host``, ``route``) plus ``codegen/topology_file_generator.py``.
+The TPU pipeline keeps the same stages with new emission targets:
+
+- ``manifest`` — the ``codegen-device`` front half: drive the native
+  analysis tool (``native/build/smi-manifest``, the source-rewriter
+  equivalent) over user sources, validate the discovered operations, and
+  write the program-metadata JSON. Device code generation has no TPU
+  analog: JAX monomorphizes ports/dtypes at trace time.
+- ``route`` — identical role to the reference's ``route``: topology JSON +
+  program metadata → binary per-rank routing tables + a hostfile
+  (``codegen/main.py:107-133``).
+- ``host`` — the ``codegen-host`` analog: emit a host bootstrap module
+  with one ``SmiInit_<program>()`` per program (reference
+  ``templates/host_hlslib.cl:7-91``), which validates routing tables and
+  returns a communicator + program.
+- ``topology`` — generate a bus-topology file for testing
+  (``codegen/topology_file_generator.py``).
+
+Usage::
+
+    python -m smi_tpu manifest app.py -o build/app.json
+    python -m smi_tpu route cluster.json build/smi-routes build/app.json
+    python -m smi_tpu host build/smi_generated_host.py build/app.json
+    python -m smi_tpu topology -n 8 -p app -f cluster.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from smi_tpu.ops.program import Program, ProgramMapping
+from smi_tpu.ops.serialization import (
+    parse_program,
+    parse_topology_file,
+    serialize_program,
+)
+
+
+def write_nodefile(topology, stream) -> None:
+    """MPI-hostfile-style rank map (``codegen/common.py:15-19`` parity):
+    one line per rank, host node first, sorted by rank."""
+    for rank, device in enumerate(topology.devices):
+        stream.write(f"{device.node}  # {device}, rank{rank}\n")
+
+
+def cmd_manifest(args: argparse.Namespace) -> int:
+    from smi_tpu.utils.native import extract_manifest, manifest_tool_available
+
+    if not manifest_tool_available():
+        print(
+            "error: native manifest tool not built; run `make -C native`",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        ops = extract_manifest(
+            args.sources,
+            p2p_rendezvous=not args.no_rendezvous,
+            validate=not args.no_validate,
+        )
+    except RuntimeError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    ops = sorted(ops, key=lambda op: op.port)
+    try:
+        program = Program(
+            ops,
+            consecutive_reads=args.consecutive_read_limit,
+            max_ranks=args.max_ranks,
+            p2p_rendezvous=not args.no_rendezvous,
+        )
+    except ValueError as e:  # PortConflict and friends
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    text = serialize_program(program)
+    if args.output:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(args.output)), exist_ok=True
+        )
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+def cmd_route(args: argparse.Namespace) -> int:
+    from smi_tpu.parallel.routing import (
+        NoRouteFound,
+        build_routing_context,
+        write_routing_tables,
+    )
+
+    try:
+        with open(args.topology) as f:
+            topology = parse_topology_file(
+                f.read(), program_paths=args.metadata,
+                ignore_programs=not args.metadata,
+            )
+        ctx = build_routing_context(topology)
+        write_routing_tables(args.dest_dir, topology, ctx)
+    except (NoRouteFound, KeyError, OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    with open(os.path.join(args.dest_dir, "hostfile"), "w") as f:
+        write_nodefile(topology, f)
+    return 0
+
+
+_HOST_TEMPLATE = '''"""Generated host bootstrap — do not edit.
+
+One ``SmiInit_<program>()`` per program, the TPU analog of the generated
+``smi_generated_host.c`` (reference ``codegen/templates/host_hlslib.cl``):
+validates the rank's binary routing tables, builds the communicator, and
+returns it with the program metadata.
+"""
+
+import json
+
+from smi_tpu.ops.serialization import parse_program
+from smi_tpu.parallel.mesh import make_communicator
+from smi_tpu.utils.native import bootstrap_rank
+
+
+def _init(program_json, rank, ranks, routing_dir, devices=None, channels=4):
+    program = parse_program(program_json)
+    if routing_dir is not None:
+        # egress tables are sized by the actual rank count of the routed
+        # topology (one row per destination rank), not the program's
+        # compile-time max_ranks bound
+        ports = bootstrap_rank(
+            routing_dir, rank, channels=channels, max_ranks=ranks,
+        )
+        if ports < program.logical_port_count:
+            raise ValueError(
+                f"routing tables sized for {ports} ports but program "
+                f"declares {program.logical_port_count}"
+            )
+    comm = make_communicator(ranks, devices=devices)
+    return comm, program
+'''
+
+_HOST_FN_TEMPLATE = '''
+
+_PROGRAM_{name} = r"""{program_json}"""
+
+
+def SmiInit_{name}(rank, ranks, routing_dir=None, devices=None, channels=4):
+    """Bootstrap rank ``rank`` of ``{name}`` (ref host_hlslib.cl:8-91)."""
+    return _init(_PROGRAM_{name}, rank, ranks, routing_dir,
+                 devices=devices, channels=channels)
+'''
+
+
+def cmd_host(args: argparse.Namespace) -> int:
+    parts = [_HOST_TEMPLATE]
+    seen = set()
+    for path in args.metadata:
+        name = os.path.splitext(os.path.basename(path))[0]
+        if not name.isidentifier():
+            print(
+                f"error: program name {name!r} is not a valid identifier",
+                file=sys.stderr,
+            )
+            return 1
+        if name in seen:
+            print(
+                f"error: duplicate program name {name!r}", file=sys.stderr
+            )
+            return 1
+        seen.add(name)
+        with open(path) as f:
+            program_json = f.read().strip()
+        parse_program(program_json)  # validate before emitting
+        parts.append(
+            _HOST_FN_TEMPLATE.format(name=name, program_json=program_json)
+        )
+    out_dir = os.path.dirname(os.path.abspath(args.host_src))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(args.host_src, "w") as f:
+        f.write("".join(parts))
+    return 0
+
+
+def cmd_topology(args: argparse.Namespace) -> int:
+    n, programs = args.n, args.programs
+    if n < len(programs):
+        print(
+            "error: the number of devices must be >= the number of programs",
+            file=sys.stderr,
+        )
+        return 1
+    device_programs = {
+        f"device-{i}:0": programs[i % len(programs)] for i in range(n)
+    }
+    connections = {}
+    # bus: link 0 of device i wired to link 1 of device i+1
+    # (codegen/topology_file_generator.py's shape)
+    for i in range(n - 1):
+        connections[f"device-{i}:0:ch0"] = f"device-{i + 1}:0:ch1"
+    if args.ring and n > 1:
+        connections[f"device-{n - 1}:0:ch0"] = "device-0:0:ch1"
+    data = {"fpgas": device_programs, "connections": connections}
+    with open(args.file, "w") as f:
+        json.dump(data, f, indent=4, separators=(",", ": "))
+        f.write("\n")
+    return 0
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    from smi_tpu.benchmarks.__main__ import main as bench_main
+
+    return bench_main(args.rest)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m smi_tpu",
+        description="smi_tpu build-time toolchain (codegen/main.py parity)",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser(
+        "manifest",
+        help="scan user sources for channel ops; write program JSON",
+    )
+    p.add_argument("sources", nargs="+", help="user source files to scan")
+    p.add_argument("-o", "--output", help="program JSON path (default stdout)")
+    p.add_argument("--consecutive-read-limit", type=int, default=8)
+    p.add_argument("--max-ranks", type=int, default=8)
+    p.add_argument("--no-rendezvous", action="store_true",
+                   help="compile P2P channels for the eager protocol")
+    p.add_argument("--no-validate", action="store_true",
+                   help="skip port-conflict validation")
+    p.set_defaults(fn=cmd_manifest)
+
+    p = sub.add_parser(
+        "route", help="write binary routing tables + hostfile"
+    )
+    p.add_argument("topology", help="topology JSON (connections + programs)")
+    p.add_argument("dest_dir", help="output directory for tables + hostfile")
+    p.add_argument("metadata", nargs="*",
+                   help="program metadata JSON files (basename = name)")
+    p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser(
+        "host", help="emit the host bootstrap module (codegen-host analog)"
+    )
+    p.add_argument("host_src", help="path of the generated Python module")
+    p.add_argument("metadata", nargs="+",
+                   help="program metadata JSON files (basename = name)")
+    p.set_defaults(fn=cmd_host)
+
+    p = sub.add_parser(
+        "topology", help="generate a bus-topology JSON for testing"
+    )
+    p.add_argument("-n", type=int, required=True, help="number of devices")
+    p.add_argument("-p", dest="programs", nargs="+", required=True,
+                   help="program names to assign round-robin")
+    p.add_argument("-f", dest="file", required=True, help="output file")
+    p.add_argument("--ring", action="store_true",
+                   help="close the bus into a ring")
+    p.set_defaults(fn=cmd_topology)
+
+    p = sub.add_parser("bench", help="run a microbenchmark")
+    p.add_argument("rest", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_bench)
+
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

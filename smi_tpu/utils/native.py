@@ -140,11 +140,18 @@ def bootstrap_rank(directory: str, rank: int, channels: int = 4,
     if lib is None:
         ports = None
         for c in range(channels):
-            cks = load_routing_table(directory, "cks", rank, c)
+            try:
+                cks = load_routing_table(directory, "cks", rank, c)
+                ckr = load_routing_table(directory, "ckr", rank, c)
+            except FileNotFoundError as e:
+                # match the native path's contract: missing tables are a
+                # bootstrap ValueError, not an IO error
+                raise ValueError(
+                    f"bootstrap failed for rank {rank} in {directory}: {e}"
+                ) from e
             if not cks or len(cks) % max_ranks:
                 raise ValueError(f"bad cks table for rank {rank} ch {c}")
             p = len(cks) // max_ranks
-            ckr = load_routing_table(directory, "ckr", rank, c)
             if len(ckr) != 2 * p:
                 raise ValueError(f"bad ckr table for rank {rank} ch {c}")
             if ports is None:
@@ -181,6 +188,10 @@ def extract_manifest(paths: Sequence[str],
         cmd.append("--no-validate")
     cmd.extend(paths)
     proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            "smi-manifest failed:\n" + proc.stderr.strip()
+        )
     ops = []
     for line in proc.stdout.splitlines():
         line = line.strip()
@@ -196,9 +207,5 @@ def extract_manifest(paths: Sequence[str],
                 dtype=data.get("data_type", "int"),
                 buffer_size=data.get("buffer_size"), **kwargs,
             )
-        )
-    if proc.returncode != 0:
-        raise RuntimeError(
-            "smi-manifest failed:\n" + proc.stderr.strip()
         )
     return ops

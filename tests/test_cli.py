@@ -1,0 +1,269 @@
+"""CLI toolchain tests + golden-file checks of generated artifacts.
+
+Reference: ``codegen/tests/test_codegen.py`` byte-compares generated files
+against goldens in ``tests/data/`` and saves a ``*.fail`` next to the
+golden on mismatch (``conftest.py:80-99``); the CLI itself is
+``codegen/main.py``. Here the generated artifacts are the program JSON,
+the binary routing tables, and the host bootstrap module.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import smi_tpu.__main__ as cli
+from smi_tpu.ops.serialization import parse_program
+from smi_tpu.utils.native import manifest_tool_available
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+APP_SOURCE = '''\
+import smi_tpu as smi
+
+def kernel(ctx, x):
+    ch = ctx.open_channel(port=0, src=0, dst=1, count=64, dtype="float",
+                          buffer_size=17)
+    got = ctx.transfer(ch, x)
+    r = ctx.reduce(got, op="max", port=1)
+    return ctx.bcast(r, root=0, port=2)
+'''
+
+
+def check_golden(name: str, produced: bytes) -> None:
+    """Byte-compare ``produced`` against ``tests/data/<name>``; on mismatch
+    write ``tests/data/<name>.fail`` for inspection (reference
+    ``codegen/tests/conftest.py:80-99``)."""
+    path = os.path.join(DATA_DIR, name)
+    with open(path, "rb") as f:
+        expected = f.read()
+    if produced != expected:
+        with open(path + ".fail", "wb") as f:
+            f.write(produced)
+        raise AssertionError(
+            f"golden mismatch for {name}; produced saved to {name}.fail"
+        )
+
+
+@pytest.fixture()
+def app_source(tmp_path):
+    src = tmp_path / "app.py"
+    src.write_text(APP_SOURCE)
+    return str(src)
+
+
+def run_cli(*argv) -> int:
+    return cli.main(list(argv))
+
+
+# ---------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------
+
+def test_topology_bus(tmp_path):
+    out = tmp_path / "topo.json"
+    assert run_cli("topology", "-n", "4", "-p", "app", "-f", str(out)) == 0
+    data = json.loads(out.read_text())
+    assert len(data["fpgas"]) == 4
+    assert all(v == "app" for v in data["fpgas"].values())
+    # bus: n-1 directed entries
+    assert len(data["connections"]) == 3
+    assert data["connections"]["device-0:0:ch0"] == "device-1:0:ch1"
+
+
+def test_topology_ring_closes_bus(tmp_path):
+    out = tmp_path / "ring.json"
+    assert run_cli("topology", "-n", "4", "-p", "a", "--ring",
+                   "-f", str(out)) == 0
+    data = json.loads(out.read_text())
+    assert data["connections"]["device-3:0:ch0"] == "device-0:0:ch1"
+
+
+def test_topology_more_programs_than_devices_fails(tmp_path, capsys):
+    out = tmp_path / "topo.json"
+    assert run_cli("topology", "-n", "1", "-p", "a", "b",
+                   "-f", str(out)) == 1
+    assert "must be >=" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------
+
+needs_tool = pytest.mark.skipif(
+    not manifest_tool_available(), reason="smi-manifest not built"
+)
+
+
+@needs_tool
+def test_manifest_extracts_program(tmp_path, app_source):
+    out = tmp_path / "app.json"
+    assert run_cli("manifest", app_source, "-o", str(out)) == 0
+    program = parse_program(out.read_text())
+    kinds = sorted((op.NAME, op.port) for op in program.operations)
+    assert kinds == [
+        ("broadcast", 2), ("pop", 0), ("push", 0), ("reduce", 1)
+    ]
+    push = program.find("push", 0)
+    assert push.dtype.value == "float"
+    assert push.buffer_size == 17
+
+
+@needs_tool
+def test_manifest_golden(tmp_path, app_source):
+    out = tmp_path / "app.json"
+    assert run_cli("manifest", app_source, "-o", str(out)) == 0
+    check_golden("cli-program.json", out.read_bytes())
+
+
+@needs_tool
+def test_manifest_port_conflict_fails(tmp_path, capsys):
+    src = tmp_path / "bad.py"
+    src.write_text(
+        "def k(ctx, x):\n"
+        "    return ctx.bcast(x, port=3) + ctx.reduce(x, port=3)\n"
+    )
+    assert run_cli("manifest", str(src), "-o", str(tmp_path / "o.json")) == 1
+    assert "port 3" in capsys.readouterr().err
+
+
+@needs_tool
+def test_manifest_no_validate_still_fails_cleanly(tmp_path, capsys):
+    src = tmp_path / "bad.py"
+    src.write_text(
+        "def k(ctx, x):\n"
+        "    return ctx.bcast(x, port=3) + ctx.reduce(x, port=3)\n"
+    )
+    # --no-validate lets the tool pass, but Program still validates:
+    # the CLI surfaces the PortConflict as a failure, not a traceback
+    assert run_cli("manifest", str(src), "--no-validate",
+                   "-o", str(tmp_path / "o.json")) == 1
+    assert "port 3" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# route
+# ---------------------------------------------------------------------
+
+@pytest.fixture()
+def routed(tmp_path, app_source):
+    """Run topology → manifest(or golden) → route; return the dest dir."""
+    topo = tmp_path / "cluster.json"
+    assert run_cli("topology", "-n", "4", "-p", "app", "-f", str(topo)) == 0
+    meta = tmp_path / "app.json"
+    with open(os.path.join(DATA_DIR, "cli-program.json"), "rb") as f:
+        meta.write_bytes(f.read())
+    dest = tmp_path / "smi-routes"
+    assert run_cli("route", str(topo), str(dest), str(meta)) == 0
+    return dest
+
+
+def test_route_writes_tables_and_hostfile(routed):
+    files = sorted(os.listdir(routed))
+    assert "hostfile" in files
+    for rank in range(4):
+        for ch in range(4):
+            assert f"cks-rank{rank}-channel{ch}" in files
+            assert f"ckr-rank{rank}-channel{ch}" in files
+    lines = (routed / "hostfile").read_text().splitlines()
+    assert lines[0] == "device-0  # device-0:0, rank0"
+    assert len(lines) == 4
+
+
+def test_route_tables_bootstrap(routed):
+    from smi_tpu.utils.native import bootstrap_rank
+
+    for rank in range(4):
+        # egress rows = actual topology rank count (4), not max_ranks
+        ports = bootstrap_rank(str(routed), rank, channels=4, max_ranks=4)
+        assert ports == 3  # ports 0..2 declared by the program
+
+
+def test_route_golden_tables(routed):
+    blob = bytearray()
+    for rank in range(4):
+        for kind in ("cks", "ckr"):
+            for ch in range(4):
+                with open(routed / f"{kind}-rank{rank}-channel{ch}", "rb") as f:
+                    blob += f.read()
+    check_golden("cli-routes.bin", bytes(blob))
+
+
+def test_route_unknown_program_fails(tmp_path, capsys):
+    topo = tmp_path / "cluster.json"
+    assert run_cli("topology", "-n", "2", "-p", "ghost",
+                   "-f", str(topo)) == 0
+    assert run_cli("route", str(topo), str(tmp_path / "routes"),
+                   str(tmp_path / "nonexistent.json")) == 1
+    assert "ghost" in capsys.readouterr().err
+
+
+def test_route_missing_topology_fails(tmp_path, capsys):
+    assert run_cli("route", str(tmp_path / "nope.json"),
+                   str(tmp_path / "routes")) == 1
+    assert "error:" in capsys.readouterr().err
+
+
+def test_host_duplicate_program_name(tmp_path, capsys):
+    a = tmp_path / "app.json"
+    b = tmp_path / "sub" / "app.json"
+    os.makedirs(b.parent)
+    for p in (a, b):
+        p.write_text('{"operations": []}')
+    assert run_cli("host", str(tmp_path / "h.py"), str(a), str(b)) == 1
+    assert "duplicate" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# host
+# ---------------------------------------------------------------------
+
+def test_host_bootstrap_module(tmp_path, routed, eight_devices):
+    meta = tmp_path / "app.json"
+    host_src = tmp_path / "smi_generated_host.py"
+    assert run_cli("host", str(host_src), str(meta)) == 0
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import smi_generated_host as h
+
+        comm, prog = h.SmiInit_app(
+            rank=0, ranks=4, routing_dir=str(routed),
+            devices=eight_devices[:4],
+        )
+        assert comm.size == 4
+        assert prog.logical_port_count == 3
+        # tables sized for fewer ports than the program declares → error
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            h.SmiInit_app(rank=0, ranks=4, routing_dir=str(tmp_path))
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("smi_generated_host", None)
+
+
+def test_host_bad_program_name(tmp_path, capsys):
+    bad = tmp_path / "not-an-identifier.json"
+    bad.write_text("{}")
+    assert run_cli("host", str(tmp_path / "h.py"), str(bad)) == 1
+    assert "identifier" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# module entry point
+# ---------------------------------------------------------------------
+
+def test_python_dash_m_entrypoint(tmp_path):
+    out = tmp_path / "t.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "smi_tpu", "topology", "-n", "2", "-p", "x",
+         "-f", str(out)],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert out.exists()
