@@ -6,6 +6,7 @@ Mirrors the reference benchmark hosts' getopt interface (e.g.
 """
 
 import argparse
+import contextlib
 import os
 import sys
 
@@ -25,6 +26,8 @@ def main(argv=None):
                         help="pipeline: disable rendezvous chunking")
     parser.add_argument("--out-dir", default=None,
                         help="write .dat/.json result files here")
+    parser.add_argument("--trace", default=None, metavar="DIR",
+                        help="collect a JAX profiler trace into DIR")
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend")
     parser.add_argument("--fake-ranks", type=int, default=None,
@@ -54,7 +57,7 @@ def main(argv=None):
 
     for name in names:
         p = dict(params)
-        if name == "bandwidth":
+        if name.startswith("bandwidth"):
             p.pop("root", None)
             p.pop("elements", None)
             if args.size_kb is not None:
@@ -66,7 +69,16 @@ def main(argv=None):
         elif name == "pipeline":
             p.pop("root", None)
             p["rendezvous"] = not args.eager
-        run_benchmark(name, comm=comm, out_dir=args.out_dir, **p)
+        elif name == "pipeline_double_rail":
+            p.pop("root", None)
+        if args.trace:
+            from smi_tpu.utils.tracing import trace
+
+            ctx = trace(args.trace)
+        else:
+            ctx = contextlib.nullcontext()
+        with ctx:
+            run_benchmark(name, comm=comm, out_dir=args.out_dir, **p)
     return 0
 
 

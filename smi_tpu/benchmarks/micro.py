@@ -31,7 +31,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from smi_tpu.benchmarks.stats import Measurement, timed_samples
-from smi_tpu.parallel.channels import P2PChannel, ring_shift
+from smi_tpu.parallel.channels import P2PChannel, ring_shift, stream_concurrent
 from smi_tpu.parallel import collectives as coll
 from smi_tpu.parallel.mesh import Communicator, make_communicator
 
@@ -46,21 +46,36 @@ def _force(fn):
 
 
 def bench_bandwidth(
-    comm: Communicator, size_kb: int = 512, runs: int = 10, repeats: int = 4
+    comm: Communicator, size_kb: int = 512, runs: int = 10, repeats: int = 4,
+    rendezvous: bool = False, buffer_size: int = 2048,
 ) -> Measurement:
-    """Two concurrent P2P channels rank0→rank1; payload Gbit/s."""
+    """Two concurrent P2P channels rank0→rank1; payload Gbit/s.
+
+    ``rendezvous=True`` moves each message in bounded
+    ``buffer_size``-element chunks (the reference's credit protocol,
+    asynchronicity degree 2048 as in ``bandwidth_0.cl:14``);
+    ``False`` is the eager variant (``bandwidth_eager``,
+    ``microbenchmarks/CMakeLists.txt:26``).
+    """
     n = max(1, size_kb * 1024 // 4 // 2)  # floats per channel
     axis = comm.axis_names[0]
 
     def shard_fn(x):
         ch0 = P2PChannel(comm=comm, port=0, src=0, dst=1, count=n,
-                         dtype="float", rendezvous=False)
+                         dtype="float", rendezvous=rendezvous,
+                         buffer_size=buffer_size)
         ch1 = P2PChannel(comm=comm, port=1, src=0, dst=1, count=n,
-                         dtype="float", rendezvous=False)
+                         dtype="float", rendezvous=rendezvous,
+                         buffer_size=buffer_size)
 
         def one(carry, _):
-            a = ch0.transfer(x)
-            b = ch1.transfer(x * 2)
+            if rendezvous:
+                # lockstep chunking keeps the two channels concurrent
+                # (separate .stream calls would serialize their scans)
+                a, b = stream_concurrent((ch0, ch1), (x, x * 2))
+            else:
+                a = ch0.transfer(x)
+                b = ch1.transfer(x * 2)
             return carry + jnp.sum(a) + jnp.sum(b), ()
 
         total, _ = lax.scan(one, jnp.zeros((), jnp.float32), None,
@@ -75,9 +90,20 @@ def bench_bandwidth(
     samples = timed_samples(_force(lambda: fn(x)), runs)
     bytes_moved = 2 * n * 4 * repeats
     gbits = [bytes_moved * 8 / t / 1e9 for t in samples]
-    return Measurement("bandwidth", "Gbit/s", gbits,
+    name = "bandwidth" if rendezvous else "bandwidth-eager"
+    return Measurement(name, "Gbit/s", gbits,
                        {"size_kb": size_kb, "channels": 2,
-                        "repeats": repeats})
+                        "repeats": repeats, "rendezvous": rendezvous})
+
+
+def bench_bandwidth_eager(comm, size_kb: int = 512, runs: int = 10,
+                          repeats: int = 4):
+    return bench_bandwidth(comm, size_kb, runs, repeats, rendezvous=False)
+
+
+def bench_bandwidth_rendezvous(comm, size_kb: int = 512, runs: int = 10,
+                               repeats: int = 4):
+    return bench_bandwidth(comm, size_kb, runs, repeats, rendezvous=True)
 
 
 def bench_latency(
@@ -267,8 +293,46 @@ def bench_pipeline(
                         "rendezvous": rendezvous})
 
 
+def bench_pipeline_double_rail(
+    comm: Communicator, elements: int = 4096, rounds: int = 16,
+    runs: int = 10,
+) -> Measurement:
+    """Ring pipeline with the payload split into two messages per hop.
+
+    Reference ``pipeline_double_rail.cl`` splits each hop's payload over
+    both QSFP rails. ICI has no user-visible rail selection — XLA owns
+    link scheduling — so the TPU rendition sends two *independent*
+    ppermutes per hop (free for XLA to overlap or coalesce onto the
+    available links) and the comparison against :func:`bench_pipeline`
+    measures what the split costs or gains.
+    """
+    axis = comm.axis_names[0]
+    half = elements // 2
+
+    def shard_fn(x):
+        def one(carry, _):
+            a, b = carry[:half], carry[half:]
+            a = ring_shift(a, comm)      # rail 0
+            b = ring_shift(b, comm)      # rail 1 — independent ppermute
+            return jnp.concatenate([a, b]) + 1.0, ()
+
+        out, _ = lax.scan(one, x, None, length=rounds)
+        return jnp.sum(out)[None]
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm.mesh, in_specs=P(), out_specs=P(axis),
+        check_vma=False,
+    ))
+    x = jnp.ones(elements, jnp.float32)
+    samples = timed_samples(_force(lambda: fn(x)), runs)
+    usecs = [t / rounds * 1e6 for t in samples]
+    return Measurement("pipeline-double-rail", "usec/round", usecs,
+                       {"elements": elements, "rounds": rounds, "rails": 2})
+
+
 BENCHMARKS: Dict[str, Callable] = {
-    "bandwidth": bench_bandwidth,
+    "bandwidth": bench_bandwidth_rendezvous,
+    "bandwidth_eager": bench_bandwidth_eager,
     "latency": bench_latency,
     "injection": bench_injection,
     "broadcast": bench_broadcast,
@@ -277,6 +341,7 @@ BENCHMARKS: Dict[str, Callable] = {
     "gather": bench_gather,
     "multi_collectives": bench_multi_collectives,
     "pipeline": bench_pipeline,
+    "pipeline_double_rail": bench_pipeline_double_rail,
 }
 
 

@@ -8,7 +8,9 @@ three communication patterns (SURVEY §2.7/§2.10):
 - :mod:`smi_tpu.models.gesummv` — distributed GESUMMV, operator split
   across two ranks with a streamed combine (tensor parallelism),
 - :mod:`smi_tpu.models.kmeans` — data-parallel K-means with Reduce+Bcast
-  collectives inside the iteration loop (data parallelism).
+  collectives inside the iteration loop (data parallelism),
+- :mod:`smi_tpu.models.onchip` — single-device baselines of stencil and
+  GESUMMV (the reference's ``*_onchip`` variants).
 
 Each module carries a pure-numpy reference implementation used by the
 tests, as the reference verifies against serial CPU code

@@ -179,6 +179,69 @@ class P2PChannel:
         return received, carry
 
 
+def stream_concurrent(
+    channels: Sequence[P2PChannel],
+    datas: Sequence[jax.Array],
+) -> Tuple[jax.Array, ...]:
+    """Move several P2P messages chunk-by-chunk *in lockstep*.
+
+    One ``lax.scan`` advances every channel by one chunk per step, so the
+    per-step ppermutes are independent ops XLA can overlap — the TPU
+    expression of the reference's concurrent channels sharing the NoC
+    (``bandwidth_0.cl``'s two app kernels pushing simultaneously).
+    ``Channel.stream`` per channel would instead lower to back-to-back
+    scans, serializing the transfers.
+
+    All channels must agree on message count and chunk size (the
+    benchmark shape). Returns the received message per channel.
+    """
+    if len(channels) != len(datas):
+        raise ValueError("one data array per channel required")
+    if not channels:
+        return ()
+    counts = {ch.count for ch in channels}
+    chunks = {min(ch.chunk_elements, ch.count) for ch in channels}
+    if len(counts) != 1 or len(chunks) != 1:
+        raise ValueError(
+            "concurrent streaming requires equal message/chunk sizes; got "
+            f"counts {sorted(counts)}, chunks {sorted(chunks)}"
+        )
+    count, chunk = counts.pop(), chunks.pop()
+    datas = tuple(
+        jnp.asarray(d, ch.jnp_dtype) for ch, d in zip(channels, datas)
+    )
+    for ch, d in zip(channels, datas):
+        ch._check_length(d)
+
+    axes_perms = [(ch._axis(), ch._perm()) for ch in channels]
+
+    def step(carry, xs):
+        outs = tuple(
+            lax.ppermute(x, axis, perm)
+            for (axis, perm), x in zip(axes_perms, xs)
+        )
+        return carry, outs
+
+    n_full = count // chunk
+    tail = count - n_full * chunk
+    parts = [[] for _ in channels]
+    if n_full:
+        stacked = tuple(
+            d[: n_full * chunk].reshape((n_full, chunk) + d.shape[1:])
+            for d in datas
+        )
+        _, received = lax.scan(step, (), stacked)
+        for i, r in enumerate(received):
+            parts[i].append(r.reshape((n_full * chunk,) + datas[i].shape[1:]))
+    if tail:
+        _, tails = step((), tuple(d[n_full * chunk:] for d in datas))
+        for i, r in enumerate(tails):
+            parts[i].append(r)
+    return tuple(
+        p[0] if len(p) == 1 else jnp.concatenate(p) for p in parts
+    )
+
+
 def ring_shift(
     x: jax.Array,
     comm: Communicator,

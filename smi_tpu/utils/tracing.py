@@ -1,0 +1,69 @@
+"""Profiling/tracing: the TPU equivalent of the reference's host timing.
+
+The reference measures with hlslib kernel-event futures
+(``bandwidth_benchmark.cpp:144-162``) and wall-clock helpers
+(``include/utils/utils.hpp:10-23``), plus offline aoc area reports. On
+TPU the device-side story is the JAX profiler: traces open in
+XProf/TensorBoard and show the ICI collectives, Pallas kernels, and the
+HBM/VMEM picture the FPGA reports approximated.
+
+- :func:`trace` — context manager writing an XPlane trace directory.
+- :func:`annotate` — named region visible on the trace timeline (the
+  analog of per-kernel event naming).
+- :func:`timed` — wall-clock timing of a callable with completion forced
+  by readback, returning (result, seconds); the host-side
+  ``current_time_usecs`` bracket pattern every benchmark host uses.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, host_tracer_level: Optional[int] = None) -> Iterator[None]:
+    """Collect a profiler trace of the enclosed block into ``log_dir``.
+
+    View with TensorBoard's profile plugin or xprof. ``host_tracer_level``
+    is forwarded to the profiler options when given.
+    """
+    options = None
+    if host_tracer_level is not None:
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(log_dir, profiler_options=options)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named timeline region: ``with annotate("halo-exchange"): ...``.
+
+    Also usable as a decorator via ``jax.profiler.annotate_function``
+    semantics; inside jit the annotation attaches to the traced op's
+    metadata.
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def timed(fn: Callable[[], Any]) -> Tuple[Any, float]:
+    """Run ``fn`` and return (result, elapsed seconds).
+
+    Completion is forced with a host readback of every array leaf (not
+    ``block_until_ready``, which tunneled backends can resolve before
+    execution finishes — see ``smi_tpu.benchmarks.stats``), so on-device
+    async dispatch doesn't fake a fast time — the role of the reference's
+    event-completion waits.
+    """
+    import numpy as np
+
+    t0 = time.perf_counter()
+    result = fn()
+    jax.tree_util.tree_map(np.asarray, result)
+    return result, time.perf_counter() - t0

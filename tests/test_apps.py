@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 import smi_tpu as smi
-from smi_tpu.models import gesummv, kmeans, stencil
+from smi_tpu.models import gesummv, kmeans, onchip, stencil
 from smi_tpu.parallel.halo import halo_exchange_2d, pad_with_halos
 
 
@@ -114,6 +114,49 @@ def test_kmeans_matches_reference(eight_devices):
     out = kmeans.run_kmeans(points, init, 10, devices=eight_devices)
     ref = kmeans.reference_kmeans(points, init, 10)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_stencil_onchip_matches_distributed(eight_devices):
+    """The single-device baseline and the 8-rank SMI variant agree —
+    the reference's onchip-vs-smi comparison (``examples/CMakeLists``)."""
+    grid = stencil.initial_grid(16, 32)
+    grid[:, -1] = 2.0
+    dist = stencil.run_stencil(
+        jnp.asarray(grid), 6, px=2, py=4, devices=eight_devices
+    )
+    base = onchip.run_stencil_onchip(grid, 6)
+    np.testing.assert_allclose(
+        np.asarray(dist), np.asarray(base), rtol=1e-6, atol=1e-6
+    )
+
+
+def test_gesummv_onchip_matches_distributed(eight_devices):
+    rng = np.random.RandomState(7)
+    a = rng.rand(64, 64).astype(np.float32)
+    b = rng.rand(64, 64).astype(np.float32)
+    x = rng.rand(64).astype(np.float32)
+    dist = gesummv.run_gesummv(
+        a, b, x, alpha=2.0, beta=0.25, devices=eight_devices
+    )
+    base = onchip.run_gesummv_onchip(a, b, x, alpha=2.0, beta=0.25)
+    np.testing.assert_allclose(
+        np.asarray(dist), np.asarray(base), rtol=2e-4
+    )
+
+
+def test_onchip_baselines_match_numpy():
+    grid = stencil.initial_grid(32, 32)
+    out = np.asarray(onchip.run_stencil_onchip(grid, 4))
+    ref = stencil.reference_stencil(grid, 4)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+    rng = np.random.RandomState(1)
+    a, b = rng.rand(2, 48, 48).astype(np.float32)
+    x = rng.rand(48).astype(np.float32)
+    y = np.asarray(onchip.run_gesummv_onchip(a, b, x, alpha=1.5, beta=0.5))
+    np.testing.assert_allclose(
+        y, gesummv.reference_gesummv(a, b, x, 1.5, 0.5), rtol=2e-4
+    )
 
 
 def test_kmeans_indivisible_points_rejected(eight_devices):

@@ -22,6 +22,8 @@ def test_all_benchmarks_run(comm8, tmp_path):
         "gather": {"elements": 64, "runs": 2},
         "multi_collectives": {"elements": 128, "runs": 2},
         "pipeline": {"elements": 224, "rounds": 2, "runs": 2},
+        "bandwidth_eager": {"size_kb": 8, "runs": 2},
+        "pipeline_double_rail": {"elements": 224, "rounds": 2, "runs": 2},
     }
     assert set(params) == set(BENCHMARKS)
     for name, p in params.items():
@@ -41,6 +43,28 @@ def test_pipeline_eager_mode(comm8):
 def test_unknown_benchmark_rejected(comm8):
     with pytest.raises(KeyError, match="unknown benchmark"):
         run_benchmark("warp-speed", comm=comm8)
+
+
+def test_bandwidth_rendezvous_vs_eager(comm8):
+    r = run_benchmark("bandwidth", comm=comm8, size_kb=8, runs=2)
+    e = run_benchmark("bandwidth_eager", comm=comm8, size_kb=8, runs=2)
+    assert r.name == "bandwidth" and r.config["rendezvous"] is True
+    assert e.name == "bandwidth-eager" and e.config["rendezvous"] is False
+
+
+def test_tracing_helpers(comm8, tmp_path):
+    import jax.numpy as jnp
+
+    from smi_tpu.utils.tracing import annotate, timed, trace
+
+    with trace(str(tmp_path / "tb")):
+        with annotate("smoke-region"):
+            out, secs = timed(lambda: jnp.arange(16.0) * 2)
+    assert secs >= 0
+    assert float(out[2]) == 4.0
+    # a trace directory with at least one event file was written
+    produced = list((tmp_path / "tb").rglob("*"))
+    assert produced, "profiler trace wrote nothing"
 
 
 def test_measurement_stats():

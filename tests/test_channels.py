@@ -163,3 +163,42 @@ def test_channel_zero_count_rejected(comm8):
     ctx = smi.SmiContext(comm8)
     with pytest.raises(ValueError, match="count"):
         ctx.open_channel(port=0, src=0, dst=1, count=0, dtype="float")
+
+
+def test_stream_concurrent_two_channels(comm8):
+    """Lockstep chunked streaming on two channels: exact payloads at each
+    dst, zeros elsewhere (the bandwidth benchmark's transfer shape)."""
+    from smi_tpu.parallel.channels import P2PChannel, stream_concurrent
+
+    n = 300  # not a multiple of the chunk -> exercises the tail step
+
+    def shard_fn(x):
+        ch0 = P2PChannel(comm=comm8, port=0, src=0, dst=1, count=n,
+                         dtype="float", buffer_size=64)
+        ch1 = P2PChannel(comm=comm8, port=1, src=0, dst=2, count=n,
+                         dtype="float", buffer_size=64)
+        a, b = stream_concurrent((ch0, ch1), (x, x * 2))
+        return jnp.stack([a, b])[None]
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm8.mesh, in_specs=P(), out_specs=P("smi"),
+        check_vma=False,
+    ))
+    x = jnp.arange(n, dtype=jnp.float32)
+    out = np.asarray(fn(x))  # (8, 2, n)
+    np.testing.assert_array_equal(out[1][0], np.asarray(x))
+    np.testing.assert_array_equal(out[2][1], 2 * np.asarray(x))
+    np.testing.assert_array_equal(out[1][1], 0)
+    np.testing.assert_array_equal(out[2][0], 0)
+    np.testing.assert_array_equal(out[3], 0)
+
+
+def test_stream_concurrent_mismatched_sizes_rejected(comm8):
+    from smi_tpu.parallel.channels import P2PChannel, stream_concurrent
+
+    ch0 = P2PChannel(comm=comm8, port=0, src=0, dst=1, count=64,
+                     dtype="float")
+    ch1 = P2PChannel(comm=comm8, port=1, src=0, dst=2, count=32,
+                     dtype="float")
+    with pytest.raises(ValueError, match="equal message/chunk"):
+        stream_concurrent((ch0, ch1), (jnp.zeros(64), jnp.zeros(32)))
