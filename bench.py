@@ -41,9 +41,13 @@ def main():
         shape=(px, py), axis_names=("sx", "sy"), devices=devices
     )
     from smi_tpu.kernels import stencil as kstencil
+    from smi_tpu.kernels import stencil_temporal as ktemporal
 
     block_h, block_w = x // px, y // py
-    if kstencil.pallas_supported(block_h, block_w, jnp.float32):
+    if ktemporal.temporal_supported(block_h, block_w, jnp.float32):
+        # k sweeps per HBM pass (temporal blocking) — the fast path
+        fn = ktemporal.make_temporal_stencil_fn(comm, iters, x, y, depth=8)
+    elif kstencil.pallas_supported(block_h, block_w, jnp.float32):
         fn = kstencil.make_fused_stencil_fn(comm, iters, x, y)
     else:
         fn = stencil.make_stencil_fn(comm, iterations=iters)

@@ -1,0 +1,307 @@
+"""Temporally-blocked Jacobi: k sweeps per HBM pass.
+
+The single-sweep fused kernel (:mod:`smi_tpu.kernels.stencil`) is
+HBM-bound: every sweep reads and writes the whole block (~8 B/cell). This
+kernel applies the classic trapezoid/temporal-blocking transform — the
+same overlap-the-halo idea the reference exploits spatially with its
+bridge kernels (``examples/kernels/stencil_smi.cl:236-386``), extended in
+time:
+
+- halos are exchanged ``k`` deep and *corner-complete* (two-phase
+  exchange, so diagonal-neighbour values arrive via the vertical
+  neighbours — the 4-point stencil's k-sweep dependency cone is the
+  Manhattan ball of radius k);
+- each row-stripe is loaded into VMEM once, ``k`` full sweeps run over a
+  (stripe + 2k)-row working tile whose valid region shrinks by one ring
+  per sweep, and the stripe's final rows are written back — ``k`` sweeps
+  for one read + one write of the block;
+- the Dirichlet global-boundary mask is re-applied every sweep from
+  global coordinates, so results are bit-identical to k serial sweeps.
+
+Stripes ride the standard one-step software pipeline (stripe *i* is
+fetched while stripe *i-1* computes); the working tile itself is the
+pipeline carry — its centre is refilled with the just-fetched stripe at
+the end of each step, so no separate previous-stripe buffer is needed and
+the stripe can be twice as tall within the ~16 MB VMEM budget.
+
+The distributed state stays in an *extended layout* ``(H, W+256)`` across
+passes — 128 lanes of padding per side holding the k halo columns plus
+dead zero lanes — so only the k-wide halo columns are refreshed between
+passes (two narrow in-place updates), not rebuilt with a full-width
+concatenate. The 120 dead lanes per side sit inside the shrink margin and
+never reach valid output.
+
+Sweeps-per-pass ``k`` plays the reference's "asynchronicity degree" role
+(``rewrite.py:26-33``): a buffer-depth knob trading working-set size for
+fewer round trips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from smi_tpu.parallel.halo import halo_exchange_2d_corners
+from smi_tpu.parallel.mesh import Communicator
+
+#: lane padding per side of the extended array (one full register tile)
+LANE_PAD = 128
+
+#: VMEM budget for stripe selection. Live rows ≈ 2 input + 2 output
+#: stripe buffers, the working tile, the k-row tail, and ~3 working-tile-
+#: sized stack temporaries inside the unrolled sweep chain (measured via
+#: Mosaic's scoped-vmem accounting); keep the total under ~16 MB.
+VMEM_BYTES_TARGET = 14_000_000
+
+
+def _pick_stripe(h: int, w: int, depth: int) -> Optional[int]:
+    """Largest divisor of ``h``: multiple of 8, ≥ depth, VMEM-fitting."""
+    lane_bytes = (w + 2 * LANE_PAD) * 4
+    for t in range(h, 7, -1):
+        if h % t or t % 8 or t < depth:
+            continue
+        rows_live = 4 * t + 4 * (t + 2 * depth) + depth
+        if rows_live * lane_bytes <= VMEM_BYTES_TARGET:
+            return t
+    return None
+
+
+def temporal_supported(h: int, w: int, dtype, depth: int = 8) -> bool:
+    return (
+        dtype == jnp.float32
+        and depth >= 1
+        and depth % 8 == 0
+        and depth <= LANE_PAD
+        and w % 128 == 0
+        and _pick_stripe(h, w, depth) is not None
+    )
+
+
+def _temporal_kernel(
+    offs_ref,    # scalar prefetch: [row0, col0] of this block
+    x_ref,       # (T, W+256) one stripe of the extended block
+    top_ref,     # (k, W+256) corner-complete halo above, pre-padded
+    bottom_ref,  # (k, W+256) below
+    o_ref,       # (T, W+256) output stripe (for the previous grid step)
+    a_ref,       # scratch: (T+2k, W+256) working tile / pipeline carry
+    tail_ref,    # scratch: last k rows of the stripe before the carried one
+    *,
+    tile: int,
+    width: int,   # W (unpadded)
+    depth: int,
+    gh: int,
+    gw: int,
+):
+    i = pl.program_id(0)
+    n = pl.num_programs(0) - 1  # number of stripes
+    t, k = tile, depth
+    wp = width + 2 * LANE_PAD
+    cur = x_ref[...]
+
+    @pl.when(i > 0)
+    def _compute():
+        j = i - 1
+        # The tile centre already carries stripe j (set at the end of the
+        # previous step); add the k boundary rows above and below.
+        @pl.when(j == 0)
+        def _top_edge():
+            a_ref[0:k, :] = top_ref[...]
+
+        @pl.when(j > 0)
+        def _top_interior():
+            a_ref[0:k, :] = tail_ref[...]
+
+        @pl.when(j == n - 1)
+        def _bottom_edge():
+            a_ref[t + k : t + 2 * k, :] = bottom_ref[...]
+
+        @pl.when(j < n - 1)
+        def _bottom_interior():
+            a_ref[t + k : t + 2 * k, :] = cur[0:k, :]
+
+        # ---- sweep-invariant Dirichlet masks from global coordinates ----
+        # (n, 1)/(1, m) shapes broadcast inside the selects, avoiding
+        # full-tile int32 temporaries.
+        g_row = (
+            offs_ref[0] + j * t - k
+            + lax.broadcasted_iota(jnp.int32, (t + 2 * k, 1), 0)
+        )
+        g_col = (
+            offs_ref[1] - LANE_PAD
+            + lax.broadcasted_iota(jnp.int32, (1, wp), 1)
+        )
+        row_b = (g_row == 0) | (g_row == gh - 1)
+        col_b = (g_col == 0) | (g_col == gw - 1)
+        # one boundary mask per stripe, amortized over the k sweeps
+        boundary = row_b | col_b
+
+        # ---- k sweeps in VMEM; valid region shrinks one ring each ----
+        val = a_ref[...]
+        for _ in range(k):
+            avg = 0.25 * (
+                pltpu.roll(val, 1, axis=0)
+                + pltpu.roll(val, t + 2 * k - 1, axis=0)
+                + pltpu.roll(val, 1, axis=1)
+                + pltpu.roll(val, wp - 1, axis=1)
+            )
+            val = jnp.where(boundary, val, avg)
+        o_ref[...] = val[k : t + k, :]
+
+    # Rotate the pipeline: save the carried stripe's last k rows as the
+    # next step's upper boundary, then refill the centre with the stripe
+    # fetched this step.
+    tail_ref[...] = a_ref[t : t + k, :]
+    a_ref[k : t + k, :] = cur
+
+
+def _temporal_pass_ext(
+    xext: jax.Array,
+    comm: Communicator,
+    gh: int,
+    gw: int,
+    depth: int,
+    interpret: bool,
+) -> jax.Array:
+    """One k-sweep pass over the extended-layout state ``(H, W+256)``."""
+    row_axis, col_axis = comm.axis_names
+    h, wp = xext.shape
+    w = wp - 2 * LANE_PAD
+    k = depth
+    t = _pick_stripe(h, w, k)
+    if t is None:
+        raise ValueError(f"no VMEM-fitting stripe for block ({h}, {w})")
+    n = h // t
+
+    # --- corner-complete halo refresh; only halo-width slices move ---
+    # (XLA fuses the block view into the ppermute operands, so no full
+    # copy of the centre columns is materialized)
+    halos = halo_exchange_2d_corners(
+        xext[:, LANE_PAD : LANE_PAD + w], comm, depth=k
+    )
+    xext = lax.dynamic_update_slice(xext, halos.left, (0, LANE_PAD - k))
+    xext = lax.dynamic_update_slice(xext, halos.right, (0, LANE_PAD + w))
+    zrow = jnp.zeros((k, LANE_PAD - k), xext.dtype)
+    top_ext = jnp.concatenate([zrow, halos.top, zrow], axis=1)
+    bottom_ext = jnp.concatenate([zrow, halos.bottom, zrow], axis=1)
+
+    rx = lax.axis_index(row_axis)
+    cy = lax.axis_index(col_axis)
+    offs = jnp.stack([rx * h, cy * w]).astype(jnp.int32)
+
+    kernel = functools.partial(
+        _temporal_kernel, tile=t, width=w, depth=k, gh=gh, gw=gw
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        # one extra step drains the pipeline (stripe j computes at j+1)
+        grid=(n + 1,),
+        in_specs=[
+            pl.BlockSpec(
+                (t, wp),
+                lambda i, offs: (jnp.minimum(i, n - 1), 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (t, wp),
+            lambda i, offs: (jnp.maximum(i - 1, 0), 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((t + 2 * k, wp), jnp.float32),
+            pltpu.VMEM((k, wp), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((h, wp), xext.dtype),
+        interpret=interpret,
+    )(offs, xext, top_ext, bottom_ext)
+
+
+def temporal_pass(
+    block: jax.Array,
+    comm: Communicator,
+    gh: int,
+    gw: int,
+    depth: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """``depth`` fused sweeps over a plain ``(H, W)`` block (one pass)."""
+    h, w = block.shape
+    zcols = jnp.zeros((h, LANE_PAD), block.dtype)
+    xext = jnp.concatenate([zcols, block, zcols], axis=1)
+    out = _temporal_pass_ext(xext, comm, gh, gw, depth, interpret)
+    return out[:, LANE_PAD : LANE_PAD + w]
+
+
+def make_temporal_stencil_fn(
+    comm: Communicator,
+    iterations: int,
+    gh: int,
+    gw: int,
+    depth: int = 8,
+    interpret: bool = False,
+):
+    """Jitted distributed stencil at ``depth`` sweeps per memory pass.
+
+    The state stays in extended layout across passes, so per pass only
+    the halo columns/rows move between ranks and the block is touched by
+    exactly one kernel read and one write. ``iterations`` need not divide
+    evenly: the remainder runs on the single-sweep fused kernel (or the
+    jnp sweep where that is unsupported).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from smi_tpu.kernels import stencil as kstencil
+    from smi_tpu.models.stencil import jacobi_step_block
+
+    row_axis, col_axis = comm.axis_names
+    spec = P(row_axis, col_axis)
+    full, rem = divmod(iterations, depth)
+
+    def shard_fn(block):
+        h, w = block.shape
+        b = block
+        if full:
+            zcols = jnp.zeros((h, LANE_PAD), block.dtype)
+            xe = jnp.concatenate([zcols, block, zcols], axis=1)
+            xe = lax.fori_loop(
+                0,
+                full,
+                lambda _, x: _temporal_pass_ext(
+                    x, comm, gh, gw, depth, interpret
+                ),
+                xe,
+            )
+            b = xe[:, LANE_PAD : LANE_PAD + w]
+        if rem and kstencil.pallas_supported(h, w, block.dtype):
+            b = lax.fori_loop(
+                0,
+                rem,
+                lambda _, x: kstencil.jacobi_step_block_fused(
+                    x, comm, gh, gw, interpret=interpret
+                ),
+                b,
+            )
+        elif rem:
+            b = lax.fori_loop(
+                0, rem, lambda _, x: jacobi_step_block(x, comm), b
+            )
+        return b
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=comm.mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+    )

@@ -55,9 +55,12 @@ def shift_along(
 
 
 class Halos(NamedTuple):
-    """Received one-deep halo slabs around a 2-D block (zeros at domain
-    edges). Shapes: top/bottom ``(h, w)`` with ``h`` = halo depth,
-    left/right ``(H, h)``."""
+    """Received halo slabs around a 2-D block (zeros at domain edges).
+
+    Shapes depend on the producer: from :func:`halo_exchange_2d`,
+    top/bottom are ``(depth, W)``; from :func:`halo_exchange_2d_corners`,
+    top/bottom are ``(depth, W+2·depth)`` (side-halo columns included).
+    left/right are ``(H, depth)`` from both."""
 
     top: jax.Array
     bottom: jax.Array
@@ -96,6 +99,50 @@ def halo_exchange_2d(
     bottom = shift_along(block[:depth, :], row_axis, nrow, -1, ring)
     left = shift_along(block[:, -depth:], col_axis, ncol, +1, ring)
     right = shift_along(block[:, :depth], col_axis, ncol, -1, ring)
+    return Halos(top=top, bottom=bottom, left=left, right=right)
+
+
+def halo_exchange_2d_corners(
+    block: jax.Array,
+    comm: Communicator,
+    depth: int = 1,
+    ring: bool = False,
+) -> Halos:
+    """Corner-complete ``depth``-deep halo exchange (two-phase).
+
+    :func:`halo_exchange_2d` leaves the four ``depth × depth`` corner
+    patches unknown — enough for one sweep of a 4-point stencil, but a
+    *k-sweep* temporal block depends on the full Manhattan ball of radius
+    k, corners included. The standard two-phase scheme fills them with no
+    extra neighbours: first the left/right column slabs move, then the
+    top/bottom slabs are sent *including the just-received side halos*
+    (width ``W+2·depth``), so diagonal values arrive via the vertical
+    neighbour — two dependent ppermute rounds, the same trick as the
+    reference routing packets through an intermediate device
+    (``ckr.cl:50-60``).
+
+    Returns ``top``/``bottom`` of shape ``(depth, W+2·depth)`` (halo
+    columns included) and ``left``/``right`` of shape ``(H, depth)``.
+    """
+    if len(comm.axis_names) != 2:
+        raise ValueError(
+            f"halo_exchange_2d_corners needs a 2-axis communicator, got "
+            f"axes {comm.axis_names}"
+        )
+    row_axis, col_axis = comm.axis_names
+    nrow = comm.mesh.shape[row_axis]
+    ncol = comm.mesh.shape[col_axis]
+    d = depth
+
+    left = shift_along(block[:, -d:], col_axis, ncol, +1, ring)
+    right = shift_along(block[:, :d], col_axis, ncol, -1, ring)
+    # phase 2: only the edge rows of the side-extended array move
+    ext_top = jnp.concatenate([left[:d], block[:d], right[:d]], axis=1)
+    ext_bottom = jnp.concatenate(
+        [left[-d:], block[-d:], right[-d:]], axis=1
+    )
+    top = shift_along(ext_bottom, row_axis, nrow, +1, ring)
+    bottom = shift_along(ext_top, row_axis, nrow, -1, ring)
     return Halos(top=top, bottom=bottom, left=left, right=right)
 
 
