@@ -55,7 +55,13 @@ from smi_tpu.ops.operations import (
     Gather,
     OP_REGISTRY,
 )
-from smi_tpu.ops.program import Program, Device, ProgramMapping, allocate_ports
+from smi_tpu.ops.program import (
+    Program,
+    Device,
+    ProgramMapping,
+    allocate_ports,
+    combined_program,
+)
 from smi_tpu.ops.serialization import (
     parse_program,
     serialize_program,
@@ -89,6 +95,7 @@ __all__ = [
     "Device",
     "ProgramMapping",
     "allocate_ports",
+    "combined_program",
     "parse_program",
     "serialize_program",
     "parse_topology_file",

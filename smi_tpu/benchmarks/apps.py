@@ -1,0 +1,130 @@
+"""Application benchmarks: the reference's example workloads, timed.
+
+Reference hosts time their kernels and verify results in the same run
+(``examples/host/stencil_smi.cpp:316-340``, ``gesummv_smi.cpp``,
+``kmeans_smi.cpp``); these do the same — each measurement verifies the
+payload against the serial reference before reporting.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from smi_tpu.benchmarks.stats import Measurement, timed_samples
+from smi_tpu.parallel.mesh import Communicator
+
+
+def _grid_for(comm: Communicator):
+    """Factor a 1-axis communicator's devices into a 2-D mesh."""
+    from smi_tpu.parallel.mesh import make_communicator
+
+    n = comm.size
+    px = max(d for d in range(1, int(n**0.5) + 1) if n % d == 0)
+    return make_communicator(
+        shape=(px, n // px), axis_names=("sx", "sy"),
+        devices=list(comm.mesh.devices.flat),
+    )
+
+
+def bench_stencil(
+    comm: Communicator, size: int = 1024, iterations: int = 32,
+    runs: int = 5,
+) -> Measurement:
+    """Distributed Jacobi throughput (cells/s); verified once vs serial."""
+    from smi_tpu.kernels import stencil_temporal as kt
+    from smi_tpu.models import stencil
+
+    comm2d = _grid_for(comm)
+    px, py = comm2d.axis_sizes
+    if size % px or size % py:
+        raise ValueError(
+            f"grid {size}x{size} not divisible by process grid "
+            f"({px}, {py}); pick a size divisible by both"
+        )
+    h, w = size // px, size // py
+    if kt.temporal_supported(h, w, jnp.float32, depth=8) and iterations >= 8:
+        fn = kt.make_temporal_stencil_fn(comm2d, iterations, size, size)
+    else:
+        fn = stencil.make_stencil_fn(comm2d, iterations)
+    g = jnp.asarray(stencil.initial_grid(size, size))
+
+    out = np.asarray(fn(g))
+    ref = stencil.reference_stencil(np.asarray(g), iterations)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    samples = timed_samples(lambda: np.asarray(jnp.sum(fn(g))), runs)
+    rates = [size * size * iterations / t / 1e9 for t in samples]
+    return Measurement(
+        "app-stencil", "Gcell/s", rates,
+        {"size": size, "iterations": iterations,
+         "mesh": f"{px}x{py}"},
+    )
+
+
+def bench_gesummv(
+    comm: Communicator, n: int = 1024, runs: int = 5
+) -> Measurement:
+    """2-rank GESUMMV GFLOP/s (2 matvecs = 4n² flops); verified."""
+    from smi_tpu.models import gesummv
+    from smi_tpu.parallel.mesh import make_communicator
+
+    devices = list(comm.mesh.devices.flat)
+    if len(devices) < 2:
+        raise ValueError(
+            "app_gesummv is the 2-rank MPMD workload "
+            "(gesummv_rank{0,1}.cl); it needs at least 2 devices"
+        )
+    comm_tp = make_communicator(2, devices=devices[:2])
+    rng = np.random.RandomState(0)
+    a = rng.rand(n, n).astype(np.float32)
+    b = rng.rand(n, n).astype(np.float32)
+    x = rng.rand(n).astype(np.float32)
+    ab = jnp.stack([jnp.asarray(a), jnp.asarray(b)])
+    xj = jnp.asarray(x)
+    fn = gesummv.make_gesummv_fn(comm_tp, n, 1.5, 0.5)
+
+    out = np.asarray(fn(ab, xj))
+    ref = gesummv.reference_gesummv(a, b, x, 1.5, 0.5)
+    np.testing.assert_allclose(out, ref, rtol=2e-3)
+
+    samples = timed_samples(lambda: np.asarray(jnp.sum(fn(ab, xj))), runs)
+    rates = [4 * n * n / t / 1e9 for t in samples]
+    return Measurement("app-gesummv", "GFLOP/s", rates, {"n": n})
+
+
+def bench_kmeans(
+    comm: Communicator, points: int = 65536, k: int = 8, dims: int = 2,
+    iterations: int = 10, runs: int = 5,
+) -> Measurement:
+    """Data-parallel K-means iteration rate; verified vs serial."""
+    from smi_tpu.models import kmeans
+
+    points -= points % comm.size
+    rng = np.random.RandomState(0)
+    pts = rng.rand(points, dims).astype(np.float32)
+    init = pts[:k].copy()
+    fn = kmeans.make_kmeans_fn(comm, iterations=iterations)
+    pts_j, init_j = jnp.asarray(pts), jnp.asarray(init)
+
+    out = np.asarray(fn(pts_j, init_j))
+    ref = kmeans.reference_kmeans(pts, init, iterations)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    samples = timed_samples(
+        lambda: np.asarray(jnp.sum(fn(pts_j, init_j))), runs
+    )
+    rates = [points * iterations / t / 1e6 for t in samples]
+    return Measurement(
+        "app-kmeans", "Mpoint-iters/s", rates,
+        {"points": points, "k": k, "dims": dims,
+         "iterations": iterations},
+    )
+
+
+APP_BENCHMARKS = {
+    "app_stencil": bench_stencil,
+    "app_gesummv": bench_gesummv,
+    "app_kmeans": bench_kmeans,
+}

@@ -344,6 +344,11 @@ BENCHMARKS: Dict[str, Callable] = {
     "pipeline_double_rail": bench_pipeline_double_rail,
 }
 
+# application-level benchmarks join the same registry
+from smi_tpu.benchmarks.apps import APP_BENCHMARKS  # noqa: E402
+
+BENCHMARKS.update(APP_BENCHMARKS)
+
 
 def run_benchmark(name: str, comm: Optional[Communicator] = None,
                   out_dir: Optional[str] = None, **params) -> Measurement:

@@ -1,0 +1,139 @@
+"""Ring attention: sequence-parallel attention over a device ring.
+
+The reference has no attention (SURVEY §2.10), but its scaling substrate
+for a too-large domain — neighbour streaming fully overlapped with
+compute (``pipeline.cl:16-31``, the stencil bridge kernels) — is exactly
+the ring-attention schedule: shard the sequence across the mesh axis,
+keep Q local, and circulate K/V blocks around the ring with one
+``ppermute`` per step while accumulating attention online. This module
+supplies that capability as a first-class model on the framework's
+primitives (``ring_shift`` inside ``shard_map``), so a sequence ``n``×
+longer than one chip's memory is attended at full exactness.
+
+The accumulation is the numerically-stable online softmax (running
+row-max ``m``, normalizer ``l``, weighted value sum ``acc``) — streamed
+consumption of in-flight data, the same shape as ``P2PChannel.stream``'s
+consumer overlap. Causality is enforced from *global* positions, so the
+result is bit-comparable to full attention on the gathered sequence.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from smi_tpu.parallel.channels import ring_shift
+from smi_tpu.parallel.mesh import Communicator
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, acc, q_off, k_off, causal, scale):
+    """Fold one K/V block into the online-softmax state.
+
+    q: (Sq, H, D); k/v: (Sk, H, D); m/l: (H, Sq); acc: (Sq, H, D).
+    ``q_off``/``k_off`` are the blocks' global sequence offsets.
+    """
+    scores = jnp.einsum("qhd,khd->hqk", q, k) * scale  # (H, Sq, Sk)
+    if causal:
+        sq, sk = q.shape[0], k.shape[0]
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        scores = jnp.where(k_pos[None] > q_pos[None], NEG_INF, scores)
+    m_new = jnp.maximum(m, scores.max(axis=-1))        # (H, Sq)
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])             # (H, Sq, Sk)
+    l_new = l * correction + p.sum(axis=-1)
+    acc_new = (
+        acc * correction.transpose(1, 0)[..., None]
+        + jnp.einsum("hqk,khd->qhd", p, v)
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention_shard(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    comm: Communicator,
+    causal: bool = False,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """Per-shard ring attention (call inside ``shard_map``).
+
+    ``q``/``k``/``v`` are this rank's ``(S_local, H, D)`` sequence shards.
+    K/V make a full ring circuit (one ``ppermute`` per step, n-1 hops);
+    XLA overlaps each hop with the previous block's attention math — the
+    stencil bridge-kernel overlap, applied to attention.
+    """
+    axis = axis_name or comm.axis_names[0]
+    n = comm.mesh.shape[axis]
+    rank = lax.axis_index(axis)
+    s_local, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    m0 = jnp.full((h, s_local), NEG_INF, q.dtype)
+    l0 = jnp.zeros((h, s_local), q.dtype)
+    acc0 = jnp.zeros_like(q)
+    q_off = rank * s_local
+
+    def step(s, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # the block currently held originated at rank - s (mod n)
+        src = lax.rem(rank - s + jnp.int32(n), jnp.int32(n))
+        m, l, acc = _block_attend(
+            q, k_cur, v_cur, m, l, acc,
+            q_off, src * s_local, causal, scale,
+        )
+        # pass K/V to the right neighbour for the next step
+        k_cur = ring_shift(k_cur, comm, offset=1, axis_name=axis)
+        v_cur = ring_shift(v_cur, comm, offset=1, axis_name=axis)
+        return k_cur, v_cur, m, l, acc
+
+    _, _, m, l, acc = lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
+    # fully-masked rows (possible only without a self-block) normalize to 0
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return acc / safe_l.transpose(1, 0)[..., None]
+
+
+def make_ring_attention_fn(
+    comm: Communicator, causal: bool = False
+):
+    """Jitted sequence-parallel attention over the communicator's axis.
+
+    Takes global ``(S, H, D)`` q/k/v sharded on the sequence dimension;
+    returns the global attention output with the same sharding.
+    """
+    axis = comm.axis_names[0]
+
+    def shard_fn(q, k, v):
+        return ring_attention_shard(q, k, v, comm, causal=causal)
+
+    spec = P(axis)
+    return jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=comm.mesh,
+            in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    )
+
+
+def reference_attention(q, k, v, causal: bool = False) -> np.ndarray:
+    """Full (gathered) attention for verification."""
+    q, k, v = (np.asarray(a, np.float64) for a in (q, k, v))
+    s, _h, d = q.shape
+    scores = np.einsum("qhd,khd->hqk", q, k) / math.sqrt(d)
+    if causal:
+        mask = np.triu(np.ones((s, s), bool), 1)
+        scores = np.where(mask[None], -np.inf, scores)
+    scores -= scores.max(axis=-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("hqk,khd->qhd", p, v)

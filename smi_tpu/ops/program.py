@@ -220,6 +220,46 @@ def allocate_ports(
     return Allocation(stream_of=stream_of, per_stream=per_stream)
 
 
+def combined_program(mapping: "ProgramMapping") -> Program:
+    """Union of every rank's program, for one SPMD trace.
+
+    The reference runs genuinely different bitstreams per rank (MPMD via
+    the routing file's program map, ``bandwidth.json``) and its ``route``
+    step loads *all* program metadata together to build consistent
+    tables (``codegen/main.py:107-133``). Under SPMD one program is
+    traced for all ranks, so the equivalent is the union of the per-rank
+    operation sets: complementary endpoints (rank 0's ``Push(0)``, rank
+    1's ``Pop(0)``) combine into one valid program, while genuine
+    conflicts (two ranks both claiming ``Push(0)`` with different
+    dtypes) fail the joint validation exactly as the reference's table
+    builder would reject them.
+
+    Tuning flags must agree on ``p2p_rendezvous`` (it changes the wire
+    protocol); ``consecutive_reads``/``max_ranks`` take the maximum.
+    """
+    programs = [p for p in mapping.programs if p is not None]
+    if not programs:
+        raise ValueError("mapping contains no programs")
+    rendezvous = {p.p2p_rendezvous for p in programs}
+    if len(rendezvous) > 1:
+        raise ValueError(
+            "MPMD programs disagree on p2p_rendezvous; the protocol must "
+            "be uniform across ranks"
+        )
+    # dedup by the full operation value (frozen dataclass): identical
+    # declarations merge (SPMD), while ops differing in ANY field — dtype,
+    # buffer size, reduce operator — both reach the joint validation
+    seen = dict.fromkeys(
+        op for program in programs for op in program.operations
+    )
+    return Program(
+        list(seen),
+        consecutive_reads=max(p.consecutive_reads for p in programs),
+        max_ranks=max(p.max_ranks for p in programs),
+        p2p_rendezvous=rendezvous.pop(),
+    )
+
+
 @dataclasses.dataclass
 class ProgramMapping:
     """Which program each device runs (SPMD: all the same; MPMD: differ).

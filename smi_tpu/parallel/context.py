@@ -126,6 +126,25 @@ class SmiContext:
         return _coll.gather(x, self.comm, root=root, port=port,
                             all_ranks=all_ranks)
 
+    # -- MPMD: per-rank divergent local compute ------------------------
+    def select(self, branches, operand):
+        """Run ``branches[rank]`` on ``operand`` (rank ≥ len: last one).
+
+        The MPMD primitive: the reference runs a different program per
+        rank via the routing file's program map
+        (``microbenchmarks/kernels/bandwidth.json``); under SPMD the
+        divergence is a ``lax.switch`` on the axis index. Branches must
+        be *communication-free* — collectives and channel transfers are
+        collective operations every rank must execute, so they belong in
+        the shared code around the select (see
+        ``smi_tpu.ops.program.combined_program`` for merging the
+        per-rank programs into the one traced program).
+        """
+        from jax import lax as _lax
+
+        idx = jnp.clip(self.rank(), 0, len(branches) - 1)
+        return _lax.switch(idx, list(branches), operand)
+
 
 def smi_kernel(
     comm: Communicator,

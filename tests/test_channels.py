@@ -16,7 +16,7 @@ import smi_tpu as smi
 from smi_tpu.ops.types import dtype_to_jnp
 
 DTYPES = ["int", "float", "double", "char", "short"]
-LENGTHS = [1, 128, 1024]
+LENGTHS = [1, 128, 1024, 10000]
 RECEIVERS = [1, 4, 7]
 
 

@@ -1,0 +1,114 @@
+"""MPMD-under-SPMD: per-rank program divergence.
+
+Reference: the routing file's program map lets different ranks run
+different bitstreams — sender/receiver in the bandwidth benchmark
+(``microbenchmarks/kernels/bandwidth_0.cl``/``bandwidth_1.cl``,
+``bandwidth.json:2-11``) and the two GESUMMV ranks. Here the same
+capability is ``combined_program`` (one validated union program for the
+SPMD trace) plus ``ctx.select`` (``lax.switch`` on the axis index for
+communication-free local divergence).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import smi_tpu as smi
+from smi_tpu.ops.program import PortConflict, combined_program
+
+
+def _mapping(programs, n=2):
+    devices = [smi.Device("node", i) for i in range(n)]
+    return smi.ProgramMapping(
+        programs=list(programs),
+        device_to_program={
+            d: programs[i % len(programs)] for i, d in enumerate(devices)
+        },
+    )
+
+
+def test_combined_program_complementary_endpoints():
+    sender = smi.Program([smi.Push(0, "float", 256)])
+    receiver = smi.Program([smi.Pop(0, "float", 256)])
+    union = combined_program(_mapping([sender, receiver]))
+    kinds = sorted((op.NAME, op.port) for op in union.operations)
+    assert kinds == [("pop", 0), ("push", 0)]
+
+
+def test_combined_program_dedupes_spmd():
+    prog = smi.Program([smi.Push(1, "int"), smi.Pop(1, "int")])
+    union = combined_program(_mapping([prog, prog]))
+    assert len(union.operations) == 2
+
+
+def test_combined_program_conflict_rejected():
+    a = smi.Program([smi.Broadcast(2, "float")])
+    b = smi.Program([smi.Reduce(2, "float", op="add")])
+    with pytest.raises(PortConflict):
+        combined_program(_mapping([a, b]))
+
+
+def test_combined_program_reduce_op_conflict_rejected():
+    """Reduce ops differing only in the operator must not silently merge."""
+    a = smi.Program([smi.Reduce(3, "float", op="add")])
+    b = smi.Program([smi.Reduce(3, "float", op="max")])
+    with pytest.raises(PortConflict):
+        combined_program(_mapping([a, b]))
+
+
+def test_combined_program_rendezvous_must_agree():
+    a = smi.Program([smi.Push(0, "int")], p2p_rendezvous=True)
+    b = smi.Program([smi.Pop(0, "int")], p2p_rendezvous=False)
+    with pytest.raises(ValueError, match="p2p_rendezvous"):
+        combined_program(_mapping([a, b]))
+
+
+def test_mpmd_bandwidth_pattern(comm8):
+    """Sender/receiver divergence: rank 0 builds the payload, rank 1
+    verifies, everyone else idles — one SPMD program."""
+    n = 64
+    sender = smi.Program([smi.Push(0, "float", 128)])
+    receiver = smi.Program([smi.Pop(0, "float", 128)])
+    union = combined_program(_mapping([sender, receiver], n=8))
+
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), program=union)
+    def app(ctx, x):
+        # local divergence: only the sender scales its payload
+        payload = ctx.select(
+            [lambda v: v * 3.0, lambda v: jnp.zeros_like(v)], x
+        )
+        # shared communication structure: every rank runs the transfer
+        ch = ctx.open_channel(port=0, src=0, dst=1, count=n, dtype="float")
+        received = ctx.transfer(ch, payload)
+        # receiver-side verification mark (bandwidth_1.cl's check)
+        expected = 3.0 * jnp.arange(n, dtype=jnp.float32)
+        ok = ctx.select(
+            [
+                lambda v: jnp.zeros((), jnp.float32),
+                lambda v: jnp.where(
+                    jnp.all(v == expected),
+                    jnp.float32(1.0),
+                    jnp.float32(-1.0),
+                ),
+            ],
+            received,
+        )
+        return jnp.concatenate([received, ok[None]])[None]
+
+    x = jnp.arange(n, dtype=jnp.float32)
+    out = np.asarray(app(x))
+    np.testing.assert_array_equal(out[1][:n], 3.0 * np.asarray(x))
+    assert out[1][n] == 1.0  # receiver verified
+    assert out[0][n] == 0.0  # sender branch
+
+
+def test_mpmd_select_clips_extra_ranks(comm8):
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+    def app(ctx, x):
+        return ctx.select([lambda v: v + 1, lambda v: v * 10], x)[None]
+
+    out = np.asarray(app(jnp.ones(4, jnp.float32)))
+    np.testing.assert_array_equal(out[0], 2.0)
+    for r in range(1, 8):  # ranks >= len(branches) take the last branch
+        np.testing.assert_array_equal(out[r], 10.0)
