@@ -214,6 +214,43 @@ def cmd_topology(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_build(args: argparse.Namespace) -> int:
+    """The ``smi_target()`` pipeline in one call: manifest → route → host.
+
+    Reference: ``CMakeLists.txt:38-196`` wires codegen-device → route →
+    codegen-host per target; here the three stages run back-to-back into
+    one output directory.
+    """
+    if not args.name.isidentifier():
+        print(
+            f"error: program name {args.name!r} is not a valid identifier",
+            file=sys.stderr,
+        )
+        return 1
+    out = args.out_dir
+    program_json = os.path.join(out, f"{args.name}.json")
+    ns = argparse.Namespace(
+        sources=args.sources, output=program_json,
+        consecutive_read_limit=args.consecutive_read_limit,
+        max_ranks=args.max_ranks, no_rendezvous=args.no_rendezvous,
+        no_validate=False,
+    )
+    rc = cmd_manifest(ns)
+    if rc:
+        return rc
+    rc = cmd_route(argparse.Namespace(
+        topology=args.topology,
+        dest_dir=os.path.join(out, "smi-routes"),
+        metadata=[program_json],
+    ))
+    if rc:
+        return rc
+    return cmd_host(argparse.Namespace(
+        host_src=os.path.join(out, "smi_generated_host.py"),
+        metadata=[program_json],
+    ))
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     from smi_tpu.benchmarks.__main__ import main as bench_main
 
@@ -268,6 +305,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ring", action="store_true",
                    help="close the bus into a ring")
     p.set_defaults(fn=cmd_topology)
+
+    p = sub.add_parser(
+        "build",
+        help="manifest + route + host in one call (smi_target parity)",
+    )
+    p.add_argument("topology", help="topology JSON")
+    p.add_argument("sources", nargs="+", help="user source files")
+    p.add_argument("-o", "--out-dir", required=True)
+    p.add_argument("--name", default="program",
+                   help="program name (basename of the metadata JSON)")
+    p.add_argument("--consecutive-read-limit", type=int, default=8)
+    p.add_argument("--max-ranks", type=int, default=8)
+    p.add_argument("--no-rendezvous", action="store_true")
+    p.set_defaults(fn=cmd_build)
 
     p = sub.add_parser("bench", help="run a microbenchmark")
     p.add_argument("rest", nargs=argparse.REMAINDER)

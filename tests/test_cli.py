@@ -267,3 +267,38 @@ def test_python_dash_m_entrypoint(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr
     assert out.exists()
+
+
+@needs_tool
+def test_build_pipeline_end_to_end(tmp_path, app_source, eight_devices):
+    """smi_target parity: one call produces program JSON + tables +
+    hostfile + bootstrap module, and the bootstrap loads them."""
+    topo = tmp_path / "cluster.json"
+    assert run_cli("topology", "-n", "4", "-p", "app", "-f", str(topo)) == 0
+    out = tmp_path / "build"
+    assert run_cli("build", str(topo), app_source,
+                   "-o", str(out), "--name", "app") == 0
+    assert (out / "app.json").exists()
+    assert (out / "smi-routes" / "hostfile").exists()
+    assert (out / "smi_generated_host.py").exists()
+
+    sys.path.insert(0, str(out))
+    try:
+        import smi_generated_host as h
+
+        comm, prog = h.SmiInit_app(
+            rank=0, ranks=4, routing_dir=str(out / "smi-routes"),
+            devices=eight_devices[:4],
+        )
+        assert comm.size == 4 and prog.logical_port_count == 3
+    finally:
+        sys.path.remove(str(out))
+        sys.modules.pop("smi_generated_host", None)
+
+
+def test_build_rejects_bad_name_before_any_stage(tmp_path, capsys):
+    out = tmp_path / "build"
+    assert run_cli("build", str(tmp_path / "t.json"), "x.py",
+                   "-o", str(out), "--name", "my-app") == 1
+    assert "identifier" in capsys.readouterr().err
+    assert not out.exists()  # nothing half-built
