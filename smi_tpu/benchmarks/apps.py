@@ -123,8 +123,55 @@ def bench_kmeans(
     )
 
 
+def bench_ring_attention(
+    comm: Communicator, seq_per_rank: int = 1024, heads: int = 8,
+    head_dim: int = 128, runs: int = 5, causal: bool = True,
+    precision=None,
+) -> Measurement:
+    """Sequence-parallel attention throughput (global tokens/s).
+
+    The long-context workload: each rank holds ``seq_per_rank`` tokens
+    and K/V blocks circulate the ring (``models/ring_attention.py``).
+    A sampled subset of query rows is verified against the reference
+    before timing (full verification is O(S²) host memory, unaffordable
+    at benchmark scale). ``precision`` defaults to HIGHEST (exactness;
+    tight tolerance); pass ``jax.lax.Precision.DEFAULT`` to measure the
+    bf16-operand MXU rate, verified at bf16-level tolerance.
+    """
+    from jax import lax
+
+    from smi_tpu.models import ring_attention as ra
+
+    if precision is None:
+        precision = lax.Precision.HIGHEST
+    n = comm.size
+    s = n * seq_per_rank
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(s, heads, head_dim).astype(np.float32))
+        for _ in range(3)
+    )
+    fn = ra.make_ring_attention_fn(comm, causal=causal, precision=precision)
+
+    out = np.asarray(fn(q, k, v))
+    idx = np.linspace(0, s - 1, num=min(s, 128), dtype=np.int64)
+    ref = ra.reference_attention_rows(q, k, v, idx, causal=causal)
+    tol = 5e-4 if precision == lax.Precision.HIGHEST else 2e-2
+    np.testing.assert_allclose(out[idx], ref, rtol=tol, atol=tol)
+
+    samples = timed_samples(lambda: np.asarray(jnp.sum(fn(q, k, v))), runs)
+    rates = [s / t / 1e6 for t in samples]
+    return Measurement(
+        "app-ring-attention", "Mtoken/s", rates,
+        {"seq": s, "seq_per_rank": seq_per_rank, "heads": heads,
+         "head_dim": head_dim, "causal": causal, "ranks": n,
+         "precision": str(precision)},
+    )
+
+
 APP_BENCHMARKS = {
     "app_stencil": bench_stencil,
     "app_gesummv": bench_gesummv,
     "app_kmeans": bench_kmeans,
+    "app_ring_attention": bench_ring_attention,
 }

@@ -34,13 +34,16 @@ from smi_tpu.parallel.mesh import Communicator
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, m, l, acc, q_off, k_off, causal, scale):
+def _block_attend(q, k, v, m, l, acc, q_off, k_off, causal, scale,
+                  precision):
     """Fold one K/V block into the online-softmax state.
 
     q: (Sq, H, D); k/v: (Sk, H, D); m/l: (H, Sq); acc: (Sq, H, D).
     ``q_off``/``k_off`` are the blocks' global sequence offsets.
     """
-    scores = jnp.einsum("qhd,khd->hqk", q, k) * scale  # (H, Sq, Sk)
+    scores = (
+        jnp.einsum("qhd,khd->hqk", q, k, precision=precision) * scale
+    )  # (H, Sq, Sk)
     if causal:
         sq, sk = q.shape[0], k.shape[0]
         q_pos = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
@@ -52,7 +55,7 @@ def _block_attend(q, k, v, m, l, acc, q_off, k_off, causal, scale):
     l_new = l * correction + p.sum(axis=-1)
     acc_new = (
         acc * correction.transpose(1, 0)[..., None]
-        + jnp.einsum("hqk,khd->qhd", p, v)
+        + jnp.einsum("hqk,khd->qhd", p, v, precision=precision)
     )
     return m_new, l_new, acc_new
 
@@ -64,6 +67,7 @@ def ring_attention_shard(
     comm: Communicator,
     causal: bool = False,
     axis_name: Optional[str] = None,
+    precision=lax.Precision.HIGHEST,
 ) -> jax.Array:
     """Per-shard ring attention (call inside ``shard_map``).
 
@@ -89,7 +93,7 @@ def ring_attention_shard(
         src = lax.rem(rank - s + jnp.int32(n), jnp.int32(n))
         m, l, acc = _block_attend(
             q, k_cur, v_cur, m, l, acc,
-            q_off, src * s_local, causal, scale,
+            q_off, src * s_local, causal, scale, precision,
         )
         # pass K/V to the right neighbour for the next step
         k_cur = ring_shift(k_cur, comm, offset=1, axis_name=axis)
@@ -103,17 +107,23 @@ def ring_attention_shard(
 
 
 def make_ring_attention_fn(
-    comm: Communicator, causal: bool = False
+    comm: Communicator, causal: bool = False,
+    precision=lax.Precision.HIGHEST,
 ):
     """Jitted sequence-parallel attention over the communicator's axis.
 
     Takes global ``(S, H, D)`` q/k/v sharded on the sequence dimension;
     returns the global attention output with the same sharding.
+    ``precision`` defaults to HIGHEST so results verify against full
+    f32 attention (TPU matmuls otherwise round operands to bf16); pass
+    ``lax.Precision.DEFAULT`` to trade exactness for MXU throughput.
     """
     axis = comm.axis_names[0]
 
     def shard_fn(q, k, v):
-        return ring_attention_shard(q, k, v, comm, causal=causal)
+        return ring_attention_shard(
+            q, k, v, comm, causal=causal, precision=precision
+        )
 
     spec = P(axis)
     return jax.jit(
@@ -133,6 +143,23 @@ def reference_attention(q, k, v, causal: bool = False) -> np.ndarray:
     if causal:
         mask = np.triu(np.ones((s, s), bool), 1)
         scores = np.where(mask[None], -np.inf, scores)
+    scores -= scores.max(axis=-1, keepdims=True)
+    p = np.exp(scores)
+    p /= p.sum(axis=-1, keepdims=True)
+    return np.einsum("hqk,khd->qhd", p, v)
+
+
+def reference_attention_rows(q, k, v, rows, causal: bool = False) -> np.ndarray:
+    """Reference attention for a subset of query rows — O(len(rows)·S)
+    host memory, for verification at benchmark scale."""
+    q, k, v = (np.asarray(a, np.float64) for a in (q, k, v))
+    rows = np.asarray(rows)
+    _s, _h, d = q.shape
+    scores = np.einsum("qhd,khd->hqk", q[rows], k) / math.sqrt(d)
+    if causal:
+        k_pos = np.arange(k.shape[0])
+        scores = np.where(k_pos[None, None] > rows[None, :, None],
+                          -np.inf, scores)
     scores -= scores.max(axis=-1, keepdims=True)
     p = np.exp(scores)
     p /= p.sum(axis=-1, keepdims=True)

@@ -1,0 +1,108 @@
+"""Multi-host control plane: process bootstrap from a hostfile.
+
+Reference parity: MPI is the reference's control plane — process launch
+via the generated hostfile (``codegen/common.py:15-19``), rank/size from
+``MPI_Comm_rank/size``, host barriers and bulk staging
+(``bandwidth_benchmark.cpp:24,142-154``). The data plane (the NoC) never
+touches MPI. Here the split is the same: ``jax.distributed`` is the
+control plane that assembles one global device pool from many hosts, and
+the data plane is XLA collectives over ICI/DCN.
+
+Typical multi-host launch (one process per host, any launcher — the
+reference uses ``mpirun``, here anything that sets a process id works)::
+
+    opts = distributed_options("smi-routes/hostfile", process_id=my_id)
+    init_distributed(opts)          # jax.distributed.initialize
+    comm = make_communicator()      # global mesh over all hosts' chips
+
+The hostfile is the one ``python -m smi_tpu route`` writes: one line per
+rank, host node first, ``#`` comments after.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Union
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def parse_hostfile(text: str) -> List[str]:
+    """Hostfile lines → ordered node list (one entry per rank).
+
+    Mirrors the writer (``smi_tpu.__main__.write_nodefile``): node name
+    first, optional ``# device, rank`` comment.
+    """
+    nodes = []
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if line:
+            nodes.append(line)
+    return nodes
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedOptions:
+    """Arguments for ``jax.distributed.initialize``, derived from the
+    hostfile: one JAX process per distinct node, coordinator on the
+    first node."""
+
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+
+    def __post_init__(self):
+        if not (0 <= self.process_id < self.num_processes):
+            raise ValueError(
+                f"process_id {self.process_id} out of range for "
+                f"{self.num_processes} processes"
+            )
+
+
+def distributed_options(
+    hostfile: Union[str, os.PathLike],
+    process_id: Optional[int] = None,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+) -> DistributedOptions:
+    """Derive the multi-host bootstrap arguments from a hostfile.
+
+    ``hostfile`` is a path or the raw text. Distinct nodes become JAX
+    processes in first-appearance order (several ranks/chips on one node
+    stay one process, as the reference packs ``SMI_DEVICES_PER_NODE``
+    FPGAs per host). ``process_id`` defaults to, in order:
+    ``$SMI_PROCESS_ID``, then 0.
+    """
+    text = hostfile
+    if os.path.exists(str(hostfile)):
+        with open(hostfile) as f:
+            text = f.read()
+    nodes = parse_hostfile(str(text))
+    if not nodes:
+        raise ValueError("hostfile lists no nodes")
+    distinct = list(dict.fromkeys(nodes))
+    if process_id is None:
+        process_id = int(os.environ.get("SMI_PROCESS_ID", "0"))
+    return DistributedOptions(
+        coordinator_address=f"{distinct[0]}:{coordinator_port}",
+        num_processes=len(distinct),
+        process_id=process_id,
+    )
+
+
+def init_distributed(opts: DistributedOptions) -> None:
+    """``jax.distributed.initialize`` with the derived options.
+
+    Single-process pools (one node) skip initialization entirely — the
+    local runtime already owns every chip, and initialize() would block
+    waiting for peers.
+    """
+    if opts.num_processes <= 1:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=opts.coordinator_address,
+        num_processes=opts.num_processes,
+        process_id=opts.process_id,
+    )

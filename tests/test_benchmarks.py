@@ -27,6 +27,9 @@ def test_all_benchmarks_run(comm8, tmp_path):
         "app_stencil": {"size": 64, "iterations": 4, "runs": 2},
         "app_gesummv": {"n": 64, "runs": 2},
         "app_kmeans": {"points": 256, "iterations": 2, "runs": 2},
+        "app_ring_attention": {
+            "seq_per_rank": 16, "heads": 2, "head_dim": 16, "runs": 2,
+        },
     }
     assert set(params) == set(BENCHMARKS)
     for name, p in params.items():

@@ -1,0 +1,52 @@
+"""Multi-host bootstrap derivation (control-plane parity: the reference's
+MPI hostfile launch, ``codegen/common.py:15-19``)."""
+
+import pytest
+
+from smi_tpu.parallel.bootstrap import (
+    DistributedOptions,
+    distributed_options,
+    init_distributed,
+    parse_hostfile,
+)
+
+HOSTFILE = """\
+node-a  # node-a:0, rank0
+node-a  # node-a:1, rank1
+node-b  # node-b:0, rank2
+node-c  # node-c:0, rank3
+"""
+
+
+def test_parse_hostfile_orders_and_strips_comments():
+    assert parse_hostfile(HOSTFILE) == ["node-a", "node-a", "node-b", "node-c"]
+
+
+def test_distributed_options_one_process_per_node(tmp_path):
+    path = tmp_path / "hostfile"
+    path.write_text(HOSTFILE)
+    opts = distributed_options(path, process_id=2)
+    assert opts.coordinator_address == "node-a:8476"
+    assert opts.num_processes == 3  # node-a packs two ranks
+    assert opts.process_id == 2
+
+
+def test_distributed_options_from_text_and_env(monkeypatch):
+    monkeypatch.setenv("SMI_PROCESS_ID", "1")
+    opts = distributed_options(HOSTFILE)
+    assert opts.process_id == 1
+
+
+def test_distributed_options_empty_rejected():
+    with pytest.raises(ValueError, match="no nodes"):
+        distributed_options("# only comments\n")
+
+
+def test_process_id_range_checked():
+    with pytest.raises(ValueError, match="out of range"):
+        DistributedOptions("x:1", 2, 5)
+
+
+def test_init_distributed_single_process_noop():
+    # must not call jax.distributed.initialize (which would block)
+    init_distributed(DistributedOptions("solo:8476", 1, 0))

@@ -44,3 +44,11 @@ def test_ring_attention_long_context_exceeds_single_shard(eight_devices):
     out = np.asarray(ra.make_ring_attention_fn(comm, causal=True)(q, k, v))
     ref = ra.reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(out, ref, rtol=5e-5, atol=5e-5)
+
+
+def test_reference_attention_rows_matches_full():
+    q, k, v = _qkv(32, 2, 8, seed=11)
+    rows = np.array([0, 7, 15, 31])
+    full = ra.reference_attention(q, k, v, causal=True)
+    sub = ra.reference_attention_rows(q, k, v, rows, causal=True)
+    np.testing.assert_allclose(sub, full[rows], rtol=1e-12, atol=1e-12)
