@@ -72,6 +72,31 @@ def _pick_stripe(h: int, w: int, depth: int) -> Optional[int]:
     return None
 
 
+def _plan(h: int, w: int, depth: int):
+    """Choose the cheaper kernel shape: full-width short stripes vs
+    column-tiled tall stripes. Returns ("full", t) | ("tiled", (t, wc))
+    | None, minimizing swept area per useful cell."""
+    wp = w + 2 * LANE_PAD
+    candidates = []
+    t_full = _pick_stripe(h, w, depth)
+    if t_full is not None:
+        candidates.append(
+            ((t_full + 2 * depth) / t_full * wp, ("full", t_full))
+        )
+    wc = _pick_col_tile(wp)
+    if wc is not None:
+        t_tiled = _pick_stripe_tiled(h, wc, depth)
+        if t_tiled is not None:
+            n_cols = wp // wc
+            swept = (t_tiled + 2 * depth) / t_tiled * (
+                wp + n_cols * 2 * LANE_PAD
+            )
+            candidates.append((swept, ("tiled", (t_tiled, wc))))
+    if not candidates:
+        return None
+    return min(candidates)[1]
+
+
 def temporal_supported(h: int, w: int, dtype, depth: int = 8) -> bool:
     return (
         dtype == jnp.float32
@@ -79,7 +104,7 @@ def temporal_supported(h: int, w: int, dtype, depth: int = 8) -> bool:
         and depth % 8 == 0
         and depth <= LANE_PAD
         and w % 128 == 0
-        and _pick_stripe(h, w, depth) is not None
+        and _plan(h, w, depth) is not None
     )
 
 
@@ -168,14 +193,25 @@ def _temporal_pass_ext(
     depth: int,
     interpret: bool,
 ) -> jax.Array:
-    """One k-sweep pass over the extended-layout state ``(H, W+256)``."""
+    """One k-sweep pass over the extended-layout state ``(H, W+256)``.
+
+    Dispatches to the cheaper kernel shape: column-tiled tall stripes
+    when the block is wide (less vertical recompute), full-width short
+    stripes otherwise.
+    """
     row_axis, col_axis = comm.axis_names
     h, wp = xext.shape
     w = wp - 2 * LANE_PAD
     k = depth
-    t = _pick_stripe(h, w, k)
-    if t is None:
+    plan = _plan(h, w, k)
+    if plan is None:
         raise ValueError(f"no VMEM-fitting stripe for block ({h}, {w})")
+    if plan[0] == "tiled":
+        t, wc = plan[1]
+        return _temporal_pass_ext_tiled(
+            xext, comm, gh, gw, k, wc, t, interpret
+        )
+    t = plan[1]
     n = h // t
 
     # --- corner-complete halo refresh; only halo-width slices move ---
@@ -226,6 +262,197 @@ def _temporal_pass_ext(
         out_shape=jax.ShapeDtypeStruct((h, wp), xext.dtype),
         interpret=interpret,
     )(offs, xext, top_ext, bottom_ext)
+
+
+def _pick_col_tile(wp: int) -> Optional[int]:
+    """Column-tile width: the largest 128-multiple divisor of ``wp``
+    that is ≤ 2048. Wider tiles mean less horizontal recompute (the two
+    128-lane aprons amortize over more columns), but tile rows must stay
+    small enough that the row stripe can be tall — 2048 lanes keeps a
+    128-row stripe within VMEM (measured sweet spot on v5e; 2816-lane
+    tiles with 64-row stripes time the same, wider regresses). Returns
+    None when ``wp`` has no such divisor."""
+    for wc in range(min(wp, 2048), 127, -128):
+        if wp % wc == 0 and wc % 128 == 0:
+            return wc
+    return None
+
+
+def _pick_stripe_tiled(h: int, wc: int, depth: int) -> Optional[int]:
+    """Row-stripe height for the column-tiled kernel: 3x2 input blocks +
+    2 output blocks of (t, wc), working tile + ~3 stack temporaries of
+    (t+2k, wc+256)."""
+    for t in range(h, 7, -1):
+        if h % t or t % 8 or t < depth:
+            continue
+        live = (8 * t * wc + 4 * (t + 2 * depth) * (wc + 2 * LANE_PAD)) * 4
+        if live <= VMEM_BYTES_TARGET:
+            return t
+    return None
+
+
+def _tiled_kernel(
+    offs_ref,    # scalar prefetch: [row0, col0] of this block
+    left_ref,    # (T, WC) column tile c-1 (clamped)
+    x_ref,       # (T, WC) column tile c
+    right_ref,   # (T, WC) column tile c+1 (clamped)
+    top_ref,     # (k, WP+256) halo above, padded 128 per side
+    bottom_ref,  # (k, WP+256) below
+    o_ref,       # (T, WC) output tile (for the previous row step)
+    a_ref,       # scratch: (T+2k, WC+256) working tile / pipeline carry
+    tail_ref,    # scratch: last k rows of the carried stripe (3 tiles wide)
+    *,
+    tile: int,
+    wc: int,
+    depth: int,
+    n_rows: int,
+    gh: int,
+    gw: int,
+):
+    c = pl.program_id(0)
+    i = pl.program_id(1)
+    t, k = tile, depth
+    n = n_rows
+    pad = LANE_PAD
+    wca = wc + 2 * pad
+
+    cur_l, cur, cur_r = left_ref[...], x_ref[...], right_ref[...]
+
+    @pl.when(i > 0)
+    def _compute():
+        j = i - 1
+
+        @pl.when(j == 0)
+        def _top_edge():
+            a_ref[0:k, :] = top_ref[:, pl.ds(c * wc, wca)]
+
+        @pl.when(j > 0)
+        def _top_interior():
+            a_ref[0:k, :] = tail_ref[...]
+
+        @pl.when(j == n - 1)
+        def _bottom_edge():
+            a_ref[t + k : t + 2 * k, :] = bottom_ref[:, pl.ds(c * wc, wca)]
+
+        @pl.when(j < n - 1)
+        def _bottom_interior():
+            a_ref[t + k : t + 2 * k, pad : pad + wc] = cur[0:k, :]
+            a_ref[t + k : t + 2 * k, pad - k : pad] = (
+                cur_l[0:k, wc - k : wc]
+            )
+            a_ref[t + k : t + 2 * k, pad + wc : pad + wc + k] = (
+                cur_r[0:k, 0:k]
+            )
+
+        g_row = (
+            offs_ref[0] + j * t - k
+            + lax.broadcasted_iota(jnp.int32, (t + 2 * k, 1), 0)
+        )
+        g_col = (
+            offs_ref[1] - LANE_PAD + c * wc - pad
+            + lax.broadcasted_iota(jnp.int32, (1, wca), 1)
+        )
+        row_b = (g_row == 0) | (g_row == gh - 1)
+        col_b = (g_col == 0) | (g_col == gw - 1)
+        boundary = row_b | col_b
+
+        val = a_ref[...]
+        for _ in range(k):
+            avg = 0.25 * (
+                pltpu.roll(val, 1, axis=0)
+                + pltpu.roll(val, t + 2 * k - 1, axis=0)
+                + pltpu.roll(val, 1, axis=1)
+                + pltpu.roll(val, wca - 1, axis=1)
+            )
+            val = jnp.where(boundary, val, avg)
+        o_ref[...] = val[k : t + k, pad : pad + wc]
+
+    # rotate the pipeline; the carry holds this column tile plus k halo
+    # columns from each neighbouring tile
+    tail_ref[...] = a_ref[t : t + k, :]
+    a_ref[k : t + k, pad : pad + wc] = cur
+    a_ref[k : t + k, pad - k : pad] = cur_l[:, wc - k : wc]
+    a_ref[k : t + k, pad + wc : pad + wc + k] = cur_r[:, 0:k]
+
+
+def _temporal_pass_ext_tiled(
+    xext: jax.Array,
+    comm: Communicator,
+    gh: int,
+    gw: int,
+    depth: int,
+    wc: int,
+    t: int,
+    interpret: bool,
+) -> jax.Array:
+    """Column-tiled k-sweep pass: same contract as the full-width pass,
+    but the row stripe is decoupled from the array width so it can be
+    tall (less vertical recompute). Neighbour columns come from reading
+    three adjacent column tiles per step (clamped at the edges — the
+    clamped garbage lands inside the 120 dead lanes and never reaches
+    valid output)."""
+    row_axis, col_axis = comm.axis_names
+    h, wp = xext.shape
+    w = wp - 2 * LANE_PAD
+    k = depth
+    n_rows = h // t
+    n_cols = wp // wc
+
+    halos = halo_exchange_2d_corners(
+        xext[:, LANE_PAD : LANE_PAD + w], comm, depth=k
+    )
+    xext = lax.dynamic_update_slice(xext, halos.left, (0, LANE_PAD - k))
+    xext = lax.dynamic_update_slice(xext, halos.right, (0, LANE_PAD + w))
+    zrow = jnp.zeros((k, LANE_PAD - k), xext.dtype)
+    zpad = jnp.zeros((k, LANE_PAD), xext.dtype)
+    # pad a full register tile per side so per-tile slices never clamp
+    top_ext = jnp.concatenate(
+        [zpad, zrow, halos.top, zrow, zpad], axis=1
+    )
+    bottom_ext = jnp.concatenate(
+        [zpad, zrow, halos.bottom, zrow, zpad], axis=1
+    )
+
+    rx = lax.axis_index(row_axis)
+    cy = lax.axis_index(col_axis)
+    offs = jnp.stack([rx * h, cy * w]).astype(jnp.int32)
+
+    kernel = functools.partial(
+        _tiled_kernel, tile=t, wc=wc, depth=k, n_rows=n_rows, gh=gh, gw=gw
+    )
+    # index maps take grid coords (c, i) and return (row_block, col_block)
+    block = lambda dc: (
+        lambda c, i, offs, _dc=dc: (
+            jnp.minimum(i, n_rows - 1),
+            jnp.clip(c + _dc, 0, n_cols - 1),
+        )
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_cols, n_rows + 1),  # row dim fastest: carries per column
+        in_specs=[
+            pl.BlockSpec((t, wc), block(-1), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, wc), block(0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, wc), block(+1), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (t, wc),
+            lambda c, i, offs: (jnp.maximum(i - 1, 0), c),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((t + 2 * k, wc + 2 * LANE_PAD), jnp.float32),
+            pltpu.VMEM((k, wc + 2 * LANE_PAD), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((h, wp), xext.dtype),
+        interpret=interpret,
+    )(offs, xext, xext, xext, top_ext, bottom_ext)
 
 
 def temporal_pass(

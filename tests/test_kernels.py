@@ -152,3 +152,40 @@ def test_temporal_multi_stripe_pipeline(eight_devices, monkeypatch):
     out = np.asarray(fn(jnp.asarray(g)))
     ref = stencil.reference_stencil(g, 16)
     np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "px,py,h,w,t,wc",
+    [
+        (1, 1, 32, 512, 16, 256),   # 3 col tiles x 2 row stripes
+        (1, 2, 16, 256, 16, 128),   # single row stripe per block
+        (1, 1, 64, 512, 64, 768),   # single col tile (n_cols=1)
+        (2, 2, 64, 512, 16, 256),   # real top/bottom halos with corners
+    ],
+)
+def test_temporal_tiled_kernel_matches_reference(
+    eight_devices, monkeypatch, px, py, h, w, t, wc
+):
+    """The column-tiled kernel shape (tall stripes, 3-block column
+    reads) is bit-exact vs the serial reference."""
+    monkeypatch.setattr(
+        ktemporal, "_plan", lambda *_a: ("tiled", (t, wc))
+    )
+    comm = smi.make_communicator(
+        shape=(px, py), axis_names=("sx", "sy"),
+        devices=eight_devices[: px * py],
+    )
+    g = stencil.initial_grid(h, w)
+    g[:, -1] = 2.0
+    g[h // 2, :] = 0.5
+    fn = ktemporal.make_temporal_stencil_fn(
+        comm, 16, h, w, depth=8, interpret=True
+    )
+    out = np.asarray(fn(jnp.asarray(g)))
+    ref = stencil.reference_stencil(g, 16)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_plan_prefers_tiled_for_wide_blocks():
+    assert ktemporal._plan(8192, 8192, 8)[0] == "tiled"
+    assert ktemporal._plan(32, 256, 8)[0] == "full"
