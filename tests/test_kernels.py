@@ -73,15 +73,18 @@ from smi_tpu.kernels import stencil_temporal as ktemporal
 
 
 @pytest.mark.parametrize(
-    "px,py,h,w,iters",
+    "px,py,h,w,iters,depth",
     [
-        (1, 1, 32, 256, 8),    # one pass exactly
-        (2, 2, 64, 512, 16),   # two passes, 2x2 mesh
-        (2, 4, 64, 1024, 20),  # remainder of 4 single sweeps
-        (1, 2, 16, 256, 8),    # single stripe per block
+        (1, 1, 32, 256, 8, 8),     # one pass exactly
+        (2, 2, 64, 512, 16, 8),    # two passes, 2x2 mesh
+        (2, 4, 64, 1024, 20, 8),   # remainder of 4 single sweeps
+        (1, 2, 16, 256, 8, 8),     # single stripe per block
+        (2, 2, 64, 512, 32, 16),   # bench.py's depth (fastest on v5e)
     ],
 )
-def test_temporal_stencil_matches_reference(eight_devices, px, py, h, w, iters):
+def test_temporal_stencil_matches_reference(
+    eight_devices, px, py, h, w, iters, depth
+):
     comm = smi.make_communicator(
         shape=(px, py), axis_names=("sx", "sy"),
         devices=eight_devices[: px * py],
@@ -90,7 +93,7 @@ def test_temporal_stencil_matches_reference(eight_devices, px, py, h, w, iters):
     g[:, -1] = 2.0
     g[h // 2, :] = 0.5
     fn = ktemporal.make_temporal_stencil_fn(
-        comm, iters, h, w, depth=8, interpret=True
+        comm, iters, h, w, depth=depth, interpret=True
     )
     out = np.asarray(fn(jnp.asarray(g)))
     ref = stencil.reference_stencil(g, iters)
