@@ -221,9 +221,20 @@ def cmd_build(args: argparse.Namespace) -> int:
     codegen-host per target; here the three stages run back-to-back into
     one output directory.
     """
+    derived = args.name is None
+    if derived:
+        # reference parity: program metadata is named after the kernel
+        # source (codegen/main.py:86), so `topology -p app` + `build
+        # app.py` line up without an explicit --name
+        args.name = os.path.splitext(os.path.basename(args.sources[0]))[0]
     if not args.name.isidentifier():
+        hint = (
+            " (derived from the first source file; pass --name to override)"
+            if derived else ""
+        )
         print(
-            f"error: program name {args.name!r} is not a valid identifier",
+            f"error: program name {args.name!r} is not a valid "
+            f"identifier{hint}",
             file=sys.stderr,
         )
         return 1
@@ -313,8 +324,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("topology", help="topology JSON")
     p.add_argument("sources", nargs="+", help="user source files")
     p.add_argument("-o", "--out-dir", required=True)
-    p.add_argument("--name", default="program",
-                   help="program name (basename of the metadata JSON)")
+    p.add_argument("--name", default=None,
+                   help="program name (default: first source's basename)")
     p.add_argument("--consecutive-read-limit", type=int, default=8)
     p.add_argument("--max-ranks", type=int, default=8)
     p.add_argument("--no-rendezvous", action="store_true")

@@ -296,6 +296,18 @@ def test_build_pipeline_end_to_end(tmp_path, app_source, eight_devices):
         sys.modules.pop("smi_generated_host", None)
 
 
+@needs_tool
+def test_build_default_name_from_source(tmp_path, app_source):
+    """With no --name, the program is named after the first source file
+    (codegen/main.py:86 parity), lining up with `topology -p app`."""
+    topo = tmp_path / "cluster.json"
+    assert run_cli("topology", "-n", "2", "-p", "app", "-f", str(topo)) == 0
+    out = tmp_path / "build"
+    assert run_cli("build", str(topo), app_source, "-o", str(out)) == 0
+    assert (out / "app.json").exists()
+    assert (out / "smi_generated_host.py").exists()
+
+
 def test_build_rejects_bad_name_before_any_stage(tmp_path, capsys):
     out = tmp_path / "build"
     assert run_cli("build", str(tmp_path / "t.json"), "x.py",
