@@ -44,9 +44,17 @@ def main():
     from smi_tpu.kernels import stencil_temporal as ktemporal
 
     block_h, block_w = x // px, y // py
-    # depth=16 measured fastest on v5e (vs 8/24/32) at this config
-    depth = 16
-    if ktemporal.temporal_supported(block_h, block_w, jnp.float32, depth):
+    # depth=16 measured fastest on v5e (vs 8/24/32) at this config;
+    # fall back to 8 before abandoning the temporal tier
+    depth = next(
+        (
+            dd for dd in (16, 8)
+            if dd <= iters
+            and ktemporal.temporal_supported(block_h, block_w, jnp.float32, dd)
+        ),
+        None,
+    )
+    if depth is not None:
         # k sweeps per HBM pass (temporal blocking) — the fast path
         fn = ktemporal.make_temporal_stencil_fn(
             comm, iters, x, y, depth=depth

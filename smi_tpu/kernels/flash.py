@@ -1,0 +1,240 @@
+"""Flash-attention block kernel for the ring-attention schedule.
+
+The jnp block-attend path (``models/ring_attention.py::_block_attend``)
+materializes the ``(H, Sq, Sk)`` score tensor in HBM — at long context
+that traffic, not the MXU, bounds throughput. This kernel is the
+TPU-native fix: the classic blockwise online-softmax (flash) schedule,
+where score tiles live only in VMEM and the running ``(m, l, acc)``
+state never leaves the chip.
+
+It deliberately has the *same contract* as ``_block_attend`` — fold one
+K/V block into carried online-softmax state, with global ``q_off`` /
+``k_off`` positions for exact causal masking — so one ring step is one
+kernel launch and the ring's cross-device accumulation is unchanged.
+This mirrors how the reference overlaps neighbour streaming with
+pipelined compute (``examples/kernels/stencil_smi.cl:236-386``): the
+ppermute moves the next K/V block while this kernel consumes the
+current one.
+
+Schedule: the grid is ``(H, n_q, n_kc)`` over 4096-lane key *chunks*;
+each grid step runs a VMEM-resident ``fori_loop`` over 512-wide key
+sub-tiles, so per-step dispatch overhead amortizes over 8 MXU tiles.
+The online-softmax state is a value carry of the inner loop and a VMEM
+scratch carry across chunks. Causality is enforced at both levels from
+global positions: fully-masked chunks are skipped by ``pl.when``, and
+the inner loop's trip count is clipped to the last live sub-tile — the
+causal schedule does ~half the dense work.
+
+Layouts are head-major — ``q``/``k``/``v``/``acc`` as ``(H, S, D)``,
+``m``/``l`` as ``(H, S, 1)`` — so every tile the kernel touches has a
+lane-tileable minor dimension and the softmax statistics are column
+vectors, avoiding in-kernel relayouts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+#: query tile rows (per grid step)
+BLOCK_Q = 512
+#: key sub-tile columns (per inner-loop iteration)
+BLOCK_K = 512
+#: key chunk (per grid step) = BLOCK_K * sub-tiles; bounds K/V VMEM use
+#: (chunks are double-buffered: 2048 rows x 128 lanes x 4 B x 2 bufs x
+#: {k,v} = 4 MB, which with q/acc tiles and loop temporaries stays
+#: inside the 16 MB scoped-VMEM limit)
+CHUNK_K = 2048
+
+
+def _pick_block(extent: int, target: int) -> Optional[int]:
+    """Largest divisor of ``extent`` that is ≤ target and a multiple of
+    8 (f32 sublane tile)."""
+    for b in range(min(extent, target), 7, -1):
+        if extent % b == 0 and b % 8 == 0:
+            return b
+    return None
+
+
+def flash_supported(s_q: int, s_k: int, d: int, dtype) -> bool:
+    """The fast path needs f32, lane-aligned head_dim, and tileable
+    sequence extents; callers fall back to the jnp path otherwise."""
+    return (
+        dtype == jnp.float32
+        and d % 128 == 0
+        and _pick_block(s_q, BLOCK_Q) is not None
+        and _pick_block(s_k, BLOCK_K) is not None
+    )
+
+
+def _flash_kernel(
+    offs_ref,   # scalar prefetch: [q_off, k_off] global block positions
+    q_ref,      # (1, bq, D) query tile, head h
+    k_ref,      # (1, kc, D) key chunk
+    v_ref,      # (1, kc, D) value chunk
+    m_in_ref,   # (1, bq, 1) carried running row-max, head h
+    l_in_ref,   # (1, bq, 1) carried normalizer
+    acc_in_ref,  # (1, bq, D) carried weighted value sum
+    m_out_ref,  # (1, bq, 1)
+    l_out_ref,  # (1, bq, 1)
+    acc_out_ref,  # (1, bq, D)
+    m_s,        # scratch (bq, 1)
+    l_s,        # scratch (bq, 1)
+    acc_s,      # scratch (bq, D)
+    *,
+    block_q: int,
+    block_k: int,
+    chunk_k: int,
+    n_kc: int,
+    causal: bool,
+    scale: float,
+    precision,
+):
+    qi = pl.program_id(1)
+    kci = pl.program_id(2)
+    bq, bk, kc = block_q, block_k, chunk_k
+    n_sub = kc // bk
+
+    @pl.when(kci == 0)
+    def _load_carry():
+        m_s[...] = m_in_ref[0]
+        l_s[...] = l_in_ref[0]
+        acc_s[...] = acc_in_ref[0]
+
+    # Global positions of this tile's rows and of the chunk's first
+    # column; chunks wholly inside the causal future are skipped.
+    q_first = offs_ref[0] + qi * bq
+    c_first = offs_ref[1] + kci * kc
+    live = (not causal) or (c_first <= q_first + bq - 1)
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0]
+        if causal:
+            # sub-tiles past the diagonal contribute nothing: clip the
+            # trip count to the last live one
+            n_live = jnp.minimum(
+                (q_first + bq - 1 - c_first) // bk + 1, n_sub
+            )
+        else:
+            n_live = n_sub
+
+        def body(ki, carry):
+            m, l, acc = carry
+            kb = k_ref[0, pl.ds(ki * bk, bk), :]
+            scores = lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            ) * scale  # (bq, bk)
+            if causal:
+                k_first = c_first + ki * bk
+                q_pos = q_first + lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0
+                )
+                k_pos = k_first + lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1
+                )
+                scores = jnp.where(k_pos > q_pos, NEG_INF, scores)
+            m_new = jnp.maximum(m, scores.max(axis=1, keepdims=True))
+            # exp(-1e30 - -1e30) = 1 for still-all-masked rows:
+            # transient garbage, zeroed by this same correction once a
+            # live key lands (the jnp path's semantics)
+            correction = jnp.exp(m - m_new)
+            p = jnp.exp(scores - m_new)
+            l = l * correction + p.sum(axis=1, keepdims=True)
+            acc = acc * correction + lax.dot_general(
+                p, v_ref[0, pl.ds(ki * bk, bk), :],
+                (((1,), (0,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            )
+            return m_new, l, acc
+
+        m, l, acc = lax.fori_loop(
+            0, n_live, body, (m_s[...], l_s[...], acc_s[...])
+        )
+        m_s[...] = m
+        l_s[...] = l
+        acc_s[...] = acc
+
+    @pl.when(kci == n_kc - 1)
+    def _store_carry():
+        m_out_ref[0] = m_s[...]
+        l_out_ref[0] = l_s[...]
+        acc_out_ref[0] = acc_s[...]
+
+
+def flash_block_attend(
+    q: jax.Array,       # (H, Sq, D)
+    k: jax.Array,       # (H, Sk, D)
+    v: jax.Array,       # (H, Sk, D)
+    m: jax.Array,       # (H, Sq, 1)
+    l: jax.Array,       # (H, Sq, 1)
+    acc: jax.Array,     # (H, Sq, D)
+    q_off,
+    k_off,
+    causal: bool,
+    scale: float,
+    precision=None,
+    interpret: bool = False,
+):
+    """Fold one K/V block into the online-softmax carry (flash tier).
+
+    Head-major twin of ``_block_attend``: same math, same global-offset
+    causal mask, but score tiles never leave VMEM. ``q_off``/``k_off``
+    may be traced (they arrive via scalar prefetch).
+    """
+    h, s_q, d = q.shape
+    s_k = k.shape[1]
+    bq = _pick_block(s_q, BLOCK_Q)
+    bk = _pick_block(s_k, BLOCK_K)
+    if bq is None or bk is None:
+        raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
+    # chunk = as many sub-tiles as fit the VMEM budget (≤ CHUNK_K lanes)
+    kc = bk * max(1, min(CHUNK_K // bk, s_k // bk))
+    while s_k % kc:
+        kc -= bk
+    n_q, n_kc = s_q // bq, s_k // kc
+    if precision is None:
+        precision = lax.Precision.HIGHEST
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,
+        causal=causal, scale=scale, precision=precision,
+    )
+    offs = jnp.stack(
+        [jnp.asarray(q_off), jnp.asarray(k_off)]
+    ).astype(jnp.int32)
+    qspec = pl.BlockSpec((1, bq, d), lambda hh, qi, ki, offs: (hh, qi, 0))
+    kspec = pl.BlockSpec((1, kc, d), lambda hh, qi, ki, offs: (hh, ki, 0))
+    colspec = pl.BlockSpec(
+        (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(h, n_q, n_kc),
+        in_specs=[qspec, kspec, kspec, colspec, colspec, qspec],
+        out_specs=[colspec, colspec, qspec],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((h, s_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, q, k, v, m, l, acc)

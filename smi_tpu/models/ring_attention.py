@@ -28,10 +28,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from smi_tpu.kernels.flash import NEG_INF
 from smi_tpu.parallel.channels import ring_shift
 from smi_tpu.parallel.mesh import Communicator
-
-NEG_INF = -1e30
 
 
 def _block_attend(q, k, v, m, l, acc, q_off, k_off, causal, scale,
@@ -60,6 +59,69 @@ def _block_attend(q, k, v, m, l, acc, q_off, k_off, causal, scale,
     return m_new, l_new, acc_new
 
 
+def _ring_schedule(fold, comm, axis, k0, v0, carry0):
+    """The ring circuit shared by both attention tiers: hold Q, pass
+    K/V to the right neighbour each step, fold the currently-held block
+    into the carry with its *origin rank* (for global causal offsets).
+    ``fold(src_rank, k_block, v_block, carry) -> carry``."""
+    n = comm.mesh.shape[axis]
+    rank = lax.axis_index(axis)
+
+    def step(s, state):
+        k_cur, v_cur, carry = state
+        # the block currently held originated at rank - s (mod n)
+        src = lax.rem(rank - s + jnp.int32(n), jnp.int32(n))
+        carry = fold(src, k_cur, v_cur, carry)
+        # pass K/V to the right neighbour for the next step
+        k_cur = ring_shift(k_cur, comm, offset=1, axis_name=axis)
+        v_cur = ring_shift(v_cur, comm, offset=1, axis_name=axis)
+        return k_cur, v_cur, carry
+
+    _, _, carry = lax.fori_loop(0, n, step, (k0, v0, carry0))
+    return carry
+
+
+def _use_flash_default(comm: Communicator, s_local, h, d, dtype) -> bool:
+    from smi_tpu.kernels.flash import flash_supported
+
+    platforms = {dev.platform for dev in comm.mesh.devices.flat}
+    return platforms == {"tpu"} and flash_supported(s_local, s_local, d, dtype)
+
+
+def _ring_attention_shard_flash(
+    q, k, v, comm, causal, axis, precision, interpret
+):
+    """Flash-tier ring schedule: head-major layouts, one Pallas launch
+    per ring step (``kernels/flash.py``), K/V moved by ``ring_shift``."""
+    from smi_tpu.kernels.flash import flash_block_attend
+
+    rank = lax.axis_index(axis)
+    s_local, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    qT = q.swapaxes(0, 1)  # (H, S, D)
+    m0 = jnp.full((h, s_local, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros_like(qT)
+    q_off = rank * s_local
+
+    def fold(src, k_cur, v_cur, carry):
+        m, l, acc = carry
+        return flash_block_attend(
+            qT, k_cur, v_cur, m, l, acc,
+            q_off, src * s_local, causal, scale, precision,
+            interpret=interpret,
+        )
+
+    m, l, acc = _ring_schedule(
+        fold, comm, axis,
+        k.swapaxes(0, 1), v.swapaxes(0, 1), (m0, l0, acc0),
+    )
+    safe_l = jnp.where(l == 0.0, 1.0, l)  # (H, S, 1)
+    out = acc / safe_l
+    return out.swapaxes(0, 1).astype(q.dtype)
+
+
 def ring_attention_shard(
     q: jax.Array,
     k: jax.Array,
@@ -68,6 +130,8 @@ def ring_attention_shard(
     causal: bool = False,
     axis_name: Optional[str] = None,
     precision=lax.Precision.HIGHEST,
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Per-shard ring attention (call inside ``shard_map``).
 
@@ -75,11 +139,21 @@ def ring_attention_shard(
     K/V make a full ring circuit (one ``ppermute`` per step, n-1 hops);
     XLA overlaps each hop with the previous block's attention math — the
     stencil bridge-kernel overlap, applied to attention.
+
+    On TPU with flash-compatible shapes the per-step block fold runs as
+    the VMEM-resident Pallas kernel (``kernels/flash.py``); otherwise
+    the jnp online-softmax below. ``use_flash`` forces the choice (pass
+    ``interpret=True`` to run the flash tier off-TPU).
     """
     axis = axis_name or comm.axis_names[0]
-    n = comm.mesh.shape[axis]
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
+    if use_flash is None:
+        use_flash = _use_flash_default(comm, s_local, h, d, q.dtype)
+    if use_flash:
+        return _ring_attention_shard_flash(
+            q, k, v, comm, causal, axis, precision, interpret
+        )
     scale = 1.0 / math.sqrt(d)
 
     m0 = jnp.full((h, s_local), NEG_INF, q.dtype)
@@ -87,20 +161,14 @@ def ring_attention_shard(
     acc0 = jnp.zeros_like(q)
     q_off = rank * s_local
 
-    def step(s, carry):
-        k_cur, v_cur, m, l, acc = carry
-        # the block currently held originated at rank - s (mod n)
-        src = lax.rem(rank - s + jnp.int32(n), jnp.int32(n))
-        m, l, acc = _block_attend(
+    def fold(src, k_cur, v_cur, carry):
+        m, l, acc = carry
+        return _block_attend(
             q, k_cur, v_cur, m, l, acc,
             q_off, src * s_local, causal, scale, precision,
         )
-        # pass K/V to the right neighbour for the next step
-        k_cur = ring_shift(k_cur, comm, offset=1, axis_name=axis)
-        v_cur = ring_shift(v_cur, comm, offset=1, axis_name=axis)
-        return k_cur, v_cur, m, l, acc
 
-    _, _, m, l, acc = lax.fori_loop(0, n, step, (k, v, m0, l0, acc0))
+    m, l, acc = _ring_schedule(fold, comm, axis, k, v, (m0, l0, acc0))
     # fully-masked rows (possible only without a self-block) normalize to 0
     safe_l = jnp.where(l == 0.0, 1.0, l)
     return acc / safe_l.transpose(1, 0)[..., None]
@@ -109,6 +177,8 @@ def ring_attention_shard(
 def make_ring_attention_fn(
     comm: Communicator, causal: bool = False,
     precision=lax.Precision.HIGHEST,
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
 ):
     """Jitted sequence-parallel attention over the communicator's axis.
 
@@ -122,7 +192,8 @@ def make_ring_attention_fn(
 
     def shard_fn(q, k, v):
         return ring_attention_shard(
-            q, k, v, comm, causal=causal, precision=precision
+            q, k, v, comm, causal=causal, precision=precision,
+            use_flash=use_flash, interpret=interpret,
         )
 
     spec = P(axis)

@@ -1,0 +1,144 @@
+"""Flash-attention kernel tier (``kernels/flash.py``) — interpret mode
+on the CPU fake mesh, verified against the jnp block fold and full
+attention. Mirrors how the kernel is used: one launch per ring step
+with carried online-softmax state and global causal offsets."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+import smi_tpu as smi
+from smi_tpu.kernels import flash
+from smi_tpu.models import ring_attention as ra
+
+
+def _qkv(s, h, d, seed=0):
+    rng = np.random.RandomState(seed)
+    return tuple(
+        jnp.asarray(rng.randn(s, h, d).astype(np.float32)) for _ in range(3)
+    )
+
+
+def test_flash_supported_gating():
+    f32 = jnp.float32
+    assert flash.flash_supported(512, 512, 128, f32)
+    assert flash.flash_supported(8, 16, 256, f32)
+    assert not flash.flash_supported(512, 512, 64, f32)    # lanes
+    assert not flash.flash_supported(512, 512, 128, jnp.bfloat16)
+    assert not flash.flash_supported(7, 512, 128, f32)     # untileable
+    assert flash._pick_block(8192, 512) == 512
+    assert flash._pick_block(24, 512) == 24
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("carry", ["fresh", "carried"])
+def test_flash_block_matches_jnp_block(causal, carry):
+    """One kernel launch == one `_block_attend` fold, including carried
+    state and non-zero global offsets (a mid-ring step)."""
+    s_q, s_k, h, d = 32, 48, 2, 128
+    q, k, v = _qkv(max(s_q, s_k), h, d, seed=1)
+    q = q[:s_q]
+    k, v = k[:s_k], v[:s_k]
+    scale = 1.0 / math.sqrt(d)
+    # q rows 16..47, k cols 32..79: partially causal-live, so both
+    # tiers take their live path (for a *fully* masked block the tiers
+    # intentionally differ in transient state — see
+    # test_flash_skips_fully_masked_block)
+    q_off, k_off = 16, 32
+
+    m0 = jnp.full((h, s_q), ra.NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, s_q), jnp.float32)
+    acc0 = jnp.zeros_like(q)
+    if carry == "carried":
+        # run one jnp fold first so the kernel starts from live state
+        m0, l0, acc0 = ra._block_attend(
+            q, k, v, m0, l0, acc0, q_off, 0, causal, scale,
+            lax.Precision.HIGHEST,
+        )
+    m_ref, l_ref, acc_ref = ra._block_attend(
+        q, k, v, m0, l0, acc0, q_off, k_off, causal, scale,
+        lax.Precision.HIGHEST,
+    )
+
+    m_f, l_f, acc_f = flash.flash_block_attend(
+        q.swapaxes(0, 1), k.swapaxes(0, 1), v.swapaxes(0, 1),
+        m0[..., None], l0[..., None], acc0.swapaxes(0, 1),
+        q_off, k_off, causal, scale, interpret=True,
+    )
+    # tolerances cover matmul accumulation-order noise only
+    np.testing.assert_allclose(
+        np.asarray(m_f)[..., 0], np.asarray(m_ref), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(l_f)[..., 0], np.asarray(l_ref), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(acc_f).swapaxes(0, 1), np.asarray(acc_ref),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("n", [1, 2, 4])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_ring_attention_matches_full(eight_devices, n, causal):
+    comm = smi.make_communicator(n, devices=eight_devices[:n])
+    s, h, d = n * 16, 2, 128
+    q, k, v = _qkv(s, h, d, seed=2)
+    fn = ra.make_ring_attention_fn(
+        comm, causal=causal, use_flash=True, interpret=True
+    )
+    out = np.asarray(fn(q, k, v))
+    ref = ra.reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_multi_chunk_carry(eight_devices):
+    """Sequences longer than one key chunk exercise the scratch carry
+    across grid steps (kci > 0) and the causal chunk skip."""
+    comm = smi.make_communicator(1, devices=eight_devices[:1])
+    s, h, d = 64, 1, 128
+    q, k, v = _qkv(s, h, d, seed=5)
+    old_chunk, old_bk = flash.CHUNK_K, flash.BLOCK_K
+    old_bq = flash.BLOCK_Q
+    try:
+        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = 16, 8, 16
+        fn = ra.make_ring_attention_fn(
+            comm, causal=True, use_flash=True, interpret=True
+        )
+        out = np.asarray(fn(q, k, v))
+    finally:
+        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = (
+            old_bq, old_bk, old_chunk
+        )
+    ref = ra.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_flash_skips_fully_masked_block():
+    """A block wholly inside the causal future leaves the carry
+    untouched (the jnp tier instead accumulates transient garbage that
+    a later live block's correction zeroes; both converge)."""
+    s_q, s_k, h, d = 16, 16, 1, 128
+    q, k, v = _qkv(16, h, d, seed=9)
+    scale = 1.0 / math.sqrt(d)
+    m0 = jnp.full((h, s_q, 1), ra.NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, s_q, 1), jnp.float32)
+    acc0 = jnp.zeros((h, s_q, d), jnp.float32)
+    m, l, acc = flash.flash_block_attend(
+        q.swapaxes(0, 1), k.swapaxes(0, 1), v.swapaxes(0, 1),
+        m0, l0, acc0, 0, 1000, True, scale, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(l), 0.0)
+    np.testing.assert_array_equal(np.asarray(acc), 0.0)
+    np.testing.assert_array_equal(np.asarray(m), np.float32(ra.NEG_INF))
+
+
+def test_auto_dispatch_prefers_jnp_off_tpu(eight_devices):
+    """On the CPU mesh the auto tier must not pick the Pallas path
+    (non-interpret Pallas is TPU-only)."""
+    comm = smi.make_communicator(2, devices=eight_devices[:2])
+    assert not ra._use_flash_default(comm, 512, 4, 128, jnp.float32)
