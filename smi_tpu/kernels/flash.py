@@ -48,11 +48,13 @@ NEG_INF = -1e30
 BLOCK_Q = 512
 #: key sub-tile columns (per inner-loop iteration)
 BLOCK_K = 512
-#: key chunk (per grid step) = BLOCK_K * sub-tiles; bounds K/V VMEM use
-#: (chunks are double-buffered: 2048 rows x 128 lanes x 4 B x 2 bufs x
-#: {k,v} = 4 MB, which with q/acc tiles and loop temporaries stays
-#: inside the 16 MB scoped-VMEM limit)
+#: key-chunk budget (per grid step) in rows at head_dim 128; scaled
+#: down for wider heads so double-buffered K/V chunks (2048 rows x 128
+#: lanes x 4 B x 2 bufs x {k,v} = 4 MB) plus q/acc tiles and loop
+#: temporaries stay inside the 16 MB scoped-VMEM limit
 CHUNK_K = 2048
+#: widest supported head_dim (q/acc tiles and K/V chunks scale with d)
+MAX_HEAD_DIM = 512
 
 
 def _pick_block(extent: int, target: int) -> Optional[int]:
@@ -70,6 +72,7 @@ def flash_supported(s_q: int, s_k: int, d: int, dtype) -> bool:
     return (
         dtype == jnp.float32
         and d % 128 == 0
+        and d <= MAX_HEAD_DIM
         and _pick_block(s_q, BLOCK_Q) is not None
         and _pick_block(s_k, BLOCK_K) is not None
     )
@@ -197,8 +200,10 @@ def flash_block_attend(
     bk = _pick_block(s_k, BLOCK_K)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
-    # chunk = as many sub-tiles as fit the VMEM budget (≤ CHUNK_K lanes)
-    kc = bk * max(1, min(CHUNK_K // bk, s_k // bk))
+    # chunk = as many sub-tiles as fit the VMEM budget, which shrinks
+    # for wide heads (K/V chunk bytes scale with d)
+    budget_rows = max(1, CHUNK_K * 128 // d)
+    kc = bk * max(1, min(budget_rows // bk, s_k // bk))
     while s_k % kc:
         kc -= bk
     n_q, n_kc = s_q // bq, s_k // kc

@@ -28,7 +28,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from smi_tpu.kernels.flash import NEG_INF
+from smi_tpu.kernels.flash import (
+    NEG_INF,
+    flash_block_attend,
+    flash_supported,
+)
 from smi_tpu.parallel.channels import ring_shift
 from smi_tpu.parallel.mesh import Communicator
 
@@ -72,18 +76,21 @@ def _ring_schedule(fold, comm, axis, k0, v0, carry0):
         # the block currently held originated at rank - s (mod n)
         src = lax.rem(rank - s + jnp.int32(n), jnp.int32(n))
         carry = fold(src, k_cur, v_cur, carry)
-        # pass K/V to the right neighbour for the next step
+        # pass K/V to the right neighbour for the next step; the fold
+        # and the shift both only read k_cur/v_cur, so XLA overlaps the
+        # ICI hop with the block math
         k_cur = ring_shift(k_cur, comm, offset=1, axis_name=axis)
         v_cur = ring_shift(v_cur, comm, offset=1, axis_name=axis)
         return k_cur, v_cur, carry
 
-    _, _, carry = lax.fori_loop(0, n, step, (k0, v0, carry0))
-    return carry
+    # n-1 looped fold+shift steps, then the last block folds without a
+    # (dead) trailing shift
+    k_last, v_last, carry = lax.fori_loop(0, n - 1, step, (k0, v0, carry0))
+    src_last = lax.rem(rank + 1, jnp.int32(n))
+    return fold(src_last, k_last, v_last, carry)
 
 
 def _use_flash_default(comm: Communicator, s_local, h, d, dtype) -> bool:
-    from smi_tpu.kernels.flash import flash_supported
-
     platforms = {dev.platform for dev in comm.mesh.devices.flat}
     return platforms == {"tpu"} and flash_supported(s_local, s_local, d, dtype)
 
@@ -93,8 +100,6 @@ def _ring_attention_shard_flash(
 ):
     """Flash-tier ring schedule: head-major layouts, one Pallas launch
     per ring step (``kernels/flash.py``), K/V moved by ``ring_shift``."""
-    from smi_tpu.kernels.flash import flash_block_attend
-
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -179,6 +184,7 @@ def make_ring_attention_fn(
     precision=lax.Precision.HIGHEST,
     use_flash: Optional[bool] = None,
     interpret: bool = False,
+    reps: int = 1,
 ):
     """Jitted sequence-parallel attention over the communicator's axis.
 
@@ -187,14 +193,26 @@ def make_ring_attention_fn(
     ``precision`` defaults to HIGHEST so results verify against full
     f32 attention (TPU matmuls otherwise round operands to bf16); pass
     ``lax.Precision.DEFAULT`` to trade exactness for MXU throughput.
+
+    ``reps > 1`` chains that many applications inside the jit (output
+    fed back as the next query) — a timing harness that amortizes
+    per-dispatch latency out of benchmark samples.
     """
     axis = comm.axis_names[0]
 
-    def shard_fn(q, k, v):
+    def once(q, k, v):
         return ring_attention_shard(
             q, k, v, comm, causal=causal, precision=precision,
             use_flash=use_flash, interpret=interpret,
         )
+
+    if reps == 1:
+        shard_fn = once
+    else:
+        def shard_fn(q, k, v):
+            return lax.fori_loop(
+                0, reps, lambda _, x: once(x, k, v), q
+            )
 
     spec = P(axis)
     return jax.jit(
