@@ -44,15 +44,8 @@ def main():
     from smi_tpu.kernels import stencil_temporal as ktemporal
 
     block_h, block_w = x // px, y // py
-    # depth=16 measured fastest on v5e (vs 8/24/32) at this config;
-    # fall back to 8 before abandoning the temporal tier
-    depth = next(
-        (
-            dd for dd in (16, 8)
-            if dd <= iters
-            and ktemporal.temporal_supported(block_h, block_w, jnp.float32, dd)
-        ),
-        None,
+    depth = ktemporal.pick_temporal_depth(
+        block_h, block_w, jnp.float32, iters
     )
     if depth is not None:
         # k sweeps per HBM pass (temporal blocking) — the fast path

@@ -44,8 +44,11 @@ def bench_stencil(
             f"({px}, {py}); pick a size divisible by both"
         )
     h, w = size // px, size // py
-    if kt.temporal_supported(h, w, jnp.float32, depth=8) and iterations >= 8:
-        fn = kt.make_temporal_stencil_fn(comm2d, iterations, size, size)
+    depth = kt.pick_temporal_depth(h, w, jnp.float32, iterations)
+    if depth is not None:
+        fn = kt.make_temporal_stencil_fn(
+            comm2d, iterations, size, size, depth=depth
+        )
     else:
         fn = stencil.make_stencil_fn(comm2d, iterations)
     g = jnp.asarray(stencil.initial_grid(size, size))

@@ -57,24 +57,31 @@ CHUNK_K = 2048
 MAX_HEAD_DIM = 512
 
 
-def _pick_block(extent: int, target: int) -> Optional[int]:
+def _pick_block(extent: int, target: int, multiple: int = 8) -> Optional[int]:
     """Largest divisor of ``extent`` that is ≤ target and a multiple of
-    8 (f32 sublane tile)."""
-    for b in range(min(extent, target), 7, -1):
-        if extent % b == 0 and b % 8 == 0:
+    the dtype's sublane tile (8 rows f32, 16 rows bf16)."""
+    for b in range(min(extent, target), multiple - 1, -1):
+        if extent % b == 0 and b % multiple == 0:
             return b
     return None
 
 
+def _sublane(dtype) -> int:
+    return 16 if dtype == jnp.bfloat16 else 8
+
+
 def flash_supported(s_q: int, s_k: int, d: int, dtype) -> bool:
-    """The fast path needs f32, lane-aligned head_dim, and tileable
-    sequence extents; callers fall back to the jnp path otherwise."""
+    """The fast path needs f32/bf16 (scores and the online-softmax
+    state are always f32), lane-aligned head_dim, and tileable sequence
+    extents; callers fall back to the jnp path otherwise."""
+    if dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    mult = _sublane(dtype)
     return (
-        dtype == jnp.float32
-        and d % 128 == 0
+        d % 128 == 0
         and d <= MAX_HEAD_DIM
-        and _pick_block(s_q, BLOCK_Q) is not None
-        and _pick_block(s_k, BLOCK_K) is not None
+        and _pick_block(s_q, BLOCK_Q, mult) is not None
+        and _pick_block(s_k, BLOCK_K, mult) is not None
     )
 
 
@@ -153,9 +160,11 @@ def _flash_kernel(
             correction = jnp.exp(m - m_new)
             p = jnp.exp(scores - m_new)
             l = l * correction + p.sum(axis=1, keepdims=True)
+            vb = v_ref[0, pl.ds(ki * bk, bk), :]
+            # match V's dtype for the MXU (free for f32; for bf16
+            # inputs p ∈ [0,1] rounds at ~2^-8, the bf16 tier's noise)
             acc = acc * correction + lax.dot_general(
-                p, v_ref[0, pl.ds(ki * bk, bk), :],
-                (((1,), (0,)), ((), ())),
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
                 precision=precision, preferred_element_type=jnp.float32,
             )
             return m_new, l, acc
@@ -196,19 +205,26 @@ def flash_block_attend(
     """
     h, s_q, d = q.shape
     s_k = k.shape[1]
-    bq = _pick_block(s_q, BLOCK_Q)
-    bk = _pick_block(s_k, BLOCK_K)
+    mult = _sublane(q.dtype)
+    bq = _pick_block(s_q, BLOCK_Q, mult)
+    bk = _pick_block(s_k, BLOCK_K, mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
     # chunk = as many sub-tiles as fit the VMEM budget, which shrinks
-    # for wide heads (K/V chunk bytes scale with d)
-    budget_rows = max(1, CHUNK_K * 128 // d)
+    # for wide heads and grows for narrow dtypes (K/V chunk bytes scale
+    # with d * itemsize)
+    budget_rows = max(1, CHUNK_K * 128 * 4 // (d * q.dtype.itemsize))
     kc = bk * max(1, min(budget_rows // bk, s_k // bk))
     while s_k % kc:
         kc -= bk
     n_q, n_kc = s_q // bq, s_k // kc
     if precision is None:
         precision = lax.Precision.HIGHEST
+    if q.dtype == jnp.bfloat16:
+        # HIGHEST requests an f32-precision contraction, which Mosaic
+        # rejects for bf16 operands (and which bf16 inputs cannot honor
+        # anyway) — the MXU's native bf16 pass is the faithful mode
+        precision = lax.Precision.DEFAULT
 
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,

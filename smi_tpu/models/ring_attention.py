@@ -105,9 +105,10 @@ def _ring_attention_shard_flash(
     scale = 1.0 / math.sqrt(d)
 
     qT = q.swapaxes(0, 1)  # (H, S, D)
+    # online-softmax state is always f32, whatever the input dtype
     m0 = jnp.full((h, s_local, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((h, s_local, 1), jnp.float32)
-    acc0 = jnp.zeros_like(qT)
+    acc0 = jnp.zeros(qT.shape, jnp.float32)
     q_off = rank * s_local
 
     def fold(src, k_cur, v_cur, carry):

@@ -24,14 +24,39 @@ def _qkv(s, h, d, seed=0):
 
 
 def test_flash_supported_gating():
-    f32 = jnp.float32
+    f32, bf16 = jnp.float32, jnp.bfloat16
     assert flash.flash_supported(512, 512, 128, f32)
     assert flash.flash_supported(8, 16, 256, f32)
+    assert flash.flash_supported(512, 512, 128, bf16)
     assert not flash.flash_supported(512, 512, 64, f32)    # lanes
-    assert not flash.flash_supported(512, 512, 128, jnp.bfloat16)
+    assert not flash.flash_supported(512, 512, 1024, f32)  # head_dim cap
+    assert not flash.flash_supported(512, 512, 128, jnp.float64)
     assert not flash.flash_supported(7, 512, 128, f32)     # untileable
+    assert not flash.flash_supported(8, 512, 128, bf16)    # bf16 sublane
     assert flash._pick_block(8192, 512) == 512
     assert flash._pick_block(24, 512) == 24
+    assert flash._pick_block(24, 512, multiple=16) is None
+    assert flash._pick_block(32, 512, multiple=16) == 32
+
+
+@pytest.mark.parametrize("n", [1, 2])
+def test_flash_ring_attention_bf16(eight_devices, n):
+    """bf16 inputs, f32 online-softmax state; bf16-level tolerance."""
+    comm = smi.make_communicator(n, devices=eight_devices[:n])
+    s, h, d = n * 32, 2, 128
+    q, k, v = (a.astype(jnp.bfloat16) for a in _qkv(s, h, d, seed=4))
+    fn = ra.make_ring_attention_fn(
+        comm, causal=True, use_flash=True, interpret=True
+    )
+    res = fn(q, k, v)
+    assert res.dtype == jnp.bfloat16  # output keeps the input dtype
+    out = np.asarray(res.astype(jnp.float32))
+    ref = ra.reference_attention(
+        np.asarray(q.astype(jnp.float32)),
+        np.asarray(k.astype(jnp.float32)),
+        np.asarray(v.astype(jnp.float32)), causal=True,
+    )
+    np.testing.assert_allclose(out, ref, rtol=3e-2, atol=3e-2)
 
 
 @pytest.mark.parametrize("causal", [False, True])
