@@ -7,6 +7,10 @@ single VMEM-resident passes and overlap DMA/ICI traffic with compute:
 
 - :mod:`smi_tpu.kernels.stencil` — fused Jacobi sweep (halo patch +
   4-point average + Dirichlet mask in one pass over the block),
+- :mod:`smi_tpu.kernels.stencil_temporal` — temporally-blocked Jacobi
+  (k sweeps per HBM pass),
+- :mod:`smi_tpu.kernels.flash` — flash-attention block fold for the
+  ring-attention schedule (VMEM-resident online softmax, f32/bf16),
 - :mod:`smi_tpu.kernels.ring` — ring collectives via
   ``make_async_remote_copy`` (explicit ICI RDMA, double-buffered, with
   neighbour-barrier + slot-credit flow control).
