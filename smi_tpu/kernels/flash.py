@@ -70,6 +70,26 @@ def _sublane(dtype) -> int:
     return 16 if dtype == jnp.bfloat16 else 8
 
 
+def _chunk_for(extent: int, block: int, d: int, itemsize: int) -> int:
+    """Chunk = whole blocks fitting the dtype-scaled VMEM budget."""
+    budget_rows = max(1, CHUNK_K * 128 * 4 // (d * itemsize))
+    c = block * max(1, min(budget_rows // block, extent // block))
+    while extent % c:
+        c -= block
+    return c
+
+
+def _resolve_precision(dtype, precision):
+    if precision is None:
+        precision = lax.Precision.HIGHEST
+    if dtype == jnp.bfloat16:
+        # HIGHEST requests an f32-precision contraction, which Mosaic
+        # rejects for bf16 operands (and which bf16 inputs cannot honor
+        # anyway) — the MXU's native bf16 pass is the faithful mode
+        precision = lax.Precision.DEFAULT
+    return precision
+
+
 def flash_supported(s_q: int, s_k: int, d: int, dtype) -> bool:
     """The fast path needs f32/bf16 (scores and the online-softmax
     state are always f32), lane-aligned head_dim, and tileable sequence
@@ -210,21 +230,9 @@ def flash_block_attend(
     bk = _pick_block(s_k, BLOCK_K, mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
-    # chunk = as many sub-tiles as fit the VMEM budget, which shrinks
-    # for wide heads and grows for narrow dtypes (K/V chunk bytes scale
-    # with d * itemsize)
-    budget_rows = max(1, CHUNK_K * 128 * 4 // (d * q.dtype.itemsize))
-    kc = bk * max(1, min(budget_rows // bk, s_k // bk))
-    while s_k % kc:
-        kc -= bk
+    kc = _chunk_for(s_k, bk, d, q.dtype.itemsize)
     n_q, n_kc = s_q // bq, s_k // kc
-    if precision is None:
-        precision = lax.Precision.HIGHEST
-    if q.dtype == jnp.bfloat16:
-        # HIGHEST requests an f32-precision contraction, which Mosaic
-        # rejects for bf16 operands (and which bf16 inputs cannot honor
-        # anyway) — the MXU's native bf16 pass is the faithful mode
-        precision = lax.Precision.DEFAULT
+    precision = _resolve_precision(q.dtype, precision)
 
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,
@@ -259,3 +267,291 @@ def flash_block_attend(
         ],
         interpret=interpret,
     )(offs, q, k, v, m, l, acc)
+
+
+# ---------------------------------------------------------------------
+# Backward pass (FlashAttention-2 style): probabilities are recomputed
+# from the saved softmax statistics, so nothing quadratic is ever
+# stored. Two kernels with opposite grid orientations — dq accumulates
+# over key chunks per query block, dk/dv accumulate over query chunks
+# per key block — each reusing the forward's chunking and causal-skip
+# machinery. The ring-level backward (gradients riding the ring home)
+# lives in models/ring_attention.py.
+# ---------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    offs_ref,    # scalar prefetch: [q_off, k_off]
+    q_ref,       # (1, bq, D)
+    k_ref,       # (1, kc, D) key chunk
+    v_ref,       # (1, kc, D)
+    do_ref,      # (1, bq, D) dout tile
+    m_ref,       # (1, bq, 1) saved row-max
+    linv_ref,    # (1, bq, 1) 1 / safe(l)
+    dlt_ref,     # (1, bq, 1) delta = rowsum(dout * out)
+    dq_ref,      # (1, bq, D) out: dq contribution
+    dq_s,        # scratch (bq, D) f32
+    *,
+    block_q: int,
+    block_k: int,
+    chunk_k: int,
+    n_kc: int,
+    causal: bool,
+    scale: float,
+    precision,
+):
+    qi = pl.program_id(1)
+    kci = pl.program_id(2)
+    bq, bk, kc = block_q, block_k, chunk_k
+    n_sub = kc // bk
+
+    @pl.when(kci == 0)
+    def _zero():
+        dq_s[...] = jnp.zeros_like(dq_s)
+
+    q_first = offs_ref[0] + qi * bq
+    c_first = offs_ref[1] + kci * kc
+    live = (not causal) or (c_first <= q_first + bq - 1)
+
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0]
+        do = do_ref[0]
+        m = m_ref[0]
+        linv = linv_ref[0]
+        dlt = dlt_ref[0]
+        if causal:
+            n_live = jnp.minimum(
+                (q_first + bq - 1 - c_first) // bk + 1, n_sub
+            )
+        else:
+            n_live = n_sub
+
+        def body(ki, dq):
+            kb = k_ref[0, pl.ds(ki * bk, bk), :]
+            vb = v_ref[0, pl.ds(ki * bk, bk), :]
+            s = lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            ) * scale
+            # normalized probabilities from the saved statistics;
+            # masked entries (and fully-masked rows, where m = -1e30)
+            # are zeroed explicitly rather than through exp underflow
+            p = jnp.exp(s - m) * linv
+            if causal:
+                k_first = c_first + ki * bk
+                q_pos = q_first + lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0
+                )
+                k_pos = k_first + lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1
+                )
+                p = jnp.where(k_pos > q_pos, 0.0, p)
+            dp = lax.dot_general(
+                do, vb, (((1,), (1,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - dlt)
+            return dq + lax.dot_general(
+                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            ) * scale
+
+        dq_s[...] = lax.fori_loop(0, n_live, body, dq_s[...])
+
+    @pl.when(kci == n_kc - 1)
+    def _store():
+        dq_ref[0] = dq_s[...]
+
+
+def _bwd_dkdv_kernel(
+    offs_ref,    # scalar prefetch: [q_off, k_off]
+    k_ref,       # (1, bkO, D) key block (the one owning this grid row)
+    v_ref,       # (1, bkO, D)
+    q_ref,       # (1, qc, D) query chunk
+    do_ref,      # (1, qc, D)
+    m_ref,       # (1, 1, qc) saved row-max, row layout
+    linv_ref,    # (1, 1, qc)
+    dlt_ref,     # (1, 1, qc)
+    dk_ref,      # (1, bkO, D) out
+    dv_ref,      # (1, bkO, D) out
+    dk_s,        # scratch (bkO, D) f32
+    dv_s,        # scratch (bkO, D) f32
+    *,
+    block_k: int,   # bkO: key rows per grid step
+    block_q: int,   # bq: query sub-tile within a chunk
+    chunk_q: int,   # qc
+    n_qc: int,
+    causal: bool,
+    scale: float,
+    precision,
+):
+    ki = pl.program_id(1)
+    qci = pl.program_id(2)
+    bkO, bq, qc = block_k, block_q, chunk_q
+    n_sub = qc // bq
+
+    @pl.when(qci == 0)
+    def _zero():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    k_first = offs_ref[1] + ki * bkO
+    c_first = offs_ref[0] + qci * qc  # first global q row of this chunk
+    # under causality only q rows >= k col contribute
+    live = (not causal) or (c_first + qc - 1 >= k_first)
+
+    @pl.when(live)
+    def _accum():
+        kb = k_ref[0]
+        vb = v_ref[0]
+        if causal:
+            s0 = jnp.maximum((k_first - c_first) // bq, 0)
+        else:
+            s0 = 0
+
+        def body(qi, carry):
+            dk, dv = carry
+            qb = q_ref[0, pl.ds(qi * bq, bq), :]
+            db = do_ref[0, pl.ds(qi * bq, bq), :]
+            m = m_ref[0, :, pl.ds(qi * bq, bq)]        # (1, bq)
+            linv = linv_ref[0, :, pl.ds(qi * bq, bq)]
+            dlt = dlt_ref[0, :, pl.ds(qi * bq, bq)]
+            s_t = lax.dot_general(
+                kb, qb, (((1,), (1,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            ) * scale  # (bkO, bq)
+            p_t = jnp.exp(s_t - m) * linv
+            if causal:
+                q_first = c_first + qi * bq
+                k_pos = k_first + lax.broadcasted_iota(
+                    jnp.int32, (bkO, bq), 0
+                )
+                q_pos = q_first + lax.broadcasted_iota(
+                    jnp.int32, (bkO, bq), 1
+                )
+                p_t = jnp.where(k_pos > q_pos, 0.0, p_t)
+            dv = dv + lax.dot_general(
+                p_t.astype(db.dtype), db, (((1,), (0,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            )
+            dp_t = lax.dot_general(
+                vb, db, (((1,), (1,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            )
+            ds_t = p_t * (dp_t - dlt)
+            dk = dk + lax.dot_general(
+                ds_t.astype(qb.dtype), qb, (((1,), (0,)), ((), ())),
+                precision=precision, preferred_element_type=jnp.float32,
+            ) * scale
+            return dk, dv
+
+        dk, dv = lax.fori_loop(s0, n_sub, body, (dk_s[...], dv_s[...]))
+        dk_s[...] = dk
+        dv_s[...] = dv
+
+    @pl.when(qci == n_qc - 1)
+    def _store():
+        dk_ref[0] = dk_s[...]
+        dv_ref[0] = dv_s[...]
+
+
+def flash_block_backward_dq(
+    q, k, v, dout, m, linv, delta, q_off, k_off,
+    causal: bool, scale: float, precision=None, interpret: bool = False,
+):
+    """dq contribution of one K/V block (f32, head-major ``(H,Sq,D)``).
+
+    ``m``/``linv``/``delta`` are ``(H, Sq, 1)`` saved statistics
+    (``linv = 1/l`` with fully-masked rows mapped to 1).
+    """
+    h, s_q, d = q.shape
+    s_k = k.shape[1]
+    mult = _sublane(q.dtype)
+    bq = _pick_block(s_q, BLOCK_Q, mult)
+    bk = _pick_block(s_k, BLOCK_K, mult)
+    if bq is None or bk is None:
+        raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
+    kc = _chunk_for(s_k, bk, d, q.dtype.itemsize)
+    n_q, n_kc = s_q // bq, s_k // kc
+    precision = _resolve_precision(q.dtype, precision)
+
+    kernel = functools.partial(
+        _bwd_dq_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,
+        causal=causal, scale=scale, precision=precision,
+    )
+    offs = jnp.stack(
+        [jnp.asarray(q_off), jnp.asarray(k_off)]
+    ).astype(jnp.int32)
+    qspec = pl.BlockSpec((1, bq, d), lambda hh, qi, ki, offs: (hh, qi, 0))
+    kspec = pl.BlockSpec((1, kc, d), lambda hh, qi, ki, offs: (hh, ki, 0))
+    colspec = pl.BlockSpec(
+        (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(h, n_q, n_kc),
+        in_specs=[qspec, kspec, kspec, qspec, colspec, colspec, colspec],
+        out_specs=[qspec],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((h, s_q, d), jnp.float32)],
+        interpret=interpret,
+    )(offs, q, k, v, dout, m, linv, delta)[0]
+
+
+def flash_block_backward_dkdv(
+    q, k, v, dout, m_row, linv_row, delta_row, q_off, k_off,
+    causal: bool, scale: float, precision=None, interpret: bool = False,
+):
+    """(dk, dv) of one K/V block from this rank's queries (f32).
+
+    ``m_row``/``linv_row``/``delta_row`` are the saved statistics in row
+    layout ``(H, 1, Sq)``.
+    """
+    h, s_q, d = q.shape
+    s_k = k.shape[1]
+    mult = _sublane(q.dtype)
+    bkO = _pick_block(s_k, BLOCK_K, mult)
+    bq = _pick_block(s_q, BLOCK_Q, mult)
+    if bkO is None or bq is None:
+        raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
+    qc = _chunk_for(s_q, bq, d, q.dtype.itemsize)
+    n_k, n_qc = s_k // bkO, s_q // qc
+    precision = _resolve_precision(q.dtype, precision)
+
+    kernel = functools.partial(
+        _bwd_dkdv_kernel, block_k=bkO, block_q=bq, chunk_q=qc,
+        n_qc=n_qc, causal=causal, scale=scale, precision=precision,
+    )
+    offs = jnp.stack(
+        [jnp.asarray(q_off), jnp.asarray(k_off)]
+    ).astype(jnp.int32)
+    kspec = pl.BlockSpec((1, bkO, d), lambda hh, ki, qi, offs: (hh, ki, 0))
+    qcspec = pl.BlockSpec((1, qc, d), lambda hh, ki, qi, offs: (hh, qi, 0))
+    rowspec = pl.BlockSpec(
+        (1, 1, qc), lambda hh, ki, qi, offs: (hh, 0, qi)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(h, n_k, n_qc),
+        in_specs=[kspec, kspec, qcspec, qcspec, rowspec, rowspec, rowspec],
+        out_specs=[kspec, kspec],
+        scratch_shapes=[
+            pltpu.VMEM((bkO, d), jnp.float32),
+            pltpu.VMEM((bkO, d), jnp.float32),
+        ],
+    )
+    dk, dv = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((h, s_k, d), jnp.float32),
+            jax.ShapeDtypeStruct((h, s_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, k, v, q, dout, m_row, linv_row, delta_row)
+    return dk, dv

@@ -31,6 +31,8 @@ from jax.sharding import PartitionSpec as P
 from smi_tpu.kernels.flash import (
     NEG_INF,
     flash_block_attend,
+    flash_block_backward_dkdv,
+    flash_block_backward_dq,
     flash_supported,
 )
 from smi_tpu.parallel.channels import ring_shift
@@ -95,11 +97,11 @@ def _use_flash_default(comm: Communicator, s_local, h, d, dtype) -> bool:
     return platforms == {"tpu"} and flash_supported(s_local, s_local, d, dtype)
 
 
-def _ring_attention_shard_flash(
-    q, k, v, comm, causal, axis, precision, interpret
-):
-    """Flash-tier ring schedule: head-major layouts, one Pallas launch
-    per ring step (``kernels/flash.py``), K/V moved by ``ring_shift``."""
+def _flash_forward(q, k, v, comm, causal, axis, precision, interpret):
+    """Flash-tier ring forward: head-major layouts, one Pallas launch
+    per ring step (``kernels/flash.py``), K/V moved by ``ring_shift``.
+    Returns ``(out, m, l)`` — the statistics are the backward pass's
+    residuals."""
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -124,8 +126,113 @@ def _ring_attention_shard_flash(
         k.swapaxes(0, 1), v.swapaxes(0, 1), (m0, l0, acc0),
     )
     safe_l = jnp.where(l == 0.0, 1.0, l)  # (H, S, 1)
-    out = acc / safe_l
-    return out.swapaxes(0, 1).astype(q.dtype)
+    out = (acc / safe_l).swapaxes(0, 1).astype(q.dtype)
+    return out, m, l
+
+
+def _flash_ring_backward(
+    q, k, v, out, m, l, dout, comm, causal, axis, precision, interpret
+):
+    """FlashAttention-2 backward over the ring.
+
+    Probabilities are recomputed blockwise from the saved ``(m, l)``
+    (``kernels/flash.py`` backward kernels — nothing quadratic is
+    stored). K/V blocks make one more ring circuit, this time carrying
+    their ``(dk, dv)`` accumulators with them: after ``n`` fold+shift
+    steps each block arrives home with the gradient contributions of
+    every rank's queries on board. ``dq`` accumulates locally.
+    """
+    n = comm.mesh.shape[axis]
+    rank = lax.axis_index(axis)
+    s_local, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    q_off = rank * s_local
+
+    qT = q.swapaxes(0, 1)
+    doutT = dout.swapaxes(0, 1).astype(q.dtype)
+    outT = out.swapaxes(0, 1).astype(jnp.float32)
+    linv = 1.0 / jnp.where(l == 0.0, 1.0, l)           # (H, S, 1)
+    delta = jnp.sum(
+        doutT.astype(jnp.float32) * outT, axis=-1, keepdims=True
+    )  # (H, S, 1)
+    m_row = m.transpose(0, 2, 1)                        # (H, 1, S)
+    linv_row = linv.transpose(0, 2, 1)
+    delta_row = delta.transpose(0, 2, 1)
+
+    dq0 = jnp.zeros((h, s_local, d), jnp.float32)
+    state0 = (
+        k.swapaxes(0, 1), v.swapaxes(0, 1),
+        jnp.zeros((h, s_local, d), jnp.float32),
+        jnp.zeros((h, s_local, d), jnp.float32),
+        dq0,
+    )
+
+    def fold(s, k_cur, v_cur, dk_cur, dv_cur, dq):
+        src = lax.rem(rank - s + jnp.int32(n), jnp.int32(n))
+        k_off = src * s_local
+        dq = dq + flash_block_backward_dq(
+            qT, k_cur, v_cur, doutT, m, linv, delta,
+            q_off, k_off, causal, scale, precision, interpret=interpret,
+        )
+        dkc, dvc = flash_block_backward_dkdv(
+            qT, k_cur, v_cur, doutT, m_row, linv_row, delta_row,
+            q_off, k_off, causal, scale, precision, interpret=interpret,
+        )
+        return dk_cur + dkc, dv_cur + dvc, dq
+
+    shift = lambda x: ring_shift(x, comm, offset=1, axis_name=axis)
+
+    def step(s, state):
+        k_cur, v_cur, dk_cur, dv_cur, dq = state
+        dk_cur, dv_cur, dq = fold(s, k_cur, v_cur, dk_cur, dv_cur, dq)
+        # the accumulators travel WITH their block; after n shifts both
+        # are back at the block's owner
+        return (shift(k_cur), shift(v_cur), shift(dk_cur), shift(dv_cur),
+                dq)
+
+    # n-1 looped fold+shift steps; the last block folds without the
+    # dead trailing k/v shift — only its accumulators make the final
+    # hop home
+    k_l, v_l, dk_l, dv_l, dqT = lax.fori_loop(0, n - 1, step, state0)
+    dk_l, dv_l, dqT = fold(n - 1, k_l, v_l, dk_l, dv_l, dqT)
+    dkT, dvT = shift(dk_l), shift(dv_l)
+    return (
+        dqT.swapaxes(0, 1).astype(q.dtype),
+        dkT.swapaxes(0, 1).astype(k.dtype),
+        dvT.swapaxes(0, 1).astype(v.dtype),
+    )
+
+
+def _ring_attention_shard_flash(
+    q, k, v, comm, causal, axis, precision, interpret
+):
+    """Flash tier with a custom VJP: forward saves the online-softmax
+    statistics; backward recomputes probabilities blockwise and rides
+    the ring in reverse — long-context attention stays trainable at
+    sizes where the jnp tier cannot even materialize the scores."""
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        out, _, _ = _flash_forward(
+            q, k, v, comm, causal, axis, precision, interpret
+        )
+        return out
+
+    def fwd(q, k, v):
+        out, m, l = _flash_forward(
+            q, k, v, comm, causal, axis, precision, interpret
+        )
+        return out, (q, k, v, out, m, l)
+
+    def bwd(res, dout):
+        q, k, v, out, m, l = res
+        return _flash_ring_backward(
+            q, k, v, out, m, l, dout, comm, causal, axis, precision,
+            interpret,
+        )
+
+    attn.defvjp(fwd, bwd)
+    return attn(q, k, v)
 
 
 def ring_attention_shard(
