@@ -30,6 +30,10 @@ def test_all_benchmarks_run(comm8, tmp_path):
         "app_ring_attention": {
             "seq_per_rank": 16, "heads": 2, "head_dim": 16, "runs": 2,
         },
+        "app_ring_attention_train": {
+            "seq_per_rank": 16, "heads": 2, "head_dim": 16, "runs": 2,
+            "reps": 2,
+        },
     }
     assert set(params) == set(BENCHMARKS)
     for name, p in params.items():
