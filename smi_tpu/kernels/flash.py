@@ -205,8 +205,8 @@ def _flash_kernel(
 
 def flash_block_attend(
     q: jax.Array,       # (H, Sq, D)
-    k: jax.Array,       # (H, Sk, D)
-    v: jax.Array,       # (H, Sk, D)
+    k: jax.Array,       # (H_kv, Sk, D); H_kv divides H (GQA)
+    v: jax.Array,       # (H_kv, Sk, D)
     m: jax.Array,       # (H, Sq, 1)
     l: jax.Array,       # (H, Sq, 1)
     acc: jax.Array,     # (H, Sq, D)
@@ -221,10 +221,14 @@ def flash_block_attend(
 
     Head-major twin of ``_block_attend``: same math, same global-offset
     causal mask, but score tiles never leave VMEM. ``q_off``/``k_off``
-    may be traced (they arrive via scalar prefetch).
+    may be traced (they arrive via scalar prefetch). Grouped-query
+    attention is native: ``group = H // H_kv`` consecutive query heads
+    read the same K/V head tile (the index map divides, no repeat is
+    materialized).
     """
     h, s_q, d = q.shape
     s_k = k.shape[1]
+    group = h // k.shape[0]
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, BLOCK_Q, mult)
     bk = _pick_block(s_k, BLOCK_K, mult)
@@ -242,7 +246,9 @@ def flash_block_attend(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
     ).astype(jnp.int32)
     qspec = pl.BlockSpec((1, bq, d), lambda hh, qi, ki, offs: (hh, qi, 0))
-    kspec = pl.BlockSpec((1, kc, d), lambda hh, qi, ki, offs: (hh, ki, 0))
+    kspec = pl.BlockSpec(
+        (1, kc, d), lambda hh, qi, ki, offs: (hh // group, ki, 0)
+    )
     colspec = pl.BlockSpec(
         (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
     )
@@ -373,8 +379,8 @@ def _bwd_dkdv_kernel(
     m_ref,       # (1, 1, qc) saved row-max, row layout
     linv_ref,    # (1, 1, qc)
     dlt_ref,     # (1, 1, qc)
-    dk_ref,      # (1, bkO, D) out
-    dv_ref,      # (1, bkO, D) out
+    dk_ref,      # (1, bkO, D) out, grouped head
+    dv_ref,      # (1, bkO, D) out, grouped head
     dk_s,        # scratch (bkO, D) f32
     dv_s,        # scratch (bkO, D) f32
     *,
@@ -382,16 +388,22 @@ def _bwd_dkdv_kernel(
     block_q: int,   # bq: query sub-tile within a chunk
     chunk_q: int,   # qc
     n_qc: int,
+    group: int,
     causal: bool,
     scale: float,
     precision,
 ):
-    ki = pl.program_id(1)
+    # Grid is (n_k, H, n_qc) — query heads vary in the MIDDLE dimension
+    # so the `group` consecutive heads sharing one K/V head revisit the
+    # same grouped output block contiguously, accumulating their dk/dv
+    # in scratch (no per-query-head HBM output, no external reduction).
+    ki = pl.program_id(0)
+    hh = pl.program_id(1)
     qci = pl.program_id(2)
     bkO, bq, qc = block_k, block_q, chunk_q
     n_sub = qc // bq
 
-    @pl.when(qci == 0)
+    @pl.when((qci == 0) & (hh % group == 0))
     def _zero():
         dk_s[...] = jnp.zeros_like(dk_s)
         dv_s[...] = jnp.zeros_like(dv_s)
@@ -450,7 +462,7 @@ def _bwd_dkdv_kernel(
         dk_s[...] = dk
         dv_s[...] = dv
 
-    @pl.when(qci == n_qc - 1)
+    @pl.when((qci == n_qc - 1) & (hh % group == group - 1))
     def _store():
         dk_ref[0] = dk_s[...]
         dv_ref[0] = dv_s[...]
@@ -463,10 +475,12 @@ def flash_block_backward_dq(
     """dq contribution of one K/V block (f32, head-major ``(H,Sq,D)``).
 
     ``m``/``linv``/``delta`` are ``(H, Sq, 1)`` saved statistics
-    (``linv = 1/l`` with fully-masked rows mapped to 1).
+    (``linv = 1/l`` with fully-masked rows mapped to 1). ``k``/``v``
+    may carry fewer (grouped) heads.
     """
     h, s_q, d = q.shape
     s_k = k.shape[1]
+    group = h // k.shape[0]
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, BLOCK_Q, mult)
     bk = _pick_block(s_k, BLOCK_K, mult)
@@ -484,7 +498,9 @@ def flash_block_backward_dq(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
     ).astype(jnp.int32)
     qspec = pl.BlockSpec((1, bq, d), lambda hh, qi, ki, offs: (hh, qi, 0))
-    kspec = pl.BlockSpec((1, kc, d), lambda hh, qi, ki, offs: (hh, ki, 0))
+    kspec = pl.BlockSpec(
+        (1, kc, d), lambda hh, qi, ki, offs: (hh // group, ki, 0)
+    )
     colspec = pl.BlockSpec(
         (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
     )
@@ -510,10 +526,14 @@ def flash_block_backward_dkdv(
     """(dk, dv) of one K/V block from this rank's queries (f32).
 
     ``m_row``/``linv_row``/``delta_row`` are the saved statistics in row
-    layout ``(H, 1, Sq)``.
+    layout ``(H, 1, Sq)``. ``k``/``v`` may carry fewer (grouped) heads;
+    the returned ``(dk, dv)`` match the K/V head count — the group
+    reduction happens in-kernel (heads iterate in the middle grid
+    dimension, so a group's output block is revisited contiguously).
     """
     h, s_q, d = q.shape
     s_k = k.shape[1]
+    group = h // k.shape[0]
     mult = _sublane(q.dtype)
     bkO = _pick_block(s_k, BLOCK_K, mult)
     bq = _pick_block(s_q, BLOCK_Q, mult)
@@ -525,19 +545,23 @@ def flash_block_backward_dkdv(
 
     kernel = functools.partial(
         _bwd_dkdv_kernel, block_k=bkO, block_q=bq, chunk_q=qc,
-        n_qc=n_qc, causal=causal, scale=scale, precision=precision,
+        n_qc=n_qc, group=group, causal=causal, scale=scale,
+        precision=precision,
     )
     offs = jnp.stack(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
     ).astype(jnp.int32)
-    kspec = pl.BlockSpec((1, bkO, d), lambda hh, ki, qi, offs: (hh, ki, 0))
-    qcspec = pl.BlockSpec((1, qc, d), lambda hh, ki, qi, offs: (hh, qi, 0))
+    h_kv = h // group
+    kspec = pl.BlockSpec(
+        (1, bkO, d), lambda ki, hh, qi, offs: (hh // group, ki, 0)
+    )
+    qcspec = pl.BlockSpec((1, qc, d), lambda ki, hh, qi, offs: (hh, qi, 0))
     rowspec = pl.BlockSpec(
-        (1, 1, qc), lambda hh, ki, qi, offs: (hh, 0, qi)
+        (1, 1, qc), lambda ki, hh, qi, offs: (hh, 0, qi)
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(h, n_k, n_qc),
+        grid=(n_k, h, n_qc),
         in_specs=[kspec, kspec, qcspec, qcspec, rowspec, rowspec, rowspec],
         out_specs=[kspec, kspec],
         scratch_shapes=[
@@ -549,8 +573,8 @@ def flash_block_backward_dkdv(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((h, s_k, d), jnp.float32),
-            jax.ShapeDtypeStruct((h, s_k, d), jnp.float32),
+            jax.ShapeDtypeStruct((h_kv, s_k, d), jnp.float32),
+            jax.ShapeDtypeStruct((h_kv, s_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(offs, k, v, q, dout, m_row, linv_row, delta_row)

@@ -101,7 +101,8 @@ def _flash_forward(q, k, v, comm, causal, axis, precision, interpret):
     """Flash-tier ring forward: head-major layouts, one Pallas launch
     per ring step (``kernels/flash.py``), K/V moved by ``ring_shift``.
     Returns ``(out, m, l)`` — the statistics are the backward pass's
-    residuals."""
+    residuals. With grouped K/V heads, only the smaller K/V circulate —
+    the kernel reads them grouped, nothing is repeated."""
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -145,6 +146,7 @@ def _flash_ring_backward(
     n = comm.mesh.shape[axis]
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
+    h_kv = k.shape[1]
     scale = 1.0 / math.sqrt(d)
     q_off = rank * s_local
 
@@ -162,8 +164,8 @@ def _flash_ring_backward(
     dq0 = jnp.zeros((h, s_local, d), jnp.float32)
     state0 = (
         k.swapaxes(0, 1), v.swapaxes(0, 1),
-        jnp.zeros((h, s_local, d), jnp.float32),
-        jnp.zeros((h, s_local, d), jnp.float32),
+        jnp.zeros((h_kv, s_local, d), jnp.float32),
+        jnp.zeros((h_kv, s_local, d), jnp.float32),
         dq0,
     )
 
@@ -248,9 +250,11 @@ def ring_attention_shard(
 ) -> jax.Array:
     """Per-shard ring attention (call inside ``shard_map``).
 
-    ``q``/``k``/``v`` are this rank's ``(S_local, H, D)`` sequence shards.
-    K/V make a full ring circuit (one ``ppermute`` per step, n-1 hops);
-    XLA overlaps each hop with the previous block's attention math — the
+    ``q`` is this rank's ``(S_local, H, D)`` sequence shard; ``k``/``v``
+    are ``(S_local, H_kv, D)`` with ``H_kv`` dividing ``H``
+    (grouped-query attention; ``H_kv == H`` is plain MHA). K/V make a
+    full ring circuit (one ``ppermute`` per step, n-1 hops); XLA
+    overlaps each hop with the previous block's attention math — the
     stencil bridge-kernel overlap, applied to attention.
 
     On TPU with flash-compatible shapes the per-step block fold runs as
@@ -261,6 +265,13 @@ def ring_attention_shard(
     axis = axis_name or comm.axis_names[0]
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
+    h_kv = k.shape[1]
+    if h % h_kv or v.shape[1] != h_kv:
+        raise ValueError(
+            f"kv heads {k.shape[1]}/{v.shape[1]} must agree and divide "
+            f"query heads {h}"
+        )
+    group = h // h_kv
     if use_flash is None:
         use_flash = _use_flash_default(comm, s_local, h, d, q.dtype)
     if use_flash:
@@ -276,6 +287,10 @@ def ring_attention_shard(
 
     def fold(src, k_cur, v_cur, carry):
         m, l, acc = carry
+        if group > 1:
+            # repeat per fold so only the small K/V ride the ring
+            k_cur = jnp.repeat(k_cur, group, axis=1)
+            v_cur = jnp.repeat(v_cur, group, axis=1)
         return _block_attend(
             q, k_cur, v_cur, m, l, acc,
             q_off, src * s_local, causal, scale, precision,

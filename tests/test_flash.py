@@ -221,16 +221,21 @@ def test_flash_ring_attention_gradients_bf16(eight_devices):
         assert bool(jnp.isfinite(x.astype(jnp.float32)).all())
 
 
-def test_flash_gradients_multi_chunk(eight_devices):
+@pytest.mark.parametrize("h,h_kv", [(1, 1), (2, 1)])
+def test_flash_gradients_multi_chunk(eight_devices, h, h_kv):
     """Backward kernels with several chunks and sub-tiles per grid
     step: scratch accumulation across kci/qci > 0, causal n_live
-    clipping (dq), and the s0 start-index clip (dk/dv)."""
+    clipping (dq), the s0 start-index clip (dk/dv), and — for the GQA
+    case — the in-kernel group reduction across contiguous head
+    revisits."""
     comm = smi.make_communicator(2, devices=eight_devices[:2])
-    s, h, d = 128, 1, 128
+    s, d = 128, 128
     rng = np.random.RandomState(7)
-    q, k, v, w = (
-        jnp.asarray(rng.randn(s, h, d).astype(np.float32))
-        for _ in range(4)
+    q = jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+    w = jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+    k, v = (
+        jnp.asarray(rng.randn(s, h_kv, d).astype(np.float32))
+        for _ in range(2)
     )
     old = flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K
     try:
@@ -257,3 +262,63 @@ def test_flash_gradients_multi_chunk(eight_devices):
                 )
     finally:
         flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = old
+
+
+@pytest.mark.parametrize("use_flash", [True, False])
+def test_ring_attention_gqa(eight_devices, use_flash):
+    """Grouped-query attention: H_kv < H heads of K/V; both tiers match
+    full attention over the repeated K/V."""
+    comm = smi.make_communicator(2, devices=eight_devices[:2])
+    s, h, h_kv, d = 64, 4, 2, 128
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+    k, v = (
+        jnp.asarray(rng.randn(s, h_kv, d).astype(np.float32))
+        for _ in range(2)
+    )
+    fn = ra.make_ring_attention_fn(
+        comm, causal=True, use_flash=use_flash, interpret=use_flash
+    )
+    out = np.asarray(fn(q, k, v))
+    ref = ra.reference_attention(
+        q, np.repeat(np.asarray(k), h // h_kv, axis=1),
+        np.repeat(np.asarray(v), h // h_kv, axis=1), causal=True,
+    )
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gqa_gradients(eight_devices):
+    """GQA gradients: flash custom-VJP (incl. the per-query-head dk/dv
+    group reduction) vs jnp-tier autodiff through the repeat."""
+    comm = smi.make_communicator(2, devices=eight_devices[:2])
+    s, h, h_kv, d = 32, 4, 2, 128
+    rng = np.random.RandomState(13)
+    q = jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+    k, v = (
+        jnp.asarray(rng.randn(s, h_kv, d).astype(np.float32))
+        for _ in range(2)
+    )
+    w = jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+    fn_f = ra.make_ring_attention_fn(
+        comm, causal=True, use_flash=True, interpret=True
+    )
+    fn_j = ra.make_ring_attention_fn(comm, causal=True, use_flash=False)
+    gf = jax.grad(lambda q, k, v: jnp.sum(fn_f(q, k, v) * w),
+                  argnums=(0, 1, 2))(q, k, v)
+    gj = jax.grad(lambda q, k, v: jnp.sum(fn_j(q, k, v) * w),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gj, ("dq", "dk", "dv")):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5,
+            err_msg=name,
+        )
+
+
+def test_ring_attention_rejects_bad_kv_heads(eight_devices):
+    comm = smi.make_communicator(1, devices=eight_devices[:1])
+    q, _, _ = _qkv(16, 4, 128)
+    k, v, _ = _qkv(16, 3, 128, seed=1)
+    fn = ra.make_ring_attention_fn(comm, use_flash=False)
+    with pytest.raises(ValueError, match="divide"):
+        fn(q, k, v)
