@@ -8,6 +8,8 @@ payload against the serial reference before reporting.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -126,8 +128,15 @@ def bench_kmeans(
     )
 
 
+def _default_seq_per_rank(comm: Communicator) -> int:
+    """1024 on TPU; 128 on CPU meshes, where a quadratic-attention step
+    at 8k global tokens runs long enough to trip XLA's 40 s collective
+    rendezvous timeout (threads that are still computing look stuck)."""
+    return 1024 if comm.is_tpu else 128
+
+
 def bench_ring_attention(
-    comm: Communicator, seq_per_rank: int = 1024, heads: int = 8,
+    comm: Communicator, seq_per_rank: Optional[int] = None, heads: int = 8,
     head_dim: int = 128, runs: int = 5, causal: bool = True,
     precision=None, reps: int = 8,
 ) -> Measurement:
@@ -153,6 +162,8 @@ def bench_ring_attention(
 
     if precision is None:
         precision = lax.Precision.HIGHEST
+    if seq_per_rank is None:
+        seq_per_rank = _default_seq_per_rank(comm)
     n = comm.size
     s = n * seq_per_rank
     rng = np.random.RandomState(0)
@@ -184,7 +195,7 @@ def bench_ring_attention(
 
 
 def bench_ring_attention_train(
-    comm: Communicator, seq_per_rank: int = 1024, heads: int = 8,
+    comm: Communicator, seq_per_rank: Optional[int] = None, heads: int = 8,
     head_dim: int = 128, runs: int = 5, causal: bool = True,
     reps: int = 4,
 ) -> Measurement:
@@ -200,6 +211,8 @@ def bench_ring_attention_train(
 
     from smi_tpu.models import ring_attention as ra
 
+    if seq_per_rank is None:
+        seq_per_rank = _default_seq_per_rank(comm)
     n = comm.size
     s = n * seq_per_rank
     rng = np.random.RandomState(0)

@@ -228,6 +228,10 @@ def flash_block_attend(
     """
     h, s_q, d = q.shape
     s_k = k.shape[1]
+    if h % k.shape[0]:
+        raise ValueError(
+            f"kv heads {k.shape[0]} must divide query heads {h}"
+        )
     group = h // k.shape[0]
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, BLOCK_Q, mult)
@@ -480,6 +484,10 @@ def flash_block_backward_dq(
     """
     h, s_q, d = q.shape
     s_k = k.shape[1]
+    if h % k.shape[0]:
+        raise ValueError(
+            f"kv heads {k.shape[0]} must divide query heads {h}"
+        )
     group = h // k.shape[0]
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, BLOCK_Q, mult)
@@ -533,6 +541,10 @@ def flash_block_backward_dkdv(
     """
     h, s_q, d = q.shape
     s_k = k.shape[1]
+    if h % k.shape[0]:
+        raise ValueError(
+            f"kv heads {k.shape[0]} must divide query heads {h}"
+        )
     group = h // k.shape[0]
     mult = _sublane(q.dtype)
     bkO = _pick_block(s_k, BLOCK_K, mult)

@@ -93,8 +93,7 @@ def _ring_schedule(fold, comm, axis, k0, v0, carry0):
 
 
 def _use_flash_default(comm: Communicator, s_local, h, d, dtype) -> bool:
-    platforms = {dev.platform for dev in comm.mesh.devices.flat}
-    return platforms == {"tpu"} and flash_supported(s_local, s_local, d, dtype)
+    return comm.is_tpu and flash_supported(s_local, s_local, d, dtype)
 
 
 def _flash_forward(q, k, v, comm, causal, axis, precision, interpret):

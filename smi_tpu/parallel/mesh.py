@@ -90,6 +90,15 @@ class Communicator:
     def replicated(self) -> P:
         return P()
 
+    @property
+    def is_tpu(self) -> bool:
+        """True when every mesh device is a TPU — the gate for compiled
+        Pallas fast paths (the CPU fake mesh runs them in interpret
+        mode instead)."""
+        return all(
+            dev.platform == "tpu" for dev in self.mesh.devices.flat
+        )
+
     def subcomm(self, *axis_names: str) -> "Communicator":
         """Communicator over a subset of axes (rows/columns of the mesh)."""
         return Communicator(
