@@ -79,6 +79,20 @@ def _chunk_for(extent: int, block: int, d: int, itemsize: int) -> int:
     return c
 
 
+def _gqa_group(h: int, h_kv: int) -> int:
+    """Validated query-heads-per-KV-head group factor."""
+    if h % h_kv:
+        raise ValueError(
+            f"kv heads {h_kv} must divide query heads {h}"
+        )
+    return h // h_kv
+
+
+def _validate_window(causal: bool, window) -> None:
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
+
+
 def _resolve_precision(dtype, precision):
     if precision is None:
         precision = lax.Precision.HIGHEST
@@ -125,6 +139,7 @@ def _flash_kernel(
     chunk_k: int,
     n_kc: int,
     causal: bool,
+    window,
     scale: float,
     precision,
 ):
@@ -140,10 +155,13 @@ def _flash_kernel(
         acc_s[...] = acc_in_ref[0]
 
     # Global positions of this tile's rows and of the chunk's first
-    # column; chunks wholly inside the causal future are skipped.
+    # column; chunks wholly inside the causal future — or, with a
+    # sliding window, wholly before any row's window — are skipped.
     q_first = offs_ref[0] + qi * bq
     c_first = offs_ref[1] + kci * kc
     live = (not causal) or (c_first <= q_first + bq - 1)
+    if window is not None:
+        live &= c_first + kc - 1 >= q_first - (window - 1)
 
     @pl.when(live)
     def _attend():
@@ -156,6 +174,13 @@ def _flash_kernel(
             )
         else:
             n_live = n_sub
+        if window is not None:
+            # first sub-tile overlapping the earliest row's window
+            s0 = jnp.maximum(
+                (q_first - (window - 1) - c_first) // bk, 0
+            )
+        else:
+            s0 = 0
 
         def body(ki, carry):
             m, l, acc = carry
@@ -172,7 +197,10 @@ def _flash_kernel(
                 k_pos = k_first + lax.broadcasted_iota(
                     jnp.int32, (bq, bk), 1
                 )
-                scores = jnp.where(k_pos > q_pos, NEG_INF, scores)
+                masked = k_pos > q_pos
+                if window is not None:
+                    masked |= k_pos < q_pos - (window - 1)
+                scores = jnp.where(masked, NEG_INF, scores)
             m_new = jnp.maximum(m, scores.max(axis=1, keepdims=True))
             # exp(-1e30 - -1e30) = 1 for still-all-masked rows:
             # transient garbage, zeroed by this same correction once a
@@ -190,7 +218,7 @@ def _flash_kernel(
             return m_new, l, acc
 
         m, l, acc = lax.fori_loop(
-            0, n_live, body, (m_s[...], l_s[...], acc_s[...])
+            s0, n_live, body, (m_s[...], l_s[...], acc_s[...])
         )
         m_s[...] = m
         l_s[...] = l
@@ -216,6 +244,7 @@ def flash_block_attend(
     scale: float,
     precision=None,
     interpret: bool = False,
+    window: Optional[int] = None,
 ):
     """Fold one K/V block into the online-softmax carry (flash tier).
 
@@ -224,15 +253,14 @@ def flash_block_attend(
     may be traced (they arrive via scalar prefetch). Grouped-query
     attention is native: ``group = H // H_kv`` consecutive query heads
     read the same K/V head tile (the index map divides, no repeat is
-    materialized).
+    materialized). ``window`` (requires ``causal``) restricts each row
+    to its ``window`` most recent positions (sliding-window attention);
+    out-of-window chunks are skipped entirely.
     """
+    _validate_window(causal, window)
     h, s_q, d = q.shape
     s_k = k.shape[1]
-    if h % k.shape[0]:
-        raise ValueError(
-            f"kv heads {k.shape[0]} must divide query heads {h}"
-        )
-    group = h // k.shape[0]
+    group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, BLOCK_Q, mult)
     bk = _pick_block(s_k, BLOCK_K, mult)
@@ -244,7 +272,7 @@ def flash_block_attend(
 
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,
-        causal=causal, scale=scale, precision=precision,
+        causal=causal, window=window, scale=scale, precision=precision,
     )
     offs = jnp.stack(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
@@ -307,6 +335,7 @@ def _bwd_dq_kernel(
     chunk_k: int,
     n_kc: int,
     causal: bool,
+    window,
     scale: float,
     precision,
 ):
@@ -322,6 +351,8 @@ def _bwd_dq_kernel(
     q_first = offs_ref[0] + qi * bq
     c_first = offs_ref[1] + kci * kc
     live = (not causal) or (c_first <= q_first + bq - 1)
+    if window is not None:
+        live &= c_first + kc - 1 >= q_first - (window - 1)
 
     @pl.when(live)
     def _accum():
@@ -336,6 +367,12 @@ def _bwd_dq_kernel(
             )
         else:
             n_live = n_sub
+        if window is not None:
+            s0 = jnp.maximum(
+                (q_first - (window - 1) - c_first) // bk, 0
+            )
+        else:
+            s0 = 0
 
         def body(ki, dq):
             kb = k_ref[0, pl.ds(ki * bk, bk), :]
@@ -356,7 +393,10 @@ def _bwd_dq_kernel(
                 k_pos = k_first + lax.broadcasted_iota(
                     jnp.int32, (bq, bk), 1
                 )
-                p = jnp.where(k_pos > q_pos, 0.0, p)
+                masked = k_pos > q_pos
+                if window is not None:
+                    masked |= k_pos < q_pos - (window - 1)
+                p = jnp.where(masked, 0.0, p)
             dp = lax.dot_general(
                 do, vb, (((1,), (1,)), ((), ())),
                 precision=precision, preferred_element_type=jnp.float32,
@@ -367,7 +407,7 @@ def _bwd_dq_kernel(
                 precision=precision, preferred_element_type=jnp.float32,
             ) * scale
 
-        dq_s[...] = lax.fori_loop(0, n_live, body, dq_s[...])
+        dq_s[...] = lax.fori_loop(s0, n_live, body, dq_s[...])
 
     @pl.when(kci == n_kc - 1)
     def _store():
@@ -394,6 +434,7 @@ def _bwd_dkdv_kernel(
     n_qc: int,
     group: int,
     causal: bool,
+    window,
     scale: float,
     precision,
 ):
@@ -414,8 +455,11 @@ def _bwd_dkdv_kernel(
 
     k_first = offs_ref[1] + ki * bkO
     c_first = offs_ref[0] + qci * qc  # first global q row of this chunk
-    # under causality only q rows >= k col contribute
+    # under causality only q rows >= k col contribute; with a sliding
+    # window, only q rows < k col + window
     live = (not causal) or (c_first + qc - 1 >= k_first)
+    if window is not None:
+        live &= c_first <= k_first + bkO - 1 + (window - 1)
 
     @pl.when(live)
     def _accum():
@@ -425,6 +469,14 @@ def _bwd_dkdv_kernel(
             s0 = jnp.maximum((k_first - c_first) // bq, 0)
         else:
             s0 = 0
+        if window is not None:
+            # last sub-tile any of this block's keys can reach
+            n_end = jnp.minimum(
+                (k_first + bkO - 1 + (window - 1) - c_first) // bq + 1,
+                n_sub,
+            )
+        else:
+            n_end = n_sub
 
         def body(qi, carry):
             dk, dv = carry
@@ -446,7 +498,10 @@ def _bwd_dkdv_kernel(
                 q_pos = q_first + lax.broadcasted_iota(
                     jnp.int32, (bkO, bq), 1
                 )
-                p_t = jnp.where(k_pos > q_pos, 0.0, p_t)
+                masked = k_pos > q_pos
+                if window is not None:
+                    masked |= k_pos < q_pos - (window - 1)
+                p_t = jnp.where(masked, 0.0, p_t)
             dv = dv + lax.dot_general(
                 p_t.astype(db.dtype), db, (((1,), (0,)), ((), ())),
                 precision=precision, preferred_element_type=jnp.float32,
@@ -462,7 +517,7 @@ def _bwd_dkdv_kernel(
             ) * scale
             return dk, dv
 
-        dk, dv = lax.fori_loop(s0, n_sub, body, (dk_s[...], dv_s[...]))
+        dk, dv = lax.fori_loop(s0, n_end, body, (dk_s[...], dv_s[...]))
         dk_s[...] = dk
         dv_s[...] = dv
 
@@ -475,6 +530,7 @@ def _bwd_dkdv_kernel(
 def flash_block_backward_dq(
     q, k, v, dout, m, linv, delta, q_off, k_off,
     causal: bool, scale: float, precision=None, interpret: bool = False,
+    window: Optional[int] = None,
 ):
     """dq contribution of one K/V block (f32, head-major ``(H,Sq,D)``).
 
@@ -482,13 +538,10 @@ def flash_block_backward_dq(
     (``linv = 1/l`` with fully-masked rows mapped to 1). ``k``/``v``
     may carry fewer (grouped) heads.
     """
+    _validate_window(causal, window)
     h, s_q, d = q.shape
     s_k = k.shape[1]
-    if h % k.shape[0]:
-        raise ValueError(
-            f"kv heads {k.shape[0]} must divide query heads {h}"
-        )
-    group = h // k.shape[0]
+    group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, BLOCK_Q, mult)
     bk = _pick_block(s_k, BLOCK_K, mult)
@@ -500,7 +553,7 @@ def flash_block_backward_dq(
 
     kernel = functools.partial(
         _bwd_dq_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,
-        causal=causal, scale=scale, precision=precision,
+        causal=causal, window=window, scale=scale, precision=precision,
     )
     offs = jnp.stack(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
@@ -530,6 +583,7 @@ def flash_block_backward_dq(
 def flash_block_backward_dkdv(
     q, k, v, dout, m_row, linv_row, delta_row, q_off, k_off,
     causal: bool, scale: float, precision=None, interpret: bool = False,
+    window: Optional[int] = None,
 ):
     """(dk, dv) of one K/V block from this rank's queries (f32).
 
@@ -539,13 +593,10 @@ def flash_block_backward_dkdv(
     reduction happens in-kernel (heads iterate in the middle grid
     dimension, so a group's output block is revisited contiguously).
     """
+    _validate_window(causal, window)
     h, s_q, d = q.shape
     s_k = k.shape[1]
-    if h % k.shape[0]:
-        raise ValueError(
-            f"kv heads {k.shape[0]} must divide query heads {h}"
-        )
-    group = h // k.shape[0]
+    group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
     bkO = _pick_block(s_k, BLOCK_K, mult)
     bq = _pick_block(s_q, BLOCK_Q, mult)
@@ -557,8 +608,8 @@ def flash_block_backward_dkdv(
 
     kernel = functools.partial(
         _bwd_dkdv_kernel, block_k=bkO, block_q=bq, chunk_q=qc,
-        n_qc=n_qc, group=group, causal=causal, scale=scale,
-        precision=precision,
+        n_qc=n_qc, group=group, causal=causal, window=window,
+        scale=scale, precision=precision,
     )
     offs = jnp.stack(
         [jnp.asarray(q_off), jnp.asarray(k_off)]

@@ -40,7 +40,7 @@ from smi_tpu.parallel.mesh import Communicator
 
 
 def _block_attend(q, k, v, m, l, acc, q_off, k_off, causal, scale,
-                  precision):
+                  precision, window=None):
     """Fold one K/V block into the online-softmax state.
 
     q: (Sq, H, D); k/v: (Sk, H, D); m/l: (H, Sq); acc: (Sq, H, D).
@@ -53,7 +53,10 @@ def _block_attend(q, k, v, m, l, acc, q_off, k_off, causal, scale,
         sq, sk = q.shape[0], k.shape[0]
         q_pos = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_pos = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        scores = jnp.where(k_pos[None] > q_pos[None], NEG_INF, scores)
+        masked = k_pos > q_pos
+        if window is not None:
+            masked |= k_pos < q_pos - (window - 1)
+        scores = jnp.where(masked[None], NEG_INF, scores)
     m_new = jnp.maximum(m, scores.max(axis=-1))        # (H, Sq)
     correction = jnp.exp(m - m_new)
     p = jnp.exp(scores - m_new[..., None])             # (H, Sq, Sk)
@@ -96,7 +99,8 @@ def _use_flash_default(comm: Communicator, s_local, h, d, dtype) -> bool:
     return comm.is_tpu and flash_supported(s_local, s_local, d, dtype)
 
 
-def _flash_forward(q, k, v, comm, causal, axis, precision, interpret):
+def _flash_forward(q, k, v, comm, causal, axis, precision, interpret,
+                   window):
     """Flash-tier ring forward: head-major layouts, one Pallas launch
     per ring step (``kernels/flash.py``), K/V moved by ``ring_shift``.
     Returns ``(out, m, l)`` — the statistics are the backward pass's
@@ -118,7 +122,7 @@ def _flash_forward(q, k, v, comm, causal, axis, precision, interpret):
         return flash_block_attend(
             qT, k_cur, v_cur, m, l, acc,
             q_off, src * s_local, causal, scale, precision,
-            interpret=interpret,
+            interpret=interpret, window=window,
         )
 
     m, l, acc = _ring_schedule(
@@ -131,7 +135,8 @@ def _flash_forward(q, k, v, comm, causal, axis, precision, interpret):
 
 
 def _flash_ring_backward(
-    q, k, v, out, m, l, dout, comm, causal, axis, precision, interpret
+    q, k, v, out, m, l, dout, comm, causal, axis, precision, interpret,
+    window,
 ):
     """FlashAttention-2 backward over the ring.
 
@@ -174,10 +179,12 @@ def _flash_ring_backward(
         dq = dq + flash_block_backward_dq(
             qT, k_cur, v_cur, doutT, m, linv, delta,
             q_off, k_off, causal, scale, precision, interpret=interpret,
+            window=window,
         )
         dkc, dvc = flash_block_backward_dkdv(
             qT, k_cur, v_cur, doutT, m_row, linv_row, delta_row,
             q_off, k_off, causal, scale, precision, interpret=interpret,
+            window=window,
         )
         return dk_cur + dkc, dv_cur + dvc, dq
 
@@ -205,7 +212,7 @@ def _flash_ring_backward(
 
 
 def _ring_attention_shard_flash(
-    q, k, v, comm, causal, axis, precision, interpret
+    q, k, v, comm, causal, axis, precision, interpret, window
 ):
     """Flash tier with a custom VJP: forward saves the online-softmax
     statistics; backward recomputes probabilities blockwise and rides
@@ -215,13 +222,13 @@ def _ring_attention_shard_flash(
     @jax.custom_vjp
     def attn(q, k, v):
         out, _, _ = _flash_forward(
-            q, k, v, comm, causal, axis, precision, interpret
+            q, k, v, comm, causal, axis, precision, interpret, window
         )
         return out
 
     def fwd(q, k, v):
         out, m, l = _flash_forward(
-            q, k, v, comm, causal, axis, precision, interpret
+            q, k, v, comm, causal, axis, precision, interpret, window
         )
         return out, (q, k, v, out, m, l)
 
@@ -229,7 +236,7 @@ def _ring_attention_shard_flash(
         q, k, v, out, m, l = res
         return _flash_ring_backward(
             q, k, v, out, m, l, dout, comm, causal, axis, precision,
-            interpret,
+            interpret, window,
         )
 
     attn.defvjp(fwd, bwd)
@@ -246,6 +253,7 @@ def ring_attention_shard(
     precision=lax.Precision.HIGHEST,
     use_flash: Optional[bool] = None,
     interpret: bool = False,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Per-shard ring attention (call inside ``shard_map``).
 
@@ -259,8 +267,16 @@ def ring_attention_shard(
     On TPU with flash-compatible shapes the per-step block fold runs as
     the VMEM-resident Pallas kernel (``kernels/flash.py``); otherwise
     the jnp online-softmax below. ``use_flash`` forces the choice (pass
-    ``interpret=True`` to run the flash tier off-TPU).
+    ``interpret=True`` to run the flash tier off-TPU). ``window``
+    (requires ``causal``) restricts each query to its ``window`` most
+    recent positions — sliding-window attention; the flash tier skips
+    out-of-window blocks entirely, so compute scales with
+    ``S * window`` instead of ``S²``.
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            "sliding window requires causal attention and window >= 1"
+        )
     axis = axis_name or comm.axis_names[0]
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
@@ -275,7 +291,7 @@ def ring_attention_shard(
         use_flash = _use_flash_default(comm, s_local, h, d, q.dtype)
     if use_flash:
         return _ring_attention_shard_flash(
-            q, k, v, comm, causal, axis, precision, interpret
+            q, k, v, comm, causal, axis, precision, interpret, window
         )
     scale = 1.0 / math.sqrt(d)
 
@@ -293,6 +309,7 @@ def ring_attention_shard(
         return _block_attend(
             q, k_cur, v_cur, m, l, acc,
             q_off, src * s_local, causal, scale, precision,
+            window=window,
         )
 
     m, l, acc = _ring_schedule(fold, comm, axis, k, v, (m0, l0, acc0))
@@ -307,6 +324,7 @@ def make_ring_attention_fn(
     use_flash: Optional[bool] = None,
     interpret: bool = False,
     reps: int = 1,
+    window: Optional[int] = None,
 ):
     """Jitted sequence-parallel attention over the communicator's axis.
 
@@ -325,7 +343,7 @@ def make_ring_attention_fn(
     def once(q, k, v):
         return ring_attention_shard(
             q, k, v, comm, causal=causal, precision=precision,
-            use_flash=use_flash, interpret=interpret,
+            use_flash=use_flash, interpret=interpret, window=window,
         )
 
     if reps == 1:
@@ -346,13 +364,16 @@ def make_ring_attention_fn(
     )
 
 
-def reference_attention(q, k, v, causal: bool = False) -> np.ndarray:
+def reference_attention(q, k, v, causal: bool = False,
+                        window=None) -> np.ndarray:
     """Full (gathered) attention for verification."""
     q, k, v = (np.asarray(a, np.float64) for a in (q, k, v))
     s, _h, d = q.shape
     scores = np.einsum("qhd,khd->hqk", q, k) / math.sqrt(d)
     if causal:
         mask = np.triu(np.ones((s, s), bool), 1)
+        if window is not None:
+            mask |= np.tril(np.ones((s, s), bool), -window)
         scores = np.where(mask[None], -np.inf, scores)
     scores -= scores.max(axis=-1, keepdims=True)
     p = np.exp(scores)

@@ -322,3 +322,67 @@ def test_ring_attention_rejects_bad_kv_heads(eight_devices):
     fn = ra.make_ring_attention_fn(comm, use_flash=False)
     with pytest.raises(ValueError, match="divide"):
         fn(q, k, v)
+
+
+@pytest.mark.parametrize("use_flash", [True, False])
+@pytest.mark.parametrize("n,window", [(1, 8), (2, 8), (4, 24)])
+def test_ring_attention_sliding_window(eight_devices, use_flash, n, window):
+    """Sliding-window attention: each query attends its `window` most
+    recent positions; both tiers match the windowed reference."""
+    comm = smi.make_communicator(n, devices=eight_devices[:n])
+    s, h, d = n * 16, 2, 128
+    q, k, v = _qkv(s, h, d, seed=17)
+    fn = ra.make_ring_attention_fn(
+        comm, causal=True, window=window,
+        use_flash=use_flash, interpret=use_flash,
+    )
+    out = np.asarray(fn(q, k, v))
+    ref = ra.reference_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_window_gradients_multi_chunk(eight_devices):
+    """Windowed gradients with several chunks/sub-tiles per grid step —
+    exercises the two-sided clipping (n_live and s0/n_end) in all three
+    kernels."""
+    comm = smi.make_communicator(2, devices=eight_devices[:2])
+    s, h, d = 128, 2, 128
+    window = 24
+    rng = np.random.RandomState(19)
+    q, k, v, w = (
+        jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+        for _ in range(4)
+    )
+    old = flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K
+    try:
+        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = 16, 8, 16
+        fn_f = ra.make_ring_attention_fn(
+            comm, causal=True, window=window,
+            use_flash=True, interpret=True,
+        )
+        fn_j = ra.make_ring_attention_fn(
+            comm, causal=True, window=window, use_flash=False
+        )
+        out_f = np.asarray(fn_f(q, k, v))
+        ref = ra.reference_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(out_f, ref, rtol=2e-5, atol=2e-5)
+        gf = jax.grad(lambda q, k, v: jnp.sum(fn_f(q, k, v) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+        gj = jax.grad(lambda q, k, v: jnp.sum(fn_j(q, k, v) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gj, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5,
+                err_msg=name,
+            )
+    finally:
+        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = old
+
+
+def test_ring_attention_window_requires_causal(eight_devices):
+    comm = smi.make_communicator(1, devices=eight_devices[:1])
+    q, k, v = _qkv(16, 2, 128)
+    fn = ra.make_ring_attention_fn(comm, causal=False, window=8,
+                                   use_flash=False)
+    with pytest.raises(ValueError, match="causal"):
+        fn(q, k, v)
