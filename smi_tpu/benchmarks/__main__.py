@@ -24,6 +24,10 @@ def main(argv=None):
                         help="bandwidth payload")
     parser.add_argument("--eager", action="store_true",
                         help="pipeline: disable rendezvous chunking")
+    parser.add_argument("--window", type=int, default=None,
+                        help="ring attention: sliding-window size")
+    parser.add_argument("--seq-per-rank", type=int, default=None,
+                        help="ring attention: tokens per rank")
     parser.add_argument("--out-dir", default=None,
                         help="write .dat/.json result files here")
     parser.add_argument("--trace", default=None, metavar="DIR",
@@ -74,6 +78,11 @@ def main(argv=None):
         elif name.startswith("app_"):
             p.pop("root", None)
             p.pop("elements", None)
+            if name.startswith("app_ring_attention"):
+                if args.window is not None:
+                    p["window"] = args.window
+                if args.seq_per_rank is not None:
+                    p["seq_per_rank"] = args.seq_per_rank
         if args.trace:
             from smi_tpu.utils.tracing import trace
 

@@ -138,7 +138,7 @@ def _default_seq_per_rank(comm: Communicator) -> int:
 def bench_ring_attention(
     comm: Communicator, seq_per_rank: Optional[int] = None, heads: int = 8,
     head_dim: int = 128, runs: int = 5, causal: bool = True,
-    precision=None, reps: int = 8,
+    precision=None, reps: int = 8, window: Optional[int] = None,
 ) -> Measurement:
     """Sequence-parallel attention throughput (global tokens/s).
 
@@ -171,16 +171,20 @@ def bench_ring_attention(
         jnp.asarray(rng.randn(s, heads, head_dim).astype(np.float32))
         for _ in range(3)
     )
-    fn = ra.make_ring_attention_fn(comm, causal=causal, precision=precision)
+    fn = ra.make_ring_attention_fn(
+        comm, causal=causal, precision=precision, window=window
+    )
 
     out = np.asarray(fn(q, k, v))
     idx = np.linspace(0, s - 1, num=min(s, 128), dtype=np.int64)
-    ref = ra.reference_attention_rows(q, k, v, idx, causal=causal)
+    ref = ra.reference_attention_rows(
+        q, k, v, idx, causal=causal, window=window
+    )
     tol = 5e-4 if precision == lax.Precision.HIGHEST else 2e-2
     np.testing.assert_allclose(out[idx], ref, rtol=tol, atol=tol)
 
     chained = ra.make_ring_attention_fn(
-        comm, causal=causal, precision=precision, reps=reps
+        comm, causal=causal, precision=precision, reps=reps, window=window
     )
     samples = timed_samples(
         lambda: np.asarray(jnp.sum(chained(q, k, v))), runs
@@ -190,14 +194,14 @@ def bench_ring_attention(
         "app-ring-attention", "Mtoken/s", rates,
         {"seq": s, "seq_per_rank": seq_per_rank, "heads": heads,
          "head_dim": head_dim, "causal": causal, "ranks": n,
-         "precision": str(precision), "reps": reps},
+         "precision": str(precision), "reps": reps, "window": window},
     )
 
 
 def bench_ring_attention_train(
     comm: Communicator, seq_per_rank: Optional[int] = None, heads: int = 8,
     head_dim: int = 128, runs: int = 5, causal: bool = True,
-    reps: int = 4,
+    reps: int = 4, window: Optional[int] = None,
 ) -> Measurement:
     """Training-step throughput: forward + backward tokens/s.
 
@@ -224,6 +228,7 @@ def bench_ring_attention_train(
     def make_grad(use_flash, reps_):
         fn = ra.make_ring_attention_fn(
             comm, causal=causal, use_flash=use_flash, reps=reps_,
+            window=window,
         )
         return jax.jit(jax.grad(
             lambda q, k, v: jnp.sum(fn(q, k, v) ** 2), argnums=(0, 1, 2)
@@ -256,7 +261,7 @@ def bench_ring_attention_train(
         "app-ring-attention-train", "Mtoken/s", rates,
         {"seq": s, "seq_per_rank": seq_per_rank, "heads": heads,
          "head_dim": head_dim, "causal": causal, "ranks": n,
-         "reps": reps},
+         "reps": reps, "window": window},
     )
 
 

@@ -381,7 +381,8 @@ def reference_attention(q, k, v, causal: bool = False,
     return np.einsum("hqk,khd->qhd", p, v)
 
 
-def reference_attention_rows(q, k, v, rows, causal: bool = False) -> np.ndarray:
+def reference_attention_rows(q, k, v, rows, causal: bool = False,
+                             window=None) -> np.ndarray:
     """Reference attention for a subset of query rows — O(len(rows)·S)
     host memory, for verification at benchmark scale."""
     q, k, v = (np.asarray(a, np.float64) for a in (q, k, v))
@@ -390,8 +391,10 @@ def reference_attention_rows(q, k, v, rows, causal: bool = False) -> np.ndarray:
     scores = np.einsum("qhd,khd->hqk", q[rows], k) / math.sqrt(d)
     if causal:
         k_pos = np.arange(k.shape[0])
-        scores = np.where(k_pos[None, None] > rows[None, :, None],
-                          -np.inf, scores)
+        masked = k_pos[None, None] > rows[None, :, None]
+        if window is not None:
+            masked |= k_pos[None, None] < rows[None, :, None] - (window - 1)
+        scores = np.where(masked, -np.inf, scores)
     scores -= scores.max(axis=-1, keepdims=True)
     p = np.exp(scores)
     p /= p.sum(axis=-1, keepdims=True)
