@@ -1,0 +1,175 @@
+"""Long-context transformer-block training on a DP x SP mesh.
+
+The reference's applications compose its communication layer with
+compute kernels (stencil: halo exchange in a sweep loop,
+``examples/kernels/stencil_smi.cl``; K-means: collectives inside the
+iteration, ``kmeans_smi.cl:132-190``). This module is the same
+composition exercised at the framework's long-context frontier: one
+pre-norm transformer block whose attention is the sequence-parallel
+ring (``models/ring_attention.py``, flash tier on TPU), trained
+data-parallel — the canonical 2-D ``(dp, sp)`` mesh.
+
+Layout per shard: activations ``(B_local, S_local, E)`` with batch
+sharded over ``dp`` and sequence over ``sp``; parameters replicated.
+Attention folds the local batch into the head axis — heads are
+independent, so ``(S, B_local*H, D)`` rides the existing per-head ring
+schedule unchanged — and causal masking stays exact because offsets
+come from the ``sp`` axis index. The training step runs entirely inside
+one ``shard_map``: local loss, local autodiff (through the flash tier's
+custom VJP), explicit ``psum`` of gradients over both axes, SGD update
+— returning replicated parameters, the reference's
+collectives-inside-the-loop shape (§2.10 DP) applied to training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from smi_tpu.models import ring_attention as ra
+from smi_tpu.parallel.mesh import Communicator
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockConfig:
+    embed: int = 256
+    heads: int = 2
+    head_dim: int = 128          # flash tier wants multiples of 128
+    mlp_ratio: int = 2
+    causal: bool = True
+    window: Optional[int] = None
+
+
+def init_params(config: BlockConfig, seed: int = 0) -> dict:
+    """Replicated block parameters (f32)."""
+    e, h, d = config.embed, config.heads, config.head_dim
+    rng = np.random.RandomState(seed)
+
+    def w(shape, scale):
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * scale)
+
+    return {
+        "wqkv": w((e, 3 * h * d), e ** -0.5),
+        "wo": w((h * d, e), (h * d) ** -0.5),
+        "w1": w((e, config.mlp_ratio * e), e ** -0.5),
+        "w2": w((config.mlp_ratio * e, e), (config.mlp_ratio * e) ** -0.5),
+    }
+
+
+def _layernorm(x):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + 1e-6)
+
+
+def block_shard(
+    params: dict,
+    x: jax.Array,               # (B_local, S_local, E)
+    comm: Communicator,
+    config: BlockConfig,
+    sp_axis: str = "sp",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """One pre-norm block on this rank's activation shard."""
+    b, s, e = x.shape
+    h, d = config.heads, config.head_dim
+
+    xn = _layernorm(x)
+    qkv = xn.reshape(b * s, e) @ params["wqkv"]          # MXU
+    q, k, v = jnp.split(qkv.reshape(b, s, 3, h, d), 3, axis=2)
+    # fold batch into heads: (B, S, 1, H, D) -> (S, B*H, D); heads are
+    # independent so the per-head ring schedule applies unchanged
+    fold = lambda t: t.reshape(b, s, h, d).transpose(1, 0, 2, 3).reshape(
+        s, b * h, d
+    )
+    attn = ra.ring_attention_shard(
+        fold(q), fold(k), fold(v), comm,
+        causal=config.causal, axis_name=sp_axis,
+        use_flash=use_flash, interpret=interpret,
+        window=config.window,
+    )                                                     # (S, B*H, D)
+    attn = attn.reshape(s, b, h * d).transpose(1, 0, 2)   # (B, S, H*D)
+    x = x + (attn.reshape(b * s, h * d) @ params["wo"]).reshape(b, s, e)
+
+    yn = _layernorm(x).reshape(b * s, e)
+    mlp = jax.nn.gelu(yn @ params["w1"]) @ params["w2"]
+    return x + mlp.reshape(b, s, e)
+
+
+def make_train_step(
+    comm: Communicator,
+    config: BlockConfig,
+    lr: float = 1e-3,
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+):
+    """Jitted SGD training step over the communicator's (dp, sp) mesh.
+
+    ``(params, x, y) -> (new_params, loss)`` with ``x``/``y`` of global
+    shape ``(B, S, E)`` — batch over the first mesh axis, sequence over
+    the second — and replicated parameters/loss.
+    """
+    dp_axis, sp_axis = comm.axis_names
+    axes = (dp_axis, sp_axis)
+
+    def step_shard(params, x, y):
+        n_total = x.shape[0] * x.shape[1] * comm.size  # per-shard equal
+
+        def local_loss(p):
+            pred = block_shard(
+                p, x, comm, config, sp_axis=sp_axis,
+                use_flash=use_flash, interpret=interpret,
+            )
+            return jnp.sum((pred - y) ** 2)
+
+        lval, grads = jax.value_and_grad(local_loss)(params)
+        # DP+SP allreduce of gradients and loss (the K-means shape)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axes), grads
+        )
+        loss = lax.psum(lval, axes) / n_total
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g / n_total, params, grads
+        )
+        return new_params, loss
+
+    data_spec = P(dp_axis, sp_axis)
+    return jax.jit(
+        jax.shard_map(
+            step_shard, mesh=comm.mesh,
+            in_specs=(P(), data_spec, data_spec),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+def reference_block(params, x, config: BlockConfig) -> np.ndarray:
+    """Single-device float64-ish reference of the block (numpy/jnp on
+    the gathered arrays) for verification."""
+    b, s, e = x.shape
+    h, d = config.heads, config.head_dim
+    xn = _layernorm(x)
+    qkv = xn.reshape(b * s, e) @ params["wqkv"]
+    q, k, v = jnp.split(qkv.reshape(b, s, 3, h, d), 3, axis=2)
+    outs = []
+    for bi in range(b):
+        outs.append(
+            ra.reference_attention(
+                np.asarray(q[bi, :, 0]), np.asarray(k[bi, :, 0]),
+                np.asarray(v[bi, :, 0]), causal=config.causal,
+                window=config.window,
+            )
+        )
+    attn = jnp.asarray(np.stack(outs), jnp.float32)       # (B, S, H, D)
+    x = x + (attn.reshape(b * s, h * d) @ params["wo"]).reshape(b, s, e)
+    yn = _layernorm(x).reshape(b * s, e)
+    mlp = jax.nn.gelu(yn @ params["w1"]) @ params["w2"]
+    return np.asarray(x + mlp.reshape(b, s, e))
