@@ -69,7 +69,10 @@ def main():
 
     timed_run()  # compile + warm up
 
-    best = min(timed_run() for _ in range(3))
+    # best-of-5: the shared chip's load varies several-fold between
+    # runs; min over more samples makes the recorded number less
+    # dependent on drawing a quiet window
+    best = min(timed_run() for _ in range(5))
 
     cells_per_sec = (x * y * iters) / best
     per_chip = cells_per_sec / n
