@@ -16,19 +16,33 @@ pipelined compute (``examples/kernels/stencil_smi.cl:236-386``): the
 ppermute moves the next K/V block while this kernel consumes the
 current one.
 
-Schedule: the grid is ``(H, n_q, n_kc)`` over 4096-lane key *chunks*;
-each grid step runs a VMEM-resident ``fori_loop`` over 512-wide key
-sub-tiles, so per-step dispatch overhead amortizes over 8 MXU tiles.
-The online-softmax state is a value carry of the inner loop and a VMEM
-scratch carry across chunks. Causality is enforced at both levels from
-global positions: fully-masked chunks are skipped by ``pl.when``, and
-the inner loop's trip count is clipped to the last live sub-tile — the
-causal schedule does ~half the dense work.
+Schedule: the forward grid is ``(H, n_q, n_kc)`` over key *chunks*
+(``CHUNK_K`` rows at head_dim 128, scaled by dtype and head width to
+fit double-buffered VMEM); each grid step runs a VMEM-resident
+``fori_loop`` over ``BLOCK_K``-wide key sub-tiles, so per-step dispatch
+overhead amortizes over many MXU tiles. The online-softmax state is a
+value carry of the inner loop and a VMEM scratch carry across chunks.
+Causality — and the optional sliding ``window`` — are enforced at both
+levels from global positions: fully-masked chunks are skipped by
+``pl.when`` and the inner trip count is clipped from both ends, so the
+causal schedule does ~half the dense work and the windowed schedule
+scales with ``S * window``.
 
 Layouts are head-major — ``q``/``k``/``v``/``acc`` as ``(H, S, D)``,
 ``m``/``l`` as ``(H, S, 1)`` — so every tile the kernel touches has a
 lane-tileable minor dimension and the softmax statistics are column
-vectors, avoiding in-kernel relayouts.
+vectors, avoiding in-kernel relayouts. Grouped-query attention maps
+query head ``hh`` to K/V head ``hh // group`` in the index maps, so
+the smaller K/V are never repeated in memory.
+
+The backward (FlashAttention-2 style) recomputes probabilities from
+the saved ``(m, l)`` in two kernels of opposite orientation —
+``_bwd_dq_kernel`` accumulates dq over key chunks per query block;
+``_bwd_dkdv_kernel`` accumulates dk/dv over query chunks per key
+block, with query heads iterating in the *middle* grid dimension so a
+group's dk/dv output block is revisited contiguously and the GQA
+reduction happens in scratch. The ring-level forward/backward
+schedules live in ``models/ring_attention.py``.
 """
 
 from __future__ import annotations
