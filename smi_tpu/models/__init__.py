@@ -12,6 +12,16 @@ three communication patterns (SURVEY §2.7/§2.10):
 - :mod:`smi_tpu.models.onchip` — single-device baselines of stencil and
   GESUMMV (the reference's ``*_onchip`` variants).
 
+Beyond reference parity, the long-context tier (first-class per the
+framework goals, built on the same ring substrate as the pipelines of
+SURVEY §2.10):
+
+- :mod:`smi_tpu.models.ring_attention` — exact sequence-parallel
+  attention (flash kernel tier on TPU; bf16, GQA, sliding windows,
+  custom-VJP backward),
+- :mod:`smi_tpu.models.transformer` — a trainable transformer block on
+  a (dp, sp) mesh composing ring attention with DP gradient psums.
+
 Each module carries a pure-numpy reference implementation used by the
 tests, as the reference verifies against serial CPU code
 (``stencil_smi.cpp:33-46``) and OpenBLAS (``gesummv_smi.cpp:300-301``).
