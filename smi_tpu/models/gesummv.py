@@ -35,6 +35,7 @@ def make_gesummv_fn(
     alpha: float,
     beta: float,
     buffer_size: Optional[int] = 2048,
+    precision=None,
 ):
     """Build the jitted 2-rank GESUMMV.
 
@@ -51,7 +52,12 @@ def make_gesummv_fn(
         mat = ab_local[0]
         rank = comm.rank()
         scale = jnp.where(rank == 0, alpha, beta).astype(mat.dtype)
-        partial_y = scale * (mat @ x)  # MXU matvec on both ranks
+        # HIGHEST precision by default: TPU matmuls otherwise round
+        # operands to bf16; the reference verifies against exact-f32
+        # BLAS. Pass Precision.DEFAULT for the native bf16 MXU rate.
+        partial_y = scale * jnp.matmul(
+            mat, x, precision=precision or lax.Precision.HIGHEST
+        )  # MXU matvec on both ranks
 
         from smi_tpu.parallel.channels import P2PChannel
 

@@ -27,25 +27,40 @@ from smi_tpu.parallel import collectives as coll
 from smi_tpu.parallel.mesh import Communicator, make_communicator
 
 
-def assign_points(points: jax.Array, means: jax.Array) -> jax.Array:
+def assign_points(points: jax.Array, means: jax.Array,
+                  precision=None) -> jax.Array:
     """Nearest-centroid assignment via one MXU matmul.
 
     ``argmin_k ||p - m_k||^2 = argmin_k (||m_k||^2 - 2 p.m_k)`` — the
-    ``||p||^2`` term is constant per point and dropped.
+    ``||p||^2`` term is constant per point and dropped. ``precision``
+    defaults to HIGHEST: TPU matmuls otherwise round operands to bf16,
+    and a ~1e-2 relative error is enough to flip borderline
+    assignments, diverging from the serial reference (the reference
+    FPGA kernels are exact f32). Pass ``Precision.DEFAULT`` to measure
+    the native bf16 MXU rate instead.
     """
-    dots = points @ means.T  # (n, K) on the MXU
+    if precision is None:
+        precision = lax.Precision.HIGHEST
+    dots = jnp.matmul(
+        points, means.T, precision=precision
+    )  # (n, K) on the MXU
     m2 = jnp.sum(means * means, axis=1)  # (K,)
     return jnp.argmin(m2[None, :] - 2.0 * dots, axis=1)
 
 
 def kmeans_iteration(
-    points: jax.Array, means: jax.Array, comm: Communicator, root: int = 0
+    points: jax.Array, means: jax.Array, comm: Communicator,
+    root: int = 0, precision=None,
 ) -> jax.Array:
     """One distributed K-means update, reference collective-for-collective."""
+    if precision is None:
+        precision = lax.Precision.HIGHEST
     k = means.shape[0]
-    assign = assign_points(points, means)
+    assign = assign_points(points, means, precision=precision)
     onehot = jax.nn.one_hot(assign, k, dtype=points.dtype)  # (n, K)
-    local_sums = onehot.T @ points  # (K, D) — MXU
+    local_sums = jnp.matmul(
+        onehot.T, points, precision=precision
+    )  # (K, D) — MXU
     local_counts = jnp.sum(onehot, axis=0)  # (K,)
 
     # Reduce partial sums to the root (port 0), counts on port 2; the root
@@ -59,7 +74,8 @@ def kmeans_iteration(
     return new_means
 
 
-def make_kmeans_fn(comm: Communicator, iterations: int, root: int = 0):
+def make_kmeans_fn(comm: Communicator, iterations: int, root: int = 0,
+                   precision=None):
     """Jitted distributed K-means: sharded points + replicated init means
     → final means (replicated)."""
     axis = comm.axis_names[0]
@@ -69,7 +85,9 @@ def make_kmeans_fn(comm: Communicator, iterations: int, root: int = 0):
         means = lax.fori_loop(
             0,
             iterations,
-            lambda _, m: kmeans_iteration(points, m, comm, root=root),
+            lambda _, m: kmeans_iteration(
+                points, m, comm, root=root, precision=precision
+            ),
             means0,
         )
         return means

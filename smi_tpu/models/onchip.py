@@ -41,15 +41,23 @@ def run_stencil_onchip(grid, iterations: int) -> jax.Array:
     return make_stencil_onchip_fn(iterations)(jnp.asarray(grid))
 
 
-def make_gesummv_onchip_fn(alpha: float = 1.0, beta: float = 1.0):
+def make_gesummv_onchip_fn(alpha: float = 1.0, beta: float = 1.0,
+                           precision=None):
     """Jitted single-device GESUMMV: ``y = alpha*A@x + beta*B@x``.
 
     The reference on-chip variant fuses both matvecs in one kernel
     (``gesummv_onchip.cl``); here both land on the MXU in one program.
+    ``precision`` defaults to HIGHEST, matching the distributed variant
+    (TPU matmuls otherwise round operands to bf16).
     """
+    if precision is None:
+        precision = jax.lax.Precision.HIGHEST
 
     def fn(a, b, x):
-        return alpha * (a @ x) + beta * (b @ x)
+        return (
+            alpha * jnp.matmul(a, x, precision=precision)
+            + beta * jnp.matmul(b, x, precision=precision)
+        )
 
     return jax.jit(fn)
 
