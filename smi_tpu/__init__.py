@@ -72,6 +72,7 @@ from smi_tpu.parallel.mesh import (
     make_communicator,
     mesh_from_topology,
 )
+from smi_tpu.parallel.channels import P2PChannel, stream_concurrent
 from smi_tpu.parallel.context import SmiContext, smi_kernel
 
 __version__ = "0.1.0"
@@ -102,6 +103,8 @@ __all__ = [
     "Communicator",
     "make_communicator",
     "mesh_from_topology",
+    "P2PChannel",
+    "stream_concurrent",
     "SmiContext",
     "smi_kernel",
 ]

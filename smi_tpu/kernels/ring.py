@@ -1,4 +1,4 @@
-"""Ring collectives as explicit ICI RDMA Pallas kernels.
+"""Ring collectives and neighbour streaming as explicit ICI RDMA kernels.
 
 Reference parity: the CK_S/CK_R NoC moves packets neighbour-to-neighbour
 over serial links with credit flow control (``codegen/templates/cks.cl``,
@@ -6,18 +6,41 @@ over serial links with credit flow control (``codegen/templates/cks.cl``,
 microbenchmarks (``test/p2p/p2p.json``, ``bandwidth.json``). On TPU the
 same neighbour streaming is ``pltpu.make_async_remote_copy`` over ICI,
 double-buffered so the send of chunk *k* overlaps the integration of
-chunk *k-1* — XLA's built-in collectives do this internally; these
-kernels exist for the cases where the schedule must be explicit (fusing
-compute into collective steps, the basis for ring-attention-style
-overlap) and as the framework's own collective implementation tier.
+chunk *k-1*.
+
+This module is the framework's **"ring" collective backend**: the rooted
+collectives (:mod:`smi_tpu.parallel.collectives`) and P2P channels
+(:mod:`smi_tpu.parallel.channels`) dispatch here when called with
+``backend="ring"`` — the explicit-schedule tier next to the default XLA
+tier, mirroring how the reference's NoC *is* its data plane.
+
+Flow control: a writer may only RDMA into a remote buffer slot after the
+remote granted it (credit semaphore). Without this a fast rank could
+clobber a slow neighbour's unconsumed chunk. The protocol is specified
+and exhaustively schedule-tested as a pure-Python state machine in
+:mod:`smi_tpu.parallel.credits`; the kernels below are its TPU
+realization, and they run it in **every** mode:
+
+- compiled on real TPU chips;
+- interpreted on the CPU fake mesh via Pallas TPU interpret mode
+  (``pltpu.InterpretParams``), which simulates the remote DMAs and
+  semaphores with real cross-device semantics — the analog of the
+  reference's strict-channel-depth emulator (``CMakeLists.txt:188-191``)
+  — so the credit path is exercised by the regular test suite.
+
+Credit accounting is exact: every grant is eventually consumed, so all
+semaphores are zero at kernel exit (interpret mode verifies this and
+reports leaks; leaked counts would poison the next collective reusing
+the semaphores).
 
 All kernels are written per-shard (called inside ``shard_map`` over one
-mesh axis) and run compiled on TPU or interpreted on the CPU fake mesh.
+mesh axis).
 """
 
 from __future__ import annotations
 
 import functools
+from typing import Union
 
 import jax
 import jax.numpy as jnp
@@ -26,13 +49,32 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from smi_tpu.ops.types import SmiOp
+from smi_tpu.parallel.backend import combine_fn as _combine_fn
 from smi_tpu.parallel.mesh import Communicator
 
+#: Distinct ``collective_id`` per kernel family: the barrier semaphore is
+#: keyed by it, so concurrent different-family rings never alias.
+_CID_ALL_GATHER = 0
+_CID_ALL_REDUCE = 1
+_CID_REDUCE_SCATTER = 2
+_CID_NEIGHBOUR_STREAM = 3
 
-def _neighbour_barrier(me, n: int, axis_name: str):
+
+def _interpret_arg(interpret: bool):
+    """Pallas ``interpret=`` argument for the requested mode.
+
+    ``True`` selects TPU interpret mode (``pltpu.InterpretParams``) rather
+    than plain interpret mode: only the former simulates remote DMA +
+    semaphore semantics across the fake-mesh devices, which the credit
+    protocol needs. It also checks that semaphores drain to zero.
+    """
+    return pltpu.InterpretParams() if interpret else False
+
+
+def _neighbour_barrier(me, n: int):
     """Block until both ring neighbours entered the kernel, so no RDMA
     lands in a buffer that is still being initialized."""
-    del axis_name
     barrier = pltpu.get_barrier_semaphore()
     nn = jnp.int32(n)  # keep arithmetic in int32 even under jax_enable_x64
     left = lax.rem(me - 1 + nn, nn)
@@ -58,6 +100,11 @@ def _grant_slot(credit_sem, slot, me, n: int):
     )
 
 
+# ---------------------------------------------------------------------------
+# All-gather
+# ---------------------------------------------------------------------------
+
+
 def _ring_all_gather_kernel(
     x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
     *, axis_name: str, n: int, flow_control: bool
@@ -65,16 +112,15 @@ def _ring_all_gather_kernel(
     """Each device forwards the chunk it most recently received to its
     right neighbour; after n-1 steps everyone holds every chunk.
 
-    Flow control: a writer may only RDMA into a remote slot after the
-    remote granted it (credit semaphore) — slot 1 is granted at start
-    (empty), and each slot is re-granted once its content has been
-    forwarded onward (send complete). Without this a fast rank could
-    clobber a slow neighbour's unsent chunk; the interpret-mode tests
-    run ranks sequentially and cannot catch that race."""
+    Protocol model: ``credits.ring_rank_steps`` — slot 1 is granted at
+    start (empty), and each slot is re-granted once its content has been
+    forwarded onward (send complete), except on the final step, whose
+    grant nobody would consume (credit balance must end at zero).
+    """
     me = lax.axis_index(axis_name)
     chunk = x_ref.shape[0]
     if flow_control:
-        _neighbour_barrier(me, n, axis_name)
+        _neighbour_barrier(me, n)
     o_ref[pl.ds(me * chunk, chunk), ...] = x_ref[...]
     comm_buf[0] = x_ref[...]
     if flow_control:
@@ -99,8 +145,11 @@ def _ring_all_gather_kernel(
         rdma.start()
         rdma.wait()
         if flow_control:
-            # our slot `slot` has now been sent onward: grant it upstream
-            _grant_slot(credit_sem, slot, me, n)
+            # our slot has been sent onward: grant it upstream — except on
+            # the last step, where no further send would consume the credit
+            @pl.when(s < n - 2)
+            def _():
+                _grant_slot(credit_sem, slot, me, n)
         o_ref[pl.ds(src_rank * chunk, chunk), ...] = comm_buf[nslot]
         return ()
 
@@ -112,6 +161,7 @@ def ring_all_gather(
     axis_name: str,
     n: int,
     interpret: bool = False,
+    flow_control: bool = True,
 ) -> jax.Array:
     """All-gather ``x`` (this shard's chunk) along a ring.
 
@@ -119,14 +169,13 @@ def ring_all_gather(
     array on every rank. Equivalent to ``lax.all_gather(..., tiled=True)``
     but with an explicit neighbour-ring schedule.
     """
+    if n == 1:
+        return x
     chunk = x.shape[0]
     out_shape = jax.ShapeDtypeStruct((n * chunk,) + x.shape[1:], x.dtype)
-    # Interpret mode executes ranks sequentially and does not implement
-    # remote semaphore signals; the credit protocol is only live (and only
-    # needed) in compiled multi-chip execution.
     kernel = functools.partial(
         _ring_all_gather_kernel, axis_name=axis_name, n=n,
-        flow_control=not interpret,
+        flow_control=flow_control,
     )
     return pl.pallas_call(
         kernel,
@@ -140,31 +189,36 @@ def ring_all_gather(
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
         compiler_params=pltpu.CompilerParams(
-            collective_id=0, has_side_effects=True
+            collective_id=_CID_ALL_GATHER, has_side_effects=True
         ),
-        interpret=interpret,
+        interpret=_interpret_arg(interpret),
     )(x)
+
+
+# ---------------------------------------------------------------------------
+# All-reduce
+# ---------------------------------------------------------------------------
 
 
 def _ring_all_reduce_kernel(
     x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
-    *, axis_name: str, n: int, flow_control: bool
+    *, axis_name: str, n: int, op: SmiOp, flow_control: bool
 ):
     """Circulating-partial ring reduce: every rank simultaneously streams
     its running partial to its right neighbour and folds its own
     contribution into what arrives; after n-1 hops every rank holds the
-    full sum (each via a rotated association order)."""
+    full reduction (each via a rotated association order)."""
+    combine = _combine_fn(op)
     me = lax.axis_index(axis_name)
     if flow_control:
-        _neighbour_barrier(me, n, axis_name)
+        _neighbour_barrier(me, n)
     comm_buf[0] = x_ref[...]
     if flow_control:
         _grant_slot(credit_sem, 1, me, n)
 
-    # After step s each rank's live slot holds the sum of the s+2
-    # contributions x_{me-s-1} + ... + x_{me}; after n-1 steps that is the
-    # full sum on every rank simultaneously (each accumulated a rotated
-    # association order).
+    # After step s each rank's live slot holds the combine of the s+2
+    # contributions x_{me-s-1} ... x_{me}; after n-1 steps that is the
+    # full reduction on every rank simultaneously.
     def step(s, _):
         slot, nslot = s % 2, (s + 1) % 2
         dst = lax.rem(me + 1, jnp.int32(n))
@@ -181,8 +235,10 @@ def _ring_all_reduce_kernel(
         rdma.start()
         rdma.wait()
         if flow_control:
-            _grant_slot(credit_sem, slot, me, n)
-        comm_buf[nslot] = comm_buf[nslot] + x_ref[...]
+            @pl.when(s < n - 2)
+            def _():
+                _grant_slot(credit_sem, slot, me, n)
+        comm_buf[nslot] = combine(comm_buf[nslot], x_ref[...])
         return ()
 
     lax.fori_loop(0, n - 1, step, ())
@@ -194,17 +250,21 @@ def ring_all_reduce(
     x: jax.Array,
     axis_name: str,
     n: int,
+    op: Union[str, SmiOp] = SmiOp.ADD,
     interpret: bool = False,
+    flow_control: bool = True,
 ) -> jax.Array:
-    """Sum-all-reduce along a ring with explicit neighbour RDMA.
+    """ADD/MAX/MIN all-reduce along a ring with explicit neighbour RDMA.
 
-    Each rank's partial sum makes a full circuit: after ``n-1`` hops every
-    rank has accumulated all ``n`` contributions (each rank accumulates a
-    rotated order, so sums match up to float reassociation).
+    Each rank's partial makes a full circuit: after ``n-1`` hops every
+    rank has folded in all ``n`` contributions (each rank accumulates a
+    rotated order, so float sums match up to reassociation).
     """
+    if n == 1:
+        return x
     kernel = functools.partial(
         _ring_all_reduce_kernel, axis_name=axis_name, n=n,
-        flow_control=not interpret,
+        op=SmiOp.parse(op), flow_control=flow_control,
     )
     return pl.pallas_call(
         kernel,
@@ -218,10 +278,224 @@ def ring_all_reduce(
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
         compiler_params=pltpu.CompilerParams(
-            collective_id=1, has_side_effects=True
+            collective_id=_CID_ALL_REDUCE, has_side_effects=True
         ),
-        interpret=interpret,
+        interpret=_interpret_arg(interpret),
     )(x)
+
+
+# ---------------------------------------------------------------------------
+# Reduce-scatter
+# ---------------------------------------------------------------------------
+
+
+def _ring_reduce_scatter_kernel(
+    x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
+    *, axis_name: str, n: int, op: SmiOp, flow_control: bool
+):
+    """Standard ring reduce-scatter: at step ``s`` rank ``r`` sends the
+    accumulated partial of chunk ``(r - s - 1) % n`` rightward and folds
+    its own contribution into the arriving partial of chunk
+    ``(r - s - 2) % n``; after ``n-1`` steps rank ``r`` holds the full
+    reduction of chunk ``r``."""
+    combine = _combine_fn(op)
+    me = lax.axis_index(axis_name)
+    nn = jnp.int32(n)
+    chunk = x_ref.shape[0] // n
+
+    def my_block(idx):
+        return x_ref[pl.ds(idx * chunk, chunk), ...]
+
+    if flow_control:
+        _neighbour_barrier(me, n)
+    comm_buf[0] = my_block(lax.rem(me - 1 + nn, nn))
+    if flow_control:
+        _grant_slot(credit_sem, 1, me, n)
+
+    def step(s, _):
+        slot, nslot = s % 2, (s + 1) % 2
+        dst = lax.rem(me + 1, nn)
+        if flow_control:
+            pltpu.semaphore_wait(credit_sem.at[nslot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[slot],
+            dst_ref=comm_buf.at[nslot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[nslot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        if flow_control:
+            @pl.when(s < n - 2)
+            def _():
+                _grant_slot(credit_sem, slot, me, n)
+        # arriving partial is for chunk (me - s - 2) % n; fold our share in
+        idx = lax.rem(me - s - 2 + 2 * nn, nn)
+        comm_buf[nslot] = combine(comm_buf[nslot], my_block(idx))
+        return ()
+
+    lax.fori_loop(0, n - 1, step, ())
+    o_ref[...] = comm_buf[(n - 1) % 2]
+
+
+def ring_reduce_scatter(
+    x: jax.Array,
+    axis_name: str,
+    n: int,
+    op: Union[str, SmiOp] = SmiOp.ADD,
+    interpret: bool = False,
+    flow_control: bool = True,
+) -> jax.Array:
+    """Reduce-scatter along a ring: rank ``r`` returns the reduction of
+    every rank's ``r``-th leading block of ``x``.
+
+    ``x.shape[0]`` must be divisible by ``n``; the result has leading
+    dimension ``x.shape[0] // n``. Equivalent to ``lax.psum_scatter(...,
+    tiled=True)`` (for ADD) with an explicit neighbour-ring schedule.
+    """
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"reduce-scatter leading dim {x.shape[0]} not divisible by "
+            f"ring size {n}"
+        )
+    if n == 1:
+        return x
+    chunk = x.shape[0] // n
+    out_shape = jax.ShapeDtypeStruct((chunk,) + x.shape[1:], x.dtype)
+    kernel = functools.partial(
+        _ring_reduce_scatter_kernel, axis_name=axis_name, n=n,
+        op=SmiOp.parse(op), flow_control=flow_control,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk) + x.shape[1:], x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            collective_id=_CID_REDUCE_SCATTER, has_side_effects=True
+        ),
+        interpret=_interpret_arg(interpret),
+    )(x)
+
+
+# ---------------------------------------------------------------------------
+# Neighbour P2P streaming
+# ---------------------------------------------------------------------------
+
+
+def _neighbour_stream_kernel(
+    x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
+    *, axis_name: str, n: int, chunks: int, direction: int,
+    flow_control: bool
+):
+    """Stream ``chunks`` chunks one hop around the ring, double-buffered.
+
+    Every rank simultaneously sends its chunk ``c`` to ``me + direction``
+    while receiving chunk ``c`` from ``me - direction`` — the TPU analog
+    of the reference's Push loop feeding a neighbour's Pop loop through
+    the NoC (``templates/push.cl``/``pop.cl``), with the send of chunk
+    ``c`` overlapping the receive/consume of the same step.
+
+    Credit protocol (see :mod:`smi_tpu.parallel.credits`): both slots
+    start empty (implicitly granted), so waits begin at chunk 2; the
+    receiver re-grants a slot to its upstream after copying it out, except
+    for the final two chunks whose grants nobody would consume.
+    """
+    me = lax.axis_index(axis_name)
+    nn = jnp.int32(n)
+    dst = lax.rem(me + direction + 2 * nn, nn)
+    upstream = lax.rem(me - direction + 2 * nn, nn)
+    if flow_control:
+        _neighbour_barrier(me, n)
+
+    def step(c, _):
+        slot = c % 2
+        if flow_control:
+            # both slots start granted (empty); wait from chunk 2 on
+            @pl.when(c >= 2)
+            def _():
+                pltpu.semaphore_wait(credit_sem.at[slot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[c],
+            dst_ref=comm_buf.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait_recv()  # chunk c arrived from upstream into our slot
+        o_ref[c] = comm_buf[slot]
+        if flow_control:
+            # slot consumed: grant it back to the upstream writer, unless
+            # no later chunk would wait on the credit
+            @pl.when(c + 2 < chunks)
+            def _():
+                pltpu.semaphore_signal(
+                    credit_sem.at[slot], inc=1, device_id=upstream,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+        rdma.wait_send()
+        return ()
+
+    lax.fori_loop(0, chunks, step, ())
+
+
+def neighbour_stream(
+    x: jax.Array,
+    axis_name: str,
+    n: int,
+    direction: int = 1,
+    interpret: bool = False,
+    flow_control: bool = True,
+) -> jax.Array:
+    """Stream ``x`` chunk-by-chunk to the ring neighbour ``me+direction``.
+
+    ``x`` has shape ``(chunks, ...)`` — one leading row per chunk; each
+    chunk is one bounded in-flight unit (the channel's asynchronicity
+    degree decides the chunking, ``channels.py``). Returns the upstream
+    neighbour's ``x``. Multi-hop P2P transfers compose this hop-by-hop,
+    exactly as the reference NoC forwards packets through intermediate
+    devices (``ckr.cl:50-60``).
+    """
+    if direction not in (1, -1):
+        raise ValueError(f"direction must be +1 or -1, got {direction}")
+    if n == 1:
+        return x
+    chunks = x.shape[0]
+    kernel = functools.partial(
+        _neighbour_stream_kernel, axis_name=axis_name, n=n,
+        chunks=chunks, direction=direction, flow_control=flow_control,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + x.shape[1:], x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            collective_id=_CID_NEIGHBOUR_STREAM, has_side_effects=True
+        ),
+        interpret=_interpret_arg(interpret),
+    )(x)
+
+
+# ---------------------------------------------------------------------------
+# Jitted wrappers
+# ---------------------------------------------------------------------------
 
 
 def make_ring_all_gather(comm: Communicator, interpret: bool = False):
@@ -240,7 +514,8 @@ def make_ring_all_gather(comm: Communicator, interpret: bool = False):
     )
 
 
-def make_ring_all_reduce(comm: Communicator, interpret: bool = False):
+def make_ring_all_reduce(comm: Communicator, interpret: bool = False,
+                         op: Union[str, SmiOp] = SmiOp.ADD):
     axis = comm.axis_names[0]
     n = comm.size
 
@@ -250,11 +525,28 @@ def make_ring_all_reduce(comm: Communicator, interpret: bool = False):
                 f"make_ring_all_reduce expects one row per shard (global "
                 f"leading dim == comm size {n}); got local shape {x.shape}"
             )
-        return ring_all_reduce(x[0], axis, n, interpret=interpret)[None]
+        return ring_all_reduce(x[0], axis, n, op=op, interpret=interpret)[None]
 
     return jax.jit(
         jax.shard_map(
             shard, mesh=comm.mesh, in_specs=P(axis), out_specs=P(axis),
+            check_vma=False,
+        )
+    )
+
+
+def make_ring_reduce_scatter(comm: Communicator, interpret: bool = False,
+                             op: Union[str, SmiOp] = SmiOp.ADD):
+    """Jitted wrapper: replicated (n*chunk, ...) input → sharded chunks."""
+    axis = comm.axis_names[0]
+    n = comm.size
+
+    def shard(x):
+        return ring_reduce_scatter(x, axis, n, op=op, interpret=interpret)
+
+    return jax.jit(
+        jax.shard_map(
+            shard, mesh=comm.mesh, in_specs=P(None), out_specs=P(axis),
             check_vma=False,
         )
     )

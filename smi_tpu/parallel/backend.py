@@ -1,0 +1,64 @@
+"""Collective implementation tiers and shared reduce-op dispatch.
+
+The framework exposes two data-plane tiers (the reference has one — its
+generated NoC *is* the data plane, §1 L0-L2 of the survey):
+
+- ``"xla"``: XLA collectives over the mesh axis (internally flow
+  controlled, ICI-optimal lowering);
+- ``"ring"``: the explicit neighbour-RDMA kernels with credit flow
+  control (:mod:`smi_tpu.kernels.ring`).
+
+This module owns the backend vocabulary and the single ADD/MAX/MIN
+dispatch used by every tier (``include/smi/reduce_operations.h``), so
+collectives, channels, and kernels cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+
+from smi_tpu.ops.types import SmiOp
+
+BACKENDS = ("xla", "ring")
+
+
+def check_backend(backend: str) -> str:
+    if backend not in BACKENDS:
+        raise ValueError(
+            f"unknown collective backend {backend!r}; expected one of "
+            f"{BACKENDS}"
+        )
+    return backend
+
+
+def combine_fn(op: Union[str, SmiOp]):
+    """Elementwise combiner for a reduce op."""
+    return {
+        SmiOp.ADD: jnp.add,
+        SmiOp.MAX: jnp.maximum,
+        SmiOp.MIN: jnp.minimum,
+    }[SmiOp.parse(op)]
+
+
+def reduction_fn(op: Union[str, SmiOp]):
+    """Axis-reduction function for a reduce op."""
+    return {
+        SmiOp.ADD: jnp.sum,
+        SmiOp.MAX: jnp.max,
+        SmiOp.MIN: jnp.min,
+    }[SmiOp.parse(op)]
+
+
+def identity_for(op: Union[str, SmiOp], dtype):
+    """The reduce op's identity element in ``dtype``."""
+    op = SmiOp.parse(op)
+    if op is SmiOp.ADD:
+        return jnp.zeros((), dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        val = jnp.inf if op is SmiOp.MIN else -jnp.inf
+    else:
+        info = jnp.iinfo(dtype)
+        val = info.max if op is SmiOp.MIN else info.min
+    return jnp.asarray(val, dtype)

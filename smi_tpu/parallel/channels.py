@@ -24,20 +24,41 @@ TPU re-design — one SPMD collective instead of two endpoint loops:
   playing exactly its reference role of pipelining depth.
 - ``p2p_rendezvous=False`` (eager, reference ``templates/push.cl:21-31``
   compiled out) sends the whole message in one ppermute.
+- ``consecutive_reads`` (the reference's ``READS_LIMIT`` CK fairness
+  bound, ``templates/device.cl:13-14``, ``cks.cl:73-81``) bounds how many
+  chunks a streamed transfer moves per pipelining step before yielding
+  the stream: each ``lax.scan`` step transfers a *burst* of up to that
+  many chunks in one ppermute, with the consumer still applied per chunk.
+- ``backend="ring"`` on ``transfer``/``stream`` moves the message over
+  the explicit credit-flow-controlled neighbour RDMA kernel
+  (:mod:`smi_tpu.kernels.ring`), hop by hop through intermediate ranks —
+  the faithful analog of packets forwarded through intermediate FPGAs'
+  CK pairs (``ckr.cl:50-60``).
 """
 
 from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Callable, Optional, Sequence, Tuple, Union
+from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from smi_tpu.ops.types import SmiDtype, dtype_to_jnp, elements_per_packet
-from smi_tpu.ops.operations import pipeline_depth_packets
+from smi_tpu.ops.types import (
+    SmiDtype,
+    SmiOp,
+    dtype_to_jnp,
+    elements_per_packet,
+)
+from smi_tpu.ops.operations import Reduce, pipeline_depth_packets
+from smi_tpu.parallel.backend import (
+    check_backend,
+    combine_fn,
+    identity_for,
+    reduction_fn,
+)
 from smi_tpu.parallel.mesh import Communicator
 
 
@@ -60,6 +81,10 @@ class P2PChannel:
     dtype: SmiDtype = SmiDtype.FLOAT
     buffer_size: Optional[int] = None  # elements; None = default depth
     rendezvous: bool = True
+    #: Chunk-burst bound per pipelining step (reference ``READS_LIMIT``,
+    #: ``device.cl:13-14``): a streamed transfer moves at most this many
+    #: chunks per scan step before yielding the stream.
+    consecutive_reads: int = 8
 
     def __post_init__(self):
         object.__setattr__(self, "dtype", SmiDtype.parse(self.dtype))
@@ -71,6 +96,10 @@ class P2PChannel:
             raise ValueError("src and dst must differ for a P2P channel")
         if self.count <= 0:
             raise ValueError(f"message count must be positive, got {self.count}")
+        if self.consecutive_reads < 1:
+            raise ValueError(
+                f"consecutive_reads must be >= 1, got {self.consecutive_reads}"
+            )
 
     @property
     def jnp_dtype(self):
@@ -108,16 +137,80 @@ class P2PChannel:
                 f"message length {data.shape[0]} != channel count {self.count}"
             )
 
-    def transfer(self, data: jax.Array) -> jax.Array:
+    def _hops(self) -> Tuple[int, int]:
+        """(direction, hop count) of the shorter way around the ring."""
+        n = self.comm.size
+        right = (self.dst - self.src) % n
+        left = (self.src - self.dst) % n
+        return (1, right) if right <= left else (-1, left)
+
+    def burst_schedule(self) -> List[int]:
+        """Element counts moved per pipelining step under rendezvous.
+
+        The observable chunking schedule: chunk size comes from the
+        asynchronicity degree (``rewrite.py:26-33``), burst width from
+        ``consecutive_reads`` (``READS_LIMIT``) — the first entries are
+        scan-steps of ``consecutive_reads`` whole chunks, then leftover
+        single chunks, then the element tail.
+        """
+        chunk = min(self.chunk_elements, self.count)
+        burst = self.consecutive_reads * chunk
+        n_bursts = self.count // burst
+        schedule = [burst] * n_bursts
+        remaining = self.count - n_bursts * burst
+        schedule += [chunk] * (remaining // chunk)
+        tail = remaining % chunk
+        if tail:
+            schedule.append(tail)
+        return schedule
+
+    def _ring_transfer(self, data: jax.Array, chunked: bool) -> jax.Array:
+        """Move the masked message hop-by-hop over the neighbour RDMA
+        kernel. Intermediate ranks forward zeros of their own, so only
+        ``dst`` ends up with the payload — the SPMD rendition of packets
+        transiting intermediate CK pairs (``ckr.cl:50-60``)."""
+        from smi_tpu.kernels import ring as _ring
+
+        direction, hops = self._hops()
+        n = self.comm.size
+        interpret = not self.comm.is_tpu
+        masked = jnp.where(self.comm.rank() == self.src, data,
+                           jnp.zeros_like(data))
+        if chunked:
+            chunk = min(self.chunk_elements, self.count)
+            n_chunks = -(-self.count // chunk)
+            pad = n_chunks * chunk - self.count
+            if pad:
+                masked = jnp.concatenate(
+                    [masked, jnp.zeros((pad,) + masked.shape[1:],
+                                       masked.dtype)]
+                )
+            masked = masked.reshape((n_chunks, chunk) + data.shape[1:])
+        else:
+            masked = masked[None]
+        out = masked
+        for _ in range(hops):
+            out = _ring.neighbour_stream(
+                out, self._axis(), n, direction=direction,
+                interpret=interpret,
+            )
+        out = out.reshape((-1,) + data.shape[1:])[: self.count]
+        return out
+
+    def transfer(self, data: jax.Array, backend: str = "xla") -> jax.Array:
         """Fused Push+Pop: send ``data`` (valid at ``src``) to ``dst``.
 
         Every rank calls this at the same program point (SPMD); the rank
         holding the payload is ``src``. Returns the message at ``dst`` and
         zeros elsewhere — the reference's non-participants simply never see
         the packets (``ckr.cl:50-60``); here they see a zero buffer.
+        ``backend="ring"`` sends over the explicit credit-controlled
+        neighbour RDMA tier instead of ``lax.ppermute``.
         """
         data = jnp.asarray(data, self.jnp_dtype)
         self._check_length(data)
+        if check_backend(backend) == "ring":
+            return self._ring_transfer(data, chunked=False)
         return lax.ppermute(data, self._axis(), self._perm())
 
     def stream(
@@ -125,58 +218,148 @@ class P2PChannel:
         data: jax.Array,
         consumer: Optional[Callable] = None,
         init_carry=None,
+        backend: str = "xla",
     ):
         """Streamed transfer: move the message chunk-by-chunk.
 
         With no ``consumer`` this behaves like :meth:`transfer` but bounds
-        in-flight data to one chunk (the rendezvous protocol's role,
-        ``push.cl:21-31``). With a ``consumer(carry, chunk) -> carry``, the
-        consumer is applied to each received chunk *inside the scan*, so
-        XLA can overlap the ppermute of chunk k+1 with consumer compute of
-        chunk k — the TPU expression of SMI's compute-while-receiving.
+        in-flight data to a burst of chunks (the rendezvous protocol's
+        role, ``push.cl:21-31``). With a ``consumer(carry, chunk) ->
+        carry``, the consumer is applied to each received chunk *inside
+        the scan*, so XLA can overlap the transfer of the next burst with
+        consumer compute — the TPU expression of SMI's
+        compute-while-receiving.
+
+        Each scan step moves up to ``consecutive_reads`` chunks in one
+        ppermute (the ``READS_LIMIT`` fairness bound: how much one stream
+        may burst before yielding, ``cks.cl:73-81``); the consumer still
+        sees individual chunks. :meth:`burst_schedule` exposes the
+        resulting schedule.
+
+        ``backend="ring"`` moves the chunks over the credit-controlled
+        neighbour RDMA kernel (hop-by-hop for non-neighbour endpoints)
+        and then applies the consumer per chunk.
 
         Returns ``(received, carry)`` where ``received`` is the reassembled
         message (valid at ``dst``).
         """
         data = jnp.asarray(data, self.jnp_dtype)
         self._check_length(data)
+        check_backend(backend)
         if not self.rendezvous:
-            out = self.transfer(data)
+            out = self.transfer(data, backend=backend)
             if consumer is not None:
                 carry = consumer(init_carry, out)
                 return out, carry
             return out, init_carry
 
+        chunk = min(self.chunk_elements, self.count)
+
+        if backend == "ring":
+            received = self._ring_transfer(data, chunked=True)
+            carry = init_carry
+            if consumer is not None:
+                n_full = self.count // chunk
+                tail = self.count - n_full * chunk
+                if n_full:
+                    full = received[: n_full * chunk].reshape(
+                        (n_full, chunk) + data.shape[1:]
+                    )
+                    carry, _ = lax.scan(
+                        lambda c, ch: (consumer(c, ch), 0), carry, full
+                    )
+                if tail:
+                    carry = consumer(carry, received[n_full * chunk:])
+            return received, carry
+
         axis, perm = self._axis(), self._perm()
 
-        def step(carry, chunk_data):
-            received = lax.ppermute(chunk_data, axis, perm)
-            if consumer is not None:
-                carry = consumer(carry, received)
-            return carry, received
+        def consume_chunks(carry, received):
+            """Apply the consumer chunk-wise to one received burst."""
+            if consumer is None:
+                return carry
+            rows = received.shape[0]
+            for i in range(rows // chunk):
+                carry = consumer(carry, received[i * chunk:(i + 1) * chunk])
+            if rows % chunk:
+                carry = consumer(carry, received[rows - rows % chunk:])
+            return carry
 
-        chunk = min(self.chunk_elements, self.count)
-        n_full = self.count // chunk
-        tail = self.count - n_full * chunk
+        def step(carry, burst_data):
+            received = lax.ppermute(burst_data, axis, perm)
+            return consume_chunks(carry, received), received
+
+        burst = self.consecutive_reads * chunk
+        n_bursts = self.count // burst
 
         carry = init_carry
         parts = []
-        if n_full:
-            chunks = data[: n_full * chunk].reshape(
-                (n_full, chunk) + data.shape[1:]
-            )
-            carry, received = lax.scan(step, carry, chunks)
-            parts.append(
-                received.reshape((n_full * chunk,) + data.shape[1:])
-            )
-        if tail:
-            # The remainder moves as one short chunk *outside* the scan so
-            # the consumer only ever sees real message elements — no
-            # zero-padding leaks into non-additive reductions.
-            carry, tail_received = step(carry, data[n_full * chunk:])
-            parts.append(tail_received)
+        used = n_bursts * burst
+        if n_bursts:
+            bursts = data[:used].reshape((n_bursts, burst) + data.shape[1:])
+            carry, received = lax.scan(step, carry, bursts)
+            parts.append(received.reshape((used,) + data.shape[1:]))
+        # leftover whole chunks move as single-chunk steps, the element
+        # tail as one short chunk — all *outside* the scan so the consumer
+        # only ever sees real message elements (no zero-padding leaks into
+        # non-additive reductions)
+        remaining = self.count - used
+        for _ in range(remaining // chunk):
+            carry, got = step(carry, data[used:used + chunk])
+            parts.append(got)
+            used += chunk
+        if used < self.count:
+            carry, got = step(carry, data[used:])
+            parts.append(got)
         received = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         return received, carry
+
+    def stream_reduce(
+        self,
+        data: jax.Array,
+        op: Union[str, SmiOp] = SmiOp.ADD,
+        lanes: Optional[int] = None,
+        backend: str = "xla",
+    ):
+        """Streamed reduction: pop each arriving chunk and fold it into
+        ``lanes`` independent partial accumulators, combined at the end.
+
+        The reference's streaming Reduce masks FP-add pipeline latency
+        with a shift register of partial accumulators
+        (``templates/reduce.cl:63-70``, config ``codegen/ops.py:110-141``);
+        chunk-at-a-time accumulation under ``lax.scan`` has the same
+        serial-dependence hazard, and ``lanes`` breaks the chain the same
+        way: chunk *k* folds into partial ``k % lanes``. The default comes
+        from the op model (:attr:`Reduce.accumulation_lanes`: 4 for
+        float/double, 1 for integers), so the knob declared in a program
+        manifest governs the runtime schedule.
+
+        Returns ``(received, total)``: the reassembled message and the
+        reduction over all its elements (both valid at ``dst``; the
+        reduction of the zero buffer elsewhere).
+        """
+        op = SmiOp.parse(op)
+        if lanes is None:
+            lanes = Reduce(self.port, self.dtype).accumulation_lanes
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        data = jnp.asarray(data, self.jnp_dtype)
+        combine = combine_fn(op)
+        chunk_reduce = reduction_fn(op)
+        dt = self.jnp_dtype
+        partials0 = jnp.full((lanes,) + data.shape[1:], identity_for(op, dt), dt)
+
+        def consumer(carry, chunk_data):
+            partials, i = carry
+            folded = combine(partials[i % lanes], chunk_reduce(chunk_data, axis=0))
+            return partials.at[i % lanes].set(folded), i + 1
+
+        received, (partials, _) = self.stream(
+            data, consumer=consumer, init_carry=(partials0, jnp.int32(0)),
+            backend=backend,
+        )
+        total = chunk_reduce(partials, axis=0)
+        return received, total
 
 
 def stream_concurrent(
@@ -185,15 +368,20 @@ def stream_concurrent(
 ) -> Tuple[jax.Array, ...]:
     """Move several P2P messages chunk-by-chunk *in lockstep*.
 
-    One ``lax.scan`` advances every channel by one chunk per step, so the
+    One ``lax.scan`` advances every channel by one burst per step, so the
     per-step ppermutes are independent ops XLA can overlap — the TPU
     expression of the reference's concurrent channels sharing the NoC
     (``bandwidth_0.cl``'s two app kernels pushing simultaneously).
     ``Channel.stream`` per channel would instead lower to back-to-back
     scans, serializing the transfers.
 
-    All channels must agree on message count and chunk size (the
-    benchmark shape). Returns the received message per channel.
+    The lockstep granularity is the channels' shared ``consecutive_reads``
+    burst (``READS_LIMIT``): a channel may move that many chunks per step
+    before the other channels advance — exactly the reference CK loop's
+    fairness bound between sources (``cks.cl:73-81``).
+
+    All channels must agree on message count, chunk size and burst width
+    (the benchmark shape). Returns the received message per channel.
     """
     if len(channels) != len(datas):
         raise ValueError("one data array per channel required")
@@ -201,12 +389,14 @@ def stream_concurrent(
         return ()
     counts = {ch.count for ch in channels}
     chunks = {min(ch.chunk_elements, ch.count) for ch in channels}
-    if len(counts) != 1 or len(chunks) != 1:
+    reads = {ch.consecutive_reads for ch in channels}
+    if len(counts) != 1 or len(chunks) != 1 or len(reads) != 1:
         raise ValueError(
-            "concurrent streaming requires equal message/chunk sizes; got "
-            f"counts {sorted(counts)}, chunks {sorted(chunks)}"
+            "concurrent streaming requires equal message/chunk/burst "
+            f"sizes; got counts {sorted(counts)}, chunks {sorted(chunks)}, "
+            f"consecutive_reads {sorted(reads)}"
         )
-    count, chunk = counts.pop(), chunks.pop()
+    count, chunk = counts.pop(), chunks.pop() * reads.pop()
     datas = tuple(
         jnp.asarray(d, ch.jnp_dtype) for ch, d in zip(channels, datas)
     )

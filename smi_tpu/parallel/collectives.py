@@ -11,17 +11,28 @@ Reference semantics to preserve:
 - only the root observes Reduce/Gather results, only non-roots receive
   Scatter slices of the root's buffer.
 
-TPU re-design: each op is one XLA collective over the communicator axis —
-the always-running support kernels, ready-to-receive handshakes and credit
-windows (``bcast.cl:18-33``, ``reduce.cl:13-32``) have no equivalent
-because XLA's collectives are internally flow-controlled. Rooted-ness is
-expressed by masking: a broadcast is a ``psum`` of the value masked to the
-root (one all-reduce, which XLA lowers to an ICI-optimal pattern); rooted
-results are masked to zeros off-root so program behaviour matches the
-reference's "non-participants never see the data". The *port* selects the
-stream assignment from the program model (distinct ports → independent
-collectives XLA is free to overlap; there is no false serialization
-because the ops share no data dependencies).
+TPU re-design: two selectable implementation tiers per collective
+(``backend=``):
+
+- ``"xla"`` (default): one XLA collective over the communicator axis —
+  the always-running support kernels, ready-to-receive handshakes and
+  credit windows (``bcast.cl:18-33``, ``reduce.cl:13-32``) have no
+  equivalent because XLA's collectives are internally flow-controlled.
+- ``"ring"``: the framework's own explicit-schedule tier — neighbour
+  RDMA Pallas kernels with credit flow control
+  (:mod:`smi_tpu.kernels.ring`), the faithful analog of the reference's
+  NoC being its data plane. Compiled on TPU meshes; on the CPU fake
+  mesh it runs under Pallas TPU interpret mode with the full credit
+  protocol live.
+
+Rooted-ness is expressed by masking: a broadcast is a ``psum`` of the
+value masked to the root (one all-reduce, which XLA lowers to an
+ICI-optimal pattern); rooted results are masked to zeros off-root so
+program behaviour matches the reference's "non-participants never see
+the data". The *port* selects the stream assignment from the program
+model (distinct ports → independent collectives XLA is free to overlap;
+there is no false serialization because the ops share no data
+dependencies).
 """
 
 from __future__ import annotations
@@ -33,7 +44,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from smi_tpu.ops.types import SmiOp
+from smi_tpu.parallel.backend import BACKENDS, check_backend as _check_backend
 from smi_tpu.parallel.mesh import Communicator
+
+
+def _ring():
+    # deferred: smi_tpu.kernels.ring imports parallel.mesh at module load
+    from smi_tpu.kernels import ring
+
+    return ring
 
 
 def _axis(comm: Communicator) -> str:
@@ -54,35 +73,49 @@ def _is_root(comm: Communicator, root: int) -> jax.Array:
 
 
 def bcast(x: jax.Array, comm: Communicator, root: int = 0,
-          port: Optional[int] = None) -> jax.Array:
+          port: Optional[int] = None, backend: str = "xla") -> jax.Array:
     """One-to-all: every rank returns the root's ``x``.
 
     Reference: ``SMI_Bcast`` (``bcast.h:43-63``); the root's support kernel
     unicasts a copy per rank (``bcast.cl:36-43``) — here a single masked
     all-reduce whose only non-zero contribution is the root's value, which
-    XLA lowers to a bandwidth-optimal ICI broadcast.
+    XLA lowers to a bandwidth-optimal ICI broadcast (or, under
+    ``backend="ring"``, circulates around the explicit credit-controlled
+    ring).
     """
     del port  # metadata only: distinct ports are independent by dataflow
+    _check_backend(backend)
     mask = _is_root(comm, root)
     contrib = jnp.where(mask, x, jnp.zeros_like(x))
+    if backend == "ring":
+        return _ring().ring_all_reduce(
+            contrib, _axis(comm), comm.size, op=SmiOp.ADD,
+            interpret=not comm.is_tpu,
+        )
     return lax.psum(contrib, _axis(comm))
 
 
 def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
            root: int = 0, port: Optional[int] = None,
-           all_ranks: bool = False) -> jax.Array:
+           all_ranks: bool = False, backend: str = "xla") -> jax.Array:
     """All-to-one reduction with ADD/MAX/MIN.
 
     Reference: ``SMI_Reduce`` (``reduce.h:18-76``): every rank contributes,
     only the root receives the result (zeros elsewhere here). With
     ``all_ranks=True`` behaves as an allreduce (no masking) — the fused
     Reduce+Bcast idiom of kmeans (``kmeans_smi.cl:132-190``) without the
-    second collective.
+    second collective. ``backend="ring"`` runs the circulating-partial
+    ring kernel (``kernels/ring.py``) instead of ``lax.psum``.
     """
     del port
+    _check_backend(backend)
     op = SmiOp.parse(op)
     name = _axis(comm)
-    if op is SmiOp.ADD:
+    if backend == "ring":
+        out = _ring().ring_all_reduce(
+            x, name, comm.size, op=op, interpret=not comm.is_tpu
+        )
+    elif op is SmiOp.ADD:
         out = lax.psum(x, name)
     elif op is SmiOp.MAX:
         out = lax.pmax(x, name)
@@ -94,14 +127,15 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
 
 
 def allreduce(x: jax.Array, comm: Communicator,
-              op: Union[str, SmiOp] = SmiOp.ADD) -> jax.Array:
+              op: Union[str, SmiOp] = SmiOp.ADD,
+              backend: str = "xla") -> jax.Array:
     """Reduce + Bcast in one collective (convenience; no reference analog
     because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``)."""
-    return reduce(x, comm, op=op, all_ranks=True)
+    return reduce(x, comm, op=op, all_ranks=True, backend=backend)
 
 
 def scatter(x: jax.Array, comm: Communicator, root: int = 0,
-            port: Optional[int] = None) -> jax.Array:
+            port: Optional[int] = None, backend: str = "xla") -> jax.Array:
     """Root distributes contiguous slices; rank r returns slice r.
 
     Reference: ``SMI_Scatter`` (``scatter.h:49-72``) — the root splits its
@@ -112,8 +146,10 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
     per-destination unicasts instead of a full broadcast.
 
     ``x`` must have leading dimension ``size * count`` (valid at root).
+    ``backend="ring"`` uses the explicit ring reduce-scatter kernel.
     """
     del port
+    _check_backend(backend)
     size = comm.size
     if x.shape[0] % size != 0:
         raise ValueError(
@@ -121,21 +157,34 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
             f"comm size {size}"
         )
     contrib = jnp.where(_is_root(comm, root), x, jnp.zeros_like(x))
+    if backend == "ring":
+        return _ring().ring_reduce_scatter(
+            contrib, _axis(comm), size, op=SmiOp.ADD,
+            interpret=not comm.is_tpu,
+        )
     return lax.psum_scatter(contrib, _axis(comm), scatter_dimension=0,
                             tiled=True)
 
 
 def gather(x: jax.Array, comm: Communicator, root: int = 0,
-           port: Optional[int] = None, all_ranks: bool = False) -> jax.Array:
+           port: Optional[int] = None, all_ranks: bool = False,
+           backend: str = "xla") -> jax.Array:
     """Root collects contiguous slices; returns ``size * count`` at root.
 
     Reference: ``SMI_Gather`` (``gather.h:47-68``) — the root pulls each
     contributor's ``count`` elements in rank order (``gather.cl:47-99``).
     Here one ``all_gather`` rides ICI and the result is masked off-root
-    (or kept everywhere with ``all_ranks=True``).
+    (or kept everywhere with ``all_ranks=True``). ``backend="ring"``
+    forwards chunks neighbour-to-neighbour around the explicit ring.
     """
     del port
-    out = lax.all_gather(x, _axis(comm), axis=0, tiled=True)
+    _check_backend(backend)
+    if backend == "ring":
+        out = _ring().ring_all_gather(
+            x, _axis(comm), comm.size, interpret=not comm.is_tpu
+        )
+    else:
+        out = lax.all_gather(x, _axis(comm), axis=0, tiled=True)
     if all_ranks:
         return out
     return jnp.where(_is_root(comm, root), out, jnp.zeros_like(out))

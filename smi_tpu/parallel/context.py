@@ -50,6 +50,10 @@ class SmiContext:
 
     comm: Communicator
     program: Optional[Program] = None
+    #: Default collective implementation tier: ``"xla"`` (XLA collectives)
+    #: or ``"ring"`` (explicit credit-controlled neighbour RDMA,
+    #: :mod:`smi_tpu.kernels.ring`).
+    backend: str = "xla"
 
     # -- communicator (include/smi/communicator.h) ---------------------
     def rank(self) -> jax.Array:
@@ -76,9 +80,11 @@ class SmiContext:
         descriptor serves both ends. ``buffer_size`` is the asynchronicity
         degree (``_ad`` variants) in elements.
         """
-        rendezvous = True
+        kwargs = {}
         if self.program is not None:
-            rendezvous = self.program.p2p_rendezvous
+            # program-declared tuning knobs override the dataclass defaults
+            kwargs["rendezvous"] = self.program.p2p_rendezvous
+            kwargs["consecutive_reads"] = self.program.consecutive_reads
             declared = self.program.find("push", port) or self.program.find("pop", port)
             if declared is not None and buffer_size is None:
                 buffer_size = declared.buffer_size
@@ -90,41 +96,69 @@ class SmiContext:
             count=count,
             dtype=dtype,
             buffer_size=buffer_size,
-            rendezvous=rendezvous,
+            **kwargs,
         )
 
-    def transfer(self, channel: P2PChannel, data: jax.Array) -> jax.Array:
+    def transfer(self, channel: P2PChannel, data: jax.Array,
+                 backend: Optional[str] = None) -> jax.Array:
         """Fused Push(all elements)+Pop: message at dst, zeros elsewhere."""
-        return channel.transfer(data)
+        return channel.transfer(data, backend=self._backend(backend))
 
     def stream(self, channel: P2PChannel, data: jax.Array,
-               consumer: Optional[Callable] = None, init_carry=None):
+               consumer: Optional[Callable] = None, init_carry=None,
+               backend: Optional[str] = None):
         """Chunked streaming transfer with optional per-chunk consumer."""
-        return channel.stream(data, consumer=consumer, init_carry=init_carry)
+        return channel.stream(data, consumer=consumer, init_carry=init_carry,
+                              backend=self._backend(backend))
+
+    def stream_reduce(self, channel: P2PChannel, data: jax.Array,
+                      op="add", lanes: Optional[int] = None,
+                      backend: Optional[str] = None):
+        """Streamed reduction with ``lanes`` partial accumulators
+        (``Reduce.accumulation_lanes`` by default)."""
+        return channel.stream_reduce(data, op=op, lanes=lanes,
+                                     backend=self._backend(backend))
 
     def ring_shift(self, x: jax.Array, offset: int = 1,
                    axis_name: Optional[str] = None) -> jax.Array:
         return ring_shift(x, self.comm, offset=offset, axis_name=axis_name)
 
     # -- collectives (include/smi/{bcast,reduce,scatter,gather}.h) -----
-    def bcast(self, x, root: int = 0, port: Optional[int] = None):
-        return _coll.bcast(x, self.comm, root=root, port=port)
+    # ``backend=None`` inherits the context default (``smi_kernel(...,
+    # backend=...)``), letting one program switch wholesale between the
+    # XLA tier and the explicit credit-controlled ring tier.
+    def _backend(self, backend: Optional[str]) -> str:
+        from smi_tpu.parallel.backend import check_backend
+
+        return self.backend if backend is None else check_backend(backend)
+
+    def bcast(self, x, root: int = 0, port: Optional[int] = None,
+              backend: Optional[str] = None):
+        return _coll.bcast(x, self.comm, root=root, port=port,
+                           backend=self._backend(backend))
 
     def reduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD, root: int = 0,
-               port: Optional[int] = None, all_ranks: bool = False):
+               port: Optional[int] = None, all_ranks: bool = False,
+               backend: Optional[str] = None):
         return _coll.reduce(x, self.comm, op=op, root=root, port=port,
-                            all_ranks=all_ranks)
+                            all_ranks=all_ranks,
+                            backend=self._backend(backend))
 
-    def allreduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD):
-        return _coll.allreduce(x, self.comm, op=op)
+    def allreduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD,
+                  backend: Optional[str] = None):
+        return _coll.allreduce(x, self.comm, op=op,
+                               backend=self._backend(backend))
 
-    def scatter(self, x, root: int = 0, port: Optional[int] = None):
-        return _coll.scatter(x, self.comm, root=root, port=port)
+    def scatter(self, x, root: int = 0, port: Optional[int] = None,
+                backend: Optional[str] = None):
+        return _coll.scatter(x, self.comm, root=root, port=port,
+                             backend=self._backend(backend))
 
     def gather(self, x, root: int = 0, port: Optional[int] = None,
-               all_ranks: bool = False):
+               all_ranks: bool = False, backend: Optional[str] = None):
         return _coll.gather(x, self.comm, root=root, port=port,
-                            all_ranks=all_ranks)
+                            all_ranks=all_ranks,
+                            backend=self._backend(backend))
 
     # -- MPMD: per-rank divergent local compute ------------------------
     def select(self, branches, operand):
@@ -152,6 +186,7 @@ def smi_kernel(
     out_specs=None,
     program: Optional[Program] = None,
     check_vma: bool = False,
+    backend: str = "xla",
 ):
     """Decorator: run ``fn(ctx, *args)`` per-shard over the communicator.
 
@@ -167,7 +202,10 @@ def smi_kernel(
     if out_specs is None:
         out_specs = P()
 
-    ctx = SmiContext(comm=comm, program=program)
+    from smi_tpu.parallel.backend import check_backend
+
+    ctx = SmiContext(comm=comm, program=program,
+                     backend=check_backend(backend))
 
     def decorator(fn: Callable) -> Callable:
         def shard_fn(*args):

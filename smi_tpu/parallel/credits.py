@@ -1,0 +1,552 @@
+"""Credit flow-control protocol: executable specification + schedule fuzzer.
+
+Reference parity: the SMI NoC is deadlock- and clobber-free because every
+writer holds *credits* for the receiver's buffer space — P2P rendezvous
+tokens (``templates/push.cl:21-31``, replenished by ``pop.cl:35-51``) and
+the collectives' explicit credit windows (``reduce.cl:13-32``). The
+emulator's strict channel-depth model exists to make violations reproduce
+(``CMakeLists.txt:188-191``).
+
+The TPU ring kernels (:mod:`smi_tpu.kernels.ring`) use the same idea over
+``make_async_remote_copy``: a rank may only RDMA into a neighbour's buffer
+slot after the neighbour granted that slot via a remote semaphore signal.
+This module is the **protocol specification**, written as per-rank state
+machines (Python generators mirroring the kernels' step structure
+one-yield-per-primitive) plus a discrete-event simulator that executes
+them under arbitrary schedules — random, adversarial, or exhaustive — and
+checks the protocol invariants the hardware would punish:
+
+- **no clobber**: a DMA never lands on a slot holding unconsumed data;
+- **no deadlock**: some rank or in-flight DMA can always make progress;
+- **credit balance**: every semaphore drains to zero at exit (a leaked
+  count would poison the next collective reusing the semaphore — Pallas
+  TPU interpret mode reports exactly this);
+- **correct delivery**: every rank terminates with the right payload.
+
+``tests/test_credits.py`` fuzzes all four ring protocols across sizes and
+schedules, and demonstrates that with flow control *disabled* the
+simulator catches the clobber — evidence the harness can see the race the
+credits exist to prevent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import random
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Primitive actions yielded by protocol generators
+# ---------------------------------------------------------------------------
+# ("signal", target_rank, sem_name, index, inc)   remote/local semaphore +=
+# ("wait", sem_name, index, amount)               block until local sem >=,
+#                                                 then decrement
+# ("dma", target_rank, slot, payload, send_index, recv_index)
+#                                                 start async copy into the
+#                                                 target's buffer slot. The
+#                                                 payload is snapshotted and
+#                                                 send[send_index] signals
+#                                                 IMMEDIATELY (hardware only
+#                                                 promises the source buffer
+#                                                 is reusable — the data may
+#                                                 still be in flight); the
+#                                                 copy lands when the
+#                                                 scheduler picks it, then
+#                                                 signals recv[recv_index] at
+#                                                 the target. In-flight copies
+#                                                 may land in ANY order —
+#                                                 the credit protocol, not
+#                                                 the wire, must prevent
+#                                                 overtaking writes.
+# ("read_slot", slot)                             -> payload (marks the slot
+#                                                 consumed)
+# ("write_slot", slot, payload)                   local slot init
+# ("output", key, payload)                        record a result
+
+SEM_SEND = "send"
+SEM_RECV = "recv"
+SEM_CREDIT = "credit"
+SEM_BARRIER = "barrier"
+
+
+class ProtocolError(AssertionError):
+    """A protocol invariant was violated under some schedule."""
+
+
+class ClobberError(ProtocolError):
+    pass
+
+
+class DeadlockError(ProtocolError):
+    pass
+
+
+class CreditLeakError(ProtocolError):
+    pass
+
+
+@dataclasses.dataclass
+class _Slot:
+    payload: object = None
+    full: bool = False
+    consumed: bool = True  # nothing to lose initially
+
+
+@dataclasses.dataclass
+class _Dma:
+    src: int
+    target: int
+    slot: int
+    payload: object
+    send_index: int
+    recv_index: int
+
+
+def _barrier_steps(me: int, n: int):
+    """Signal both ring neighbours, wait for both — mirrors
+    ``ring._neighbour_barrier``."""
+    yield ("signal", (me - 1) % n, SEM_BARRIER, 0, 1)
+    yield ("signal", (me + 1) % n, SEM_BARRIER, 0, 1)
+    yield ("wait", SEM_BARRIER, 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# Protocol state machines (mirror smi_tpu/kernels/ring.py kernel bodies)
+# ---------------------------------------------------------------------------
+
+
+def all_gather_rank(me: int, n: int, chunk, flow_control: bool = True):
+    """Mirrors ``_ring_all_gather_kernel``: forward the chunk received
+    last step to the right neighbour; slots alternate; slot 1 granted at
+    start; per-step re-grant after the onward send except the final step."""
+    left, right = (me - 1) % n, (me + 1) % n
+    if flow_control:
+        yield from _barrier_steps(me, n)
+    yield ("output", me, chunk)
+    yield ("write_slot", 0, chunk)
+    if flow_control:
+        yield ("signal", left, SEM_CREDIT, 1, 1)
+    for s in range(n - 1):
+        slot, nslot = s % 2, (s + 1) % 2
+        if flow_control:
+            yield ("wait", SEM_CREDIT, nslot, 1)
+        payload = yield ("read_slot", slot)
+        yield ("dma", right, nslot, payload, slot, nslot)
+        yield ("wait", SEM_SEND, slot, 1)
+        yield ("wait", SEM_RECV, nslot, 1)
+        if flow_control and s < n - 2:
+            yield ("signal", left, SEM_CREDIT, slot, 1)
+        arrived = yield ("read_slot", nslot)
+        yield ("output", (me - s - 1) % n, arrived)
+
+
+def all_reduce_rank(me: int, n: int, value, combine: Callable,
+                    flow_control: bool = True):
+    """Mirrors ``_ring_all_reduce_kernel``: circulate the running partial
+    rightward, folding the local contribution into each arrival."""
+    left, right = (me - 1) % n, (me + 1) % n
+    if flow_control:
+        yield from _barrier_steps(me, n)
+    yield ("write_slot", 0, value)
+    if flow_control:
+        yield ("signal", left, SEM_CREDIT, 1, 1)
+    for s in range(n - 1):
+        slot, nslot = s % 2, (s + 1) % 2
+        if flow_control:
+            yield ("wait", SEM_CREDIT, nslot, 1)
+        payload = yield ("read_slot", slot)
+        yield ("dma", right, nslot, payload, slot, nslot)
+        yield ("wait", SEM_SEND, slot, 1)
+        yield ("wait", SEM_RECV, nslot, 1)
+        if flow_control and s < n - 2:
+            yield ("signal", left, SEM_CREDIT, slot, 1)
+        arrived = yield ("read_slot", nslot)
+        yield ("write_slot", nslot, combine(arrived, value))
+    final = yield ("read_slot", (n - 1) % 2)
+    yield ("output", 0, final)
+
+
+def reduce_scatter_rank(me: int, n: int, blocks: Sequence, combine: Callable,
+                        flow_control: bool = True):
+    """Mirrors ``_ring_reduce_scatter_kernel``: at step ``s`` send the
+    partial of block ``(me - s - 1) % n``, fold the local share into the
+    arriving partial of block ``(me - s - 2) % n``."""
+    left, right = (me - 1) % n, (me + 1) % n
+    if flow_control:
+        yield from _barrier_steps(me, n)
+    yield ("write_slot", 0, blocks[(me - 1) % n])
+    if flow_control:
+        yield ("signal", left, SEM_CREDIT, 1, 1)
+    for s in range(n - 1):
+        slot, nslot = s % 2, (s + 1) % 2
+        if flow_control:
+            yield ("wait", SEM_CREDIT, nslot, 1)
+        payload = yield ("read_slot", slot)
+        yield ("dma", right, nslot, payload, slot, nslot)
+        yield ("wait", SEM_SEND, slot, 1)
+        yield ("wait", SEM_RECV, nslot, 1)
+        if flow_control and s < n - 2:
+            yield ("signal", left, SEM_CREDIT, slot, 1)
+        arrived = yield ("read_slot", nslot)
+        yield ("write_slot", nslot, combine(arrived, blocks[(me - s - 2) % n]))
+    final = yield ("read_slot", (n - 1) % 2)
+    yield ("output", me, final)
+
+
+def neighbour_stream_rank(me: int, n: int, chunks: Sequence,
+                          direction: int = 1, flow_control: bool = True):
+    """Mirrors ``_neighbour_stream_kernel``: stream own chunks one hop
+    downstream while consuming the upstream's; both slots start granted,
+    waits begin at chunk 2, grants stop when nobody would consume them."""
+    dst = (me + direction) % n
+    upstream = (me - direction) % n
+    if flow_control:
+        yield from _barrier_steps(me, n)
+    total = len(chunks)
+    for c, chunk in enumerate(chunks):
+        slot = c % 2
+        if flow_control and c >= 2:
+            yield ("wait", SEM_CREDIT, slot, 1)
+        yield ("dma", dst, slot, chunk, slot, slot)
+        yield ("wait", SEM_RECV, slot, 1)
+        arrived = yield ("read_slot", slot)
+        yield ("output", c, arrived)
+        if flow_control and c + 2 < total:
+            yield ("signal", upstream, SEM_CREDIT, slot, 1)
+        yield ("wait", SEM_SEND, slot, 1)
+
+
+# ---------------------------------------------------------------------------
+# Discrete-event simulator
+# ---------------------------------------------------------------------------
+
+
+class Strategy:
+    """Picks the next runnable entity. Subclass for adversarial orders."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+
+    def pick(self, choices: List):  # choices: ("rank", r) | ("dma", i)
+        return self.rng.choice(choices)
+
+
+class DelayDmaStrategy(Strategy):
+    """Adversarial: let ranks run as far ahead as possible before any DMA
+    lands — maximizes the window for clobbers."""
+
+    def pick(self, choices):
+        ranks = [c for c in choices if c[0] == "rank"]
+        return self.rng.choice(ranks) if ranks else self.rng.choice(choices)
+
+
+class FavourRankStrategy(Strategy):
+    """Adversarial: one rank races ahead, the others lag."""
+
+    def __init__(self, favourite: int, seed: int = 0):
+        super().__init__(seed)
+        self.favourite = favourite
+
+    def pick(self, choices):
+        favoured = [
+            c for c in choices
+            if c == ("rank", self.favourite)
+        ]
+        if favoured and self.rng.random() < 0.85:
+            return favoured[0]
+        return self.rng.choice(choices)
+
+
+class RingSimulator:
+    """Execute per-rank protocol generators under one schedule.
+
+    ``coarse=True`` makes a scheduled rank run atomically until a
+    *communication boundary*: a DMA start (which creates a new schedulable
+    landing) or a wait it cannot yet satisfy. This is a partial-order
+    reduction — local actions and counting-semaphore signals commute with
+    other ranks' actions, so only the DMA-landing / rank-progress
+    interleavings carry nondeterminism. It shrinks the schedule space
+    enough for :func:`explore_all_schedules` to cover tiny configurations
+    completely without losing any detectable race.
+    """
+
+    def __init__(self, generators: Sequence[Iterator], strategy: Strategy,
+                 coarse: bool = False):
+        self.gens = list(generators)
+        self.n = len(self.gens)
+        self.strategy = strategy
+        self.coarse = coarse
+        self.sems: Dict[Tuple[int, str, int], int] = {}
+        self.slots: Dict[Tuple[int, int], _Slot] = {}
+        self.inflight: List[Optional[_Dma]] = []
+        self.outputs: List[Dict] = [dict() for _ in range(self.n)]
+        # (pending_action, value_to_send) per rank; None action = finished
+        self.state: List[Optional[Tuple]] = []
+        for gen in self.gens:
+            try:
+                action = next(gen)
+                self.state.append((action, None))
+            except StopIteration:
+                self.state.append(None)
+
+    # -- helpers --
+    def _sem(self, rank: int, name: str, index: int) -> int:
+        return self.sems.get((rank, name, index), 0)
+
+    def _add(self, rank: int, name: str, index: int, inc: int) -> None:
+        key = (rank, name, index)
+        self.sems[key] = self.sems.get(key, 0) + inc
+
+    def _slot(self, rank: int, index: int) -> _Slot:
+        return self.slots.setdefault((rank, index), _Slot())
+
+    # -- execution --
+    def _runnable(self) -> List:
+        out = []
+        for r, st in enumerate(self.state):
+            if st is None:
+                continue
+            action, _ = st
+            if action[0] == "wait":
+                _, name, index, amount = action
+                if self._sem(r, name, index) >= amount:
+                    out.append(("rank", r))
+            else:
+                out.append(("rank", r))
+        for i, dma in enumerate(self.inflight):
+            if dma is not None:
+                out.append(("dma", i))
+        return out
+
+    def _advance(self, r: int, value=None) -> None:
+        try:
+            action = self.gens[r].send(value)
+            self.state[r] = (action, None)
+        except StopIteration:
+            self.state[r] = None
+
+    def _execute_rank(self, r: int) -> None:
+        while True:
+            kind = self.state[r][0][0]
+            self._execute_one(r)
+            if not self.coarse or kind == "dma":
+                return  # dma start is a boundary: its landing must be
+                        # schedulable before this rank continues
+            st = self.state[r]
+            if st is None:
+                return
+            nxt = st[0]
+            if nxt[0] == "wait":
+                _, name, index, amount = nxt
+                if self._sem(r, name, index) < amount:
+                    return  # blocked
+
+    def _execute_one(self, r: int) -> None:
+        action, _ = self.state[r]
+        kind = action[0]
+        if kind == "wait":
+            _, name, index, amount = action
+            self._add(r, name, index, -amount)
+            self._advance(r)
+        elif kind == "signal":
+            _, target, name, index, inc = action
+            self._add(target, name, index, inc)
+            self._advance(r)
+        elif kind == "dma":
+            _, target, slot, payload, send_index, recv_index = action
+            self.inflight.append(
+                _Dma(src=r, target=target, slot=slot, payload=payload,
+                     send_index=send_index, recv_index=recv_index)
+            )
+            # send completion = source buffer reusable; worst case this is
+            # immediate, long before the remote landing
+            self._add(r, SEM_SEND, send_index, 1)
+            self._advance(r)
+        elif kind == "write_slot":
+            _, slot, payload = action
+            s = self._slot(r, slot)
+            s.payload, s.full, s.consumed = payload, True, False
+            self._advance(r)
+        elif kind == "read_slot":
+            _, slot = action
+            s = self._slot(r, slot)
+            if not s.full:
+                raise ProtocolError(
+                    f"rank {r} read empty slot {slot}"
+                )
+            s.consumed = True
+            self._advance(r, s.payload)
+        elif kind == "output":
+            _, key, payload = action
+            self.outputs[r][key] = payload
+            self._advance(r)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown action {action!r}")
+
+    def _land_dma(self, i: int) -> None:
+        dma = self.inflight[i]
+        self.inflight[i] = None
+        s = self._slot(dma.target, dma.slot)
+        if s.full and not s.consumed:
+            raise ClobberError(
+                f"DMA from rank {dma.src} landed on rank {dma.target} "
+                f"slot {dma.slot} holding unconsumed data"
+            )
+        s.payload, s.full, s.consumed = dma.payload, True, False
+        self._add(dma.target, SEM_RECV, dma.recv_index, 1)
+
+    def run(self, max_steps: int = 1_000_000) -> List[Dict]:
+        for _ in range(max_steps):
+            if all(st is None for st in self.state) and not any(
+                d is not None for d in self.inflight
+            ):
+                self._check_drained()
+                return self.outputs
+            choices = self._runnable()
+            if not choices:
+                blocked = [
+                    (r, st[0]) for r, st in enumerate(self.state)
+                    if st is not None
+                ]
+                raise DeadlockError(f"no runnable entity; blocked: {blocked}")
+            kind, idx = self.strategy.pick(choices)
+            if kind == "rank":
+                self._execute_rank(idx)
+            else:
+                self._land_dma(idx)
+        raise ProtocolError("simulation did not terminate")
+
+    def _check_drained(self) -> None:
+        leaked = {k: v for k, v in self.sems.items() if v != 0}
+        if leaked:
+            raise CreditLeakError(
+                f"semaphores non-zero at exit: {leaked}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Exhaustive exploration (tiny configurations)
+# ---------------------------------------------------------------------------
+
+
+def explore_all_schedules(make_generators: Callable[[], Sequence[Iterator]],
+                          max_schedules: int = 200_000) -> int:
+    """Depth-first over *every* scheduler choice for a tiny configuration.
+
+    Re-instantiates the generators per path (generators are single-shot),
+    replaying a prefix of choices then branching. Returns the number of
+    complete schedules explored; raises on any invariant violation.
+    """
+
+    class _Replay(Strategy):
+        def __init__(self, prefix: List):
+            self.prefix = list(prefix)
+            self.trace: List = []
+            self.branch_points: List[Tuple[int, List]] = []
+
+        def pick(self, choices):
+            choices = sorted(choices)
+            i = len(self.trace)
+            if i < len(self.prefix):
+                choice = self.prefix[i]
+                if choice not in choices:
+                    raise ProtocolError(
+                        "schedule replay diverged; simulator is "
+                        "nondeterministic beyond scheduler choice"
+                    )
+            else:
+                choice = choices[0]
+                if len(choices) > 1:
+                    self.branch_points.append((i, choices[1:]))
+            self.trace.append(choice)
+            return choice
+
+    stack: List[List] = [[]]
+    explored = 0
+    while stack:
+        prefix = stack.pop()
+        strategy = _Replay(prefix)
+        RingSimulator(make_generators(), strategy, coarse=True).run()
+        explored += 1
+        if explored >= max_schedules:
+            raise ProtocolError(
+                f"exploration budget exceeded ({max_schedules} schedules)"
+            )
+        for i, alternatives in strategy.branch_points:
+            if i >= len(prefix):  # only branch beyond the replayed prefix
+                for alt in alternatives:
+                    stack.append(strategy.trace[:i] + [alt])
+    return explored
+
+
+# ---------------------------------------------------------------------------
+# Convenience harnesses
+# ---------------------------------------------------------------------------
+
+
+def simulate_all_gather(n: int, strategy: Strategy,
+                        flow_control: bool = True) -> None:
+    gens = [
+        all_gather_rank(r, n, f"chunk{r}", flow_control=flow_control)
+        for r in range(n)
+    ]
+    outputs = RingSimulator(gens, strategy).run()
+    expected = {i: f"chunk{i}" for i in range(n)}
+    for r in range(n):
+        if outputs[r] != expected:
+            raise ProtocolError(
+                f"rank {r} gathered {outputs[r]}, wanted {expected}"
+            )
+
+
+def simulate_all_reduce(n: int, strategy: Strategy,
+                        flow_control: bool = True) -> None:
+    gens = [
+        all_reduce_rank(r, n, frozenset([r]), lambda a, b: a | b,
+                        flow_control=flow_control)
+        for r in range(n)
+    ]
+    outputs = RingSimulator(gens, strategy).run()
+    want = frozenset(range(n))
+    for r in range(n):
+        if outputs[r] != {0: want}:
+            raise ProtocolError(f"rank {r} reduced {outputs[r]}, wanted {want}")
+
+
+def simulate_reduce_scatter(n: int, strategy: Strategy,
+                            flow_control: bool = True) -> None:
+    gens = [
+        reduce_scatter_rank(
+            r, n, [frozenset([(r, b)]) for b in range(n)],
+            lambda a, b: a | b, flow_control=flow_control,
+        )
+        for r in range(n)
+    ]
+    outputs = RingSimulator(gens, strategy).run()
+    for r in range(n):
+        want = frozenset((src, r) for src in range(n))
+        if outputs[r] != {r: want}:
+            raise ProtocolError(
+                f"rank {r} got {outputs[r]}, wanted {want}"
+            )
+
+
+def simulate_neighbour_stream(n: int, chunks: int, strategy: Strategy,
+                              direction: int = 1,
+                              flow_control: bool = True) -> None:
+    gens = [
+        neighbour_stream_rank(
+            r, n, [(r, c) for c in range(chunks)],
+            direction=direction, flow_control=flow_control,
+        )
+        for r in range(n)
+    ]
+    outputs = RingSimulator(gens, strategy).run()
+    for r in range(n):
+        upstream = (r - direction) % n
+        want = {c: (upstream, c) for c in range(chunks)}
+        if outputs[r] != want:
+            raise ProtocolError(
+                f"rank {r} received {outputs[r]}, wanted {want}"
+            )

@@ -202,3 +202,213 @@ def test_stream_concurrent_mismatched_sizes_rejected(comm8):
                      dtype="float")
     with pytest.raises(ValueError, match="equal message/chunk"):
         stream_concurrent((ch0, ch1), (jnp.zeros(64), jnp.zeros(32)))
+
+
+# ---------------------------------------------------------------------------
+# Ring backend: credit-flow-controlled neighbour RDMA P2P tier
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dst", [1, 4, 7])
+def test_ring_transfer_multi_hop(comm8, dst):
+    """P2P over the explicit ring tier: non-neighbour endpoints forward
+    hop-by-hop through intermediate ranks (``ckr.cl:50-60``)."""
+
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+    def app(ctx, x):
+        ch = ctx.open_channel(port=0, src=0, dst=dst, count=64, dtype="float")
+        return ctx.transfer(ch, x, backend="ring")[None]
+
+    x = _payload(64, "float")
+    out = np.asarray(app(x))
+    np.testing.assert_array_equal(out[dst], np.asarray(x))
+    for r in range(8):
+        if r != dst:
+            np.testing.assert_array_equal(out[r], np.zeros_like(out[r]))
+
+
+@pytest.mark.parametrize("length", [1, 333, 1024])
+def test_ring_stream_chunked(comm8, length):
+    """Streamed ring transfer with odd lengths (chunk padding must not
+    leak into the reassembled message)."""
+
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+    def app(ctx, x):
+        ch = ctx.open_channel(
+            port=0, src=2, dst=3, count=length, dtype="float", buffer_size=7
+        )
+        received, _ = ctx.stream(ch, x, backend="ring")
+        return received[None]
+
+    x = _payload(length, "float")
+    out = np.asarray(app(x))
+    np.testing.assert_array_equal(out[3], np.asarray(x))
+
+
+def test_ring_stream_consumer_carry(comm8):
+    """The consumer sees each chunk of a ring-streamed message in order."""
+
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+    def app(ctx, x):
+        ch = ctx.open_channel(
+            port=0, src=0, dst=1, count=300, dtype="float", buffer_size=56
+        )
+        received, total = ctx.stream(
+            ch, x, consumer=lambda c, chunk: c + chunk.sum(),
+            init_carry=jnp.float32(0), backend="ring",
+        )
+        return jnp.concatenate([received, total[None]])[None]
+
+    x = _payload(300, "float")
+    out = np.asarray(app(x))
+    np.testing.assert_array_equal(out[1, :300], np.asarray(x))
+    np.testing.assert_allclose(out[1, 300], np.asarray(x).sum())
+
+
+# ---------------------------------------------------------------------------
+# consecutive_reads (READS_LIMIT) burst schedule
+# ---------------------------------------------------------------------------
+
+
+def test_burst_schedule_changes_with_consecutive_reads(comm8):
+    """The knob must change the observable chunking schedule
+    (``device.cl:13-14``): bursts of k chunks per pipelining step."""
+    base = dict(comm=comm8, port=0, src=0, dst=1, count=400,
+                dtype="float", buffer_size=7)  # chunk = 8 packets = 56 elems
+    ch1 = smi.P2PChannel(consecutive_reads=1, **base)
+    ch4 = smi.P2PChannel(consecutive_reads=4, **base)
+    assert ch1.burst_schedule() == [56] * 7 + [8]
+    assert ch4.burst_schedule() == [224, 56, 56, 56, 8]
+    assert sum(ch1.burst_schedule()) == sum(ch4.burst_schedule()) == 400
+
+
+def test_burst_schedule_is_the_traced_schedule(comm8):
+    """The jaxpr's transfer ops follow burst_schedule(): one ppermute per
+    schedule entry outside the scan, one inside it."""
+    from jax.sharding import PartitionSpec as PS
+
+    def build(consecutive_reads):
+        ch = smi.P2PChannel(
+            comm=comm8, port=0, src=0, dst=1, count=400, dtype="float",
+            buffer_size=7, consecutive_reads=consecutive_reads,
+        )
+
+        def shard(x):
+            received, _ = ch.stream(x)
+            return received
+
+        return jax.make_jaxpr(
+            jax.shard_map(shard, mesh=comm8.mesh, in_specs=PS(),
+                          out_specs=PS(), check_vma=False)
+        )(jnp.zeros(400, jnp.float32))
+
+    # cr=1: scan over 7 uniform chunks (1 ppermute in the body) + tail = 2
+    assert str(build(1)).count("ppermute") == 2
+    # cr=4: scan over 1 burst + 3 leftover chunks + tail = 5
+    assert str(build(4)).count("ppermute") == 5
+
+
+def test_burst_payload_equality(comm8):
+    """Burst width must not change delivered bytes or consumer results."""
+    results = []
+    for cr in (1, 3, 8):
+        prog = smi.Program(
+            [smi.Push(0, "float", 7), smi.Pop(0, "float", 7)],
+            consecutive_reads=cr,
+        )
+
+        @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), program=prog)
+        def app(ctx, x):
+            ch = ctx.open_channel(port=0, src=0, dst=5, count=500, dtype="float")
+            assert ch.consecutive_reads == cr  # program knob reaches channel
+            received, total = ctx.stream(
+                ch, x, consumer=lambda c, chunk: c + chunk.sum(),
+                init_carry=jnp.float32(0),
+            )
+            return jnp.concatenate([received, total[None]])[None]
+
+        out = np.asarray(app(_payload(500, "float")))
+        results.append(out)
+    for r in results[1:]:
+        np.testing.assert_array_equal(results[0], r)
+
+
+# ---------------------------------------------------------------------------
+# stream_reduce: accumulation_lanes (latency-masking shift register analog)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_reduce_correct_and_lane_defaults(comm8):
+    """Default lanes follow the op model: 4 for float (reduce.cl:63-70 /
+    ops.py:110-141), 1 for int — and both reduce correctly."""
+    for dtype, op, expect in [
+        ("float", "add", lambda v: v.sum()),
+        ("float", "max", lambda v: v.max()),
+        ("int", "min", lambda v: v.min()),
+    ]:
+        @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+        def app(ctx, x):
+            ch = ctx.open_channel(
+                port=0, src=0, dst=2, count=600, dtype=dtype, buffer_size=7
+            )
+            _, total = ctx.stream_reduce(ch, x, op=op)
+            return total[None][None]
+
+        x = _payload(600, dtype)
+        out = np.asarray(app(x))
+        np.testing.assert_allclose(out[2, 0], expect(np.asarray(x)), rtol=1e-6)
+
+
+def test_accumulation_lanes_change_float_association(comm8):
+    """lanes is a live knob: different lane counts reassociate the
+    streamed float sum (observably different bits), as the reference's
+    shift register reassociates its accumulation."""
+
+    def run(lanes):
+        @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+        def app(ctx, x):
+            ch = ctx.open_channel(
+                port=0, src=0, dst=1, count=560, dtype="float", buffer_size=7
+            )
+            _, total = ctx.stream_reduce(ch, x, lanes=lanes)
+            return total[None][None]
+
+        # alternate huge/small whole chunks (chunk = 56 elements) so the
+        # lane assignment — which chunks share an accumulator — changes
+        # the float rounding
+        x = jnp.asarray(
+            np.where((np.arange(560) // 56) % 2 == 0, 3e7, 1.7), np.float32
+        )
+        return np.asarray(app(x))[1, 0]
+
+    r1, r4 = run(1), run(4)
+    expected = np.sum(
+        np.where((np.arange(560) // 56) % 2 == 0, 3e7, 1.7)
+    )
+    np.testing.assert_allclose(r1, expected, rtol=1e-5)
+    np.testing.assert_allclose(r4, expected, rtol=1e-5)
+    assert r1 != r4  # the knob observably reassociates the accumulation
+
+
+def test_default_lanes_match_op_model(comm8):
+    """The default lane count is exactly Reduce.accumulation_lanes."""
+    from smi_tpu.ops.operations import Reduce
+
+    def run(dtype, lanes):
+        @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+        def app(ctx, x):
+            ch = ctx.open_channel(
+                port=0, src=0, dst=1, count=560, dtype=dtype, buffer_size=7
+            )
+            _, total = ctx.stream_reduce(ch, x, lanes=lanes)
+            return total.astype(jnp.float32)[None][None]
+
+        x = jnp.asarray(
+            np.where((np.arange(560) // 56) % 2 == 0, 3e7, 1.7),
+            dtype_to_jnp(dtype),
+        )
+        return np.asarray(app(x))[1, 0]
+
+    assert Reduce(0, "float").accumulation_lanes == 4
+    assert run("float", None) == run("float", 4)
+    assert run("float", None) != run("float", 1)

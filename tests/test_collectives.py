@@ -17,11 +17,17 @@ from smi_tpu.ops.types import dtype_to_jnp
 ROOTS = [0, 3, 7]
 LENGTHS = [1, 64, 1000]
 
+#: Every collective runs on both implementation tiers: the XLA lowering
+#: and the explicit credit-flow-controlled ring kernels
+#: (``kernels/ring.py`` via Pallas TPU interpret mode on the fake mesh).
+BACKENDS = ["xla", "ring"]
+
 
 @pytest.mark.parametrize("root", ROOTS)
 @pytest.mark.parametrize("length", [1, 333])
-def test_bcast_roots(comm8, root, length):
-    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_bcast_roots(comm8, backend, root, length):
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), backend=backend)
     def app(ctx, base):
         mine = base + ctx.rank()  # every rank holds a different value
         return ctx.bcast(mine, root=root)[None]
@@ -33,8 +39,9 @@ def test_bcast_roots(comm8, root, length):
 
 
 @pytest.mark.parametrize("dtype", ["int", "float", "double"])
-def test_bcast_dtypes(comm8, dtype):
-    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_bcast_dtypes(comm8, backend, dtype):
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), backend=backend)
     def app(ctx, x):
         return ctx.bcast(x + ctx.rank().astype(x.dtype), root=2)[None]
 
@@ -49,8 +56,9 @@ def test_bcast_dtypes(comm8, dtype):
     ("min", lambda vals: vals.min(0)),
 ])
 @pytest.mark.parametrize("root", [0, 5])
-def test_reduce_ops_roots(comm8, op, expect, root):
-    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_reduce_ops_roots(comm8, backend, op, expect, root):
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), backend=backend)
     def app(ctx, x):
         contrib = x * (ctx.rank().astype(x.dtype) + 1)
         return ctx.reduce(contrib, op=op, root=root)[None]
@@ -64,8 +72,9 @@ def test_reduce_ops_roots(comm8, op, expect, root):
             np.testing.assert_array_equal(out[r], np.zeros(8, np.float32))
 
 
-def test_allreduce(comm8):
-    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_allreduce(comm8, backend):
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), backend=backend)
     def app(ctx, x):
         return ctx.allreduce(x + ctx.rank().astype(x.dtype))[None]
 
@@ -76,8 +85,9 @@ def test_allreduce(comm8):
 
 
 @pytest.mark.parametrize("root", [0, 6])
-def test_scatter(comm8, root):
-    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_scatter(comm8, backend, root):
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), backend=backend)
     def app(ctx, x):
         # only the root's buffer matters (scatter.cl:46-91)
         mine = jnp.where(ctx.rank() == root, x, jnp.zeros_like(x))
@@ -90,8 +100,9 @@ def test_scatter(comm8, root):
 
 
 @pytest.mark.parametrize("root", [0, 4])
-def test_gather(comm8, root):
-    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_gather(comm8, backend, root):
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), backend=backend)
     def app(ctx, x):
         contrib = x + ctx.rank().astype(x.dtype) * 100
         return ctx.gather(contrib, root=root)[None]
@@ -105,10 +116,11 @@ def test_gather(comm8, root):
             np.testing.assert_array_equal(out[r], np.zeros(64, np.float32))
 
 
-def test_multi_collectives_distinct_ports(comm8):
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_multi_collectives_distinct_ports(comm8, backend):
     """Concurrent broadcasts on distinct ports (multi_collectives.cl:1-12)."""
 
-    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), backend=backend)
     def app(ctx, x):
         a = ctx.bcast(x + ctx.rank().astype(x.dtype), root=0, port=0)
         b = ctx.bcast(x * 2 + ctx.rank().astype(x.dtype), root=1, port=1)
@@ -124,10 +136,11 @@ def test_multi_collectives_distinct_ports(comm8):
         np.testing.assert_allclose(out[r, 2], base * 3 + 2)
 
 
-def test_mixed_p2p_and_collective(comm8):
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_mixed_p2p_and_collective(comm8, backend):
     """P2P pipeline + broadcast in one program (test/mixed/mixed.cl)."""
 
-    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"), backend=backend)
     def app(ctx, x):
         shifted = ctx.ring_shift(x + ctx.rank().astype(x.dtype), offset=1)
         summed = ctx.reduce(shifted, op="add", root=0, port=1)

@@ -1,0 +1,149 @@
+"""Credit flow-control protocol: schedule-fuzzed state-machine tests.
+
+Reference: the SMI NoC's credit protocols (``templates/push.cl:21-31``,
+``pop.cl:35-51``, ``reduce.cl:13-32``) are exercised by the strict
+channel-depth emulator; here the equivalent protocol that guards the ring
+kernels' RDMA slots (:mod:`smi_tpu.kernels.ring`) is specified in
+:mod:`smi_tpu.parallel.credits` and driven through random, adversarial,
+and (for tiny configurations) exhaustive schedules.
+
+These tests are pure Python — no JAX — and they are the evidence that
+``flow_control=True`` in the kernels implements a sound protocol: no
+clobber, no deadlock, no credit leak, correct delivery, under every
+explored interleaving. The companion mutation tests show the harness
+*can* see the race: with credits disabled, adversarial schedules corrupt
+data.
+"""
+
+import pytest
+
+from smi_tpu.parallel import credits as C
+
+NS = [2, 3, 5, 8]
+SEEDS = range(12)
+
+
+@pytest.mark.parametrize("n", NS)
+@pytest.mark.parametrize("seed", SEEDS)
+def test_all_gather_random_schedules(n, seed):
+    C.simulate_all_gather(n, C.Strategy(seed))
+
+
+@pytest.mark.parametrize("n", NS)
+@pytest.mark.parametrize("seed", SEEDS)
+def test_all_reduce_random_schedules(n, seed):
+    C.simulate_all_reduce(n, C.Strategy(seed))
+
+
+@pytest.mark.parametrize("n", NS)
+@pytest.mark.parametrize("seed", SEEDS)
+def test_reduce_scatter_random_schedules(n, seed):
+    C.simulate_reduce_scatter(n, C.Strategy(seed))
+
+
+@pytest.mark.parametrize("n", NS)
+@pytest.mark.parametrize("direction", [1, -1])
+@pytest.mark.parametrize("seed", SEEDS)
+def test_neighbour_stream_random_schedules(n, direction, seed):
+    C.simulate_neighbour_stream(n, 5, C.Strategy(seed), direction=direction)
+
+
+@pytest.mark.parametrize("n", [3, 5])
+@pytest.mark.parametrize("seed", SEEDS)
+def test_adversarial_delayed_dmas(n, seed):
+    """DMAs land as late as possible — maximal clobber window."""
+    C.simulate_all_gather(n, C.DelayDmaStrategy(seed))
+    C.simulate_all_reduce(n, C.DelayDmaStrategy(seed))
+    C.simulate_reduce_scatter(n, C.DelayDmaStrategy(seed))
+    C.simulate_neighbour_stream(n, 6, C.DelayDmaStrategy(seed))
+
+
+@pytest.mark.parametrize("n", [3, 5])
+@pytest.mark.parametrize("seed", SEEDS)
+def test_adversarial_favoured_rank(n, seed):
+    """One rank races ahead while the others lag — the fast-writer /
+    slow-consumer scenario the credits exist for."""
+    for fav in range(n):
+        C.simulate_all_gather(n, C.FavourRankStrategy(fav, seed))
+        C.simulate_neighbour_stream(n, 6, C.FavourRankStrategy(fav, seed))
+
+
+@pytest.mark.parametrize("name,make", [
+    ("neighbour_stream_n2c2", lambda: [
+        C.neighbour_stream_rank(r, 2, [(r, c) for c in range(2)])
+        for r in range(2)
+    ]),
+    ("neighbour_stream_n2c3", lambda: [
+        C.neighbour_stream_rank(r, 2, [(r, c) for c in range(3)])
+        for r in range(2)
+    ]),
+    ("all_gather_n2", lambda: [
+        C.all_gather_rank(r, 2, f"c{r}") for r in range(2)
+    ]),
+    ("all_reduce_n2", lambda: [
+        C.all_reduce_rank(r, 2, frozenset([r]), lambda a, b: a | b)
+        for r in range(2)
+    ]),
+    ("reduce_scatter_n2", lambda: [
+        C.reduce_scatter_rank(
+            r, 2, [frozenset([(r, b)]) for b in range(2)], lambda a, b: a | b
+        )
+        for r in range(2)
+    ]),
+])
+def test_exhaustive_tiny_configs(name, make):
+    """Every scheduler interleaving (communication-boundary granularity)
+    of the two-rank protocols passes all invariants."""
+    explored = C.explore_all_schedules(make, max_schedules=500_000)
+    assert explored > 50  # genuinely many distinct schedules
+
+
+def test_mutation_no_credits_is_caught_fuzzed():
+    """Disabling flow control must produce a detectable violation under
+    adversarial schedules — proof the harness can see the race."""
+    caught = 0
+    for seed in range(60):
+        for fav in range(3):
+            try:
+                C.simulate_neighbour_stream(
+                    3, 8, C.FavourRankStrategy(fav, seed), flow_control=False
+                )
+            except C.ProtocolError:
+                caught += 1
+    assert caught > 0
+
+
+def test_mutation_no_credits_all_gather_corrupts():
+    """all_gather without credits: an overtaking landing corrupts the
+    gathered payload (caught as clobber or as wrong output)."""
+    caught = 0
+    for seed in range(60):
+        for fav in range(3):
+            try:
+                C.simulate_all_gather(
+                    3, C.FavourRankStrategy(fav, seed), flow_control=False
+                )
+            except C.ProtocolError:
+                caught += 1
+    assert caught > 0
+
+
+def test_deadlock_detection_works():
+    """A rank waiting on a credit nobody grants must be reported as a
+    deadlock, not an infinite loop."""
+
+    def stuck_rank():
+        yield ("wait", C.SEM_CREDIT, 0, 1)
+
+    with pytest.raises(C.DeadlockError):
+        C.RingSimulator([stuck_rank()], C.Strategy(0)).run()
+
+
+def test_credit_leak_detection_works():
+    """A dangling semaphore count at exit must be reported."""
+
+    def leaky_rank():
+        yield ("signal", 0, C.SEM_CREDIT, 0, 1)
+
+    with pytest.raises(C.CreditLeakError):
+        C.RingSimulator([leaky_rank()], C.Strategy(0)).run()
