@@ -179,12 +179,36 @@ const std::map<std::string, OpKind> kCallNames = {
     {"gather", OpKind::Gather},
 };
 
+const std::set<std::string> kOpenNames = {
+    "open_channel", "open_send_channel", "open_receive_channel"};
+
 const std::set<std::string> kDtypes = {"int", "float", "double", "char",
                                        "short"};
 const std::set<std::string> kReduceOps = {"add", "max", "min"};
 
-std::optional<long> as_int(const Arg& a) {
-  if (!a.literal || a.value.type != Token::Number) return std::nullopt;
+// Names the grammar recognizes — imports may alias exactly these
+// (`from smi_tpu import Push as P`), mirroring how the reference binds
+// only SMI_* symbols (source-rewriter/src/rewrite.cpp:35-46).
+bool is_known_op_name(const std::string& name) {
+  return kCallNames.count(name) > 0 || kOpenNames.count(name) > 0;
+}
+
+// Scan-time symbol state: import aliases (alias -> canonical op name)
+// and module-level integer constants (the reference resolves const ints
+// through variable declarations, source-rewriter/src/ops/utils.cpp:5-48).
+struct Symbols {
+  std::map<std::string, std::string> aliases;
+  std::map<std::string, long> constants;
+};
+
+std::optional<long> as_int(const Arg& a, const Symbols& syms) {
+  if (!a.literal) return std::nullopt;
+  if (a.value.type == Token::Ident) {
+    auto it = syms.constants.find(a.value.text);
+    if (it != syms.constants.end()) return it->second;
+    return std::nullopt;
+  }
+  if (a.value.type != Token::Number) return std::nullopt;
   try {
     return std::stol(a.value.text);
   } catch (...) {
@@ -210,24 +234,118 @@ const Arg* find_arg(const std::vector<Arg>& args, const std::string& kw,
   return nullptr;
 }
 
+// Parse `from <module> import name [as alias] {, name [as alias]}`,
+// recording aliases for recognized op names. Leaves `tok` on the first
+// token after the import statement.
+void parse_from_import(Lexer& lex, Token& tok, Symbols& syms) {
+  // skip the dotted module path up to `import`
+  while (tok.type != Token::End &&
+         !(tok.type == Token::Ident && tok.text == "import"))
+    tok = lex.next();
+  if (tok.type == Token::End) return;
+  tok = lex.next();
+  if (tok.type == Token::Punct && tok.text == "(") tok = lex.next();
+  while (tok.type == Token::Ident) {
+    std::string target = tok.text;
+    std::string local = target;
+    tok = lex.next();
+    if (tok.type == Token::Ident && tok.text == "as") {
+      tok = lex.next();
+      if (tok.type != Token::Ident) break;
+      local = tok.text;
+      tok = lex.next();
+    }
+    if (is_known_op_name(target)) syms.aliases[local] = target;
+    if (tok.type == Token::Punct && (tok.text == "," || tok.text == ")")) {
+      bool close = tok.text == ")";
+      tok = lex.next();
+      if (close) break;
+    } else {
+      break;
+    }
+  }
+}
+
 }  // namespace
 
 ScanResult scan_source(const std::string& source,
                        const std::string& filename) {
   ScanResult result;
+  Symbols syms;
   Lexer lex(source);
   Token tok = lex.next();
+  bool after_dot = false;  // previous token was `.` (attribute access)
+  int depth = 0;           // bracket depth outside matched-call arg lists
 
   while (tok.type != Token::End) {
     if (tok.type != Token::Ident) {
+      after_dot = tok.type == Token::Punct && tok.text == ".";
+      if (tok.type == Token::Punct) {
+        if (tok.text == "(" || tok.text == "[" || tok.text == "{") depth++;
+        if (tok.text == ")" || tok.text == "]" || tok.text == "}")
+          depth = depth > 0 ? depth - 1 : 0;
+      }
       tok = lex.next();
       continue;
     }
     std::string name = tok.text;
     int call_line = tok.line;
+    bool qualified = after_dot;
+    after_dot = false;
+
+    // import-alias statements (`from smi_tpu import Push as P`)
+    if (!qualified && name == "from") {
+      parse_from_import(lex, tok, syms);
+      continue;
+    }
+
     Token after = lex.next();
     bool is_call =
         after.type == Token::Punct && after.text == "(";
+
+    // top-level integer constants (`PORT = 3`) — single assignment,
+    // simple literal only; anything fancier invalidates the binding
+    if (!qualified && !is_call && depth == 0 &&
+        after.type == Token::Punct && after.text == "=") {
+      Token value = lex.next();
+      if (value.type == Token::Punct && value.text == "=") {
+        // `==` comparison, not an assignment
+        tok = lex.next();
+        continue;
+      }
+      if (value.type != Token::Number) {
+        // non-literal RHS: drop any stale binding and let the main loop
+        // re-process the RHS token (it may itself be an op call)
+        syms.constants.erase(name);
+        tok = value;
+        continue;
+      }
+      Token trailing = lex.next();
+      bool simple = !(trailing.type == Token::Punct &&
+                      (trailing.text == "+" || trailing.text == "-" ||
+                       trailing.text == "*" || trailing.text == "/" ||
+                       trailing.text == "%" || trailing.text == "." ||
+                       trailing.text == "(" || trailing.text == "["));
+      if (simple) {
+        try {
+          syms.constants[name] = std::stol(value.text);
+        } catch (...) {
+          syms.constants.erase(name);
+        }
+      } else {
+        syms.constants.erase(name);  // computed value: not a constant
+      }
+      tok = trailing;
+      continue;
+    }
+
+    // resolve import aliases (the canonical name drives matching; the
+    // attribute qualifier, if any, is ignored as the reference ignores
+    // the callee's scope once the name matches)
+    if (!qualified) {
+      auto alias = syms.aliases.find(name);
+      if (alias != syms.aliases.end()) name = alias->second;
+    }
 
     auto handle = [&](OpKind kind, const std::vector<Arg>& args) {
       Operation op;
@@ -245,13 +363,14 @@ ScanResult scan_source(const std::string& source,
                                 " call without a port argument");
         return;
       }
-      auto port = as_int(*port_arg);
+      auto port = as_int(*port_arg, syms);
       if (!port) {
-        // ports must be compile-time constants, as in the reference
+        // ports must be compile-time constants — integer literals or
+        // names bound once to one, as in the reference
         // (source-rewriter/src/ops/utils.cpp:5-48)
         result.errors.push_back(
             filename + ":" + std::to_string(call_line) + ": " + name +
-            " port is not an integer literal");
+            " port is not a compile-time integer constant");
         return;
       }
       op.port = static_cast<int>(*port);
@@ -268,7 +387,7 @@ ScanResult scan_source(const std::string& source,
         }
       }
       if (const Arg* b = find_arg(args, "buffer_size", is_ctor ? 2 : -1)) {
-        if (auto bi = as_int(*b)) op.buffer_size = *bi;
+        if (auto bi = as_int(*b, syms)) op.buffer_size = *bi;
       }
       if (kind == OpKind::Reduce) {
         if (const Arg* o = find_arg(args, "op", -1)) {
@@ -288,17 +407,17 @@ ScanResult scan_source(const std::string& source,
         tok = lex.next();
         continue;
       }
-      if (name == "open_channel" || name == "open_send_channel" ||
-          name == "open_receive_channel") {
+      if (kOpenNames.count(name) > 0) {
         std::vector<Arg> args = parse_args(lex, tok);
         // a channel open declares both endpoints' ops at that port
         const Arg* port_arg = find_arg(args, "port", 0);
-        auto port = port_arg ? as_int(*port_arg) : std::nullopt;
+        auto port = port_arg ? as_int(*port_arg, syms)
+                             : std::optional<long>();
         if (!port) {
           result.errors.push_back(filename + ":" +
                                   std::to_string(call_line) +
-                                  ": open_channel port is not an integer "
-                                  "literal");
+                                  ": open_channel port is not a "
+                                  "compile-time integer constant");
         } else {
           Operation op;
           op.port = static_cast<int>(*port);
@@ -316,7 +435,7 @@ ScanResult scan_source(const std::string& source,
             }
           }
           if (const Arg* b = find_arg(args, "buffer_size", -1))
-            if (auto bi = as_int(*b)) op.buffer_size = *bi;
+            if (auto bi = as_int(*b, syms)) op.buffer_size = *bi;
           if (name != "open_receive_channel") {
             op.kind = OpKind::Push;
             result.ops.push_back(op);

@@ -74,10 +74,92 @@ def test_manifest_rejects_duplicate_port(tmp_path):
     assert "claimed twice" in proc.stderr
 
 
-def test_manifest_rejects_non_literal_port(tmp_path):
+def test_manifest_resolves_constant_port(tmp_path):
+    """Ports bound once to an integer literal resolve, as the reference
+    resolves const ints through variable declarations
+    (``source-rewriter/src/ops/utils.cpp:5-48``, golden case
+    ``codegen/tests/data/constant-variable.cl``)."""
     proc = run_manifest(tmp_path, "p = 3\nx = Push(p)\n")
+    assert proc.returncode == 0, proc.stderr
+    assert '"port": 3' in proc.stdout
+
+
+def test_manifest_rejects_computed_port(tmp_path):
+    """A computed port is rejected with a file:line diagnostic."""
+    proc = run_manifest(tmp_path, "p = 3 + 1\nx = Push(p)\n")
     assert proc.returncode == 1
-    assert "not an integer literal" in proc.stderr
+    assert "not a compile-time integer constant" in proc.stderr
+    assert "prog.py:2" in proc.stderr
+
+
+def test_manifest_rejects_unknown_name_port(tmp_path):
+    proc = run_manifest(tmp_path, "x = Push(mystery_port)\n")
+    assert proc.returncode == 1
+    assert "prog.py:1" in proc.stderr
+    assert "not a compile-time integer constant" in proc.stderr
+
+
+def test_manifest_aliased_imports(tmp_path):
+    """`from smi_tpu import Push as P` binds the local alias
+    (reference: the rewriter matches bound SMI_* symbols regardless of
+    spelling at the call site)."""
+    proc = run_manifest(
+        tmp_path,
+        "from smi_tpu import Push as P, Pop as Q\n"
+        "from smi_tpu.ops.operations import Reduce\n"
+        'a = P(0, "float")\nb = Q(0, "float")\nc = Reduce(1, "int")\n',
+    )
+    assert proc.returncode == 0, proc.stderr
+    kinds = [l.split('"')[3] for l in proc.stdout.splitlines() if l.strip()]
+    assert kinds == ["push", "pop", "reduce"]
+
+
+def test_manifest_parenthesized_import_list(tmp_path):
+    proc = run_manifest(
+        tmp_path,
+        "from smi_tpu import (\n    Push as Send,\n    Pop,\n)\n"
+        'a = Send(2, "int")\nb = Pop(2, "int")\n',
+    )
+    assert proc.returncode == 0, proc.stderr
+    kinds = [l.split('"')[3] for l in proc.stdout.splitlines() if l.strip()]
+    assert kinds == ["push", "pop"]
+
+
+def test_manifest_attribute_qualified_calls(tmp_path):
+    """Attribute-qualified call sites (`smi.Push`, `smi_tpu.ops.Push`)
+    match on the final name segment."""
+    proc = run_manifest(
+        tmp_path,
+        "import smi_tpu as smi\n"
+        'a = smi.Push(0, "float")\n'
+        'b = smi.ops.operations.Pop(0, "float")\n',
+    )
+    assert proc.returncode == 0, proc.stderr
+    kinds = [l.split('"')[3] for l in proc.stdout.splitlines() if l.strip()]
+    assert kinds == ["push", "pop"]
+
+
+def test_manifest_alias_does_not_leak_to_unrelated_names(tmp_path):
+    """Only recognized op names may be aliased; other imports stay inert,
+    and a reassigned constant stops being one."""
+    proc = run_manifest(
+        tmp_path,
+        "from functools import partial as Push_like\n"
+        "p = 3\np = q\nx = Push(p)\n",
+    )
+    assert proc.returncode == 1  # p lost its binding -> computed port
+    assert "not a compile-time integer constant" in proc.stderr
+
+
+def test_manifest_keyword_args_do_not_become_constants(tmp_path):
+    """`foo(port=9)` in an unrelated call must not bind `port` as a
+    module constant."""
+    proc = run_manifest(
+        tmp_path,
+        "configure(port=9)\nx = Push(port)\n",
+    )
+    assert proc.returncode == 1
+    assert "prog.py:2" in proc.stderr
 
 
 def test_manifest_rejects_unknown_dtype(tmp_path):
