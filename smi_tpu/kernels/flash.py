@@ -103,8 +103,14 @@ def _gqa_group(h: int, h_kv: int) -> int:
 
 
 def _validate_window(causal: bool, window) -> None:
-    if window is not None and not causal:
+    if window is None:
+        return
+    if not causal:
         raise ValueError("sliding window requires causal attention")
+    if window < 1:
+        # window=0 would fully mask every row; the exp(0)=1 transient-
+        # garbage scheme would then silently return a v-average instead
+        raise ValueError(f"window must be >= 1, got {window}")
 
 
 def _resolve_precision(dtype, precision):

@@ -100,7 +100,20 @@ def _plan(h: int, w: int, depth: int):
 def pick_temporal_depth(h: int, w: int, dtype, iterations: int):
     """Deepest supported sweeps-per-pass for a block, preferring 16
     (measured fastest on v5e vs 8/24/32) and falling back to 8 before
-    abandoning the temporal tier. Returns None when unsupported."""
+    abandoning the temporal tier. Returns None when unsupported.
+
+    Why 16 is the knee (v5e, 8192² f32; see ``vs_tpu_roofline`` in
+    ``bench.py`` output): the hypothetical HBM bound is 819 GB/s / (8 B
+    per cell per k sweeps) ≈ 12.8k Gcell/s at k=16 — two orders above
+    the measured ~86, so by k=16 the kernel is decisively *not*
+    HBM-bound; it is VPU-bound (~10 vector ops per cell·sweep, roughly
+    a third of the ~6.2 TFLOP/s f32 VPU peak once shrink-margin
+    recompute is counted). Past the knee, larger k only adds cost: the
+    working tile grows by 2k rows of halo whose rings are recomputed
+    every sweep, VMEM pressure halves the stripe height, and the k-deep
+    corner-complete halo exchange widens — all while the HBM term it
+    amortizes is already negligible. k=24/32 measured slower; k=16 vs
+    k=8 measured ~5% faster."""
     return next(
         (
             d for d in (16, 8)

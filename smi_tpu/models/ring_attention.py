@@ -313,7 +313,13 @@ def ring_attention_shard(
         )
 
     m, l, acc = _ring_schedule(fold, comm, axis, k, v, (m0, l0, acc0))
-    # fully-masked rows (possible only without a self-block) normalize to 0
+    # safe_l only guards the l == 0 "no fold ran" case (unreachable in the
+    # ring schedule: the self-block always contributes the diagonal). NOTE
+    # a row with zero *live* keys would NOT land here: its m stays NEG_INF,
+    # every key scores p = exp(0) = 1, and l ends up equal to the key
+    # count — the output would be a mean of v, not 0. Any future
+    # cross-attention or padded-row path must mask p where m == NEG_INF
+    # instead of relying on this guard.
     safe_l = jnp.where(l == 0.0, 1.0, l)
     return acc / safe_l.transpose(1, 0)[..., None]
 

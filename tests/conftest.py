@@ -22,15 +22,24 @@ if "xla_force_host_platform_device_count" not in _flags:
 # var alone is not enough here: site customization may import jax at
 # interpreter startup, capturing JAX_PLATFORMS before this file runs, so
 # the config is also updated post-import (backends init lazily).
-os.environ["JAX_PLATFORMS"] = "cpu"
+# SMI_TPU_RUN_TPU_TESTS opts into the hardware tier instead
+# (tests/test_flash_tpu.py): the TPU platform stays visible and the
+# compiled Mosaic paths run on the real chip.
+_tpu_tier = bool(os.environ.get("SMI_TPU_RUN_TPU_TESTS"))
+if not _tpu_tier:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _tpu_tier:
+    jax.config.update("jax_platforms", "cpu")
 
 # The SMI surface includes a 'double' dtype (include/smi/data_types.h);
-# emulator-tier tests exercise it with real float64.
-jax.config.update("jax_enable_x64", True)
+# emulator-tier tests exercise it with real float64. The TPU tier keeps
+# the default 32-bit mode — the hardware has no f64, and x64-widened
+# literals break tracing of the compiled kernels.
+if not _tpu_tier:
+    jax.config.update("jax_enable_x64", True)
 
 import faulthandler  # noqa: E402
 import sys  # noqa: E402
