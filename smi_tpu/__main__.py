@@ -7,8 +7,13 @@ The TPU pipeline keeps the same stages with new emission targets:
 - ``manifest`` — the ``codegen-device`` front half: drive the native
   analysis tool (``native/build/smi-manifest``, the source-rewriter
   equivalent) over user sources, validate the discovered operations, and
-  write the program-metadata JSON. Device code generation has no TPU
-  analog: JAX monomorphizes ports/dtypes at trace time.
+  write the program-metadata JSON.
+- ``device`` — the ``codegen-device`` back half: emit the monomorphized
+  device module (one ``SMI_<Op>_<port>_<dtype>`` helper per declared op,
+  the reference's specialized-symbol surface) from a program manifest.
+  JAX monomorphizes at trace time, so the generated symbols pin the
+  *manifest* — declared port/dtype/operator/buffer-size — rather than
+  new code paths.
 - ``route`` — identical role to the reference's ``route``: topology JSON +
   program metadata → binary per-rank routing tables + a hostfile
   (``codegen/main.py:107-133``).
@@ -111,6 +116,126 @@ def cmd_route(args: argparse.Namespace) -> int:
         return 1
     with open(os.path.join(args.dest_dir, "hostfile"), "w") as f:
         write_nodefile(topology, f)
+    return 0
+
+
+_DEVICE_HEADER = '''"""Generated device module for program "{name}" — do not edit.
+
+Trace-time analog of ``smi_generated_device.cl`` (reference
+``codegen/templates/device.cl``): one monomorphized helper per declared
+(op, port, dtype) — the reference's rewriter renames user call sites to
+exactly such specialized symbols (``codegen/tests/data/
+port-expected.cl:5-19``) so each gets its own hardware FIFOs. Under JAX
+the specialization itself is free at trace time; what these helpers pin
+down is the *manifest*: the declared port, dtype, reduce operator and
+buffer size are baked into each symbol, so a program written against
+this module cannot drift from the artifacts its routing tables were
+built from.
+"""
+
+from smi_tpu.ops.serialization import parse_program as _parse_program
+
+_PROGRAM_JSON = r"""{program_json}"""
+
+#: The declared operations (the manifest this module was generated from).
+PROGRAM = _parse_program(_PROGRAM_JSON)
+
+#: (family, port, stream-usage) -> stream slot, the port allocation the
+#: routing tables were built from (``codegen/notes.txt`` deal order).
+STREAMS = dict(PROGRAM.allocation)
+
+
+def _check_channel(channel, port, dtype):
+    if channel.port != port or channel.dtype.value != dtype:
+        raise ValueError(
+            f"channel (port={{channel.port}}, dtype="
+            f"{{channel.dtype.value}}) used through the specialized "
+            f"symbol for port {{port}}/{{dtype}}"
+        )
+'''
+
+_DEVICE_P2P_TEMPLATE = '''
+
+def SMI_Open_{dirn}_channel_{port}_{dtype}(ctx, src, dst, count):
+    """Open the declared port-{port} {dtype} channel
+    (``include/smi/{hdr}.h`` analog; buffer size pinned from the
+    manifest)."""
+    return ctx.open_channel(port={port}, src=src, dst=dst, count=count,
+                            dtype="{dtype}", buffer_size={buffer_size})
+
+
+def SMI_{opname}_{port}_{dtype}(ctx, channel, data, backend=None):
+    """Move the full message through the port-{port} channel (the SPMD
+    fusion of the reference's per-element {opname} loop,
+    ``templates/{tmpl}.cl``)."""
+    _check_channel(channel, {port}, "{dtype}")
+    return ctx.transfer(channel, data, backend=backend)
+'''
+
+_DEVICE_COLLECTIVE_TEMPLATE = '''
+
+def SMI_{opname}_{port}_{dtype}(ctx, x, root=0, backend=None):
+    """Port-{port} {dtype} {lower} (``templates/{tmpl}.cl`` analog{extra_doc})."""
+    return ctx.{method}(x, root=root, port={port}{extra_arg},
+                        backend=backend)
+'''
+
+
+def _emit_device_module(name: str, program_json: str) -> str:
+    program = parse_program(program_json)
+    parts = [_DEVICE_HEADER.format(name=name, program_json=program_json)]
+    for op in program.operations:
+        dt = op.dtype.value
+        buf = repr(op.buffer_size)
+        if op.family == "push":
+            parts.append(_DEVICE_P2P_TEMPLATE.format(
+                dirn="send", opname="Push", tmpl="push", hdr="push",
+                port=op.port, dtype=dt, buffer_size=buf,
+            ))
+        elif op.family == "pop":
+            parts.append(_DEVICE_P2P_TEMPLATE.format(
+                dirn="receive", opname="Pop", tmpl="pop", hdr="pop",
+                port=op.port, dtype=dt, buffer_size=buf,
+            ))
+        elif op.family == "reduce":
+            parts.append(_DEVICE_COLLECTIVE_TEMPLATE.format(
+                opname="Reduce", tmpl="reduce", lower="reduce",
+                method="reduce", port=op.port, dtype=dt,
+                extra_arg=f', op="{op.op.value}"',
+                extra_doc=f'; operator pinned to {op.op.value.upper()}',
+            ))
+        else:
+            opname = {"broadcast": "Bcast", "scatter": "Scatter",
+                      "gather": "Gather"}[op.family]
+            parts.append(_DEVICE_COLLECTIVE_TEMPLATE.format(
+                opname=opname, tmpl=op.family, lower=op.family,
+                method={"broadcast": "bcast"}.get(op.family, op.family),
+                port=op.port, dtype=dt, extra_arg="", extra_doc="",
+            ))
+    return "".join(parts)
+
+
+def cmd_device(args: argparse.Namespace) -> int:
+    """Emit the monomorphized device module (codegen-device's back half;
+    the front half — call-site discovery — is ``manifest``)."""
+    name = os.path.splitext(os.path.basename(args.metadata))[0]
+    if not name.isidentifier():
+        print(
+            f"error: program name {name!r} is not a valid identifier",
+            file=sys.stderr,
+        )
+        return 1
+    with open(args.metadata) as f:
+        program_json = f.read().strip()
+    try:
+        text = _emit_device_module(name, program_json)
+    except (ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    out_dir = os.path.dirname(os.path.abspath(args.device_src))
+    os.makedirs(out_dir, exist_ok=True)
+    with open(args.device_src, "w") as f:
+        f.write(text)
     return 0
 
 
@@ -256,6 +381,12 @@ def cmd_build(args: argparse.Namespace) -> int:
     ))
     if rc:
         return rc
+    rc = cmd_device(argparse.Namespace(
+        device_src=os.path.join(out, "smi_generated_device.py"),
+        metadata=program_json,
+    ))
+    if rc:
+        return rc
     return cmd_host(argparse.Namespace(
         host_src=os.path.join(out, "smi_generated_host.py"),
         metadata=[program_json],
@@ -305,6 +436,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("metadata", nargs="+",
                    help="program metadata JSON files (basename = name)")
     p.set_defaults(fn=cmd_host)
+
+    p = sub.add_parser(
+        "device",
+        help="emit the monomorphized device module (codegen-device analog)",
+    )
+    p.add_argument("device_src", help="path of the generated Python module")
+    p.add_argument("metadata", help="program metadata JSON (basename = name)")
+    p.set_defaults(fn=cmd_device)
 
     p = sub.add_parser(
         "topology", help="generate a bus-topology JSON for testing"

@@ -264,13 +264,15 @@ def flash_vs_stock(comm, quick: bool = False):
     def make_stock(r):
         @jax.jit
         def stock_reps(q, k, v):
-            def body(i, acc):
-                return acc + stock(q, k, v, causal=True).astype(jnp.float32)
-            return jax.lax.fori_loop(
-                0, r, body, jnp.zeros(q.shape, jnp.float32)
-            )
+            # feed the output back as the next query so the call is
+            # loop-carried — a loop-invariant body would be hoisted and
+            # the measurement would show r× the real rate
+            def body(i, qi):
+                return stock(qi, k, v, causal=True).astype(q.dtype)
+            return jax.lax.fori_loop(0, r, body, q)
 
-        return lambda: np.asarray(jnp.sum(stock_reps(qb, kb, vb)))
+        return lambda: np.asarray(
+            jnp.sum(stock_reps(qb, kb, vb).astype(jnp.float32)))
 
     rate_stock, trace_stock = _diff_rate(make_stock, work)
     return [

@@ -53,12 +53,29 @@ from smi_tpu.ops.types import SmiOp
 from smi_tpu.parallel.backend import combine_fn as _combine_fn
 from smi_tpu.parallel.mesh import Communicator
 
-#: Distinct ``collective_id`` per kernel family: the barrier semaphore is
-#: keyed by it, so concurrent different-family rings never alias.
+#: ``collective_id`` base per kernel family. The barrier semaphore is
+#: keyed by the collective id, so rings that may run concurrently must
+#: not share one. The id space is ``family_base * STREAMS + stream``:
+#: the *stream* slot comes from the program model's port allocation
+#: (``ops/program.py``) — the runtime consumer of the port->stream deal:
+#: collectives on distinct ports land on distinct streams and therefore
+#: distinct semaphore domains, the TPU analog of the reference's
+#: per-port support kernels owning their own hardware FIFOs
+#: (``multi_collectives.cl``'s overlap guarantee).
+RING_STREAMS = 4
 _CID_ALL_GATHER = 0
 _CID_ALL_REDUCE = 1
 _CID_REDUCE_SCATTER = 2
 _CID_NEIGHBOUR_STREAM = 3
+
+
+def ring_collective_id(family_base: int, stream: int = 0) -> int:
+    """Barrier-semaphore id for a ring collective on a given stream."""
+    if not (0 <= stream < RING_STREAMS):
+        raise ValueError(
+            f"stream must be in [0, {RING_STREAMS}), got {stream}"
+        )
+    return family_base * RING_STREAMS + stream
 
 
 def _interpret_arg(interpret: bool):
@@ -162,6 +179,7 @@ def ring_all_gather(
     n: int,
     interpret: bool = False,
     flow_control: bool = True,
+    stream: int = 0,
 ) -> jax.Array:
     """All-gather ``x`` (this shard's chunk) along a ring.
 
@@ -189,7 +207,8 @@ def ring_all_gather(
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
         compiler_params=pltpu.CompilerParams(
-            collective_id=_CID_ALL_GATHER, has_side_effects=True
+            collective_id=ring_collective_id(_CID_ALL_GATHER, stream),
+            has_side_effects=True,
         ),
         interpret=_interpret_arg(interpret),
     )(x)
@@ -253,6 +272,7 @@ def ring_all_reduce(
     op: Union[str, SmiOp] = SmiOp.ADD,
     interpret: bool = False,
     flow_control: bool = True,
+    stream: int = 0,
 ) -> jax.Array:
     """ADD/MAX/MIN all-reduce along a ring with explicit neighbour RDMA.
 
@@ -278,7 +298,8 @@ def ring_all_reduce(
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
         compiler_params=pltpu.CompilerParams(
-            collective_id=_CID_ALL_REDUCE, has_side_effects=True
+            collective_id=ring_collective_id(_CID_ALL_REDUCE, stream),
+            has_side_effects=True,
         ),
         interpret=_interpret_arg(interpret),
     )(x)
@@ -347,6 +368,7 @@ def ring_reduce_scatter(
     op: Union[str, SmiOp] = SmiOp.ADD,
     interpret: bool = False,
     flow_control: bool = True,
+    stream: int = 0,
 ) -> jax.Array:
     """Reduce-scatter along a ring: rank ``r`` returns the reduction of
     every rank's ``r``-th leading block of ``x``.
@@ -380,7 +402,8 @@ def ring_reduce_scatter(
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
         compiler_params=pltpu.CompilerParams(
-            collective_id=_CID_REDUCE_SCATTER, has_side_effects=True
+            collective_id=ring_collective_id(_CID_REDUCE_SCATTER, stream),
+            has_side_effects=True,
         ),
         interpret=_interpret_arg(interpret),
     )(x)
@@ -456,6 +479,7 @@ def neighbour_stream(
     direction: int = 1,
     interpret: bool = False,
     flow_control: bool = True,
+    stream: int = 0,
 ) -> jax.Array:
     """Stream ``x`` chunk-by-chunk to the ring neighbour ``me+direction``.
 
@@ -487,7 +511,8 @@ def neighbour_stream(
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
         compiler_params=pltpu.CompilerParams(
-            collective_id=_CID_NEIGHBOUR_STREAM, has_side_effects=True
+            collective_id=ring_collective_id(_CID_NEIGHBOUR_STREAM, stream),
+            has_side_effects=True,
         ),
         interpret=_interpret_arg(interpret),
     )(x)

@@ -95,12 +95,20 @@ def _ring_schedule(fold, comm, axis, k0, v0, carry0):
     return fold(src_last, k_last, v_last, carry)
 
 
+def _padded_head_dim(d: int) -> int:
+    """Head dim rounded up to the MXU lane width (128)."""
+    return -(-d // 128) * 128
+
+
 def _use_flash_default(comm: Communicator, s_local, h, d, dtype) -> bool:
-    return comm.is_tpu and flash_supported(s_local, s_local, d, dtype)
+    # non-lane-aligned head dims run flash via zero-padding to 128
+    return comm.is_tpu and flash_supported(
+        s_local, s_local, _padded_head_dim(d), dtype
+    )
 
 
 def _flash_forward(q, k, v, comm, causal, axis, precision, interpret,
-                   window):
+                   window, scale=None):
     """Flash-tier ring forward: head-major layouts, one Pallas launch
     per ring step (``kernels/flash.py``), K/V moved by ``ring_shift``.
     Returns ``(out, m, l)`` — the statistics are the backward pass's
@@ -108,7 +116,8 @@ def _flash_forward(q, k, v, comm, causal, axis, precision, interpret,
     the kernel reads them grouped, nothing is repeated."""
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
-    scale = 1.0 / math.sqrt(d)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
 
     qT = q.swapaxes(0, 1)  # (H, S, D)
     # online-softmax state is always f32, whatever the input dtype
@@ -136,7 +145,7 @@ def _flash_forward(q, k, v, comm, causal, axis, precision, interpret,
 
 def _flash_ring_backward(
     q, k, v, out, m, l, dout, comm, causal, axis, precision, interpret,
-    window,
+    window, scale=None,
 ):
     """FlashAttention-2 backward over the ring.
 
@@ -151,7 +160,8 @@ def _flash_ring_backward(
     rank = lax.axis_index(axis)
     s_local, h, d = q.shape
     h_kv = k.shape[1]
-    scale = 1.0 / math.sqrt(d)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
     q_off = rank * s_local
 
     qT = q.swapaxes(0, 1)
@@ -212,7 +222,8 @@ def _flash_ring_backward(
 
 
 def _ring_attention_shard_flash(
-    q, k, v, comm, causal, axis, precision, interpret, window
+    q, k, v, comm, causal, axis, precision, interpret, window,
+    scale=None,
 ):
     """Flash tier with a custom VJP: forward saves the online-softmax
     statistics; backward recomputes probabilities blockwise and rides
@@ -222,13 +233,15 @@ def _ring_attention_shard_flash(
     @jax.custom_vjp
     def attn(q, k, v):
         out, _, _ = _flash_forward(
-            q, k, v, comm, causal, axis, precision, interpret, window
+            q, k, v, comm, causal, axis, precision, interpret, window,
+            scale=scale,
         )
         return out
 
     def fwd(q, k, v):
         out, m, l = _flash_forward(
-            q, k, v, comm, causal, axis, precision, interpret, window
+            q, k, v, comm, causal, axis, precision, interpret, window,
+            scale=scale,
         )
         return out, (q, k, v, out, m, l)
 
@@ -236,7 +249,7 @@ def _ring_attention_shard_flash(
         q, k, v, out, m, l = res
         return _flash_ring_backward(
             q, k, v, out, m, l, dout, comm, causal, axis, precision,
-            interpret, window,
+            interpret, window, scale=scale,
         )
 
     attn.defvjp(fwd, bwd)
@@ -290,6 +303,20 @@ def ring_attention_shard(
     if use_flash is None:
         use_flash = _use_flash_default(comm, s_local, h, d, q.dtype)
     if use_flash:
+        dp = _padded_head_dim(d)
+        if dp != d:
+            # zero-pad the head dim to the 128-lane tile: padded lanes
+            # contribute 0 to every dot product, so scores and outputs
+            # are exact; the explicit scale keeps 1/sqrt(d_original).
+            # Padding sits OUTSIDE the custom-VJP boundary, so autodiff
+            # pads dout / slices dq,dk,dv automatically.
+            pad = [(0, 0), (0, 0), (0, dp - d)]
+            out = _ring_attention_shard_flash(
+                jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                comm, causal, axis, precision, interpret, window,
+                scale=1.0 / math.sqrt(d),
+            )
+            return out[..., :d]
         return _ring_attention_shard_flash(
             q, k, v, comm, causal, axis, precision, interpret, window
         )

@@ -55,6 +55,24 @@ def _ring():
     return ring
 
 
+def _stream_for(port: Optional[int], program, family: str) -> int:
+    """Stream slot of a collective's port — the runtime consumer of the
+    program model's port->stream deal (``ops/program.py``): ring
+    collectives on distinct streams use distinct barrier-semaphore
+    domains (``kernels/ring.py::ring_collective_id``), so they can
+    genuinely overlap, mirroring ``multi_collectives.cl``."""
+    from smi_tpu.kernels.ring import RING_STREAMS
+    from smi_tpu.ops.operations import OUT_DATA
+
+    if port is None:
+        return 0
+    if program is not None:
+        op = program.find(family, port)
+        if op is not None:
+            return program.stream_of(op, OUT_DATA) % RING_STREAMS
+    return port % RING_STREAMS
+
+
 def _axis(comm: Communicator) -> str:
     if len(comm.axis_names) != 1:
         raise NotImplementedError(
@@ -73,7 +91,8 @@ def _is_root(comm: Communicator, root: int) -> jax.Array:
 
 
 def bcast(x: jax.Array, comm: Communicator, root: int = 0,
-          port: Optional[int] = None, backend: str = "xla") -> jax.Array:
+          port: Optional[int] = None, backend: str = "xla",
+          program=None) -> jax.Array:
     """One-to-all: every rank returns the root's ``x``.
 
     Reference: ``SMI_Bcast`` (``bcast.h:43-63``); the root's support kernel
@@ -83,7 +102,6 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
     ``backend="ring"``, circulates around the explicit credit-controlled
     ring).
     """
-    del port  # metadata only: distinct ports are independent by dataflow
     _check_backend(backend)
     mask = _is_root(comm, root)
     contrib = jnp.where(mask, x, jnp.zeros_like(x))
@@ -91,13 +109,17 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
         return _ring().ring_all_reduce(
             contrib, _axis(comm), comm.size, op=SmiOp.ADD,
             interpret=not comm.is_tpu,
+            stream=_stream_for(port, program, "broadcast"),
         )
+    # on the XLA tier the port is metadata only: distinct ports are
+    # independent by dataflow
     return lax.psum(contrib, _axis(comm))
 
 
 def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
            root: int = 0, port: Optional[int] = None,
-           all_ranks: bool = False, backend: str = "xla") -> jax.Array:
+           all_ranks: bool = False, backend: str = "xla",
+           program=None) -> jax.Array:
     """All-to-one reduction with ADD/MAX/MIN.
 
     Reference: ``SMI_Reduce`` (``reduce.h:18-76``): every rank contributes,
@@ -107,13 +129,13 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
     second collective. ``backend="ring"`` runs the circulating-partial
     ring kernel (``kernels/ring.py``) instead of ``lax.psum``.
     """
-    del port
     _check_backend(backend)
     op = SmiOp.parse(op)
     name = _axis(comm)
     if backend == "ring":
         out = _ring().ring_all_reduce(
-            x, name, comm.size, op=op, interpret=not comm.is_tpu
+            x, name, comm.size, op=op, interpret=not comm.is_tpu,
+            stream=_stream_for(port, program, "reduce"),
         )
     elif op is SmiOp.ADD:
         out = lax.psum(x, name)
@@ -128,14 +150,16 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
 
 def allreduce(x: jax.Array, comm: Communicator,
               op: Union[str, SmiOp] = SmiOp.ADD,
-              backend: str = "xla") -> jax.Array:
+              backend: str = "xla", program=None) -> jax.Array:
     """Reduce + Bcast in one collective (convenience; no reference analog
     because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``)."""
-    return reduce(x, comm, op=op, all_ranks=True, backend=backend)
+    return reduce(x, comm, op=op, all_ranks=True, backend=backend,
+                  program=program)
 
 
 def scatter(x: jax.Array, comm: Communicator, root: int = 0,
-            port: Optional[int] = None, backend: str = "xla") -> jax.Array:
+            port: Optional[int] = None, backend: str = "xla",
+            program=None) -> jax.Array:
     """Root distributes contiguous slices; rank r returns slice r.
 
     Reference: ``SMI_Scatter`` (``scatter.h:49-72``) — the root splits its
@@ -148,7 +172,6 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
     ``x`` must have leading dimension ``size * count`` (valid at root).
     ``backend="ring"`` uses the explicit ring reduce-scatter kernel.
     """
-    del port
     _check_backend(backend)
     size = comm.size
     if x.shape[0] % size != 0:
@@ -161,6 +184,7 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
         return _ring().ring_reduce_scatter(
             contrib, _axis(comm), size, op=SmiOp.ADD,
             interpret=not comm.is_tpu,
+            stream=_stream_for(port, program, "scatter"),
         )
     return lax.psum_scatter(contrib, _axis(comm), scatter_dimension=0,
                             tiled=True)
@@ -168,7 +192,7 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
 
 def gather(x: jax.Array, comm: Communicator, root: int = 0,
            port: Optional[int] = None, all_ranks: bool = False,
-           backend: str = "xla") -> jax.Array:
+           backend: str = "xla", program=None) -> jax.Array:
     """Root collects contiguous slices; returns ``size * count`` at root.
 
     Reference: ``SMI_Gather`` (``gather.h:47-68``) — the root pulls each
@@ -177,11 +201,11 @@ def gather(x: jax.Array, comm: Communicator, root: int = 0,
     (or kept everywhere with ``all_ranks=True``). ``backend="ring"``
     forwards chunks neighbour-to-neighbour around the explicit ring.
     """
-    del port
     _check_backend(backend)
     if backend == "ring":
         out = _ring().ring_all_gather(
-            x, _axis(comm), comm.size, interpret=not comm.is_tpu
+            x, _axis(comm), comm.size, interpret=not comm.is_tpu,
+            stream=_stream_for(port, program, "gather"),
         )
     else:
         out = lax.all_gather(x, _axis(comm), axis=0, tiled=True)

@@ -135,30 +135,35 @@ class SmiContext:
     def bcast(self, x, root: int = 0, port: Optional[int] = None,
               backend: Optional[str] = None):
         return _coll.bcast(x, self.comm, root=root, port=port,
-                           backend=self._backend(backend))
+                           backend=self._backend(backend),
+                           program=self.program)
 
     def reduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD, root: int = 0,
                port: Optional[int] = None, all_ranks: bool = False,
                backend: Optional[str] = None):
         return _coll.reduce(x, self.comm, op=op, root=root, port=port,
                             all_ranks=all_ranks,
-                            backend=self._backend(backend))
+                            backend=self._backend(backend),
+                            program=self.program)
 
     def allreduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD,
                   backend: Optional[str] = None):
         return _coll.allreduce(x, self.comm, op=op,
-                               backend=self._backend(backend))
+                               backend=self._backend(backend),
+                               program=self.program)
 
     def scatter(self, x, root: int = 0, port: Optional[int] = None,
                 backend: Optional[str] = None):
         return _coll.scatter(x, self.comm, root=root, port=port,
-                             backend=self._backend(backend))
+                             backend=self._backend(backend),
+                             program=self.program)
 
     def gather(self, x, root: int = 0, port: Optional[int] = None,
                all_ranks: bool = False, backend: Optional[str] = None):
         return _coll.gather(x, self.comm, root=root, port=port,
                             all_ranks=all_ranks,
-                            backend=self._backend(backend))
+                            backend=self._backend(backend),
+                            program=self.program)
 
     # -- MPMD: per-rank divergent local compute ------------------------
     def select(self, branches, operand):

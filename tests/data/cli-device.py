@@ -1,0 +1,113 @@
+"""Generated device module for program "cli_program" — do not edit.
+
+Trace-time analog of ``smi_generated_device.cl`` (reference
+``codegen/templates/device.cl``): one monomorphized helper per declared
+(op, port, dtype) — the reference's rewriter renames user call sites to
+exactly such specialized symbols (``codegen/tests/data/
+port-expected.cl:5-19``) so each gets its own hardware FIFOs. Under JAX
+the specialization itself is free at trace time; what these helpers pin
+down is the *manifest*: the declared port, dtype, reduce operator and
+buffer size are baked into each symbol, so a program written against
+this module cannot drift from the artifacts its routing tables were
+built from.
+"""
+
+from smi_tpu.ops.serialization import parse_program as _parse_program
+
+_PROGRAM_JSON = r"""{
+  "operations": [
+    {
+      "type": "push",
+      "port": 0,
+      "data_type": "float",
+      "buffer_size": 17,
+      "args": {}
+    },
+    {
+      "type": "pop",
+      "port": 0,
+      "data_type": "float",
+      "buffer_size": 17,
+      "args": {}
+    },
+    {
+      "type": "reduce",
+      "port": 1,
+      "data_type": "int",
+      "buffer_size": null,
+      "args": {
+        "op_type": "max"
+      }
+    },
+    {
+      "type": "broadcast",
+      "port": 2,
+      "data_type": "int",
+      "buffer_size": null,
+      "args": {}
+    }
+  ],
+  "consecutive_reads": 8,
+  "max_ranks": 8,
+  "p2p_rendezvous": true
+}"""
+
+#: The declared operations (the manifest this module was generated from).
+PROGRAM = _parse_program(_PROGRAM_JSON)
+
+#: (family, port, stream-usage) -> stream slot, the port allocation the
+#: routing tables were built from (``codegen/notes.txt`` deal order).
+STREAMS = dict(PROGRAM.allocation)
+
+
+def _check_channel(channel, port, dtype):
+    if channel.port != port or channel.dtype.value != dtype:
+        raise ValueError(
+            f"channel (port={channel.port}, dtype="
+            f"{channel.dtype.value}) used through the specialized "
+            f"symbol for port {port}/{dtype}"
+        )
+
+
+def SMI_Open_send_channel_0_float(ctx, src, dst, count):
+    """Open the declared port-0 float channel
+    (``include/smi/push.h`` analog; buffer size pinned from the
+    manifest)."""
+    return ctx.open_channel(port=0, src=src, dst=dst, count=count,
+                            dtype="float", buffer_size=17)
+
+
+def SMI_Push_0_float(ctx, channel, data, backend=None):
+    """Move the full message through the port-0 channel (the SPMD
+    fusion of the reference's per-element Push loop,
+    ``templates/push.cl``)."""
+    _check_channel(channel, 0, "float")
+    return ctx.transfer(channel, data, backend=backend)
+
+
+def SMI_Open_receive_channel_0_float(ctx, src, dst, count):
+    """Open the declared port-0 float channel
+    (``include/smi/pop.h`` analog; buffer size pinned from the
+    manifest)."""
+    return ctx.open_channel(port=0, src=src, dst=dst, count=count,
+                            dtype="float", buffer_size=17)
+
+
+def SMI_Pop_0_float(ctx, channel, data, backend=None):
+    """Move the full message through the port-0 channel (the SPMD
+    fusion of the reference's per-element Pop loop,
+    ``templates/pop.cl``)."""
+    _check_channel(channel, 0, "float")
+    return ctx.transfer(channel, data, backend=backend)
+
+
+def SMI_Reduce_1_int(ctx, x, root=0, backend=None):
+    """Port-1 int reduce (``templates/reduce.cl`` analog; operator pinned to MAX)."""
+    return ctx.reduce(x, root=root, port=1, op="max",
+                        backend=backend)
+
+
+def SMI_Bcast_2_int(ctx, x, root=0, backend=None):
+    """Port-2 int broadcast (``templates/broadcast.cl`` analog)."""
+    return ctx.bcast(x, root=root, port=2,
+                        backend=backend)

@@ -165,3 +165,47 @@ def test_collective_root_out_of_range_rejected(comm8):
             return ctx.bcast(x, root=8)[None]
 
         app(jnp.zeros(4, jnp.float32))
+
+
+def test_ring_stream_slots_follow_port_allocation(comm8):
+    """Distinct ports map to distinct ring collective ids (barrier
+    semaphore domains) — the runtime consumer of the port->stream deal
+    (multi_collectives.cl overlap guarantee)."""
+    from smi_tpu.kernels.ring import RING_STREAMS, ring_collective_id
+    from smi_tpu.parallel.collectives import _stream_for
+
+    prog = smi.Program([smi.Broadcast(0), smi.Broadcast(1),
+                        smi.Broadcast(2)])
+    streams = [_stream_for(p, prog, "broadcast") for p in range(3)]
+    assert len(set(streams)) == 3  # dealt to distinct streams
+    ids = [ring_collective_id(1, st) for st in streams]
+    assert len(set(ids)) == 3
+
+    # without a program, the port still separates semaphore domains
+    assert _stream_for(0, None, "broadcast") != _stream_for(1, None, "broadcast")
+    assert _stream_for(None, None, "broadcast") == 0
+    with pytest.raises(ValueError):
+        ring_collective_id(0, RING_STREAMS)
+
+
+def test_multi_ring_collectives_distinct_ports(comm8):
+    """Three concurrent ring broadcasts on distinct ports, with the
+    program model supplying the stream slots."""
+    prog = smi.Program([smi.Broadcast(0), smi.Broadcast(1),
+                        smi.Broadcast(2)])
+
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"),
+                    program=prog, backend="ring")
+    def app(ctx, x):
+        a = ctx.bcast(x + ctx.rank().astype(x.dtype), root=0, port=0)
+        b = ctx.bcast(x * 2 + ctx.rank().astype(x.dtype), root=1, port=1)
+        c = ctx.bcast(x * 3 + ctx.rank().astype(x.dtype), root=2, port=2)
+        return jnp.stack([a, b, c])[None]
+
+    x = jnp.arange(32, dtype=jnp.float32)
+    out = np.asarray(app(x))
+    base = np.arange(32, dtype=np.float32)
+    for r in range(8):
+        np.testing.assert_allclose(out[r, 0], base + 0)
+        np.testing.assert_allclose(out[r, 1], base * 2 + 1)
+        np.testing.assert_allclose(out[r, 2], base * 3 + 2)
