@@ -171,34 +171,42 @@ def flash_train_point(comm, quick: bool = False):
     from smi_tpu.models import ring_attention as ra
 
     s, h, d = (4096 if quick else 8192), 8, 128
-    rng = np.random.RandomState(0)
-    q, k, v = (
-        jnp.asarray(rng.randn(s, h, d), jnp.float32) for _ in range(3)
-    )
+    out = []
+    dtypes = [("f32", jnp.float32)]
+    if not quick:
+        dtypes.append(("bf16", jnp.bfloat16))
+    for name, dtype in dtypes:
+        rng = np.random.RandomState(0)
+        q, k, v = (
+            jnp.asarray(rng.randn(s, h, d), dtype) for _ in range(3)
+        )
 
-    def make_fn(r):
-        fn = ra.make_ring_attention_fn(comm, causal=True, reps=r)
-        grad = jax.jit(jax.grad(
-            lambda q, k, v: jnp.sum(fn(q, k, v) ** 2), argnums=(0, 1, 2)
-        ))
-        return lambda: np.asarray(jnp.sum(grad(q, k, v)[0]))
+        def make_fn(r, _q=q, _k=k, _v=v):
+            fn = ra.make_ring_attention_fn(comm, causal=True, reps=r)
+            grad = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(
+                    fn(q, k, v).astype(jnp.float32) ** 2
+                ),
+                argnums=(0, 1, 2),
+            ))
+            return lambda: np.asarray(
+                jnp.sum(grad(_q, _k, _v)[0].astype(jnp.float32)))
 
-    work = _attention_flops(s, h, d, causal=True, train=True)
-    rate, trace = _diff_rate(make_fn, work)
-    tflops = rate / 1e12
-    tokens = rate / work * s
-    return [
-        _result(
-            "flash_attn_train_tflops", tflops, "TFLOP/s",
-            {"S": s, "H": h, "D": d, "dtype": "f32", "causal": True,
+        work = _attention_flops(s, h, d, causal=True, train=True)
+        rate, trace = _diff_rate(make_fn, work)
+        tflops = rate / 1e12
+        tokens = rate / work * s
+        out.append(_result(
+            f"flash_attn_train_tflops_{name}", tflops, "TFLOP/s",
+            {"S": s, "H": h, "D": d, "dtype": name, "causal": True,
              "timing": trace},
             {"mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16},
-        ),
-        _result(
-            "flash_attn_train_tokens", tokens / 1e6, "Mtoken/s",
-            {"S": s, "H": h, "D": d, "dtype": "f32"},
-        ),
-    ]
+        ))
+        out.append(_result(
+            f"flash_attn_train_tokens_{name}", tokens / 1e6, "Mtoken/s",
+            {"S": s, "H": h, "D": d, "dtype": name},
+        ))
+    return out
 
 
 def flash_vs_jnp(comm, quick: bool = False):

@@ -60,13 +60,17 @@ NEG_INF = -1e30
 
 #: query tile rows (per grid step)
 BLOCK_Q = 512
-#: key sub-tile columns (per inner-loop iteration)
+#: key sub-tile columns (per inner-loop iteration). bf16 sustains a
+#: wider tile profitably (v5e sweep at S=8192 causal: 1024-wide keys
+#: lift the forward from ~54 to ~95 TFLOP/s and fwd+bwd from ~19 to
+#: ~89); f32 measured fractionally *slower* at 1024, so it keeps 512.
 BLOCK_K = 512
-#: key-chunk budget (per grid step) in rows at head_dim 128; scaled
-#: down for wider heads so double-buffered K/V chunks (2048 rows x 128
-#: lanes x 4 B x 2 bufs x {k,v} = 4 MB) plus q/acc tiles and loop
-#: temporaries stay inside the 16 MB scoped-VMEM limit
-CHUNK_K = 2048
+BLOCK_K_BF16 = 1024
+#: VMEM budget for a K/V chunk pair. Empirical Mosaic limit (v5e,
+#: d=128): double-buffered chunks at 8 MB (k+v x 2 bufs) fail to
+#: compile, 4 MB compiles — and a chunk covering the whole extent is
+#: fetched once, not double-buffered, so it may use the entire budget.
+KV_CHUNK_BUDGET = 4 * 1024 * 1024
 #: widest supported head_dim (q/acc tiles and K/V chunks scale with d)
 MAX_HEAD_DIM = 512
 
@@ -84,9 +88,21 @@ def _sublane(dtype) -> int:
     return 16 if dtype == jnp.bfloat16 else 8
 
 
+def _block_k(dtype) -> int:
+    return BLOCK_K_BF16 if dtype == jnp.bfloat16 else BLOCK_K
+
+
 def _chunk_for(extent: int, block: int, d: int, itemsize: int) -> int:
-    """Chunk = whole blocks fitting the dtype-scaled VMEM budget."""
-    budget_rows = max(1, CHUNK_K * 128 * 4 // (d * itemsize))
+    """Rows per K/V (or Q) chunk within the VMEM budget.
+
+    A chunk spanning the whole extent is resident once (no pipeline
+    double-buffering), so it may fill :data:`KV_CHUNK_BUDGET` outright;
+    otherwise chunks are streamed double-buffered and the k+v pair must
+    fit the budget twice over.
+    """
+    if extent * d * itemsize * 2 <= KV_CHUNK_BUDGET:
+        return extent
+    budget_rows = max(block, KV_CHUNK_BUDGET // (d * itemsize * 2 * 2))
     c = block * max(1, min(budget_rows // block, extent // block))
     while extent % c:
         c -= block
@@ -283,7 +299,7 @@ def flash_block_attend(
     group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, BLOCK_Q, mult)
-    bk = _pick_block(s_k, BLOCK_K, mult)
+    bk = _pick_block(s_k, _block_k(q.dtype), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
     kc = _chunk_for(s_k, bk, d, q.dtype.itemsize)
@@ -564,7 +580,7 @@ def flash_block_backward_dq(
     group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, BLOCK_Q, mult)
-    bk = _pick_block(s_k, BLOCK_K, mult)
+    bk = _pick_block(s_k, _block_k(q.dtype), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
     kc = _chunk_for(s_k, bk, d, q.dtype.itemsize)
@@ -618,7 +634,7 @@ def flash_block_backward_dkdv(
     s_k = k.shape[1]
     group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
-    bkO = _pick_block(s_k, BLOCK_K, mult)
+    bkO = _pick_block(s_k, _block_k(q.dtype), mult)
     bq = _pick_block(s_q, BLOCK_Q, mult)
     if bkO is None or bq is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")

@@ -127,16 +127,16 @@ def test_flash_multi_chunk_carry(eight_devices):
     comm = smi.make_communicator(1, devices=eight_devices[:1])
     s, h, d = 64, 1, 128
     q, k, v = _qkv(s, h, d, seed=5)
-    old_chunk, old_bk = flash.CHUNK_K, flash.BLOCK_K
+    old_chunk, old_bk = flash.KV_CHUNK_BUDGET, flash.BLOCK_K
     old_bq = flash.BLOCK_Q
     try:
-        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = 16, 8, 16
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = 16, 8, 32768
         fn = ra.make_ring_attention_fn(
             comm, causal=True, use_flash=True, interpret=True
         )
         out = np.asarray(fn(q, k, v))
     finally:
-        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = (
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = (
             old_bq, old_bk, old_chunk
         )
     ref = ra.reference_attention(q, k, v, causal=True)
@@ -237,9 +237,9 @@ def test_flash_gradients_multi_chunk(eight_devices, h, h_kv):
         jnp.asarray(rng.randn(s, h_kv, d).astype(np.float32))
         for _ in range(2)
     )
-    old = flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K
+    old = flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET
     try:
-        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = 16, 8, 16
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = 16, 8, 32768
         for causal in (True, False):
             fn_f = ra.make_ring_attention_fn(
                 comm, causal=causal, use_flash=True, interpret=True
@@ -261,7 +261,7 @@ def test_flash_gradients_multi_chunk(eight_devices, h, h_kv):
                     err_msg=f"{name} causal={causal}",
                 )
     finally:
-        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = old
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = old
 
 
 @pytest.mark.parametrize("use_flash", [True, False])
@@ -353,9 +353,9 @@ def test_ring_attention_window_gradients_multi_chunk(eight_devices):
         jnp.asarray(rng.randn(s, h, d).astype(np.float32))
         for _ in range(4)
     )
-    old = flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K
+    old = flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET
     try:
-        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = 16, 8, 16
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = 16, 8, 32768
         fn_f = ra.make_ring_attention_fn(
             comm, causal=True, window=window,
             use_flash=True, interpret=True,
@@ -376,7 +376,7 @@ def test_ring_attention_window_gradients_multi_chunk(eight_devices):
                 err_msg=name,
             )
     finally:
-        flash.BLOCK_Q, flash.BLOCK_K, flash.CHUNK_K = old
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = old
 
 
 def test_ring_attention_window_requires_causal(eight_devices):
