@@ -29,9 +29,7 @@ Run on the TPU host: ``python -m smi_tpu.benchmarks.surface [--quick]``.
 from __future__ import annotations
 
 import json
-import math
 import time
-from typing import Optional
 
 import numpy as np
 

@@ -129,7 +129,7 @@ def _ring_all_gather_kernel(
     """Each device forwards the chunk it most recently received to its
     right neighbour; after n-1 steps everyone holds every chunk.
 
-    Protocol model: ``credits.ring_rank_steps`` — slot 1 is granted at
+    Protocol model: ``credits.all_gather_rank`` — slot 1 is granted at
     start (empty), and each slot is re-granted once its content has been
     forwarded onward (send complete), except on the final step, whose
     grant nobody would consume (credit balance must end at zero).
