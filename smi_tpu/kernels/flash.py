@@ -201,60 +201,10 @@ def _flash_kernel(
 
     @pl.when(live)
     def _attend():
-        q = q_ref[0]
-        if causal:
-            # sub-tiles past the diagonal contribute nothing: clip the
-            # trip count to the last live one
-            n_live = jnp.minimum(
-                (q_first + bq - 1 - c_first) // bk + 1, n_sub
-            )
-        else:
-            n_live = n_sub
-        if window is not None:
-            # first sub-tile overlapping the earliest row's window
-            s0 = jnp.maximum(
-                (q_first - (window - 1) - c_first) // bk, 0
-            )
-        else:
-            s0 = 0
-
-        def body(ki, carry):
-            m, l, acc = carry
-            kb = k_ref[0, pl.ds(ki * bk, bk), :]
-            scores = lax.dot_general(
-                q, kb, (((1,), (1,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            ) * scale  # (bq, bk)
-            if causal:
-                k_first = c_first + ki * bk
-                q_pos = q_first + lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0
-                )
-                k_pos = k_first + lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1
-                )
-                masked = k_pos > q_pos
-                if window is not None:
-                    masked |= k_pos < q_pos - (window - 1)
-                scores = jnp.where(masked, NEG_INF, scores)
-            m_new = jnp.maximum(m, scores.max(axis=1, keepdims=True))
-            # exp(-1e30 - -1e30) = 1 for still-all-masked rows:
-            # transient garbage, zeroed by this same correction once a
-            # live key lands (the jnp path's semantics)
-            correction = jnp.exp(m - m_new)
-            p = jnp.exp(scores - m_new)
-            l = l * correction + p.sum(axis=1, keepdims=True)
-            vb = v_ref[0, pl.ds(ki * bk, bk), :]
-            # match V's dtype for the MXU (free for f32; for bf16
-            # inputs p ∈ [0,1] rounds at ~2^-8, the bf16 tier's noise)
-            acc = acc * correction + lax.dot_general(
-                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            )
-            return m_new, l, acc
-
-        m, l, acc = lax.fori_loop(
-            s0, n_live, body, (m_s[...], l_s[...], acc_s[...])
+        m, l, acc = _chunk_sweep(
+            q_ref, k_ref, v_ref, m_s[...], l_s[...], acc_s[...],
+            q_first, c_first, bq=bq, bk=bk, n_sub=n_sub, causal=causal,
+            window=window, scale=scale, precision=precision,
         )
         m_s[...] = m
         l_s[...] = l
@@ -265,6 +215,205 @@ def _flash_kernel(
         m_out_ref[0] = m_s[...]
         l_out_ref[0] = l_s[...]
         acc_out_ref[0] = acc_s[...]
+
+
+def _chunk_sweep(q_ref, k_ref, v_ref, m0, l0, acc0, q_first, c_first,
+                 *, bq, bk, n_sub, causal, window, scale, precision):
+    """Fold one K/V chunk's live sub-tiles into the online-softmax state
+    (the shared inner loop of the carried and fused forward kernels)."""
+    q = q_ref[0]
+    if causal:
+        # sub-tiles past the diagonal contribute nothing: clip the
+        # trip count to the last live one
+        n_live = jnp.minimum(
+            (q_first + bq - 1 - c_first) // bk + 1, n_sub
+        )
+    else:
+        n_live = n_sub
+    if window is not None:
+        # first sub-tile overlapping the earliest row's window
+        s0 = jnp.maximum(
+            (q_first - (window - 1) - c_first) // bk, 0
+        )
+    else:
+        s0 = 0
+
+    def body(ki, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(ki * bk, bk), :]
+        scores = lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk)
+        if causal:
+            # the masks are computed unconditionally: the VPU iota/select
+            # work overlaps the MXU matmuls, whereas guarding it with an
+            # in-loop lax.cond measured ~40% SLOWER (Mosaic pipelines
+            # poorly around the branch)
+            k_first = c_first + ki * bk
+            q_pos = q_first + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0
+            )
+            k_pos = k_first + lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1
+            )
+            masked = k_pos > q_pos
+            if window is not None:
+                masked |= k_pos < q_pos - (window - 1)
+            scores = jnp.where(masked, NEG_INF, scores)
+        m_new = jnp.maximum(m, scores.max(axis=1, keepdims=True))
+        # exp(-1e30 - -1e30) = 1 for still-all-masked rows:
+        # transient garbage, zeroed by this same correction once a
+        # live key lands (the jnp path's semantics)
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)
+        l = l * correction + p.sum(axis=1, keepdims=True)
+        vb = v_ref[0, pl.ds(ki * bk, bk), :]
+        # match V's dtype for the MXU (free for f32; for bf16
+        # inputs p ∈ [0,1] rounds at ~2^-8, the bf16 tier's noise)
+        acc = acc * correction + lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            precision=precision, preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    return lax.fori_loop(s0, n_live, body, (m0, l0, acc0))
+
+
+def _flash_fused_kernel(
+    offs_ref,   # scalar prefetch: [q_off, k_off]
+    q_ref,      # (1, bq, D)
+    k_ref,      # (1, kc, D)
+    v_ref,      # (1, kc, D)
+    out_ref,    # (1, bq, D) normalized output, q's dtype
+    m_out_ref,  # (1, bq, 1) residuals for the backward
+    l_out_ref,  # (1, bq, 1)
+    m_s, l_s, acc_s,
+    *,
+    block_q: int,
+    block_k: int,
+    chunk_k: int,
+    n_kc: int,
+    causal: bool,
+    window,
+    scale: float,
+    precision,
+):
+    """Single-shot forward: fresh state in, normalized output out.
+
+    The carried kernel (:func:`_flash_kernel`) must round-trip
+    ``(m, l, acc)`` through HBM because a ring step's state continues on
+    the next launch; when the whole K/V extent is attended in ONE launch
+    (ring size 1 — the single-chip case) that traffic is pure overhead:
+    the f32 accumulator alone is ``4/itemsize`` times the output. This
+    variant initializes the state in scratch and writes only the
+    normalized output (+ the (bq, 1) softmax statistics the backward
+    needs), roughly halving HBM traffic per token.
+    """
+    qi = pl.program_id(1)
+    kci = pl.program_id(2)
+    bq, bk, kc = block_q, block_k, chunk_k
+    n_sub = kc // bk
+
+    @pl.when(kci == 0)
+    def _init():
+        m_s[...] = jnp.full((bq, 1), NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((bq, 1), jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    q_first = offs_ref[0] + qi * bq
+    c_first = offs_ref[1] + kci * kc
+    live = (not causal) or (c_first <= q_first + bq - 1)
+    if window is not None:
+        live &= c_first + kc - 1 >= q_first - (window - 1)
+
+    @pl.when(live)
+    def _attend():
+        m, l, acc = _chunk_sweep(
+            q_ref, k_ref, v_ref, m_s[...], l_s[...], acc_s[...],
+            q_first, c_first, bq=bq, bk=bk, n_sub=n_sub, causal=causal,
+            window=window, scale=scale, precision=precision,
+        )
+        m_s[...] = m
+        l_s[...] = l
+        acc_s[...] = acc
+
+    @pl.when(kci == n_kc - 1)
+    def _finalize():
+        l = l_s[...]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out_ref[0] = (acc_s[...] / safe_l).astype(out_ref.dtype)
+        m_out_ref[0] = m_s[...]
+        l_out_ref[0] = l
+
+
+def flash_attend_fused(
+    q: jax.Array,       # (H, Sq, D)
+    k: jax.Array,       # (H_kv, Sk, D)
+    v: jax.Array,       # (H_kv, Sk, D)
+    q_off,
+    k_off,
+    causal: bool,
+    scale: float,
+    precision=None,
+    interpret: bool = False,
+    window: Optional[int] = None,
+):
+    """Whole-extent attention in one launch: ``(out, m, l)``.
+
+    ``out`` is normalized and in ``q.dtype``; ``m``/``l`` are the
+    backward's residuals. Used when the ring has a single rank (the
+    carried :func:`flash_block_attend` otherwise).
+    """
+    _validate_window(causal, window)
+    h, s_q, d = q.shape
+    s_k = k.shape[1]
+    group = _gqa_group(h, k.shape[0])
+    mult = _sublane(q.dtype)
+    bq = _pick_block(s_q, BLOCK_Q, mult)
+    bk = _pick_block(s_k, _block_k(q.dtype), mult)
+    if bq is None or bk is None:
+        raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
+    kc = _chunk_for(s_k, bk, d, q.dtype.itemsize)
+    n_q, n_kc = s_q // bq, s_k // kc
+    precision = _resolve_precision(q.dtype, precision)
+
+    kernel = functools.partial(
+        _flash_fused_kernel, block_q=bq, block_k=bk, chunk_k=kc,
+        n_kc=n_kc, causal=causal, window=window, scale=scale,
+        precision=precision,
+    )
+    offs = jnp.stack(
+        [jnp.asarray(q_off), jnp.asarray(k_off)]
+    ).astype(jnp.int32)
+    qspec = pl.BlockSpec((1, bq, d), lambda hh, qi, ki, offs: (hh, qi, 0))
+    kspec = pl.BlockSpec(
+        (1, kc, d), lambda hh, qi, ki, offs: (hh // group, ki, 0)
+    )
+    colspec = pl.BlockSpec(
+        (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(h, n_q, n_kc),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=[qspec, colspec, colspec],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, q, k, v)
 
 
 def flash_block_attend(

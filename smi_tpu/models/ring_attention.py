@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from smi_tpu.kernels.flash import (
     NEG_INF,
+    flash_attend_fused,
     flash_block_attend,
     flash_block_backward_dkdv,
     flash_block_backward_dq,
@@ -120,6 +121,16 @@ def _flash_forward(q, k, v, comm, causal, axis, precision, interpret,
         scale = 1.0 / math.sqrt(d)
 
     qT = q.swapaxes(0, 1)  # (H, S, D)
+    if comm.mesh.shape[axis] == 1:
+        # single-rank ring: the whole K/V extent is one launch, so the
+        # fused kernel applies — fresh state in scratch, normalized
+        # output written directly (no (m, l, acc) HBM round trip)
+        out, m, l = flash_attend_fused(
+            qT, k.swapaxes(0, 1), v.swapaxes(0, 1), 0, 0, causal,
+            scale, precision, interpret=interpret, window=window,
+        )
+        return out.swapaxes(0, 1), m, l
+
     # online-softmax state is always f32, whatever the input dtype
     m0 = jnp.full((h, s_local, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((h, s_local, 1), jnp.float32)
