@@ -55,7 +55,7 @@ def main():
     comm = make_communicator(
         shape=(px, py), axis_names=("sx", "sy"), devices=devices
     )
-    from smi_tpu.benchmarks.surface import _diff_rate
+    from smi_tpu.benchmarks.surface import diff_rate
     from smi_tpu.kernels import stencil as kstencil
     from smi_tpu.kernels import stencil_temporal as ktemporal
 
@@ -89,7 +89,7 @@ def main():
     # *extra* cells by the *extra* time — the ~100-200 ms tunnel
     # dispatch+readback cost cancels, so the number is the kernel's
     # sustained throughput rather than the tunnel's latency
-    cells_per_sec, _trace = _diff_rate(
+    cells_per_sec, _trace = diff_rate(
         make_fn, x * y * base_iters, runs=5
     )
     per_chip = cells_per_sec / n

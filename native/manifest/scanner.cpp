@@ -199,6 +199,7 @@ bool is_known_op_name(const std::string& name) {
 struct Symbols {
   std::map<std::string, std::string> aliases;
   std::map<std::string, long> constants;
+  std::set<std::string> assigned;  // names seen on an assignment LHS
 };
 
 std::optional<long> as_int(const Arg& a, const Symbols& syms) {
@@ -266,12 +267,91 @@ void parse_from_import(Lexer& lex, Token& tok, Symbols& syms) {
   }
 }
 
+// Pass 1 of the scan: walk the whole source once, recording import
+// aliases and top-level constant bindings. Running this to completion
+// BEFORE op extraction makes the "bound once" rule retroactive: a name
+// rebound anywhere in the file — even after a call site — is poisoned,
+// because the scanner cannot know which binding that call site sees.
+void collect_symbols(const std::string& source, Symbols& syms) {
+  Lexer lex(source);
+  Token tok = lex.next();
+  bool after_dot = false;
+  int depth = 0;
+
+  while (tok.type != Token::End) {
+    if (tok.type != Token::Ident) {
+      after_dot = tok.type == Token::Punct && tok.text == ".";
+      if (tok.type == Token::Punct) {
+        if (tok.text == "(" || tok.text == "[" || tok.text == "{") depth++;
+        if (tok.text == ")" || tok.text == "]" || tok.text == "}")
+          depth = depth > 0 ? depth - 1 : 0;
+      }
+      tok = lex.next();
+      continue;
+    }
+    std::string name = tok.text;
+    bool qualified = after_dot;
+    after_dot = false;
+
+    // import-alias statements (`from smi_tpu import Push as P`)
+    if (!qualified && name == "from") {
+      parse_from_import(lex, tok, syms);
+      continue;
+    }
+
+    Token after = lex.next();
+    bool is_call = after.type == Token::Punct && after.text == "(";
+
+    // top-level integer constants (`PORT = 3`) — SINGLE assignment of a
+    // bare literal. A second assignment (any RHS, anywhere in the file)
+    // poisons the name (docs/manifest.md "bound once").
+    if (!qualified && !is_call && depth == 0 &&
+        after.type == Token::Punct && after.text == "=") {
+      Token value = lex.next();
+      if (value.type == Token::Punct && value.text == "=") {
+        // `==` comparison, not an assignment
+        tok = lex.next();
+        continue;
+      }
+      bool reassigned = syms.assigned.count(name) > 0;
+      syms.assigned.insert(name);
+      if (value.type != Token::Number) {
+        // non-literal RHS: not a constant; re-process the RHS token
+        syms.constants.erase(name);
+        tok = value;
+        continue;
+      }
+      Token trailing = lex.next();
+      // the literal stands alone only if the statement ends here: next
+      // token on a later line, end of file, or a statement separator.
+      // Any same-line continuation (`+ 1`, `if fast else 4`, `, 5`,
+      // `< x`) makes the value computed, not constant.
+      bool simple = trailing.type == Token::End ||
+                    trailing.line > value.line ||
+                    (trailing.type == Token::Punct && trailing.text == ";");
+      if (simple && !reassigned) {
+        try {
+          syms.constants[name] = std::stol(value.text);
+        } catch (...) {
+          syms.constants.erase(name);
+        }
+      } else {
+        syms.constants.erase(name);  // computed or rebound: not constant
+      }
+      tok = trailing;
+      continue;
+    }
+    tok = after;
+  }
+}
+
 }  // namespace
 
 ScanResult scan_source(const std::string& source,
                        const std::string& filename) {
   ScanResult result;
   Symbols syms;
+  collect_symbols(source, syms);  // pass 1: aliases + constants
   Lexer lex(source);
   Token tok = lex.next();
   bool after_dot = false;  // previous token was `.` (attribute access)
@@ -293,51 +373,18 @@ ScanResult scan_source(const std::string& source,
     bool qualified = after_dot;
     after_dot = false;
 
-    // import-alias statements (`from smi_tpu import Push as P`)
+    // symbols were collected in pass 1; here the import statement's
+    // tokens only need to be skipped (an RHS op call after `=` still
+    // falls through to extraction below)
     if (!qualified && name == "from") {
-      parse_from_import(lex, tok, syms);
+      Symbols scratch;
+      parse_from_import(lex, tok, scratch);
       continue;
     }
 
     Token after = lex.next();
     bool is_call =
         after.type == Token::Punct && after.text == "(";
-
-    // top-level integer constants (`PORT = 3`) — single assignment,
-    // simple literal only; anything fancier invalidates the binding
-    if (!qualified && !is_call && depth == 0 &&
-        after.type == Token::Punct && after.text == "=") {
-      Token value = lex.next();
-      if (value.type == Token::Punct && value.text == "=") {
-        // `==` comparison, not an assignment
-        tok = lex.next();
-        continue;
-      }
-      if (value.type != Token::Number) {
-        // non-literal RHS: drop any stale binding and let the main loop
-        // re-process the RHS token (it may itself be an op call)
-        syms.constants.erase(name);
-        tok = value;
-        continue;
-      }
-      Token trailing = lex.next();
-      bool simple = !(trailing.type == Token::Punct &&
-                      (trailing.text == "+" || trailing.text == "-" ||
-                       trailing.text == "*" || trailing.text == "/" ||
-                       trailing.text == "%" || trailing.text == "." ||
-                       trailing.text == "(" || trailing.text == "["));
-      if (simple) {
-        try {
-          syms.constants[name] = std::stol(value.text);
-        } catch (...) {
-          syms.constants.erase(name);
-        }
-      } else {
-        syms.constants.erase(name);  // computed value: not a constant
-      }
-      tok = trailing;
-      continue;
-    }
 
     // resolve import aliases (the canonical name drives matching; the
     // attribute qualifier, if any, is ignored as the reference ignores

@@ -59,7 +59,7 @@ def _one(fn):
     return time.perf_counter() - t0
 
 
-def _diff_rate(make_fn, work_per_rep: float, r1: int = 1, factor: int = 4,
+def diff_rate(make_fn, work_per_rep: float, r1: int = 1, factor: int = 4,
                min_delta: float = 1.0, runs: int = 3, max_reps: int = 512):
     """Differential throughput: work / (t(r2) - t(r1)).
 
@@ -82,6 +82,10 @@ def _diff_rate(make_fn, work_per_rep: float, r1: int = 1, factor: int = 4,
             return rate, (r1, r2, round(t1, 4), round(t2, 4))
         r1, t1 = r2, t2
         r2 *= factor
+
+
+#: internal callers predate the public promotion
+_diff_rate = diff_rate
 
 
 def _result(metric, value, unit, config, roofline=None):

@@ -60,7 +60,16 @@ def _stream_for(port: Optional[int], program, family: str) -> int:
     program model's port->stream deal (``ops/program.py``): ring
     collectives on distinct streams use distinct barrier-semaphore
     domains (``kernels/ring.py::ring_collective_id``), so they can
-    genuinely overlap, mirroring ``multi_collectives.cl``."""
+    genuinely overlap, mirroring ``multi_collectives.cl``.
+
+    With a program, a declared stream slot beyond the ring tier's
+    semaphore-domain count is a loud error — sharing a domain between
+    potentially-concurrent rings is exactly the aliasing the deal
+    prevents. Without a program the port wraps modulo the domain count
+    (a heuristic: nothing declares which collectives may run
+    concurrently, so ports ≥ RING_STREAMS may alias; declare a program
+    for the guarantee).
+    """
     from smi_tpu.kernels.ring import RING_STREAMS
     from smi_tpu.ops.operations import OUT_DATA
 
@@ -69,7 +78,15 @@ def _stream_for(port: Optional[int], program, family: str) -> int:
     if program is not None:
         op = program.find(family, port)
         if op is not None:
-            return program.stream_of(op, OUT_DATA) % RING_STREAMS
+            stream = program.stream_of(op, OUT_DATA)
+            if stream >= RING_STREAMS:
+                raise ValueError(
+                    f"{family} port {port} was dealt to stream {stream}, "
+                    f"beyond the ring tier's {RING_STREAMS} barrier-"
+                    f"semaphore domains; reduce the program's "
+                    f"num_streams or the concurrent-collective count"
+                )
+            return stream
     return port % RING_STREAMS
 
 

@@ -22,10 +22,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 # var alone is not enough here: site customization may import jax at
 # interpreter startup, capturing JAX_PLATFORMS before this file runs, so
 # the config is also updated post-import (backends init lazily).
-# SMI_TPU_RUN_TPU_TESTS opts into the hardware tier instead
+# SMI_TPU_RUN_TPU_TESTS=1 opts into the hardware tier instead
 # (tests/test_flash_tpu.py): the TPU platform stays visible and the
-# compiled Mosaic paths run on the real chip.
-_tpu_tier = bool(os.environ.get("SMI_TPU_RUN_TPU_TESTS"))
+# compiled Mosaic paths run on the real chip. "0"/"false"/"no"/"" all
+# mean off, so CI matrices can set the variable explicitly either way.
+_tpu_tier = os.environ.get(
+    "SMI_TPU_RUN_TPU_TESTS", ""
+).strip().lower() not in ("", "0", "false", "no")
 if not _tpu_tier:
     os.environ["JAX_PLATFORMS"] = "cpu"
 

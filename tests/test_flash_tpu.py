@@ -19,7 +19,8 @@ import numpy as np
 import pytest
 
 pytestmark = pytest.mark.skipif(
-    not os.environ.get("SMI_TPU_RUN_TPU_TESTS"),
+    os.environ.get("SMI_TPU_RUN_TPU_TESTS", "").strip().lower()
+    in ("", "0", "false", "no"),
     reason="TPU-only: set SMI_TPU_RUN_TPU_TESTS=1 on a TPU host",
 )
 

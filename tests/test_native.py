@@ -234,3 +234,34 @@ def test_bootstrap_against_python_writer(tmp_path):
 def test_bootstrap_missing_rank_fails(tmp_path):
     with pytest.raises(ValueError):
         native.bootstrap_rank(str(tmp_path), 5, channels=4, max_ranks=2)
+
+
+def test_manifest_rebound_literal_is_poisoned(tmp_path):
+    """A name assigned twice — even to literals both times — stops being
+    a constant: the scanner cannot know which binding a call site sees
+    (docs/manifest.md 'bound once')."""
+    proc = run_manifest(tmp_path, "p = 0\nx = Push(p)\np = 1\n")
+    assert proc.returncode == 1
+    assert "not a compile-time integer constant" in proc.stderr
+
+
+def test_manifest_conditional_literal_rejected(tmp_path):
+    """`p = 3 if fast else 4` must not bind p=3 (same-line expression
+    continuation after the literal)."""
+    proc = run_manifest(tmp_path, "p = 3 if fast else 4\nx = Push(p)\n")
+    assert proc.returncode == 1
+    assert "not a compile-time integer constant" in proc.stderr
+
+
+def test_manifest_tuple_and_comparison_not_constants(tmp_path):
+    proc = run_manifest(tmp_path, "p = 3, 4\nx = Push(p)\n")
+    assert proc.returncode == 1
+    proc = run_manifest(tmp_path, "ok = 3 < limit\nx = Push(ok)\n")
+    assert proc.returncode == 1
+
+
+def test_manifest_semicolon_statement_is_constant(tmp_path):
+    proc = run_manifest(tmp_path, "p = 3; q = 5\nx = Push(p); y = Pop(q)\n")
+    assert proc.returncode == 0, proc.stderr
+    assert '"port": 3' in proc.stdout
+    assert '"port": 5' in proc.stdout

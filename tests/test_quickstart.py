@@ -58,6 +58,7 @@ def test_quickstart_runs_verbatim(tmp_path, eight_devices):
         assert proc.returncode == 0, f"{line}\n{proc.stderr}"
     for artifact in ("app.json", "smi-routes/hostfile",
                      "smi-routes/cks-rank0-channel0",
+                     "smi_generated_device.py",
                      "smi_generated_host.py"):
         assert (tmp_path / "build" / artifact).exists(), artifact
 
