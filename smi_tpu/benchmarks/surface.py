@@ -211,6 +211,46 @@ def flash_train_point(comm, quick: bool = False):
     return out
 
 
+def longcontext_points(comm, quick: bool = False):
+    """The long-context claim, measured: 32k tokens on one chip, full
+    causal and sliding-window (compute scaling with S·window)."""
+    import jax.numpy as jnp
+
+    from smi_tpu.models import ring_attention as ra
+
+    if quick:
+        return []
+    s, h, d = 32768, 8, 128
+    out = []
+    for window in (None, 4096):
+        rng = np.random.RandomState(0)
+        q, k, v = (
+            jnp.asarray(rng.randn(s, h, d), jnp.bfloat16) for _ in range(3)
+        )
+
+        def make_fn(r, _w=window):
+            fn = ra.make_ring_attention_fn(
+                comm, causal=True, use_flash=True, reps=r, window=_w,
+            )
+            return lambda: np.asarray(
+                jnp.sum(fn(q, k, v).astype(jnp.float32)))
+
+        # full causal: S²/2 live area; windowed: ~S·window
+        if window is None:
+            work = _attention_flops(s, h, d, causal=True, train=False)
+        else:
+            work = 2 * 2 * s * window * h * d
+        rate, trace = _diff_rate(make_fn, work)
+        tag = "causal" if window is None else f"window{window}"
+        out.append(_result(
+            f"flash_attn_fwd_s{s}_bf16_{tag}", rate / 1e12, "TFLOP/s",
+            {"S": s, "H": h, "D": d, "dtype": "bf16", "window": window,
+             "timing": trace},
+            {"mfu_vs_bf16_peak": rate / PEAK_BF16},
+        ))
+    return out
+
+
 def flash_vs_jnp(comm, quick: bool = False):
     """Flash tier speedup over the jnp (HBM-materialized) tier."""
     import jax.numpy as jnp
@@ -452,6 +492,7 @@ def main(argv=None):
     comm = make_communicator(1, devices=jax.devices()[:1])
     sections = {
         "fwd": flash_forward_points,
+        "longcontext": longcontext_points,
         "train": flash_train_point,
         "ratio": flash_vs_jnp,
         "stock": flash_vs_stock,
