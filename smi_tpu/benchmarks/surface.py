@@ -338,6 +338,54 @@ def flash_vs_stock(comm, quick: bool = False):
     ]
 
 
+def model_train_point(comm, quick: bool = False):
+    """Whole-model training throughput: the transformer block (QKV/O +
+    MLP matmuls + ring attention + layernorms + SGD) in mixed precision
+    — the composition showpiece measured end-to-end."""
+    import jax.numpy as jnp
+
+    from smi_tpu.models import transformer as tf
+    from smi_tpu.parallel.mesh import make_communicator
+
+    if quick:
+        return []
+    s, e, h, d = 8192, 1024, 8, 128
+    comm2 = make_communicator(
+        shape=(1, 1), axis_names=("dp", "sp"),
+        devices=list(comm.mesh.devices.flat)[:1],
+    )
+    cfg = tf.BlockConfig(embed=e, heads=h, head_dim=d,
+                         compute_dtype="bfloat16")
+    params = tf.init_params(cfg)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, s, e).astype(np.float32))
+
+    def make_fn(r):
+        step = tf.make_train_step(comm2, cfg)
+
+        def run():
+            p, tokens = dict(params), 0
+            for _ in range(r):
+                p, loss = step(p, x, x)
+            return np.asarray(loss)
+
+        return run
+
+    rate, trace = _diff_rate(make_fn, s)
+    # block FLOPs per token, fwd+bwd (x3): QKV (2*E*3HD) + O (2*HD*E) +
+    # MLP (2*2*ratio*E^2) + causal attention (4*S*H*D/2 per token)
+    matmul = 2 * e * 3 * h * d + 2 * h * d * e + 4 * cfg.mlp_ratio * e * e
+    attn = 4 * s * h * d / 2
+    tflops = rate * 3 * (matmul + attn) / 1e12
+    return [_result(
+        "transformer_train_tokens_bf16", rate / 1e6, "Mtoken/s",
+        {"S": s, "embed": e, "H": h, "D": d, "compute": "bf16",
+         "timing": trace},
+        {"approx_tflops": tflops,
+         "mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16},
+    )]
+
+
 # ---------------------------------------------------------------------------
 # Stencil tiers + roofline
 # ---------------------------------------------------------------------------
@@ -494,6 +542,7 @@ def main(argv=None):
         "fwd": flash_forward_points,
         "longcontext": longcontext_points,
         "train": flash_train_point,
+        "model": model_train_point,
         "ratio": flash_vs_jnp,
         "stock": flash_vs_stock,
         "tiers": stencil_tiers,

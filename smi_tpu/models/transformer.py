@@ -44,6 +44,16 @@ class BlockConfig:
     mlp_ratio: int = 2
     causal: bool = True
     window: Optional[int] = None
+    #: mixed precision: matmuls and the attention ring run in this
+    #: dtype ("bfloat16" for the MXU's native pass — the flash tier
+    #: measures ~4.7x the f32 rate) while parameters, layernorm
+    #: statistics, gradients, and the optimizer state stay f32 (the
+    #: standard master-weight scheme). "float32" = full precision.
+    compute_dtype: str = "float32"
+
+    @property
+    def _cdtype(self):
+        return jnp.dtype(self.compute_dtype)
 
 
 def init_params(config: BlockConfig, seed: int = 0) -> dict:
@@ -80,9 +90,15 @@ def block_shard(
     """One pre-norm block on this rank's activation shard."""
     b, s, e = x.shape
     h, d = config.heads, config.head_dim
+    cd = config._cdtype
+
+    def mm(a, w):
+        """Matmul in the compute dtype (params cast per-use; autodiff
+        transposes the casts, so gradients land back in f32)."""
+        return (a.astype(cd) @ params[w].astype(cd)).astype(jnp.float32)
 
     xn = _layernorm(x)
-    qkv = xn.reshape(b * s, e) @ params["wqkv"]          # MXU
+    qkv = mm(xn.reshape(b * s, e), "wqkv")               # MXU
     q, k, v = jnp.split(qkv.reshape(b, s, 3, h, d), 3, axis=2)
     # fold batch into heads: (B, S, 1, H, D) -> (S, B*H, D); heads are
     # independent so the per-head ring schedule applies unchanged
@@ -90,16 +106,16 @@ def block_shard(
         s, b * h, d
     )
     attn = ra.ring_attention_shard(
-        fold(q), fold(k), fold(v), comm,
-        causal=config.causal, axis_name=sp_axis,
+        fold(q).astype(cd), fold(k).astype(cd), fold(v).astype(cd),
+        comm, causal=config.causal, axis_name=sp_axis,
         use_flash=use_flash, interpret=interpret,
         window=config.window,
-    )                                                     # (S, B*H, D)
+    ).astype(jnp.float32)                                 # (S, B*H, D)
     attn = attn.reshape(s, b, h * d).transpose(1, 0, 2)   # (B, S, H*D)
-    x = x + (attn.reshape(b * s, h * d) @ params["wo"]).reshape(b, s, e)
+    x = x + mm(attn.reshape(b * s, h * d), "wo").reshape(b, s, e)
 
     yn = _layernorm(x).reshape(b * s, e)
-    mlp = jax.nn.gelu(yn @ params["w1"]) @ params["w2"]
+    mlp = mm(jax.nn.gelu(mm(yn, "w1")), "w2")
     return x + mlp.reshape(b, s, e)
 
 
