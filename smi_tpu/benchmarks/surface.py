@@ -248,6 +248,33 @@ def longcontext_points(comm, quick: bool = False):
              "timing": trace},
             {"mfu_vs_bf16_peak": rate / PEAK_BF16},
         ))
+
+    # long-context *training*: fwd+bwd through the custom VJP with the
+    # sliding window — the claim that 32k-token training fits one chip
+    import jax
+
+    w = 4096
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(s, h, d), jnp.bfloat16) for _ in range(3)
+    )
+
+    def make_train(r):
+        fn = ra.make_ring_attention_fn(comm, causal=True, reps=r, window=w)
+        grad = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2),
+        ))
+        return lambda: np.asarray(
+            jnp.sum(grad(q, k, v)[0].astype(jnp.float32)))
+
+    rate, trace = _diff_rate(make_train, s)
+    out.append(_result(
+        f"flash_attn_train_tokens_s{s}_window{w}_bf16", rate / 1e6,
+        "Mtoken/s",
+        {"S": s, "H": h, "D": d, "dtype": "bf16", "window": w,
+         "timing": trace},
+    ))
     return out
 
 
