@@ -44,6 +44,9 @@ class BlockConfig:
     mlp_ratio: int = 2
     causal: bool = True
     window: Optional[int] = None
+    #: grouped-query attention: number of K/V heads (None = heads, plain
+    #: MHA). Must divide ``heads``; only the smaller K/V ride the ring.
+    kv_heads: Optional[int] = None
     #: mixed precision: matmuls and the attention ring run in this
     #: dtype ("bfloat16" for the MXU's native pass — the flash tier
     #: measures ~4.7x the f32 rate) while parameters, layernorm
@@ -55,6 +58,15 @@ class BlockConfig:
     def _cdtype(self):
         return jnp.dtype(self.compute_dtype)
 
+    @property
+    def _kv(self) -> int:
+        kv = self.kv_heads if self.kv_heads is not None else self.heads
+        if self.heads % kv:
+            raise ValueError(
+                f"kv_heads {kv} must divide heads {self.heads}"
+            )
+        return kv
+
 
 def init_params(config: BlockConfig, seed: int = 0) -> dict:
     """Replicated block parameters (f32)."""
@@ -64,8 +76,9 @@ def init_params(config: BlockConfig, seed: int = 0) -> dict:
     def w(shape, scale):
         return jnp.asarray(rng.randn(*shape).astype(np.float32) * scale)
 
+    kv = config._kv
     return {
-        "wqkv": w((e, 3 * h * d), e ** -0.5),
+        "wqkv": w((e, (h + 2 * kv) * d), e ** -0.5),
         "wo": w((h * d, e), (h * d) ** -0.5),
         "w1": w((e, config.mlp_ratio * e), e ** -0.5),
         "w2": w((config.mlp_ratio * e, e), (config.mlp_ratio * e) ** -0.5),
@@ -97,16 +110,21 @@ def block_shard(
         transposes the casts, so gradients land back in f32)."""
         return (a.astype(cd) @ params[w].astype(cd)).astype(jnp.float32)
 
+    kv = config._kv
     xn = _layernorm(x)
     qkv = mm(xn.reshape(b * s, e), "wqkv")               # MXU
-    q, k, v = jnp.split(qkv.reshape(b, s, 3, h, d), 3, axis=2)
-    # fold batch into heads: (B, S, 1, H, D) -> (S, B*H, D); heads are
-    # independent so the per-head ring schedule applies unchanged
-    fold = lambda t: t.reshape(b, s, h, d).transpose(1, 0, 2, 3).reshape(
-        s, b * h, d
-    )
+    qkv = qkv.reshape(b, s, h + 2 * kv, d)
+    q = qkv[:, :, :h]
+    k = qkv[:, :, h:h + kv]
+    v = qkv[:, :, h + kv:]
+    # fold batch into heads: (B, S, Hx, D) -> (S, B*Hx, D); heads are
+    # independent so the per-head ring schedule applies unchanged, and
+    # the GQA group mapping hh // (H/KV) stays correct because each
+    # batch's heads are contiguous
+    fold = lambda t, hx: t.transpose(1, 0, 2, 3).reshape(s, b * hx, d)
     attn = ra.ring_attention_shard(
-        fold(q).astype(cd), fold(k).astype(cd), fold(v).astype(cd),
+        fold(q, h).astype(cd), fold(k, kv).astype(cd),
+        fold(v, kv).astype(cd),
         comm, causal=config.causal, axis_name=sp_axis,
         use_flash=use_flash, interpret=interpret,
         window=config.window,
@@ -172,15 +190,24 @@ def reference_block(params, x, config: BlockConfig) -> np.ndarray:
     the gathered arrays) for verification."""
     b, s, e = x.shape
     h, d = config.heads, config.head_dim
+    kv = config._kv
     xn = _layernorm(x)
-    qkv = xn.reshape(b * s, e) @ params["wqkv"]
-    q, k, v = jnp.split(qkv.reshape(b, s, 3, h, d), 3, axis=2)
+    qkv = (xn.reshape(b * s, e) @ params["wqkv"]).reshape(
+        b, s, h + 2 * kv, d
+    )
+    q = qkv[:, :, :h]
+    k = qkv[:, :, h:h + kv]
+    v = qkv[:, :, h + kv:]
+    if kv != h:
+        # reference semantics: each K/V head serves heads//kv query heads
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
     outs = []
     for bi in range(b):
         outs.append(
             ra.reference_attention(
-                np.asarray(q[bi, :, 0]), np.asarray(k[bi, :, 0]),
-                np.asarray(v[bi, :, 0]), causal=config.causal,
+                np.asarray(q[bi]), np.asarray(k[bi]),
+                np.asarray(v[bi]), causal=config.causal,
                 window=config.window,
             )
         )
