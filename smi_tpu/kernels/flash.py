@@ -109,6 +109,67 @@ def _chunk_for(extent: int, block: int, d: int, itemsize: int) -> int:
     return c
 
 
+def _window_chunk(extent: int, block: int, d: int, itemsize: int) -> int:
+    """Streamed-chunk rows for the windowed schedules: two sub-tiles
+    per chunk when the extent and the VMEM budget allow it (halves
+    per-grid-step overhead vs block-sized chunks while keeping dead
+    fetch at the span edges small), one otherwise. Unlike
+    :func:`_chunk_for`, windowed chunks are always streamed (the live
+    span moves with the q tile), so the k+v pair must fit the budget
+    double-buffered even when the extent is small."""
+    kc = 2 * block
+    if extent % kc == 0 and kc * d * itemsize * 4 <= KV_CHUNK_BUDGET:
+        return kc
+    return block
+
+
+def _live_chunk0(row_first, axis_off, chunk: int, n_grid: int,
+                 n_total: int):
+    """First *fetched* chunk of the windowed schedules' streamed axis:
+    the chunk holding global position ``row_first``, clipped so the
+    ``n_grid`` visited chunks stay in range. The kernels and the
+    BlockSpec index maps MUST both derive the offset from this one
+    expression — they agree on which chunk each grid step fetched."""
+    return jnp.clip((row_first - axis_off) // chunk, 0, n_total - n_grid)
+
+
+def _window_chunks(extent: int, chunk: int, tile: int, window):
+    """``(n_grid, n_total)`` chunk counts of the streamed axis.
+
+    With a sliding window, a ``tile``-row block of the stationary axis
+    can only intersect chunks covering its ``window + tile - 1``-row
+    live span — the grid visits just that many chunks and the BlockSpec
+    index map offsets them to the live range, so out-of-window chunks
+    are never *fetched* (Pallas prefetches every grid block from HBM
+    even when ``pl.when`` skips its compute — at S=32k/window=4k that
+    dead traffic, not masking, bounded the windowed path).
+    """
+    n_total = extent // chunk
+    if window is None:
+        return n_total, n_total
+    span = window + tile - 1
+    return min(n_total, (span - 2) // chunk + 2), n_total
+
+
+def _kv_index_map(group: int, bq: int, kc: int, window, n_kc: int,
+                  n_kc_total: int):
+    """K/V BlockSpec index map of the q-stationary kernels (forward and
+    dq): plain chunk order without a window; with one, the grid's chunk
+    axis is offset to the q tile's live span (the kernel recomputes the
+    same ``chunk0``)."""
+    if window is None:
+        return lambda hh, qi, ki, offs: (hh // group, ki, 0)
+
+    def index_map(hh, qi, ki, offs):
+        chunk0 = _live_chunk0(
+            offs[0] + qi * bq - (window - 1), offs[1], kc, n_kc,
+            n_kc_total,
+        )
+        return (hh // group, chunk0 + ki, 0)
+
+    return index_map
+
+
 def _gqa_group(h: int, h_kv: int) -> int:
     """Validated query-heads-per-KV-head group factor."""
     if h % h_kv:
@@ -174,6 +235,7 @@ def _flash_kernel(
     block_k: int,
     chunk_k: int,
     n_kc: int,
+    n_kc_total: int,
     causal: bool,
     window,
     scale: float,
@@ -193,8 +255,17 @@ def _flash_kernel(
     # Global positions of this tile's rows and of the chunk's first
     # column; chunks wholly inside the causal future — or, with a
     # sliding window, wholly before any row's window — are skipped.
+    # With a window the grid's chunk axis is relative: it covers only
+    # the n_kc chunks that can intersect this tile's live span, offset
+    # by chunk0 (must match the BlockSpec index map).
     q_first = offs_ref[0] + qi * bq
-    c_first = offs_ref[1] + kci * kc
+    if window is not None:
+        chunk0 = _live_chunk0(
+            q_first - (window - 1), offs_ref[1], kc, n_kc, n_kc_total
+        )
+    else:
+        chunk0 = 0
+    c_first = offs_ref[1] + (chunk0 + kci) * kc
     live = (not causal) or (c_first <= q_first + bq - 1)
     if window is not None:
         live &= c_first + kc - 1 >= q_first - (window - 1)
@@ -276,22 +347,35 @@ def _chunk_sweep(q_ref, k_ref, v_ref, m0, l0, acc0, q_first, c_first,
 
         return body
 
-    if causal and window is None:
-        # Two static loop phases instead of per-tile masking: a sub-tile
+    if causal:
+        # Static loop phases instead of per-tile masking: a sub-tile
         # whose last key is at or before the tile's first query row can
-        # never be masked, and with bk >= bq that is every live tile but
-        # the final one or two — only those pay the iota/select cost.
-        # (A per-iteration lax.cond here measured ~40% *slower* — Mosaic
-        # pipelines poorly around in-loop branches — but two fori_loops
-        # with static bodies keep both pipelines clean. The windowed
-        # path keeps full masking: its leading edge would need a third
-        # phase.)
+        # never be causally masked, and one whose first key is within
+        # the earliest row's window needs no window mask — so only the
+        # diagonal tiles and the trailing window edge pay the
+        # iota/select cost. (A per-iteration lax.cond here measured
+        # ~40% *slower* — Mosaic pipelines poorly around in-loop
+        # branches — but fori_loops with static bodies keep the
+        # pipelines clean.) Phases: [s0, a) window-edge masked,
+        # [a, b) unmasked interior, [b, n_live) diagonal masked.
         n_unmasked = jnp.clip(
             (q_first - c_first - bk + 1) // bk + 1, 0, n_live
         )
-        split = jnp.maximum(s0, n_unmasked)
-        carry = lax.fori_loop(s0, split, make_body(False), (m0, l0, acc0))
-        return lax.fori_loop(split, n_live, make_body(True), carry)
+        if window is None:
+            b = jnp.maximum(s0, n_unmasked)
+            carry = lax.fori_loop(
+                s0, b, make_body(False), (m0, l0, acc0)
+            )
+            return lax.fori_loop(b, n_live, make_body(True), carry)
+        # first sub-tile whose every key is inside every row's window:
+        # k_first >= (q_first + bq - 1) - (window - 1)  (ceil division)
+        a = jnp.clip(
+            (q_first + bq - window - c_first + bk - 1) // bk, s0, n_live
+        )
+        b = jnp.clip(n_unmasked, a, n_live)
+        carry = lax.fori_loop(s0, a, make_body(True), (m0, l0, acc0))
+        carry = lax.fori_loop(a, b, make_body(False), carry)
+        return lax.fori_loop(b, n_live, make_body(True), carry)
 
     return lax.fori_loop(s0, n_live, make_body(causal), (m0, l0, acc0))
 
@@ -310,6 +394,7 @@ def _flash_fused_kernel(
     block_k: int,
     chunk_k: int,
     n_kc: int,
+    n_kc_total: int,
     causal: bool,
     window,
     scale: float,
@@ -338,7 +423,13 @@ def _flash_fused_kernel(
         acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
 
     q_first = offs_ref[0] + qi * bq
-    c_first = offs_ref[1] + kci * kc
+    if window is not None:
+        chunk0 = _live_chunk0(
+            q_first - (window - 1), offs_ref[1], kc, n_kc, n_kc_total
+        )
+    else:
+        chunk0 = 0
+    c_first = offs_ref[1] + (chunk0 + kci) * kc
     live = (not causal) or (c_first <= q_first + bq - 1)
     if window is not None:
         live &= c_first + kc - 1 >= q_first - (window - 1)
@@ -390,21 +481,29 @@ def flash_attend_fused(
     bk = _pick_block(s_k, _block_k(q.dtype), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
-    kc = _chunk_for(s_k, bk, d, q.dtype.itemsize)
-    n_q, n_kc = s_q // bq, s_k // kc
+    # windowed: stream small chunks and visit only the live span (see
+    # _window_chunks) — dead chunks are never fetched
+    kc = (
+        _window_chunk(s_k, bk, d, q.dtype.itemsize)
+        if window is not None
+        else _chunk_for(s_k, bk, d, q.dtype.itemsize)
+    )
+    n_kc, n_kc_total = _window_chunks(s_k, kc, bq, window)
+    n_q = s_q // bq
     precision = _resolve_precision(q.dtype, precision)
 
     kernel = functools.partial(
         _flash_fused_kernel, block_q=bq, block_k=bk, chunk_k=kc,
-        n_kc=n_kc, causal=causal, window=window, scale=scale,
-        precision=precision,
+        n_kc=n_kc, n_kc_total=n_kc_total, causal=causal, window=window,
+        scale=scale, precision=precision,
     )
     offs = jnp.stack(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
     ).astype(jnp.int32)
     qspec = pl.BlockSpec((1, bq, d), lambda hh, qi, ki, offs: (hh, qi, 0))
     kspec = pl.BlockSpec(
-        (1, kc, d), lambda hh, qi, ki, offs: (hh // group, ki, 0)
+        (1, kc, d),
+        _kv_index_map(group, bq, kc, window, n_kc, n_kc_total),
     )
     colspec = pl.BlockSpec(
         (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
@@ -467,20 +566,27 @@ def flash_block_attend(
     bk = _pick_block(s_k, _block_k(q.dtype), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
-    kc = _chunk_for(s_k, bk, d, q.dtype.itemsize)
-    n_q, n_kc = s_q // bq, s_k // kc
+    kc = (
+        _window_chunk(s_k, bk, d, q.dtype.itemsize)
+        if window is not None
+        else _chunk_for(s_k, bk, d, q.dtype.itemsize)
+    )
+    n_kc, n_kc_total = _window_chunks(s_k, kc, bq, window)
+    n_q = s_q // bq
     precision = _resolve_precision(q.dtype, precision)
 
     kernel = functools.partial(
         _flash_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,
-        causal=causal, window=window, scale=scale, precision=precision,
+        n_kc_total=n_kc_total, causal=causal, window=window,
+        scale=scale, precision=precision,
     )
     offs = jnp.stack(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
     ).astype(jnp.int32)
     qspec = pl.BlockSpec((1, bq, d), lambda hh, qi, ki, offs: (hh, qi, 0))
     kspec = pl.BlockSpec(
-        (1, kc, d), lambda hh, qi, ki, offs: (hh // group, ki, 0)
+        (1, kc, d),
+        _kv_index_map(group, bq, kc, window, n_kc, n_kc_total),
     )
     colspec = pl.BlockSpec(
         (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
@@ -535,6 +641,7 @@ def _bwd_dq_kernel(
     block_k: int,
     chunk_k: int,
     n_kc: int,
+    n_kc_total: int,
     causal: bool,
     window,
     scale: float,
@@ -550,7 +657,13 @@ def _bwd_dq_kernel(
         dq_s[...] = jnp.zeros_like(dq_s)
 
     q_first = offs_ref[0] + qi * bq
-    c_first = offs_ref[1] + kci * kc
+    if window is not None:
+        chunk0 = _live_chunk0(
+            q_first - (window - 1), offs_ref[1], kc, n_kc, n_kc_total
+        )
+    else:
+        chunk0 = 0
+    c_first = offs_ref[1] + (chunk0 + kci) * kc
     live = (not causal) or (c_first <= q_first + bq - 1)
     if window is not None:
         live &= c_first + kc - 1 >= q_first - (window - 1)
@@ -575,40 +688,67 @@ def _bwd_dq_kernel(
         else:
             s0 = 0
 
-        def body(ki, dq):
-            kb = k_ref[0, pl.ds(ki * bk, bk), :]
-            vb = v_ref[0, pl.ds(ki * bk, bk), :]
-            s = lax.dot_general(
-                q, kb, (((1,), (1,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            ) * scale
-            # normalized probabilities from the saved statistics;
-            # masked entries (and fully-masked rows, where m = -1e30)
-            # are zeroed explicitly rather than through exp underflow
-            p = jnp.exp(s - m) * linv
-            if causal:
-                k_first = c_first + ki * bk
-                q_pos = q_first + lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0
+        def make_body(apply_mask: bool):
+            def body(ki, dq):
+                kb = k_ref[0, pl.ds(ki * bk, bk), :]
+                vb = v_ref[0, pl.ds(ki * bk, bk), :]
+                s = lax.dot_general(
+                    q, kb, (((1,), (1,)), ((), ())),
+                    precision=precision,
+                    preferred_element_type=jnp.float32,
+                ) * scale
+                # normalized probabilities from the saved statistics;
+                # masked entries (and fully-masked rows, m = -1e30)
+                # are zeroed explicitly rather than via exp underflow
+                p = jnp.exp(s - m) * linv
+                if apply_mask:
+                    k_first = c_first + ki * bk
+                    q_pos = q_first + lax.broadcasted_iota(
+                        jnp.int32, (bq, bk), 0
+                    )
+                    k_pos = k_first + lax.broadcasted_iota(
+                        jnp.int32, (bq, bk), 1
+                    )
+                    masked = k_pos > q_pos
+                    if window is not None:
+                        masked |= k_pos < q_pos - (window - 1)
+                    p = jnp.where(masked, 0.0, p)
+                dp = lax.dot_general(
+                    do, vb, (((1,), (1,)), ((), ())),
+                    precision=precision,
+                    preferred_element_type=jnp.float32,
                 )
-                k_pos = k_first + lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1
-                )
-                masked = k_pos > q_pos
-                if window is not None:
-                    masked |= k_pos < q_pos - (window - 1)
-                p = jnp.where(masked, 0.0, p)
-            dp = lax.dot_general(
-                do, vb, (((1,), (1,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - dlt)
-            return dq + lax.dot_general(
-                ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            ) * scale
+                ds = p * (dp - dlt)
+                return dq + lax.dot_general(
+                    ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+                    precision=precision,
+                    preferred_element_type=jnp.float32,
+                ) * scale
 
-        dq_s[...] = lax.fori_loop(s0, n_live, body, dq_s[...])
+            return body
+
+        if causal:
+            # same static phase split as the forward's _chunk_sweep:
+            # [s0, a) window edge, [a, b) unmasked, [b, n_live) diagonal
+            n_unmasked = jnp.clip(
+                (q_first - c_first - bk + 1) // bk + 1, 0, n_live
+            )
+            if window is None:
+                a = s0
+                b = jnp.maximum(s0, n_unmasked)
+            else:
+                a = jnp.clip(
+                    (q_first + bq - window - c_first + bk - 1) // bk,
+                    s0, n_live,
+                )
+                b = jnp.clip(n_unmasked, a, n_live)
+            dq = lax.fori_loop(s0, a, make_body(True), dq_s[...])
+            dq = lax.fori_loop(a, b, make_body(False), dq)
+            dq_s[...] = lax.fori_loop(b, n_live, make_body(True), dq)
+        else:
+            dq_s[...] = lax.fori_loop(
+                s0, n_live, make_body(False), dq_s[...]
+            )
 
     @pl.when(kci == n_kc - 1)
     def _store():
@@ -633,6 +773,7 @@ def _bwd_dkdv_kernel(
     block_q: int,   # bq: query sub-tile within a chunk
     chunk_q: int,   # qc
     n_qc: int,
+    n_qc_total: int,
     group: int,
     causal: bool,
     window,
@@ -655,7 +796,14 @@ def _bwd_dkdv_kernel(
         dv_s[...] = jnp.zeros_like(dv_s)
 
     k_first = offs_ref[1] + ki * bkO
-    c_first = offs_ref[0] + qci * qc  # first global q row of this chunk
+    # with a window the q-chunk axis is relative to this key block's
+    # live q span [k_first, k_first + bkO - 1 + window - 1] (causal
+    # lower edge; must match the BlockSpec index map)
+    if window is not None:
+        chunk0 = _live_chunk0(k_first, offs_ref[0], qc, n_qc, n_qc_total)
+    else:
+        chunk0 = 0
+    c_first = offs_ref[0] + (chunk0 + qci) * qc  # first q row, global
     # under causality only q rows >= k col contribute; with a sliding
     # window, only q rows < k col + window
     live = (not causal) or (c_first + qc - 1 >= k_first)
@@ -679,46 +827,79 @@ def _bwd_dkdv_kernel(
         else:
             n_end = n_sub
 
-        def body(qi, carry):
-            dk, dv = carry
-            qb = q_ref[0, pl.ds(qi * bq, bq), :]
-            db = do_ref[0, pl.ds(qi * bq, bq), :]
-            m = m_ref[0, :, pl.ds(qi * bq, bq)]        # (1, bq)
-            linv = linv_ref[0, :, pl.ds(qi * bq, bq)]
-            dlt = dlt_ref[0, :, pl.ds(qi * bq, bq)]
-            s_t = lax.dot_general(
-                kb, qb, (((1,), (1,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            ) * scale  # (bkO, bq)
-            p_t = jnp.exp(s_t - m) * linv
-            if causal:
-                q_first = c_first + qi * bq
-                k_pos = k_first + lax.broadcasted_iota(
-                    jnp.int32, (bkO, bq), 0
+        def make_body(apply_mask: bool):
+            def body(qi, carry):
+                dk, dv = carry
+                qb = q_ref[0, pl.ds(qi * bq, bq), :]
+                db = do_ref[0, pl.ds(qi * bq, bq), :]
+                m = m_ref[0, :, pl.ds(qi * bq, bq)]        # (1, bq)
+                linv = linv_ref[0, :, pl.ds(qi * bq, bq)]
+                dlt = dlt_ref[0, :, pl.ds(qi * bq, bq)]
+                s_t = lax.dot_general(
+                    kb, qb, (((1,), (1,)), ((), ())),
+                    precision=precision,
+                    preferred_element_type=jnp.float32,
+                ) * scale  # (bkO, bq)
+                p_t = jnp.exp(s_t - m) * linv
+                if apply_mask:
+                    q_first = c_first + qi * bq
+                    k_pos = k_first + lax.broadcasted_iota(
+                        jnp.int32, (bkO, bq), 0
+                    )
+                    q_pos = q_first + lax.broadcasted_iota(
+                        jnp.int32, (bkO, bq), 1
+                    )
+                    masked = k_pos > q_pos
+                    if window is not None:
+                        masked |= k_pos < q_pos - (window - 1)
+                    p_t = jnp.where(masked, 0.0, p_t)
+                dv = dv + lax.dot_general(
+                    p_t.astype(db.dtype), db, (((1,), (0,)), ((), ())),
+                    precision=precision,
+                    preferred_element_type=jnp.float32,
                 )
-                q_pos = q_first + lax.broadcasted_iota(
-                    jnp.int32, (bkO, bq), 1
+                dp_t = lax.dot_general(
+                    vb, db, (((1,), (1,)), ((), ())),
+                    precision=precision,
+                    preferred_element_type=jnp.float32,
                 )
-                masked = k_pos > q_pos
-                if window is not None:
-                    masked |= k_pos < q_pos - (window - 1)
-                p_t = jnp.where(masked, 0.0, p_t)
-            dv = dv + lax.dot_general(
-                p_t.astype(db.dtype), db, (((1,), (0,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            )
-            dp_t = lax.dot_general(
-                vb, db, (((1,), (1,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            )
-            ds_t = p_t * (dp_t - dlt)
-            dk = dk + lax.dot_general(
-                ds_t.astype(qb.dtype), qb, (((1,), (0,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            ) * scale
-            return dk, dv
+                ds_t = p_t * (dp_t - dlt)
+                dk = dk + lax.dot_general(
+                    ds_t.astype(qb.dtype), qb, (((1,), (0,)), ((), ())),
+                    precision=precision,
+                    preferred_element_type=jnp.float32,
+                ) * scale
+                return dk, dv
 
-        dk, dv = lax.fori_loop(s0, n_end, body, (dk_s[...], dv_s[...]))
+            return body
+
+        if causal:
+            # phase split, mirrored from the forward: here the
+            # *diagonal* tiles are at the START of the query sweep and
+            # the window edge at the END. [s0, a) diagonal masked,
+            # [a, b) unmasked, [b, n_end) window-edge masked. A query
+            # sub-tile is causally unmasked iff its first row is at or
+            # after this key block's last column, and window-unmasked
+            # iff its last row is within the window of the block's
+            # first column.
+            a = jnp.clip(
+                (k_first + bkO - 1 - c_first + bq - 1) // bq, s0, n_end
+            )
+            if window is None:
+                b = n_end
+            else:
+                b = jnp.clip(
+                    (k_first + window - bq - c_first) // bq + 1, a, n_end
+                )
+            carry = lax.fori_loop(
+                s0, a, make_body(True), (dk_s[...], dv_s[...])
+            )
+            carry = lax.fori_loop(a, b, make_body(False), carry)
+            dk, dv = lax.fori_loop(b, n_end, make_body(True), carry)
+        else:
+            dk, dv = lax.fori_loop(
+                s0, n_end, make_body(False), (dk_s[...], dv_s[...])
+            )
         dk_s[...] = dk
         dv_s[...] = dv
 
@@ -748,20 +929,27 @@ def flash_block_backward_dq(
     bk = _pick_block(s_k, _block_k(q.dtype), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
-    kc = _chunk_for(s_k, bk, d, q.dtype.itemsize)
-    n_q, n_kc = s_q // bq, s_k // kc
+    kc = (
+        _window_chunk(s_k, bk, d, q.dtype.itemsize)
+        if window is not None
+        else _chunk_for(s_k, bk, d, q.dtype.itemsize)
+    )
+    n_kc, n_kc_total = _window_chunks(s_k, kc, bq, window)
+    n_q = s_q // bq
     precision = _resolve_precision(q.dtype, precision)
 
     kernel = functools.partial(
         _bwd_dq_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,
-        causal=causal, window=window, scale=scale, precision=precision,
+        n_kc_total=n_kc_total, causal=causal, window=window,
+        scale=scale, precision=precision,
     )
     offs = jnp.stack(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
     ).astype(jnp.int32)
     qspec = pl.BlockSpec((1, bq, d), lambda hh, qi, ki, offs: (hh, qi, 0))
     kspec = pl.BlockSpec(
-        (1, kc, d), lambda hh, qi, ki, offs: (hh // group, ki, 0)
+        (1, kc, d),
+        _kv_index_map(group, bq, kc, window, n_kc, n_kc_total),
     )
     colspec = pl.BlockSpec(
         (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
@@ -803,14 +991,19 @@ def flash_block_backward_dkdv(
     bq = _pick_block(s_q, BLOCK_Q, mult)
     if bkO is None or bq is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
-    qc = _chunk_for(s_q, bq, d, q.dtype.itemsize)
-    n_k, n_qc = s_k // bkO, s_q // qc
+    qc = (
+        _window_chunk(s_q, bq, d, q.dtype.itemsize)
+        if window is not None
+        else _chunk_for(s_q, bq, d, q.dtype.itemsize)
+    )
+    n_qc, n_qc_total = _window_chunks(s_q, qc, bkO, window)
+    n_k = s_k // bkO
     precision = _resolve_precision(q.dtype, precision)
 
     kernel = functools.partial(
         _bwd_dkdv_kernel, block_k=bkO, block_q=bq, chunk_q=qc,
-        n_qc=n_qc, group=group, causal=causal, window=window,
-        scale=scale, precision=precision,
+        n_qc=n_qc, n_qc_total=n_qc_total, group=group, causal=causal,
+        window=window, scale=scale, precision=precision,
     )
     offs = jnp.stack(
         [jnp.asarray(q_off), jnp.asarray(k_off)]
@@ -819,9 +1012,22 @@ def flash_block_backward_dkdv(
     kspec = pl.BlockSpec(
         (1, bkO, d), lambda ki, hh, qi, offs: (hh // group, ki, 0)
     )
-    qcspec = pl.BlockSpec((1, qc, d), lambda ki, hh, qi, offs: (hh, qi, 0))
+    if window is None:
+        def _qchunk0(ki, offs):
+            return 0
+    else:
+        def _qchunk0(ki, offs):
+            return _live_chunk0(
+                offs[1] + ki * bkO, offs[0], qc, n_qc, n_qc_total
+            )
+
+    qcspec = pl.BlockSpec(
+        (1, qc, d),
+        lambda ki, hh, qi, offs: (hh, _qchunk0(ki, offs) + qi, 0),
+    )
     rowspec = pl.BlockSpec(
-        (1, 1, qc), lambda ki, hh, qi, offs: (hh, 0, qi)
+        (1, 1, qc),
+        lambda ki, hh, qi, offs: (hh, 0, _qchunk0(ki, offs) + qi),
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

@@ -379,6 +379,55 @@ def test_ring_attention_window_gradients_multi_chunk(eight_devices):
         flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = old
 
 
+def test_ring_attention_window_chunk_offset(eight_devices):
+    """Windowed schedules with a live span much shorter than the K/V
+    extent — the grid's streamed axis is *relative* (fewer grid chunks
+    than total chunks) and the BlockSpec index maps offset it by a
+    nonzero ``chunk0``. Guards the index-map/kernel agreement on which
+    chunk each grid step fetched; every other windowed test resolves to
+    ``n_grid == n_total`` where the offset is identically zero."""
+    comm = smi.make_communicator(2, devices=eight_devices[:2])
+    s, h, d = 256, 2, 128
+    window = 24
+    rng = np.random.RandomState(23)
+    q, k, v, w = (
+        jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+        for _ in range(4)
+    )
+    old = flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET
+    try:
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = (
+            16, 8, 1 << 20
+        )
+        # precondition: the relative axis is genuinely shorter than the
+        # extent, so chunk0 takes nonzero values (the point of the test)
+        per_rank = s // 2
+        kc = flash._window_chunk(per_rank, 8, d, 4)
+        n_kc, n_total = flash._window_chunks(per_rank, kc, 16, window)
+        assert n_kc < n_total, (n_kc, n_total)
+        fn_f = ra.make_ring_attention_fn(
+            comm, causal=True, window=window,
+            use_flash=True, interpret=True,
+        )
+        fn_j = ra.make_ring_attention_fn(
+            comm, causal=True, window=window, use_flash=False
+        )
+        out_f = np.asarray(fn_f(q, k, v))
+        ref = ra.reference_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(out_f, ref, rtol=2e-5, atol=2e-5)
+        gf = jax.grad(lambda q, k, v: jnp.sum(fn_f(q, k, v) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+        gj = jax.grad(lambda q, k, v: jnp.sum(fn_j(q, k, v) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gj, ("dq", "dk", "dv")):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5,
+                err_msg=name,
+            )
+    finally:
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = old
+
+
 def test_ring_attention_window_requires_causal(eight_devices):
     comm = smi.make_communicator(1, devices=eight_devices[:1])
     q, k, v = _qkv(16, 2, 128)
