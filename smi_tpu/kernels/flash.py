@@ -16,17 +16,18 @@ pipelined compute (``examples/kernels/stencil_smi.cl:236-386``): the
 ppermute moves the next K/V block while this kernel consumes the
 current one.
 
-Schedule: the forward grid is ``(H, n_q, n_kc)`` over key *chunks*
-(``CHUNK_K`` rows at head_dim 128, scaled by dtype and head width to
-fit double-buffered VMEM); each grid step runs a VMEM-resident
-``fori_loop`` over ``BLOCK_K``-wide key sub-tiles, so per-step dispatch
-overhead amortizes over many MXU tiles. The online-softmax state is a
-value carry of the inner loop and a VMEM scratch carry across chunks.
-Causality — and the optional sliding ``window`` — are enforced at both
-levels from global positions: fully-masked chunks are skipped by
-``pl.when`` and the inner trip count is clipped from both ends, so the
-causal schedule does ~half the dense work and the windowed schedule
-scales with ``S * window``.
+Schedule: the forward grid is ``(H, n_q, n_kc)``, one BLOCK_K-wide
+K/V tile per grid step (streamed double-buffered), with the
+online-softmax state held in VMEM scratch as *lane-wide* ``(bq, 128)``
+registers — all lanes equal — so every broadcast against a score tile
+is a whole-register replication rather than a 1-lane relayout (the
+relayouts were worth ~20% at S=8192 bf16). Causality — and the optional
+sliding ``window`` — are enforced per tile from global positions:
+fully-masked tiles are skipped by ``pl.when``, fully-live tiles take a
+maskless body, and only the diagonal/window-edge tiles pay the
+iota/select cost; the causal schedule does ~half the dense work and the
+windowed schedule scales with ``S * window`` (its grid visits only the
+live span, so dead tiles are never even fetched).
 
 Layouts are head-major — ``q``/``k``/``v``/``acc`` as ``(H, S, D)``,
 ``m``/``l`` as ``(H, S, 1)`` — so every tile the kernel touches has a
@@ -57,13 +58,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+#: register lane width — softmax statistics are kept this wide
+LANES = 128
 
 #: query tile rows (per grid step)
 BLOCK_Q = 512
-#: key sub-tile columns (per inner-loop iteration). bf16 sustains a
-#: wider tile profitably (v5e sweep at S=8192 causal: 1024-wide keys
-#: lift the forward from ~54 to ~95 TFLOP/s and fwd+bwd from ~19 to
-#: ~89); f32 measured fractionally *slower* at 1024, so it keeps 512.
+#: key tile columns: the forward's whole per-grid-step tile width, and
+#: the backward kernels' inner-loop sub-tile. bf16 sustains a wider
+#: tile profitably (v5e sweeps, S=8192 causal); f32 measured
+#: fractionally *slower* at 1024, so it keeps 512.
 BLOCK_K = 512
 BLOCK_K_BF16 = 1024
 #: VMEM budget for a K/V chunk pair. Empirical Mosaic limit (v5e,
@@ -216,48 +219,69 @@ def flash_supported(s_q: int, s_k: int, d: int, dtype) -> bool:
     )
 
 
-def _flash_kernel(
-    offs_ref,   # scalar prefetch: [q_off, k_off] global block positions
-    q_ref,      # (1, bq, D) query tile, head h
-    k_ref,      # (1, kc, D) key chunk
-    v_ref,      # (1, kc, D) value chunk
-    m_in_ref,   # (1, bq, 1) carried running row-max, head h
-    l_in_ref,   # (1, bq, 1) carried normalizer
-    acc_in_ref,  # (1, bq, D) carried weighted value sum
-    m_out_ref,  # (1, bq, 1)
-    l_out_ref,  # (1, bq, 1)
-    acc_out_ref,  # (1, bq, D)
-    m_s,        # scratch (bq, 1)
-    l_s,        # scratch (bq, 1)
-    acc_s,      # scratch (bq, D)
-    *,
-    block_q: int,
-    block_k: int,
-    chunk_k: int,
-    n_kc: int,
-    n_kc_total: int,
-    causal: bool,
-    window,
-    scale: float,
-    precision,
-):
-    qi = pl.program_id(1)
-    kci = pl.program_id(2)
-    bq, bk, kc = block_q, block_k, chunk_k
-    n_sub = kc // bk
+def _lane_full(x, n: int):
+    """Broadcast a lane-wide ``(bq, LANES)`` all-equal-lanes register to
+    ``n`` columns: whole-register replication when ``n`` is a multiple
+    of LANES (cheap on the VPU), else a ``(bq, 1)`` slice left to numpy
+    broadcasting (small-test shapes only)."""
+    if n % LANES == 0:
+        return jnp.tile(x, (1, n // LANES))
+    return x[:, :1]
 
-    @pl.when(kci == 0)
-    def _load_carry():
-        m_s[...] = m_in_ref[0]
-        l_s[...] = l_in_ref[0]
-        acc_s[...] = acc_in_ref[0]
 
-    # Global positions of this tile's rows and of the chunk's first
-    # column; chunks wholly inside the causal future — or, with a
-    # sliding window, wholly before any row's window — are skipped.
-    # With a window the grid's chunk axis is relative: it covers only
-    # the n_kc chunks that can intersect this tile's live span, offset
-    # by chunk0 (must match the BlockSpec index map).
+def _attend_tile(q_ref, k_ref, v_ref, m_s, l_s, acc_s, q_first, c_first,
+                 *, kc, d, window, scale, precision, apply_mask):
+    """Fold ONE ``(bq, kc)`` score tile into the lane-wide online-softmax
+    state — the straight-line body both forward kernels dispatch to.
+
+    The statistics live as ``(bq, LANES)`` registers whose lanes are all
+    equal, so every broadcast against the score tile is a whole-register
+    replication; keeping them as ``(bq, 1)`` columns instead (the
+    pre-r2 design) forced a 1-lane relayout per use, which measured as
+    the gap between ~100 and ~120 TFLOP/s at S=8192 bf16 — the same gap
+    hand-tuned stock closes with its MIN_BLOCK_SIZE-wide m/l."""
+    q = q_ref[0]
+    kb = k_ref[0]
+    s = lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())),
+        precision=precision, preferred_element_type=jnp.float32,
+    ) * scale  # (bq, kc)
+    bq = s.shape[0]
+    if apply_mask:
+        q_pos = q_first + lax.broadcasted_iota(jnp.int32, (bq, kc), 0)
+        k_pos = c_first + lax.broadcasted_iota(jnp.int32, (bq, kc), 1)
+        masked = k_pos > q_pos
+        if window is not None:
+            masked |= k_pos < q_pos - (window - 1)
+        s = jnp.where(masked, NEG_INF, s)
+    m_prev = m_s[...]
+    l_prev = l_s[...]
+    # exp(-1e30 - -1e30) = 1 for still-all-masked rows: transient
+    # garbage, zeroed by the alpha correction once a live key lands
+    # (the jnp path's semantics)
+    m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+    p = jnp.exp(s - _lane_full(m_next, kc))
+    alpha = jnp.exp(m_prev - m_next)
+    l_s[...] = l_prev * alpha + jnp.sum(p, axis=1)[:, None]
+    m_s[...] = m_next
+    vb = v_ref[0]
+    # match V's dtype for the MXU (free for f32; for bf16 inputs
+    # p ∈ [0,1] rounds at ~2^-8, the bf16 tier's noise)
+    acc_s[...] = acc_s[...] * _lane_full(alpha, d) + lax.dot_general(
+        p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        precision=precision, preferred_element_type=jnp.float32,
+    )
+
+
+def _tile_positions(offs_ref, qi, kci, *, bq, kc, n_kc, n_kc_total,
+                    causal, window):
+    """(q_first, c_first, live, unmasked) of one forward grid step.
+
+    ``live``: the tile intersects the causal past and (with a window)
+    some row's window — dead tiles skip compute via ``pl.when``.
+    ``unmasked``: every (row, col) pair is live, so the iota/select
+    masking can be skipped entirely — true for all but the one or two
+    diagonal-crossing tiles and the trailing window edge."""
     q_first = offs_ref[0] + qi * bq
     if window is not None:
         chunk0 = _live_chunk0(
@@ -269,115 +293,86 @@ def _flash_kernel(
     live = (not causal) or (c_first <= q_first + bq - 1)
     if window is not None:
         live &= c_first + kc - 1 >= q_first - (window - 1)
+    if causal:
+        unmasked = c_first + kc - 1 <= q_first
+        if window is not None:
+            unmasked &= c_first >= q_first + bq - window
+    else:
+        unmasked = True
+    return q_first, c_first, live, unmasked
 
-    @pl.when(live)
-    def _attend():
-        m, l, acc = _chunk_sweep(
-            q_ref, k_ref, v_ref, m_s[...], l_s[...], acc_s[...],
-            q_first, c_first, bq=bq, bk=bk, n_sub=n_sub, causal=causal,
-            window=window, scale=scale, precision=precision,
+
+def _dispatch_tile(live, unmasked, causal, attend):
+    """Run ``attend(apply_mask)`` under ``pl.when``: fully-live tiles
+    take the maskless body; only diagonal / window-edge tiles pay the
+    iota/select cost (shared by both forward kernels)."""
+    if causal:
+        @pl.when(live & jnp.logical_not(unmasked))
+        def _masked():
+            attend(True)
+
+        @pl.when(live & unmasked)
+        def _unmasked():
+            attend(False)
+    else:
+        @pl.when(live)
+        def _all():
+            attend(False)
+
+
+def _flash_kernel(
+    offs_ref,   # scalar prefetch: [q_off, k_off] global block positions
+    q_ref,      # (1, bq, D) query tile, head h
+    k_ref,      # (1, kc, D) key tile
+    v_ref,      # (1, kc, D) value tile
+    m_in_ref,   # (1, bq, 1) carried running row-max, head h
+    l_in_ref,   # (1, bq, 1) carried normalizer
+    acc_in_ref,  # (1, bq, D) carried weighted value sum
+    m_out_ref,  # (1, bq, 1)
+    l_out_ref,  # (1, bq, 1)
+    acc_out_ref,  # (1, bq, D)
+    m_s,        # scratch (bq, LANES) — lane-wide, all lanes equal
+    l_s,        # scratch (bq, LANES)
+    acc_s,      # scratch (bq, D)
+    *,
+    block_q: int,
+    chunk_k: int,
+    n_kc: int,
+    n_kc_total: int,
+    causal: bool,
+    window,
+    scale: float,
+    precision,
+):
+    qi = pl.program_id(1)
+    kci = pl.program_id(2)
+    bq, kc = block_q, chunk_k
+
+    @pl.when(kci == 0)
+    def _load_carry():
+        m_s[...] = jnp.tile(m_in_ref[0], (1, LANES))
+        l_s[...] = jnp.tile(l_in_ref[0], (1, LANES))
+        acc_s[...] = acc_in_ref[0]
+
+    q_first, c_first, live, unmasked = _tile_positions(
+        offs_ref, qi, kci, bq=bq, kc=kc, n_kc=n_kc,
+        n_kc_total=n_kc_total, causal=causal, window=window,
+    )
+
+    def attend(apply_mask):
+        _attend_tile(
+            q_ref, k_ref, v_ref, m_s, l_s, acc_s, q_first, c_first,
+            kc=kc, d=acc_s.shape[-1], window=window, scale=scale,
+            precision=precision, apply_mask=apply_mask,
         )
-        m_s[...] = m
-        l_s[...] = l
-        acc_s[...] = acc
+
+    _dispatch_tile(live, unmasked, causal, attend)
 
     @pl.when(kci == n_kc - 1)
     def _store_carry():
-        m_out_ref[0] = m_s[...]
-        l_out_ref[0] = l_s[...]
+        m_out_ref[0] = m_s[:, :1]
+        l_out_ref[0] = l_s[:, :1]
         acc_out_ref[0] = acc_s[...]
-
-
-def _chunk_sweep(q_ref, k_ref, v_ref, m0, l0, acc0, q_first, c_first,
-                 *, bq, bk, n_sub, causal, window, scale, precision):
-    """Fold one K/V chunk's live sub-tiles into the online-softmax state
-    (the shared inner loop of the carried and fused forward kernels)."""
-    q = q_ref[0]
-    if causal:
-        # sub-tiles past the diagonal contribute nothing: clip the
-        # trip count to the last live one
-        n_live = jnp.minimum(
-            (q_first + bq - 1 - c_first) // bk + 1, n_sub
-        )
-    else:
-        n_live = n_sub
-    if window is not None:
-        # first sub-tile overlapping the earliest row's window
-        s0 = jnp.maximum(
-            (q_first - (window - 1) - c_first) // bk, 0
-        )
-    else:
-        s0 = 0
-
-    def make_body(apply_mask: bool):
-        def body(ki, carry):
-            m, l, acc = carry
-            kb = k_ref[0, pl.ds(ki * bk, bk), :]
-            scores = lax.dot_general(
-                q, kb, (((1,), (1,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            ) * scale  # (bq, bk)
-            if apply_mask:
-                k_first = c_first + ki * bk
-                q_pos = q_first + lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 0
-                )
-                k_pos = k_first + lax.broadcasted_iota(
-                    jnp.int32, (bq, bk), 1
-                )
-                masked = k_pos > q_pos
-                if window is not None:
-                    masked |= k_pos < q_pos - (window - 1)
-                scores = jnp.where(masked, NEG_INF, scores)
-            m_new = jnp.maximum(m, scores.max(axis=1, keepdims=True))
-            # exp(-1e30 - -1e30) = 1 for still-all-masked rows:
-            # transient garbage, zeroed by this same correction once a
-            # live key lands (the jnp path's semantics)
-            correction = jnp.exp(m - m_new)
-            p = jnp.exp(scores - m_new)
-            l = l * correction + p.sum(axis=1, keepdims=True)
-            vb = v_ref[0, pl.ds(ki * bk, bk), :]
-            # match V's dtype for the MXU (free for f32; for bf16
-            # inputs p ∈ [0,1] rounds at ~2^-8, the bf16 tier's noise)
-            acc = acc * correction + lax.dot_general(
-                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-                precision=precision, preferred_element_type=jnp.float32,
-            )
-            return m_new, l, acc
-
-        return body
-
-    if causal:
-        # Static loop phases instead of per-tile masking: a sub-tile
-        # whose last key is at or before the tile's first query row can
-        # never be causally masked, and one whose first key is within
-        # the earliest row's window needs no window mask — so only the
-        # diagonal tiles and the trailing window edge pay the
-        # iota/select cost. (A per-iteration lax.cond here measured
-        # ~40% *slower* — Mosaic pipelines poorly around in-loop
-        # branches — but fori_loops with static bodies keep the
-        # pipelines clean.) Phases: [s0, a) window-edge masked,
-        # [a, b) unmasked interior, [b, n_live) diagonal masked.
-        n_unmasked = jnp.clip(
-            (q_first - c_first - bk + 1) // bk + 1, 0, n_live
-        )
-        if window is None:
-            b = jnp.maximum(s0, n_unmasked)
-            carry = lax.fori_loop(
-                s0, b, make_body(False), (m0, l0, acc0)
-            )
-            return lax.fori_loop(b, n_live, make_body(True), carry)
-        # first sub-tile whose every key is inside every row's window:
-        # k_first >= (q_first + bq - 1) - (window - 1)  (ceil division)
-        a = jnp.clip(
-            (q_first + bq - window - c_first + bk - 1) // bk, s0, n_live
-        )
-        b = jnp.clip(n_unmasked, a, n_live)
-        carry = lax.fori_loop(s0, a, make_body(True), (m0, l0, acc0))
-        carry = lax.fori_loop(a, b, make_body(False), carry)
-        return lax.fori_loop(b, n_live, make_body(True), carry)
-
-    return lax.fori_loop(s0, n_live, make_body(causal), (m0, l0, acc0))
 
 
 def _flash_fused_kernel(
@@ -391,7 +386,6 @@ def _flash_fused_kernel(
     m_s, l_s, acc_s,
     *,
     block_q: int,
-    block_k: int,
     chunk_k: int,
     n_kc: int,
     n_kc_total: int,
@@ -413,45 +407,43 @@ def _flash_fused_kernel(
     """
     qi = pl.program_id(1)
     kci = pl.program_id(2)
-    bq, bk, kc = block_q, block_k, chunk_k
-    n_sub = kc // bk
+    bq, kc = block_q, chunk_k
 
     @pl.when(kci == 0)
     def _init():
-        m_s[...] = jnp.full((bq, 1), NEG_INF, jnp.float32)
-        l_s[...] = jnp.zeros((bq, 1), jnp.float32)
+        m_s[...] = jnp.full((bq, LANES), NEG_INF, jnp.float32)
+        l_s[...] = jnp.zeros((bq, LANES), jnp.float32)
         acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
 
-    q_first = offs_ref[0] + qi * bq
-    if window is not None:
-        chunk0 = _live_chunk0(
-            q_first - (window - 1), offs_ref[1], kc, n_kc, n_kc_total
-        )
-    else:
-        chunk0 = 0
-    c_first = offs_ref[1] + (chunk0 + kci) * kc
-    live = (not causal) or (c_first <= q_first + bq - 1)
-    if window is not None:
-        live &= c_first + kc - 1 >= q_first - (window - 1)
+    q_first, c_first, live, unmasked = _tile_positions(
+        offs_ref, qi, kci, bq=bq, kc=kc, n_kc=n_kc,
+        n_kc_total=n_kc_total, causal=causal, window=window,
+    )
 
-    @pl.when(live)
-    def _attend():
-        m, l, acc = _chunk_sweep(
-            q_ref, k_ref, v_ref, m_s[...], l_s[...], acc_s[...],
-            q_first, c_first, bq=bq, bk=bk, n_sub=n_sub, causal=causal,
-            window=window, scale=scale, precision=precision,
+    def attend(apply_mask):
+        _attend_tile(
+            q_ref, k_ref, v_ref, m_s, l_s, acc_s, q_first, c_first,
+            kc=kc, d=acc_s.shape[-1], window=window, scale=scale,
+            precision=precision, apply_mask=apply_mask,
         )
-        m_s[...] = m
-        l_s[...] = l
-        acc_s[...] = acc
+
+    _dispatch_tile(live, unmasked, causal, attend)
 
     @pl.when(kci == n_kc - 1)
     def _finalize():
         l = l_s[...]
         safe_l = jnp.where(l == 0.0, 1.0, l)
-        out_ref[0] = (acc_s[...] / safe_l).astype(out_ref.dtype)
-        m_out_ref[0] = m_s[...]
-        l_out_ref[0] = l
+        d = acc_s.shape[-1]
+        out_ref[0] = (acc_s[...] / _lane_full(safe_l, d)).astype(
+            out_ref.dtype
+        )
+        m_out_ref[0] = m_s[:, :1]
+        l_out_ref[0] = l[:, :1]
+
+
+_FWD_DIM_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary"),
+)
 
 
 def flash_attend_fused(
@@ -481,19 +473,17 @@ def flash_attend_fused(
     bk = _pick_block(s_k, _block_k(q.dtype), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
-    # windowed: stream small chunks and visit only the live span (see
-    # _window_chunks) — dead chunks are never fetched
-    kc = (
-        _window_chunk(s_k, bk, d, q.dtype.itemsize)
-        if window is not None
-        else _chunk_for(s_k, bk, d, q.dtype.itemsize)
-    )
+    # one block-sized K/V tile per grid step (streamed double-buffered;
+    # a v5e sweep showed no gain from larger resident chunks once the
+    # softmax state is lane-wide); with a window the grid visits only
+    # the live span (_window_chunks) so dead tiles are never fetched
+    kc = bk
     n_kc, n_kc_total = _window_chunks(s_k, kc, bq, window)
     n_q = s_q // bq
     precision = _resolve_precision(q.dtype, precision)
 
     kernel = functools.partial(
-        _flash_fused_kernel, block_q=bq, block_k=bk, chunk_k=kc,
+        _flash_fused_kernel, block_q=bq, chunk_k=kc,
         n_kc=n_kc, n_kc_total=n_kc_total, causal=causal, window=window,
         scale=scale, precision=precision,
     )
@@ -514,8 +504,8 @@ def flash_attend_fused(
         in_specs=[qspec, kspec, kspec],
         out_specs=[qspec, colspec, colspec],
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
     )
@@ -527,6 +517,7 @@ def flash_attend_fused(
             jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
             jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
         ],
+        compiler_params=_FWD_DIM_SEMANTICS,
         interpret=interpret,
     )(offs, q, k, v)
 
@@ -555,7 +546,7 @@ def flash_block_attend(
     read the same K/V head tile (the index map divides, no repeat is
     materialized). ``window`` (requires ``causal``) restricts each row
     to its ``window`` most recent positions (sliding-window attention);
-    out-of-window chunks are skipped entirely.
+    out-of-window tiles are skipped entirely.
     """
     _validate_window(causal, window)
     h, s_q, d = q.shape
@@ -566,17 +557,13 @@ def flash_block_attend(
     bk = _pick_block(s_k, _block_k(q.dtype), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
-    kc = (
-        _window_chunk(s_k, bk, d, q.dtype.itemsize)
-        if window is not None
-        else _chunk_for(s_k, bk, d, q.dtype.itemsize)
-    )
+    kc = bk
     n_kc, n_kc_total = _window_chunks(s_k, kc, bq, window)
     n_q = s_q // bq
     precision = _resolve_precision(q.dtype, precision)
 
     kernel = functools.partial(
-        _flash_kernel, block_q=bq, block_k=bk, chunk_k=kc, n_kc=n_kc,
+        _flash_kernel, block_q=bq, chunk_k=kc, n_kc=n_kc,
         n_kc_total=n_kc_total, causal=causal, window=window,
         scale=scale, precision=precision,
     )
@@ -597,8 +584,8 @@ def flash_block_attend(
         in_specs=[qspec, kspec, kspec, colspec, colspec, qspec],
         out_specs=[colspec, colspec, qspec],
         scratch_shapes=[
-            pltpu.VMEM((bq, 1), jnp.float32),
-            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
     )
@@ -610,6 +597,7 @@ def flash_block_attend(
             jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
             jax.ShapeDtypeStruct((h, s_q, d), jnp.float32),
         ],
+        compiler_params=_FWD_DIM_SEMANTICS,
         interpret=interpret,
     )(offs, q, k, v, m, l, acc)
 
@@ -965,6 +953,7 @@ def flash_block_backward_dq(
         kernel,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((h, s_q, d), jnp.float32)],
+        compiler_params=_FWD_DIM_SEMANTICS,
         interpret=interpret,
     )(offs, q, k, v, dout, m, linv, delta)[0]
 
@@ -1046,6 +1035,9 @@ def flash_block_backward_dkdv(
             jax.ShapeDtypeStruct((h_kv, s_k, d), jnp.float32),
             jax.ShapeDtypeStruct((h_kv, s_k, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
         interpret=interpret,
     )(offs, k, v, q, dout, m_row, linv_row, delta_row)
     return dk, dv
