@@ -2,11 +2,12 @@
 
 The regular suite exercises the flash forward/dq/dkdv kernels in
 interpret mode on the CPU fake mesh (``tests/test_flash.py``); the
-compiled path — including the (bq, 1) column-layout and (1, 1, qc)
-row-layout statistics blocks, the most layout-sensitive pieces — only
-exists on hardware. These tests run the same checks compiled on the one
-real chip; they skip automatically on CPU-only runners. (ADVICE round 1,
-item 1.)
+compiled path — the forward's lane-wide (bq, 128) statistics scratch,
+the backward's (bq, 1) column / (1, 1, qc) row statistics blocks, and
+the windowed relative chunk axis, the most layout-sensitive pieces —
+only exists on hardware. These tests run the same checks compiled on
+the one real chip; they skip automatically on CPU-only runners.
+(ADVICE round 1, item 1.)
 
 Run manually on the TPU host:
 ``SMI_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_flash_tpu.py``
@@ -78,6 +79,47 @@ def test_compiled_forward_and_backward(tpu, dtype_name, h, h_kv):
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             rtol=gtol, atol=gtol, err_msg=f"d{name}",
+        )
+
+
+def test_compiled_window_chunk_offset(tpu):
+    """Windowed schedules whose live span is far shorter than the K/V
+    extent, compiled: the streamed grid axis is relative and the
+    BlockSpec index maps offset it by a nonzero chunk0 (f32, S=4096,
+    window=512: the grid visits 2 of 4 total chunks). The
+    Mosaic-compiled twin of
+    tests/test_flash.py::test_ring_attention_window_chunk_offset."""
+    import jax.numpy as jnp
+    import smi_tpu as smi
+    from smi_tpu.kernels import flash
+    from smi_tpu.models import ring_attention as ra
+    comm = smi.make_communicator(1, devices=[tpu])
+    s, h, d, w = 4096, 2, 128, 512
+    chunk = flash._window_chunk(s, flash.BLOCK_K, d, 4)
+    n_grid, n_total = flash._window_chunks(s, chunk, flash.BLOCK_Q, w)
+    assert n_grid < n_total, (n_grid, n_total)  # nonzero chunk0
+    rng = np.random.RandomState(2)
+    q, k, v, wt = (
+        jnp.asarray(rng.randn(s, h, d), jnp.float32) for _ in range(4)
+    )
+    fn_f = ra.make_ring_attention_fn(
+        comm, causal=True, use_flash=True, interpret=False, window=w
+    )
+    fn_j = ra.make_ring_attention_fn(
+        comm, causal=True, use_flash=False, window=w
+    )
+    out_f = np.asarray(fn_f(q, k, v))
+    out_j = np.asarray(fn_j(q, k, v))
+    np.testing.assert_allclose(out_f, out_j, rtol=2e-4, atol=2e-4)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(fn_f(q, k, v) * wt),
+                  argnums=(0, 1, 2))(q, k, v)
+    gj = jax.grad(lambda q, k, v: jnp.sum(fn_j(q, k, v) * wt),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gj, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4,
+            err_msg=name,
         )
 
 
