@@ -70,6 +70,7 @@ from smi_tpu.ops.serialization import (
 from smi_tpu.parallel.mesh import (
     Communicator,
     make_communicator,
+    make_hybrid_communicator,
     mesh_from_topology,
 )
 from smi_tpu.parallel.channels import P2PChannel, stream_concurrent
@@ -102,6 +103,7 @@ __all__ = [
     "parse_topology_file",
     "Communicator",
     "make_communicator",
+    "make_hybrid_communicator",
     "mesh_from_topology",
     "P2PChannel",
     "stream_concurrent",

@@ -174,6 +174,58 @@ def allreduce(x: jax.Array, comm: Communicator,
                   program=program)
 
 
+def allreduce_hierarchical(x: jax.Array, comm: Communicator,
+                           op: Union[str, SmiOp] = SmiOp.ADD,
+                           inner: Optional[str] = None,
+                           outer: Optional[str] = None) -> jax.Array:
+    """Two-tier allreduce for hybrid (slice × in-slice) communicators.
+
+    Reference parity: SMI's router keeps traffic inside a node when it
+    can — intra-node links cost 1, inter-node QSFP routes cost 100
+    (``codegen/program.py:7-8``) — so a reduction crosses the expensive
+    tier once with already-combined data. The TPU rendition for a
+    ``make_hybrid_communicator`` mesh: reduce-scatter over the ICI
+    axis, reduce the shards across slices over DCN (each shard crosses
+    the slow tier exactly once, at 1/per_slice the full volume per
+    link), then all-gather back over ICI. MAX/MIN have no scatter
+    form, so they run the two psum-tier stages directly.
+
+    ``x``'s leading dimension must be divisible by the inner axis size
+    for the ADD path. Defaults take the communicator's axes as
+    ``(outer, inner)``.
+    """
+    if len(comm.axis_names) != 2 and (inner is None or outer is None):
+        raise ValueError(
+            "hierarchical allreduce needs a 2-axis communicator or "
+            "explicit inner=/outer= axis names"
+        )
+    outer = outer if outer is not None else comm.axis_names[0]
+    inner = inner if inner is not None else comm.axis_names[1]
+    if inner == outer:
+        raise ValueError(
+            f"inner and outer tiers must be distinct axes, got "
+            f"{inner!r} for both"
+        )
+    for name in (inner, outer):
+        if name not in comm.mesh.axis_names:
+            raise ValueError(
+                f"axis {name!r} not in mesh axes {comm.mesh.axis_names}"
+            )
+    op = SmiOp(op)
+    if op is not SmiOp.ADD:
+        fn = lax.pmax if op is SmiOp.MAX else lax.pmin
+        return fn(fn(x, inner), outer)
+    inner_size = comm.mesh.shape[inner]
+    if x.shape[0] % inner_size != 0:
+        raise ValueError(
+            f"leading dim {x.shape[0]} not divisible by inner axis "
+            f"size {inner_size}"
+        )
+    shard = lax.psum_scatter(x, inner, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, outer)
+    return lax.all_gather(shard, inner, axis=0, tiled=True)
+
+
 def scatter(x: jax.Array, comm: Communicator, root: int = 0,
             port: Optional[int] = None, backend: str = "xla",
             program=None) -> jax.Array:

@@ -146,6 +146,80 @@ def make_communicator(
     return Communicator(mesh=mesh, axis_names=tuple(axis_names))
 
 
+def make_hybrid_communicator(
+    n_slices: Optional[int] = None,
+    per_slice: Optional[int] = None,
+    axis_names: Sequence[str] = ("dcn", "ici"),
+    devices=None,
+) -> Communicator:
+    """Two-tier communicator: outer axis across slices, inner within.
+
+    Reference parity: the SMI network is two-tier — FPGAs grouped per
+    node (``SMI_DEVICES_PER_NODE=2``, ``CMakeLists.txt:10``) with
+    intra-node links costed 1 and inter-node QSFP routes costed 100
+    (``codegen/program.py:7-8``), so the router prefers staying inside
+    a node. The TPU analog is a multi-slice system: fast ICI inside a
+    slice, DCN across slices. This builds a ``(n_slices, per_slice)``
+    mesh whose OUTER axis is the slow tier, so collectives over
+    ``axis_names[1]`` ride ICI and only the cross-slice stage touches
+    DCN (see ``collectives.allreduce_hierarchical``).
+
+    On a real multi-slice platform the grouping follows each device's
+    reported ``slice_index``; on single-slice or CPU (the emulator
+    tier) the flat device list is split evenly into ``n_slices``
+    groups, which keeps rank order identical across tiers.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if len(axis_names) != 2:
+        raise ValueError(f"need (outer, inner) axis names, got {axis_names}")
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", None) or 0,
+                            []).append(d)
+    if len(by_slice) > 1:
+        groups = [by_slice[k] for k in sorted(by_slice)]
+        sizes = {len(g) for g in groups}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"uneven slices: {sorted(len(g) for g in groups)}"
+            )
+        if n_slices is not None and n_slices != len(groups):
+            raise ValueError(
+                f"n_slices={n_slices} but platform reports {len(groups)}"
+            )
+        if per_slice is not None and per_slice != len(groups[0]):
+            raise ValueError(
+                f"per_slice={per_slice} but slices have {len(groups[0])}"
+            )
+    else:
+        if n_slices is None:
+            raise ValueError(
+                "single-slice platform: pass n_slices to split the "
+                "device list into virtual slices"
+            )
+        flat = list(devices)
+        if per_slice is None:
+            if len(flat) % n_slices:
+                raise ValueError(
+                    f"{len(flat)} devices do not split into "
+                    f"{n_slices} slices"
+                )
+            per_slice = len(flat) // n_slices
+        if n_slices * per_slice > len(flat):
+            raise ValueError(
+                f"need {n_slices * per_slice} devices, have {len(flat)}"
+            )
+        flat = flat[: n_slices * per_slice]
+        groups = [
+            flat[i * per_slice : (i + 1) * per_slice]
+            for i in range(n_slices)
+        ]
+    dev_array = np.array(groups)
+    mesh = Mesh(dev_array, tuple(axis_names))
+    return Communicator(mesh=mesh, axis_names=tuple(axis_names))
+
+
 def mesh_from_topology(topology: Topology, devices=None) -> Communicator:
     """Build a communicator whose rank order follows a topology file.
 
