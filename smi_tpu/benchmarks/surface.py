@@ -212,28 +212,33 @@ def flash_train_point(comm, quick: bool = False):
 
 
 def longcontext_points(comm, quick: bool = False):
-    """The long-context claim, measured: 32k tokens on one chip, full
-    causal and sliding-window (compute scaling with S·window)."""
+    """The long-context claim, measured: 32k and 64k tokens on one
+    chip — full causal at 32k, sliding-window forward and training
+    (compute scaling with S·window) at both lengths."""
+    import jax
+
     import jax.numpy as jnp
 
     from smi_tpu.models import ring_attention as ra
 
     if quick:
         return []
-    s, h, d = 32768, 8, 128
+    h, d, w = 8, 128, 4096
     out = []
-    for window in (None, 4096):
+    for s, window in (
+        (32768, None), (32768, w), (65536, w),
+    ):
         rng = np.random.RandomState(0)
         q, k, v = (
             jnp.asarray(rng.randn(s, h, d), jnp.bfloat16) for _ in range(3)
         )
 
-        def make_fn(r, _w=window):
+        def make_fn(r, _w=window, _q=q, _k=k, _v=v):
             fn = ra.make_ring_attention_fn(
                 comm, causal=True, use_flash=True, reps=r, window=_w,
             )
             return lambda: np.asarray(
-                jnp.sum(fn(q, k, v).astype(jnp.float32)))
+                jnp.sum(fn(_q, _k, _v).astype(jnp.float32)))
 
         # full causal: S²/2 live area; windowed: ~S·window
         if window is None:
@@ -250,31 +255,35 @@ def longcontext_points(comm, quick: bool = False):
         ))
 
     # long-context *training*: fwd+bwd through the custom VJP with the
-    # sliding window — the claim that 32k-token training fits one chip
-    import jax
+    # sliding window — 32k- and 64k-token training on one chip
+    for s in (32768, 65536):
+        rng = np.random.RandomState(0)
+        q, k, v = (
+            jnp.asarray(rng.randn(s, h, d), jnp.bfloat16) for _ in range(3)
+        )
 
-    w = 4096
-    rng = np.random.RandomState(0)
-    q, k, v = (
-        jnp.asarray(rng.randn(s, h, d), jnp.bfloat16) for _ in range(3)
-    )
+        def make_train(r, _s=s, _q=q, _k=k, _v=v):
+            fn = ra.make_ring_attention_fn(
+                comm, causal=True, reps=r, window=w,
+                # 64k: per-rep grad residuals would exceed HBM
+                remat_reps=_s >= 65536,
+            )
+            grad = jax.jit(jax.grad(
+                lambda q, k, v: jnp.sum(
+                    fn(q, k, v).astype(jnp.float32) ** 2
+                ),
+                argnums=(0, 1, 2),
+            ))
+            return lambda: np.asarray(
+                jnp.sum(grad(_q, _k, _v)[0].astype(jnp.float32)))
 
-    def make_train(r):
-        fn = ra.make_ring_attention_fn(comm, causal=True, reps=r, window=w)
-        grad = jax.jit(jax.grad(
-            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
-            argnums=(0, 1, 2),
+        rate, trace = _diff_rate(make_train, s)
+        out.append(_result(
+            f"flash_attn_train_tokens_s{s}_window{w}_bf16", rate / 1e6,
+            "Mtoken/s",
+            {"S": s, "H": h, "D": d, "dtype": "bf16", "window": w,
+             "timing": trace},
         ))
-        return lambda: np.asarray(
-            jnp.sum(grad(q, k, v)[0].astype(jnp.float32)))
-
-    rate, trace = _diff_rate(make_train, s)
-    out.append(_result(
-        f"flash_attn_train_tokens_s{s}_window{w}_bf16", rate / 1e6,
-        "Mtoken/s",
-        {"S": s, "H": h, "D": d, "dtype": "bf16", "window": w,
-         "timing": trace},
-    ))
     return out
 
 

@@ -369,6 +369,7 @@ def make_ring_attention_fn(
     interpret: bool = False,
     reps: int = 1,
     window: Optional[int] = None,
+    remat_reps: bool = False,
 ):
     """Jitted sequence-parallel attention over the communicator's axis.
 
@@ -380,7 +381,11 @@ def make_ring_attention_fn(
 
     ``reps > 1`` chains that many applications inside the jit (output
     fed back as the next query) — a timing harness that amortizes
-    per-dispatch latency out of benchmark samples.
+    per-dispatch latency out of benchmark samples. ``remat_reps``
+    rematerializes each rep under differentiation: grad-of-reps
+    otherwise saves per-rep residuals (reps x the k/v footprint —
+    8 GB at S=64k/reps=64, an HBM OOM). It costs ~20% recompute, so
+    it stays off where the chain fits.
     """
     axis = comm.axis_names[0]
 
@@ -393,9 +398,11 @@ def make_ring_attention_fn(
     if reps == 1:
         shard_fn = once
     else:
+        chained = jax.checkpoint(once) if remat_reps else once
+
         def shard_fn(q, k, v):
             return lax.fori_loop(
-                0, reps, lambda _, x: once(x, k, v), q
+                0, reps, lambda _, x: chained(x, k, v), q
             )
 
     spec = P(axis)
