@@ -212,9 +212,9 @@ def flash_train_point(comm, quick: bool = False):
 
 
 def longcontext_points(comm, quick: bool = False):
-    """The long-context claim, measured: 32k and 64k tokens on one
-    chip — full causal at 32k, sliding-window forward and training
-    (compute scaling with S·window) at both lengths."""
+    """The long-context claim, measured: 32k, 64k and 128k tokens on
+    one chip — full causal at 32k, sliding-window forward and training
+    (compute scaling with S·window) at every length."""
     import jax
 
     import jax.numpy as jnp
@@ -226,7 +226,7 @@ def longcontext_points(comm, quick: bool = False):
     h, d, w = 8, 128, 4096
     out = []
     for s, window in (
-        (32768, None), (32768, w), (65536, w),
+        (32768, None), (32768, w), (65536, w), (131072, w),
     ):
         rng = np.random.RandomState(0)
         q, k, v = (
@@ -255,8 +255,8 @@ def longcontext_points(comm, quick: bool = False):
         ))
 
     # long-context *training*: fwd+bwd through the custom VJP with the
-    # sliding window — 32k- and 64k-token training on one chip
-    for s in (32768, 65536):
+    # sliding window — 32k/64k/128k-token training on one chip
+    for s in (32768, 65536, 131072):
         rng = np.random.RandomState(0)
         q, k, v = (
             jnp.asarray(rng.randn(s, h, d), jnp.bfloat16) for _ in range(3)
@@ -265,7 +265,7 @@ def longcontext_points(comm, quick: bool = False):
         def make_train(r, _s=s, _q=q, _k=k, _v=v):
             fn = ra.make_ring_attention_fn(
                 comm, causal=True, reps=r, window=w,
-                # 64k: per-rep grad residuals would exceed HBM
+                # 64k+: per-rep grad residuals would exceed HBM
                 remat_reps=_s >= 65536,
             )
             grad = jax.jit(jax.grad(
