@@ -377,7 +377,8 @@ def flash_vs_stock(comm, quick: bool = False):
 def model_train_point(comm, quick: bool = False):
     """Whole-model training throughput: the transformer block (QKV/O +
     MLP matmuls + ring attention + layernorms + SGD) in mixed precision
-    — the composition showpiece measured end-to-end."""
+    — the composition showpiece measured end-to-end, at S=8192 full
+    causal and at 32k tokens with the sliding window."""
     import jax.numpy as jnp
 
     from smi_tpu.models import transformer as tf
@@ -385,41 +386,51 @@ def model_train_point(comm, quick: bool = False):
 
     if quick:
         return []
-    s, e, h, d = 8192, 1024, 8, 128
+    e, h, d = 1024, 8, 128
     comm2 = make_communicator(
         shape=(1, 1), axis_names=("dp", "sp"),
         devices=list(comm.mesh.devices.flat)[:1],
     )
-    cfg = tf.BlockConfig(embed=e, heads=h, head_dim=d,
-                         compute_dtype="bfloat16")
-    params = tf.init_params(cfg)
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(1, s, e).astype(np.float32))
+    out = []
+    for s, window in ((8192, None), (32768, 4096)):
+        cfg = tf.BlockConfig(embed=e, heads=h, head_dim=d,
+                             compute_dtype="bfloat16", window=window)
+        params = tf.init_params(cfg)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1, s, e).astype(np.float32))
 
-    def make_fn(r):
-        step = tf.make_train_step(comm2, cfg)
+        def make_fn(r, _cfg=cfg, _params=params, _x=x):
+            step = tf.make_train_step(comm2, _cfg)
 
-        def run():
-            p, tokens = dict(params), 0
-            for _ in range(r):
-                p, loss = step(p, x, x)
-            return np.asarray(loss)
+            def run():
+                p, loss = dict(_params), None
+                for _ in range(r):
+                    p, loss = step(p, _x, _x)
+                return np.asarray(loss)
 
-        return run
+            return run
 
-    rate, trace = _diff_rate(make_fn, s)
-    # block FLOPs per token, fwd+bwd (x3): QKV (2*E*3HD) + O (2*HD*E) +
-    # MLP (2*2*ratio*E^2) + causal attention (4*S*H*D/2 per token)
-    matmul = 2 * e * 3 * h * d + 2 * h * d * e + 4 * cfg.mlp_ratio * e * e
-    attn = 4 * s * h * d / 2
-    tflops = rate * 3 * (matmul + attn) / 1e12
-    return [_result(
-        "transformer_train_tokens_bf16", rate / 1e6, "Mtoken/s",
-        {"S": s, "embed": e, "H": h, "D": d, "compute": "bf16",
-         "timing": trace},
-        {"approx_tflops": tflops,
-         "mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16},
-    )]
+        rate, trace = _diff_rate(make_fn, s)
+        # block FLOPs per token, fwd+bwd (x3): QKV (2*E*3HD) +
+        # O (2*HD*E) + MLP (2*2*ratio*E^2) + attention per token
+        # (4*S*H*D/2 causal — the exact causal average; 4*window*H*D
+        # windowed — the full-window upper bound, the same S·window
+        # convention as longcontext_points, ~7% above the causal-edge
+        # average at S=32k/W=4k)
+        matmul = (2 * e * 3 * h * d + 2 * h * d * e
+                  + 4 * cfg.mlp_ratio * e * e)
+        attn = 4 * window * h * d if window else 4 * s * h * d / 2
+        tflops = rate * 3 * (matmul + attn) / 1e12
+        tag = "" if window is None else f"_s{s}_window{window}"
+        out.append(_result(
+            f"transformer_train_tokens{tag}_bf16", rate / 1e6,
+            "Mtoken/s",
+            {"S": s, "embed": e, "H": h, "D": d, "compute": "bf16",
+             "window": window, "timing": trace},
+            {"approx_tflops": tflops,
+             "mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16},
+        ))
+    return out
 
 
 # ---------------------------------------------------------------------------
