@@ -36,6 +36,69 @@ def run_manifest(tmp_path, source, extra_args=()):
     )
 
 
+def test_manifest_never_crashes_on_garbage(tmp_path):
+    """Robustness fuzz: the scanner must terminate cleanly (no signal,
+    no hang) on arbitrary byte soup — truncated sources, pathological
+    nesting, stray quotes, NUL-free binary-ish text, unicode. Exit 0
+    with a (possibly empty) manifest or nonzero with a diagnostic are
+    both fine; dying on a signal or timing out is a bug. The reference
+    leans on Clang for this hardening (``source-rewriter``); our
+    hand-written lexer has to prove it alone."""
+    import random
+
+    rng = random.Random(1234)
+    tokens = [
+        "open_channel", "ctx.", "port=", "0", "1", "999999999999",
+        "(", ")", "[", "]", "{", "}", ":", ",", "=", ".", "@",
+        "def ", "class ", "import ", "from ", "smi_tpu", "as ",
+        "'", '"', "'''", '"""', "#", "\\", "\n", "\t", "    ",
+        "dtype=", '"float"', "lambda", "*", "**", "->", "...",
+        "é", "世", "\U0001f600",
+    ]
+    cases = []
+    for i in range(40):
+        n = rng.randint(1, 120)
+        cases.append("".join(rng.choice(tokens) for _ in range(n)))
+    # structured edge cases
+    cases += [
+        "",                                   # empty file
+        "(" * 5000,                           # deep nesting
+        "def f(:\n" * 200,                    # malformed defs
+        "ctx.open_channel(" ,                 # truncated call
+        "from smi_tpu import " ,              # truncated import
+        "x = '" ,                             # unterminated string
+        '"""' ,                               # unterminated docstring
+        "open_channel(port=" + "9" * 1000 + ")",  # huge literal
+        "\n".join("import a" for _ in range(5000)),  # many lines
+    ]
+    # raw byte soup too — truncated multibyte sequences and 0x80-0xFF
+    # noise are the likeliest crash class for a hand-written lexer
+    byte_cases = [
+        bytes([rng.randrange(256) for _ in range(rng.randint(1, 400))])
+        for _ in range(10)
+    ] + [b"\xff\xfe", b"open_channel(\x80\x81\x82)", b"\xe4\xb8"]
+    bin_path = os.path.join(NATIVE, "build", "smi-manifest")
+    for i, source in enumerate(cases + byte_cases):
+        src = tmp_path / f"fuzz_{i}.py"
+        if isinstance(source, bytes):
+            src.write_bytes(source)
+        else:
+            src.write_text(source, encoding="utf-8")
+        proc = subprocess.run(
+            [bin_path, str(src)], capture_output=True, text=True,
+            errors="replace", timeout=10,
+        )
+        assert proc.returncode >= 0, (
+            f"scanner died on signal {-proc.returncode} for case "
+            f"{i}: {source[:80]!r}"  # noqa: E501
+        )
+        if proc.returncode != 0:
+            # failures must carry a diagnostic, not die silently
+            assert proc.stderr.strip(), (
+                f"silent nonzero exit for case {i}: {source[:80]!r}"
+            )
+
+
 def test_manifest_extracts_ops(tmp_path):
     proc = run_manifest(
         tmp_path,
