@@ -225,12 +225,17 @@ def longcontext_points(comm, quick: bool = False):
         return []
     h, d, w = 8, 128, 4096
     out = []
-    for s, window in (
-        (32768, None), (32768, w), (65536, w), (131072, w),
+    # (S, window, kv_heads): kv_heads < h is grouped-query attention —
+    # the 8x smaller K/V is what carries the 256k point onto one chip
+    for s, window, h_kv in (
+        (32768, None, h), (32768, w, h), (65536, w, h), (131072, w, h),
+        (262144, w, 1),
     ):
         rng = np.random.RandomState(0)
-        q, k, v = (
-            jnp.asarray(rng.randn(s, h, d), jnp.bfloat16) for _ in range(3)
+        q = jnp.asarray(rng.randn(s, h, d), jnp.bfloat16)
+        k, v = (
+            jnp.asarray(rng.randn(s, h_kv, d), jnp.bfloat16)
+            for _ in range(2)
         )
 
         def make_fn(r, _w=window, _q=q, _k=k, _v=v):
@@ -247,19 +252,23 @@ def longcontext_points(comm, quick: bool = False):
             work = 2 * 2 * s * window * h * d
         rate, trace = _diff_rate(make_fn, work)
         tag = "causal" if window is None else f"window{window}"
+        if h_kv != h:
+            tag = f"gqa{h // h_kv}_{tag}"
         out.append(_result(
             f"flash_attn_fwd_s{s}_bf16_{tag}", rate / 1e12, "TFLOP/s",
-            {"S": s, "H": h, "D": d, "dtype": "bf16", "window": window,
-             "timing": trace},
+            {"S": s, "H": h, "D": d, "kv_heads": h_kv, "dtype": "bf16",
+             "window": window, "timing": trace},
             {"mfu_vs_bf16_peak": rate / PEAK_BF16},
         ))
 
     # long-context *training*: fwd+bwd through the custom VJP with the
-    # sliding window — 32k/64k/128k-token training on one chip
-    for s in (32768, 65536, 131072):
+    # sliding window — 32k/64k/128k MHA and 256k GQA on one chip
+    for s, h_kv in ((32768, h), (65536, h), (131072, h), (262144, 1)):
         rng = np.random.RandomState(0)
-        q, k, v = (
-            jnp.asarray(rng.randn(s, h, d), jnp.bfloat16) for _ in range(3)
+        q = jnp.asarray(rng.randn(s, h, d), jnp.bfloat16)
+        k, v = (
+            jnp.asarray(rng.randn(s, h_kv, d), jnp.bfloat16)
+            for _ in range(2)
         )
 
         def make_train(r, _s=s, _q=q, _k=k, _v=v):
@@ -278,11 +287,12 @@ def longcontext_points(comm, quick: bool = False):
                 jnp.sum(grad(_q, _k, _v)[0].astype(jnp.float32)))
 
         rate, trace = _diff_rate(make_train, s)
+        tag = "" if h_kv == h else f"_gqa{h // h_kv}"
         out.append(_result(
-            f"flash_attn_train_tokens_s{s}_window{w}_bf16", rate / 1e6,
-            "Mtoken/s",
-            {"S": s, "H": h, "D": d, "dtype": "bf16", "window": w,
-             "timing": trace},
+            f"flash_attn_train_tokens_s{s}{tag}_window{w}_bf16",
+            rate / 1e6, "Mtoken/s",
+            {"S": s, "H": h, "D": d, "kv_heads": h_kv, "dtype": "bf16",
+             "window": w, "timing": trace},
         ))
     return out
 
