@@ -212,9 +212,11 @@ def flash_train_point(comm, quick: bool = False):
 
 
 def longcontext_points(comm, quick: bool = False):
-    """The long-context claim, measured: 32k, 64k and 128k tokens on
-    one chip — full causal at 32k, sliding-window forward and training
-    (compute scaling with S·window) at every length."""
+    """The long-context claim, measured: 32k to 512k tokens on one
+    chip. Full causal at 32k; sliding-window forward at every length
+    (compute scaling with S·window, grouped-query K/V from 256k up);
+    training through the custom-VJP backward up to 256k (512k trains
+    too, but only the rep-chained timing harness no longer fits)."""
     import jax
 
     import jax.numpy as jnp
@@ -227,9 +229,12 @@ def longcontext_points(comm, quick: bool = False):
     out = []
     # (S, window, kv_heads): kv_heads < h is grouped-query attention —
     # the 8x smaller K/V is what carries the 256k point onto one chip
+    # 512k is forward-only: a single fwd+bwd step runs (verified), but
+    # the rep-chained timing harness itself needs reps x 1 GB for the
+    # chained q carry, which no longer fits beside the gradients
     for s, window, h_kv in (
         (32768, None, h), (32768, w, h), (65536, w, h), (131072, w, h),
-        (262144, w, 1),
+        (262144, w, 1), (524288, w, 1),
     ):
         rng = np.random.RandomState(0)
         q = jnp.asarray(rng.randn(s, h, d), jnp.bfloat16)
