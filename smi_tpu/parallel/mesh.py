@@ -146,6 +146,54 @@ def make_communicator(
     return Communicator(mesh=mesh, axis_names=tuple(axis_names))
 
 
+def _slice_groups(devices, n_slices, per_slice):
+    """Group devices into equal slices (pure — unit-testable with stub
+    devices). Platform-reported ``slice_index`` wins; otherwise the
+    flat list splits evenly into ``n_slices`` virtual slices."""
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", None) or 0,
+                            []).append(d)
+    if len(by_slice) > 1:
+        groups = [by_slice[k] for k in sorted(by_slice)]
+        sizes = {len(g) for g in groups}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"uneven slices: {sorted(len(g) for g in groups)}"
+            )
+        if n_slices is not None and n_slices != len(groups):
+            raise ValueError(
+                f"n_slices={n_slices} but platform reports {len(groups)}"
+            )
+        if per_slice is not None and per_slice != len(groups[0]):
+            raise ValueError(
+                f"per_slice={per_slice} but slices have {len(groups[0])}"
+            )
+        return groups
+    if n_slices is None:
+        raise ValueError(
+            "single-slice platform: pass n_slices to split the "
+            "device list into virtual slices"
+        )
+    flat = list(devices)
+    if per_slice is None:
+        if len(flat) % n_slices:
+            raise ValueError(
+                f"{len(flat)} devices do not split into "
+                f"{n_slices} slices"
+            )
+        per_slice = len(flat) // n_slices
+    if n_slices * per_slice > len(flat):
+        raise ValueError(
+            f"need {n_slices * per_slice} devices, have {len(flat)}"
+        )
+    flat = flat[: n_slices * per_slice]
+    return [
+        flat[i * per_slice : (i + 1) * per_slice]
+        for i in range(n_slices)
+    ]
+
+
 def make_hybrid_communicator(
     n_slices: Optional[int] = None,
     per_slice: Optional[int] = None,
@@ -173,48 +221,7 @@ def make_hybrid_communicator(
         devices = jax.devices()
     if len(axis_names) != 2:
         raise ValueError(f"need (outer, inner) axis names, got {axis_names}")
-    by_slice: dict = {}
-    for d in devices:
-        by_slice.setdefault(getattr(d, "slice_index", None) or 0,
-                            []).append(d)
-    if len(by_slice) > 1:
-        groups = [by_slice[k] for k in sorted(by_slice)]
-        sizes = {len(g) for g in groups}
-        if len(sizes) != 1:
-            raise ValueError(
-                f"uneven slices: {sorted(len(g) for g in groups)}"
-            )
-        if n_slices is not None and n_slices != len(groups):
-            raise ValueError(
-                f"n_slices={n_slices} but platform reports {len(groups)}"
-            )
-        if per_slice is not None and per_slice != len(groups[0]):
-            raise ValueError(
-                f"per_slice={per_slice} but slices have {len(groups[0])}"
-            )
-    else:
-        if n_slices is None:
-            raise ValueError(
-                "single-slice platform: pass n_slices to split the "
-                "device list into virtual slices"
-            )
-        flat = list(devices)
-        if per_slice is None:
-            if len(flat) % n_slices:
-                raise ValueError(
-                    f"{len(flat)} devices do not split into "
-                    f"{n_slices} slices"
-                )
-            per_slice = len(flat) // n_slices
-        if n_slices * per_slice > len(flat):
-            raise ValueError(
-                f"need {n_slices * per_slice} devices, have {len(flat)}"
-            )
-        flat = flat[: n_slices * per_slice]
-        groups = [
-            flat[i * per_slice : (i + 1) * per_slice]
-            for i in range(n_slices)
-        ]
+    groups = _slice_groups(devices, n_slices, per_slice)
     dev_array = np.array(groups)
     mesh = Mesh(dev_array, tuple(axis_names))
     return Communicator(mesh=mesh, axis_names=tuple(axis_names))

@@ -42,6 +42,44 @@ def test_hybrid_subcomm_sizes(eight_devices):
     assert comm.subcomm("dcn").size == 2
 
 
+class _StubDevice:
+    """Minimal stand-in for a multi-slice platform device."""
+
+    def __init__(self, i, slice_index):
+        self.id = i
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"d{self.id}@s{self.slice_index}"
+
+
+def test_slice_groups_platform_reported():
+    """On a real multi-slice platform the grouping follows each
+    device's slice_index, whatever the list order."""
+    from smi_tpu.parallel.mesh import _slice_groups
+
+    devs = [_StubDevice(i, slice_index=i % 2) for i in range(6)]
+    groups = _slice_groups(devs, None, None)
+    assert [len(g) for g in groups] == [3, 3]
+    assert all(d.slice_index == 0 for d in groups[0])
+    assert all(d.slice_index == 1 for d in groups[1])
+    # explicit counts must agree with the platform
+    assert _slice_groups(devs, 2, 3) == groups
+    with pytest.raises(ValueError, match="platform reports"):
+        _slice_groups(devs, 3, None)
+    with pytest.raises(ValueError, match="per_slice"):
+        _slice_groups(devs, None, 2)
+
+
+def test_slice_groups_uneven_platform_rejected():
+    from smi_tpu.parallel.mesh import _slice_groups
+
+    devs = [_StubDevice(i, slice_index=0 if i < 4 else 1)
+            for i in range(6)]
+    with pytest.raises(ValueError, match="uneven"):
+        _slice_groups(devs, None, None)
+
+
 def test_hybrid_requires_slice_count(eight_devices):
     with pytest.raises(ValueError, match="n_slices"):
         smi.make_hybrid_communicator(devices=eight_devices)
