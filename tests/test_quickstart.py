@@ -35,8 +35,10 @@ def fenced_blocks(text):
 def test_quickstart_runs_verbatim(tmp_path, eight_devices):
     blocks = fenced_blocks(open(DOC).read())
     langs = [lang for lang, _ in blocks]
-    assert langs == ["python", "bash", "python"], langs
-    app_src, build_cmds, run_src = (body for _, body in blocks)
+    assert langs == ["python", "bash", "python", "python"], langs
+    app_src, build_cmds, run_src, longctx_src = (
+        body for _, body in blocks
+    )
 
     # 1. the user program, as documented
     (tmp_path / "app.py").write_text(app_src)
@@ -76,3 +78,7 @@ def test_quickstart_runs_verbatim(tmp_path, eight_devices):
         sys.path[:] = sys_path
         for mod in ("app", "smi_generated_host"):
             sys.modules.pop(mod, None)
+
+    # 4. the long-context + hybrid-mesh script, as documented
+    exec(compile(longctx_src, "long_context.py", "exec"),
+         {"__name__": "__quickstart__"})  # noqa: S102
