@@ -379,6 +379,46 @@ def test_ring_attention_window_gradients_multi_chunk(eight_devices):
         flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = old
 
 
+def test_causal_fetch_clamp_equivalence(eight_devices):
+    """The causal dead-chunk fetch clamp (index map folds future chunks
+    onto the last live one; the kernel gates them off) must be exactly
+    output-equivalent to the plain causal schedule, including on a ring
+    (nonzero k_off, fully-dead and fully-live blocks)."""
+    rng = np.random.RandomState(29)
+    s, h, d = 256, 2, 128
+    q, k, v = (
+        jnp.asarray(rng.randn(s, h, d).astype(np.float32))
+        for _ in range(3)
+    )
+    old = flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET
+    old_min = flash.CAUSAL_CLAMP_MIN_CHUNKS
+    outs = {}
+    try:
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = (
+            16, 8, 1 << 20
+        )
+        for clamp, min_chunks in (("on", 1), ("off", 1 << 30)):
+            flash.CAUSAL_CLAMP_MIN_CHUNKS = min_chunks
+            for n in (1, 2):
+                comm = smi.make_communicator(
+                    n, devices=eight_devices[:n]
+                )
+                fn = ra.make_ring_attention_fn(
+                    comm, causal=True, use_flash=True, interpret=True
+                )
+                outs[(clamp, n)] = np.asarray(fn(q, k, v))
+    finally:
+        flash.BLOCK_Q, flash.BLOCK_K, flash.KV_CHUNK_BUDGET = old
+        flash.CAUSAL_CLAMP_MIN_CHUNKS = old_min
+    for n in (1, 2):
+        np.testing.assert_array_equal(
+            outs[("on", n)], outs[("off", n)]
+        )
+    ref = ra.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(outs[("on", 1)], ref, rtol=2e-5,
+                               atol=2e-5)
+
+
 def test_ring_attention_window_chunk_offset(eight_devices):
     """Windowed schedules with a live span much shorter than the K/V
     extent — the grid's streamed axis is *relative* (fewer grid chunks
