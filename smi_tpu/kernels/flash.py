@@ -30,9 +30,15 @@ windowed schedule scales with ``S * window`` (its grid visits only the
 live span, so dead tiles are never even fetched).
 
 Layouts are head-major — ``q``/``k``/``v``/``acc`` as ``(H, S, D)``,
-``m``/``l`` as ``(H, S, 1)`` — so every tile the kernel touches has a
-lane-tileable minor dimension and the softmax statistics are column
-vectors, avoiding in-kernel relayouts. Grouped-query attention maps
+``m``/``l`` as **row vectors** ``(H, 1, S)``. Row layout matters in
+HBM: TPU tiling pads the minor dim to 128 lanes, so an ``(H, S, 1)``
+column stat occupies ``128x`` its useful bytes — as much as the whole
+accumulator — which both inflated the saved-stats traffic of every
+fwd+bwd step and blew the 16 MB scoped-VMEM limit when XLA kept the
+ring fold's carried stats on-chip (caught by the AOT topology tier,
+``tests/test_aot_tpu.py``). Rows are compact; the kernels transpose a
+``(1, bq)`` sliver per q-tile at load/store, which is noise next to
+the tile matmuls. Grouped-query attention maps
 query head ``hh`` to K/V head ``hh // group`` in the index maps, so
 the smaller K/V are never repeated in memory.
 
@@ -370,11 +376,11 @@ def _flash_kernel(
     q_ref,      # (1, bq, D) query tile, head h
     k_ref,      # (1, kc, D) key tile
     v_ref,      # (1, kc, D) value tile
-    m_in_ref,   # (1, bq, 1) carried running row-max, head h
-    l_in_ref,   # (1, bq, 1) carried normalizer
+    m_in_ref,   # (1, 1, bq) carried running row-max (row layout), head h
+    l_in_ref,   # (1, 1, bq) carried normalizer
     acc_in_ref,  # (1, bq, D) carried weighted value sum
-    m_out_ref,  # (1, bq, 1)
-    l_out_ref,  # (1, bq, 1)
+    m_out_ref,  # (1, 1, bq)
+    l_out_ref,  # (1, 1, bq)
     acc_out_ref,  # (1, bq, D)
     m_s,        # scratch (bq, LANES) — lane-wide, all lanes equal
     l_s,        # scratch (bq, LANES)
@@ -395,8 +401,9 @@ def _flash_kernel(
 
     @pl.when(kci == 0)
     def _load_carry():
-        m_s[...] = jnp.tile(m_in_ref[0], (1, LANES))
-        l_s[...] = jnp.tile(l_in_ref[0], (1, LANES))
+        # (1, bq) row -> (bq, 1) column -> lane-wide register
+        m_s[...] = jnp.tile(jnp.transpose(m_in_ref[0]), (1, LANES))
+        l_s[...] = jnp.tile(jnp.transpose(l_in_ref[0]), (1, LANES))
         acc_s[...] = acc_in_ref[0]
 
     q_first, c_first, live, unmasked = _tile_positions(
@@ -415,8 +422,8 @@ def _flash_kernel(
 
     @pl.when(kci == n_kc - 1)
     def _store_carry():
-        m_out_ref[0] = m_s[:, :1]
-        l_out_ref[0] = l_s[:, :1]
+        m_out_ref[0] = jnp.transpose(m_s[:, :1])
+        l_out_ref[0] = jnp.transpose(l_s[:, :1])
         acc_out_ref[0] = acc_s[...]
 
 
@@ -426,8 +433,8 @@ def _flash_fused_kernel(
     k_ref,      # (1, kc, D)
     v_ref,      # (1, kc, D)
     out_ref,    # (1, bq, D) normalized output, q's dtype
-    m_out_ref,  # (1, bq, 1) residuals for the backward
-    l_out_ref,  # (1, bq, 1)
+    m_out_ref,  # (1, 1, bq) residuals for the backward (row layout)
+    l_out_ref,  # (1, 1, bq)
     m_s, l_s, acc_s,
     *,
     block_q: int,
@@ -447,8 +454,8 @@ def _flash_fused_kernel(
     (ring size 1 — the single-chip case) that traffic is pure overhead:
     the f32 accumulator alone is ``4/itemsize`` times the output. This
     variant initializes the state in scratch and writes only the
-    normalized output (+ the (bq, 1) softmax statistics the backward
-    needs), roughly halving HBM traffic per token.
+    normalized output (+ the (1, bq) row-layout softmax statistics the
+    backward needs), roughly halving HBM traffic per token.
     """
     qi = pl.program_id(1)
     kci = pl.program_id(2)
@@ -482,8 +489,8 @@ def _flash_fused_kernel(
         out_ref[0] = (acc_s[...] / _lane_full(safe_l, d)).astype(
             out_ref.dtype
         )
-        m_out_ref[0] = m_s[:, :1]
-        l_out_ref[0] = l[:, :1]
+        m_out_ref[0] = jnp.transpose(m_s[:, :1])
+        l_out_ref[0] = jnp.transpose(l[:, :1])
 
 
 _FWD_DIM_SEMANTICS = pltpu.CompilerParams(
@@ -506,8 +513,9 @@ def flash_attend_fused(
     """Whole-extent attention in one launch: ``(out, m, l)``.
 
     ``out`` is normalized and in ``q.dtype``; ``m``/``l`` are the
-    backward's residuals. Used when the ring has a single rank (the
-    carried :func:`flash_block_attend` otherwise).
+    backward's residuals, in compact row layout ``(H, 1, Sq)``. Used
+    when the ring has a single rank (the carried
+    :func:`flash_block_attend` otherwise).
     """
     _validate_window(causal, window)
     h, s_q, d = q.shape
@@ -541,14 +549,14 @@ def flash_attend_fused(
         _kv_index_map(group, bq, kc, window, n_kc, n_kc_total,
                       causal=causal),
     )
-    colspec = pl.BlockSpec(
-        (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
+    rowspec = pl.BlockSpec(
+        (1, 1, bq), lambda hh, qi, ki, offs: (hh, 0, qi)
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(h, n_q, n_kc),
         in_specs=[qspec, kspec, kspec],
-        out_specs=[qspec, colspec, colspec],
+        out_specs=[qspec, rowspec, rowspec],
         scratch_shapes=[
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, LANES), jnp.float32),
@@ -560,8 +568,8 @@ def flash_attend_fused(
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
-            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((h, 1, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((h, 1, s_q), jnp.float32),
         ],
         compiler_params=_FWD_DIM_SEMANTICS,
         interpret=interpret,
@@ -572,8 +580,8 @@ def flash_block_attend(
     q: jax.Array,       # (H, Sq, D)
     k: jax.Array,       # (H_kv, Sk, D); H_kv divides H (GQA)
     v: jax.Array,       # (H_kv, Sk, D)
-    m: jax.Array,       # (H, Sq, 1)
-    l: jax.Array,       # (H, Sq, 1)
+    m: jax.Array,       # (H, 1, Sq) row layout
+    l: jax.Array,       # (H, 1, Sq)
     acc: jax.Array,     # (H, Sq, D)
     q_off,
     k_off,
@@ -622,14 +630,14 @@ def flash_block_attend(
         _kv_index_map(group, bq, kc, window, n_kc, n_kc_total,
                       causal=causal),
     )
-    colspec = pl.BlockSpec(
-        (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
+    rowspec = pl.BlockSpec(
+        (1, 1, bq), lambda hh, qi, ki, offs: (hh, 0, qi)
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(h, n_q, n_kc),
-        in_specs=[qspec, kspec, kspec, colspec, colspec, qspec],
-        out_specs=[colspec, colspec, qspec],
+        in_specs=[qspec, kspec, kspec, rowspec, rowspec, qspec],
+        out_specs=[rowspec, rowspec, qspec],
         scratch_shapes=[
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, LANES), jnp.float32),
@@ -640,8 +648,8 @@ def flash_block_attend(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
-            jax.ShapeDtypeStruct((h, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((h, 1, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((h, 1, s_q), jnp.float32),
             jax.ShapeDtypeStruct((h, s_q, d), jnp.float32),
         ],
         compiler_params=_FWD_DIM_SEMANTICS,
@@ -666,11 +674,14 @@ def _bwd_dq_kernel(
     k_ref,       # (1, kc, D) key chunk
     v_ref,       # (1, kc, D)
     do_ref,      # (1, bq, D) dout tile
-    m_ref,       # (1, bq, 1) saved row-max
-    linv_ref,    # (1, bq, 1) 1 / safe(l)
-    dlt_ref,     # (1, bq, 1) delta = rowsum(dout * out)
+    m_ref,       # (1, 1, bq) saved row-max (row layout)
+    linv_ref,    # (1, 1, bq) 1 / safe(l)
+    dlt_ref,     # (1, 1, bq) delta = rowsum(dout * out)
     dq_ref,      # (1, bq, D) out: dq contribution
     dq_s,        # scratch (bq, D) f32
+    m_s,         # scratch (bq, 1) f32 — stats as columns, transposed
+    linv_s,      # scratch (bq, 1) f32   once per q tile (kci == 0) and
+    dlt_s,       # scratch (bq, 1) f32   reused across all key chunks
     *,
     block_q: int,
     block_k: int,
@@ -690,6 +701,11 @@ def _bwd_dq_kernel(
     @pl.when(kci == 0)
     def _zero():
         dq_s[...] = jnp.zeros_like(dq_s)
+        # dq consumes the stats as per-row (bq, 1) columns; the rows
+        # arrive compact and are transposed once per q tile
+        m_s[...] = jnp.transpose(m_ref[0])
+        linv_s[...] = jnp.transpose(linv_ref[0])
+        dlt_s[...] = jnp.transpose(dlt_ref[0])
 
     q_first = offs_ref[0] + qi * bq
     if window is not None:
@@ -707,9 +723,9 @@ def _bwd_dq_kernel(
     def _accum():
         q = q_ref[0]
         do = do_ref[0]
-        m = m_ref[0]
-        linv = linv_ref[0]
-        dlt = dlt_ref[0]
+        m = m_s[...]
+        linv = linv_s[...]
+        dlt = dlt_s[...]
         if causal:
             n_live = jnp.minimum(
                 (q_first + bq - 1 - c_first) // bk + 1, n_sub
@@ -951,9 +967,9 @@ def flash_block_backward_dq(
 ):
     """dq contribution of one K/V block (f32, head-major ``(H,Sq,D)``).
 
-    ``m``/``linv``/``delta`` are ``(H, Sq, 1)`` saved statistics
-    (``linv = 1/l`` with fully-masked rows mapped to 1). ``k``/``v``
-    may carry fewer (grouped) heads.
+    ``m``/``linv``/``delta`` are ``(H, 1, Sq)`` row-layout saved
+    statistics (``linv = 1/l`` with fully-masked rows mapped to 1).
+    ``k``/``v`` may carry fewer (grouped) heads.
     """
     _validate_window(causal, window)
     h, s_q, d = q.shape
@@ -986,15 +1002,20 @@ def flash_block_backward_dq(
         (1, kc, d),
         _kv_index_map(group, bq, kc, window, n_kc, n_kc_total),
     )
-    colspec = pl.BlockSpec(
-        (1, bq, 1), lambda hh, qi, ki, offs: (hh, qi, 0)
+    rowspec = pl.BlockSpec(
+        (1, 1, bq), lambda hh, qi, ki, offs: (hh, 0, qi)
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(h, n_q, n_kc),
-        in_specs=[qspec, kspec, kspec, qspec, colspec, colspec, colspec],
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec, rowspec],
         out_specs=[qspec],
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
     )
     return pl.pallas_call(
         kernel,

@@ -78,6 +78,28 @@ def ring_collective_id(family_base: int, stream: int = 0) -> int:
     return family_base * RING_STREAMS + stream
 
 
+def _compiler_params(family_base: int, stream: int, flow_control: bool):
+    """Mosaic compiler params for a ring kernel.
+
+    ``collective_id`` names the cross-device **barrier** semaphore — and
+    only that. Mosaic rejects a kernel that declares a ``collective_id``
+    but never touches the barrier ("collective_id has to be unspecified
+    ... when not using a custom barrier"), so the id is attached only in
+    flow-control mode, the only mode that opens the kernel with
+    :func:`_neighbour_barrier`. The no-flow-control tier uses plain
+    remote DMAs whose send/recv semaphores are kernel-local scratch and
+    need no global id. (Caught by the AOT topology tier,
+    ``tests/test_aot_tpu.py``: interpret mode accepted the stray id,
+    real lowering does not.)
+    """
+    if flow_control:
+        return pltpu.CompilerParams(
+            collective_id=ring_collective_id(family_base, stream),
+            has_side_effects=True,
+        )
+    return pltpu.CompilerParams(has_side_effects=True)
+
+
 def _interpret_arg(interpret: bool):
     """Pallas ``interpret=`` argument for the requested mode.
 
@@ -117,6 +139,23 @@ def _grant_slot(credit_sem, slot, me, n: int):
     )
 
 
+def _lift_payload(x: jax.Array) -> jax.Array:
+    """Give a 1-D payload a unit row axis so VMEM buffers built from it
+    are >=3-D once a slot/unit axis is prepended.
+
+    Mosaic tiles the trailing two dims of a VMEM buffer; a dynamic slice
+    along the *sublane* dim of a 2-D buffer must be tile-aligned, which
+    a traced slot index can never prove ("Slice shape along dimension 0
+    must be aligned to tiling"). Every ring kernel therefore keeps its
+    dynamically-indexed axes (double-buffer slots, gather units, chunk
+    rows) strictly ahead of a >=2-D payload, where slicing is untiled
+    and alignment-free — caught by the AOT topology tier
+    (``tests/test_aot_tpu.py``); interpret mode has no tiling and hides
+    this class of bug.
+    """
+    return x.reshape(1, -1) if x.ndim < 2 else x
+
+
 # ---------------------------------------------------------------------------
 # All-gather
 # ---------------------------------------------------------------------------
@@ -129,16 +168,20 @@ def _ring_all_gather_kernel(
     """Each device forwards the chunk it most recently received to its
     right neighbour; after n-1 steps everyone holds every chunk.
 
+    Unit-block layout: ``x_ref`` is this rank's whole chunk as ONE unit
+    ``(1, *payload)``, ``o_ref`` is ``(n, *payload)``, and all dynamic
+    indexing (rank slots, double-buffer slots) happens on the untiled
+    leading axes (see :func:`_lift_payload`).
+
     Protocol model: ``credits.all_gather_rank`` — slot 1 is granted at
     start (empty), and each slot is re-granted once its content has been
     forwarded onward (send complete), except on the final step, whose
     grant nobody would consume (credit balance must end at zero).
     """
     me = lax.axis_index(axis_name)
-    chunk = x_ref.shape[0]
     if flow_control:
         _neighbour_barrier(me, n)
-    o_ref[pl.ds(me * chunk, chunk), ...] = x_ref[...]
+    o_ref[pl.ds(me, 1), ...] = x_ref[...]
     comm_buf[0] = x_ref[...]
     if flow_control:
         _grant_slot(credit_sem, 1, me, n)  # slot 1 starts empty
@@ -167,7 +210,7 @@ def _ring_all_gather_kernel(
             @pl.when(s < n - 2)
             def _():
                 _grant_slot(credit_sem, slot, me, n)
-        o_ref[pl.ds(src_rank * chunk, chunk), ...] = comm_buf[nslot]
+        o_ref[pl.ds(src_rank, 1), ...] = comm_buf[nslot]
         return ()
 
     lax.fori_loop(0, n - 1, step, ())
@@ -189,29 +232,30 @@ def ring_all_gather(
     """
     if n == 1:
         return x
-    chunk = x.shape[0]
-    out_shape = jax.ShapeDtypeStruct((n * chunk,) + x.shape[1:], x.dtype)
+    payload = _lift_payload(x)
+    xu = payload[None]  # (1, *payload): one unit per rank
+    out_shape = jax.ShapeDtypeStruct((n,) + payload.shape, x.dtype)
     kernel = functools.partial(
         _ring_all_gather_kernel, axis_name=axis_name, n=n,
         flow_control=flow_control,
     )
-    return pl.pallas_call(
+    gathered = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2,) + x.shape, x.dtype),
+            pltpu.VMEM((2, 1) + payload.shape, x.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
-            collective_id=ring_collective_id(_CID_ALL_GATHER, stream),
-            has_side_effects=True,
+        compiler_params=_compiler_params(
+            _CID_ALL_GATHER, stream, flow_control,
         ),
         interpret=_interpret_arg(interpret),
-    )(x)
+    )(xu)
+    return gathered.reshape((n * x.shape[0],) + x.shape[1:])
 
 
 # ---------------------------------------------------------------------------
@@ -282,27 +326,28 @@ def ring_all_reduce(
     """
     if n == 1:
         return x
+    payload = _lift_payload(x)
     kernel = functools.partial(
         _ring_all_reduce_kernel, axis_name=axis_name, n=n,
         op=SmiOp.parse(op), flow_control=flow_control,
     )
-    return pl.pallas_call(
+    reduced = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        out_shape=jax.ShapeDtypeStruct(payload.shape, x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2,) + x.shape, x.dtype),
+            pltpu.VMEM((2,) + payload.shape, x.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
-            collective_id=ring_collective_id(_CID_ALL_REDUCE, stream),
-            has_side_effects=True,
+        compiler_params=_compiler_params(
+            _CID_ALL_REDUCE, stream, flow_control,
         ),
         interpret=_interpret_arg(interpret),
-    )(x)
+    )(payload)
+    return reduced.reshape(x.shape)
 
 
 # ---------------------------------------------------------------------------
@@ -318,14 +363,17 @@ def _ring_reduce_scatter_kernel(
     accumulated partial of chunk ``(r - s - 1) % n`` rightward and folds
     its own contribution into the arriving partial of chunk
     ``(r - s - 2) % n``; after ``n-1`` steps rank ``r`` holds the full
-    reduction of chunk ``r``."""
+    reduction of chunk ``r``.
+
+    Unit-block layout: ``x_ref`` is ``(n, *block)`` (one unit per
+    destination rank), so block selection is a unit slice of the untiled
+    leading axis (see :func:`_lift_payload`)."""
     combine = _combine_fn(op)
     me = lax.axis_index(axis_name)
     nn = jnp.int32(n)
-    chunk = x_ref.shape[0] // n
 
     def my_block(idx):
-        return x_ref[pl.ds(idx * chunk, chunk), ...]
+        return x_ref[pl.ds(idx, 1), ...]
 
     if flow_control:
         _neighbour_barrier(me, n)
@@ -385,28 +433,33 @@ def ring_reduce_scatter(
     if n == 1:
         return x
     chunk = x.shape[0] // n
-    out_shape = jax.ShapeDtypeStruct((chunk,) + x.shape[1:], x.dtype)
+    if x.ndim == 1:
+        xu = x.reshape(n, 1, chunk)
+    else:
+        xu = x.reshape((n, chunk) + x.shape[1:])
+    block = xu.shape[1:]
+    out_shape = jax.ShapeDtypeStruct((1,) + block, x.dtype)
     kernel = functools.partial(
         _ring_reduce_scatter_kernel, axis_name=axis_name, n=n,
         op=SmiOp.parse(op), flow_control=flow_control,
     )
-    return pl.pallas_call(
+    scattered = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk) + x.shape[1:], x.dtype),
+            pltpu.VMEM((2, 1) + block, x.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
-            collective_id=ring_collective_id(_CID_REDUCE_SCATTER, stream),
-            has_side_effects=True,
+        compiler_params=_compiler_params(
+            _CID_REDUCE_SCATTER, stream, flow_control,
         ),
         interpret=_interpret_arg(interpret),
-    )(x)
+    )(xu)
+    return scattered.reshape((chunk,) + x.shape[1:])
 
 
 # ---------------------------------------------------------------------------
@@ -495,27 +548,30 @@ def neighbour_stream(
     if n == 1:
         return x
     chunks = x.shape[0]
+    # per-chunk payloads must be >=2-D so the chunk/slot axes stay
+    # untiled (see _lift_payload)
+    xu = x.reshape(chunks, 1, -1) if x.ndim < 3 else x
     kernel = functools.partial(
         _neighbour_stream_kernel, axis_name=axis_name, n=n,
         chunks=chunks, direction=direction, flow_control=flow_control,
     )
-    return pl.pallas_call(
+    streamed = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        out_shape=jax.ShapeDtypeStruct(xu.shape, x.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2,) + x.shape[1:], x.dtype),
+            pltpu.VMEM((2,) + xu.shape[1:], x.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(
-            collective_id=ring_collective_id(_CID_NEIGHBOUR_STREAM, stream),
-            has_side_effects=True,
+        compiler_params=_compiler_params(
+            _CID_NEIGHBOUR_STREAM, stream, flow_control,
         ),
         interpret=_interpret_arg(interpret),
-    )(x)
+    )(xu)
+    return streamed.reshape(x.shape)
 
 
 # ---------------------------------------------------------------------------

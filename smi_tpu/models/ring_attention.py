@@ -131,9 +131,11 @@ def _flash_forward(q, k, v, comm, causal, axis, precision, interpret,
         )
         return out.swapaxes(0, 1), m, l
 
-    # online-softmax state is always f32, whatever the input dtype
-    m0 = jnp.full((h, s_local, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((h, s_local, 1), jnp.float32)
+    # online-softmax state is always f32, whatever the input dtype; the
+    # statistics ride in compact (H, 1, S) row layout (column vectors
+    # would be lane-padded 128x by TPU tiling — see kernels/flash.py)
+    m0 = jnp.full((h, 1, s_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, 1, s_local), jnp.float32)
     acc0 = jnp.zeros(qT.shape, jnp.float32)
     q_off = rank * s_local
 
@@ -149,8 +151,8 @@ def _flash_forward(q, k, v, comm, causal, axis, precision, interpret,
         fold, comm, axis,
         k.swapaxes(0, 1), v.swapaxes(0, 1), (m0, l0, acc0),
     )
-    safe_l = jnp.where(l == 0.0, 1.0, l)  # (H, S, 1)
-    out = (acc / safe_l).swapaxes(0, 1).astype(q.dtype)
+    safe_l = jnp.where(l == 0.0, 1.0, l)  # (H, 1, S)
+    out = (acc / safe_l.swapaxes(1, 2)).swapaxes(0, 1).astype(q.dtype)
     return out, m, l
 
 
@@ -178,13 +180,14 @@ def _flash_ring_backward(
     qT = q.swapaxes(0, 1)
     doutT = dout.swapaxes(0, 1).astype(q.dtype)
     outT = out.swapaxes(0, 1).astype(jnp.float32)
-    linv = 1.0 / jnp.where(l == 0.0, 1.0, l)           # (H, S, 1)
+    # all statistics stay in compact (H, 1, S) row layout end-to-end:
+    # the forward saves rows, delta reduces straight into a row, and
+    # both backward kernels consume rows (dq transposes per-tile
+    # in-kernel) — no lane-padded (H, S, 1) tensor ever hits HBM
+    linv = 1.0 / jnp.where(l == 0.0, 1.0, l)           # (H, 1, S)
     delta = jnp.sum(
-        doutT.astype(jnp.float32) * outT, axis=-1, keepdims=True
-    )  # (H, S, 1)
-    m_row = m.transpose(0, 2, 1)                        # (H, 1, S)
-    linv_row = linv.transpose(0, 2, 1)
-    delta_row = delta.transpose(0, 2, 1)
+        doutT.astype(jnp.float32) * outT, axis=-1
+    )[:, None, :]                                       # (H, 1, S)
 
     dq0 = jnp.zeros((h, s_local, d), jnp.float32)
     state0 = (
@@ -203,7 +206,7 @@ def _flash_ring_backward(
             window=window,
         )
         dkc, dvc = flash_block_backward_dkdv(
-            qT, k_cur, v_cur, doutT, m_row, linv_row, delta_row,
+            qT, k_cur, v_cur, doutT, m, linv, delta,
             q_off, k_off, causal, scale, precision, interpret=interpret,
             window=window,
         )
@@ -406,6 +409,11 @@ def make_ring_attention_fn(
             )
 
     spec = P(axis)
+    # NOTE: no compiler_options here — the returned fn is meant to be
+    # composed (jax.grad / outer jit), and XLA rejects options on a jit
+    # that ends up nested. Multi-rank compiled rings that trip the
+    # scoped-VMEM default should pass utils.compile.TPU_COMPILER_OPTIONS
+    # to their own top-level jit (make_train_step already does).
     return jax.jit(
         jax.shard_map(
             shard_fn, mesh=comm.mesh,

@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 from smi_tpu.models import ring_attention as ra
 from smi_tpu.parallel.mesh import Communicator
+from smi_tpu.utils.compile import tpu_compiler_options
 
 
 @dataclasses.dataclass(frozen=True)
@@ -181,7 +182,10 @@ def make_train_step(
             in_specs=(P(), data_spec, data_spec),
             out_specs=(P(), P()),
             check_vma=False,
-        )
+        ),
+        # admit the ring schedule's VMEM-resident loop carry
+        # (utils/compile.py — default scoped budget rejects it)
+        compiler_options=tpu_compiler_options(comm.is_tpu),
     )
 
 

@@ -1,0 +1,43 @@
+"""Shared XLA compile options for the framework's TPU programs.
+
+Reference parity: the reference centralizes its toolchain flags in one
+place (``aoc`` board/seed/fmax flags assembled by CMake,
+``/root/reference/CMakeLists.txt:92-118``) so every kernel builds with
+the same hardware assumptions. The TPU analog is a canonical
+``compiler_options`` dict handed to ``jax.jit``.
+
+Why the scoped-VMEM override exists: XLA's TPU backend may keep a
+loop's carried values *on-chip* between custom-call (Mosaic kernel)
+invocations — for the ring-attention schedule that is precisely the
+design (K/V blocks and the f32 accumulator stay in VMEM across ring
+steps instead of round-tripping HBM) — but its default budget for such
+scoped allocations is 16 MB, a fraction of a v5e core's 128 MB VMEM.
+An 8-device (dp=2, sp=4) flash train step carries ~30 MB
+(q/k/v bf16 tiles + f32 acc) and is rejected with "Ran out of memory
+in memory space vmem ... on stack" at the default; raising the cap to
+64 MB admits it while leaving half the VMEM for Mosaic kernel frames
+and pipelining. The cap is a ceiling, not a reservation — programs
+that never carry state on-chip are unaffected. (Found by AOT-compiling
+the multi-chip surface, ``tests/test_aot_tpu.py``; the CPU emulator
+tier has no VMEM and can never catch it.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: scoped-VMEM ceiling (KiB) for TPU compiles — see module docstring
+SCOPED_VMEM_KIB = 64 * 1024
+
+TPU_COMPILER_OPTIONS = {
+    "xla_tpu_scoped_vmem_limit_kib": str(SCOPED_VMEM_KIB),
+}
+
+
+def tpu_compiler_options(is_tpu: bool) -> Optional[dict]:
+    """``compiler_options`` for ``jax.jit`` — TPU meshes only.
+
+    Returns ``None`` off-TPU: the CPU/emulator backend rejects unknown
+    ``xla_tpu_*`` flags.
+    """
+    return dict(TPU_COMPILER_OPTIONS) if is_tpu else None

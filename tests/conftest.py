@@ -26,9 +26,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 # (tests/test_flash_tpu.py): the TPU platform stays visible and the
 # compiled Mosaic paths run on the real chip. "0"/"false"/"no"/"" all
 # mean off, so CI matrices can set the variable explicitly either way.
-_tpu_tier = os.environ.get(
-    "SMI_TPU_RUN_TPU_TESTS", ""
-).strip().lower() not in ("", "0", "false", "no")
+def _opted_in(var: str) -> bool:
+    return os.environ.get(var, "").strip().lower() not in (
+        "", "0", "false", "no"
+    )
+
+
+_tpu_tier = _opted_in("SMI_TPU_RUN_TPU_TESTS")
+# The AOT tier (tests/test_aot_tpu.py) compiles the multi-chip surface
+# for a real TPU topology from this (possibly CPU-only) host; like the
+# hardware tier it is run as its own pytest invocation.
+_aot_tier = _opted_in("SMI_TPU_RUN_AOT_TESTS")
 if not _tpu_tier:
     os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -38,10 +46,12 @@ if not _tpu_tier:
     jax.config.update("jax_platforms", "cpu")
 
 # The SMI surface includes a 'double' dtype (include/smi/data_types.h);
-# emulator-tier tests exercise it with real float64. The TPU tier keeps
-# the default 32-bit mode — the hardware has no f64, and x64-widened
-# literals break tracing of the compiled kernels.
-if not _tpu_tier:
+# emulator-tier tests exercise it with real float64. The TPU-targeting
+# tiers (hardware and AOT) keep the default 32-bit mode — the hardware
+# has no f64, x64-widened literals break tracing of the compiled
+# kernels, and Mosaic's lowering of stray int64 converts recurses
+# without bound (jax 0.9 _convert_element_type_lowering_rule).
+if not _tpu_tier and not _aot_tier:
     jax.config.update("jax_enable_x64", True)
 
 import faulthandler  # noqa: E402

@@ -91,15 +91,15 @@ def test_flash_block_matches_jnp_block(causal, carry):
 
     m_f, l_f, acc_f = flash.flash_block_attend(
         q.swapaxes(0, 1), k.swapaxes(0, 1), v.swapaxes(0, 1),
-        m0[..., None], l0[..., None], acc0.swapaxes(0, 1),
+        m0[:, None, :], l0[:, None, :], acc0.swapaxes(0, 1),
         q_off, k_off, causal, scale, interpret=True,
     )
     # tolerances cover matmul accumulation-order noise only
     np.testing.assert_allclose(
-        np.asarray(m_f)[..., 0], np.asarray(m_ref), rtol=1e-5, atol=1e-5
+        np.asarray(m_f)[:, 0, :], np.asarray(m_ref), rtol=1e-5, atol=1e-5
     )
     np.testing.assert_allclose(
-        np.asarray(l_f)[..., 0], np.asarray(l_ref), rtol=1e-5, atol=1e-5
+        np.asarray(l_f)[:, 0, :], np.asarray(l_ref), rtol=1e-5, atol=1e-5
     )
     np.testing.assert_allclose(
         np.asarray(acc_f).swapaxes(0, 1), np.asarray(acc_ref),
@@ -150,8 +150,8 @@ def test_flash_skips_fully_masked_block():
     s_q, s_k, h, d = 16, 16, 1, 128
     q, k, v = _qkv(16, h, d, seed=9)
     scale = 1.0 / math.sqrt(d)
-    m0 = jnp.full((h, s_q, 1), ra.NEG_INF, jnp.float32)
-    l0 = jnp.zeros((h, s_q, 1), jnp.float32)
+    m0 = jnp.full((h, 1, s_q), ra.NEG_INF, jnp.float32)
+    l0 = jnp.zeros((h, 1, s_q), jnp.float32)
     acc0 = jnp.zeros((h, s_q, d), jnp.float32)
     m, l, acc = flash.flash_block_attend(
         q.swapaxes(0, 1), k.swapaxes(0, 1), v.swapaxes(0, 1),
