@@ -33,14 +33,17 @@ semaphores are zero at kernel exit (interpret mode verifies this and
 reports leaks; leaked counts would poison the next collective reusing
 the semaphores).
 
-All kernels are written per-shard (called inside ``shard_map`` over one
-mesh axis).
+All kernels are written per-shard (called inside ``shard_map``). A ring
+may span one mesh axis, several (flattened row-major, the communicator's
+rank order), or a strict subset of the mesh's axes — pass ``mesh_axes``
+so remote device ids resolve to the right global position (see
+:func:`_logical_id_fn`).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Union
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -100,6 +103,103 @@ def _compiler_params(family_base: int, stream: int, flow_control: bool):
     return pltpu.CompilerParams(has_side_effects=True)
 
 
+#: ring axes: a single mesh axis name, or an ordered tuple of names the
+#: ring spans (row-major rank significance, matching Communicator.rank)
+RingAxes = Union[str, Tuple[str, ...]]
+#: full mesh structure as ordered (name, size) pairs — required whenever
+#: the ring does NOT span the whole mesh in mesh-axis order
+MeshAxes = Optional[Tuple[Tuple[str, int], ...]]
+
+
+def _normalize_axes(axis_name: RingAxes) -> Tuple[str, ...]:
+    return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def _ring_rank(ring_axes: Sequence[str], ring_sizes: dict):
+    """Flattened rank over the ring axes (row-major, = Communicator.rank)."""
+    r = lax.axis_index(ring_axes[0])
+    for name in ring_axes[1:]:
+        r = r * jnp.int32(ring_sizes[name]) + lax.axis_index(name)
+    return jnp.int32(r)
+
+
+def _logical_id_fn(ring_axes: Tuple[str, ...], mesh_axes: MeshAxes):
+    """Map a flattened *ring* rank to the global LOGICAL device id.
+
+    ``DeviceIdType.LOGICAL`` addresses the linearized position in the
+    FULL shard_map mesh — not the position along the collective's own
+    axis. A ring spanning only some axes of a larger mesh (e.g. the
+    ``sy`` rings of a 2-D stencil mesh, one per row) must therefore
+    rebuild the global id from the target's ring coordinates plus the
+    caller's own coordinates on every non-ring axis. Passing the
+    axis-local index instead signals a *different row's* device — the
+    cross-ring semaphore corruption the interpret tier reports as
+    "Semaphore ... non-zero at kernel exit" (and a silent data race on
+    hardware). Identity when the ring spans the whole mesh in mesh
+    order — the historical single-axis case.
+    """
+    if mesh_axes is None or tuple(n for n, _ in mesh_axes) == ring_axes:
+        return lambda target: target
+    sizes = dict(mesh_axes)
+    missing = [n for n in ring_axes if n not in sizes]
+    if missing:
+        raise ValueError(
+            f"ring axes {missing} not present in mesh axes "
+            f"{[n for n, _ in mesh_axes]}"
+        )
+
+    def to_logical(target):
+        coords = {}
+        rem = target
+        for name in reversed(ring_axes):
+            s = jnp.int32(sizes[name])
+            coords[name] = lax.rem(rem, s)
+            rem = rem // s
+        lid = jnp.int32(0)
+        for name, s in mesh_axes:
+            idx = coords.get(name)
+            if idx is None:
+                idx = lax.axis_index(name)
+            lid = lid * jnp.int32(s) + jnp.int32(idx)
+        return lid
+
+    return to_logical
+
+
+
+def _ring_context(axis_name: RingAxes, n: int, mesh_axes: MeshAxes):
+    """(ring_axes, ring_sizes, to_logical) shared by the four wrappers.
+
+    ``ring_sizes`` carries the per-axis extents a flattened multi-axis
+    rank needs; for a single axis only ``n`` matters. ``mesh_axes``
+    (ordered (name, size) of the FULL mesh) is REQUIRED whenever the
+    ring does not span the whole mesh in mesh order — see
+    :func:`_logical_id_fn`.
+    """
+    ring_axes = _normalize_axes(axis_name)
+    if mesh_axes is not None:
+        sizes = dict(mesh_axes)
+        ring_sizes = {a: sizes[a] for a in ring_axes if a in sizes}
+    else:
+        ring_sizes = {ring_axes[0]: n} if len(ring_axes) == 1 else None
+        if ring_sizes is None:
+            raise ValueError(
+                "multi-axis rings need mesh_axes=((name, size), ...) to "
+                "derive per-axis extents and logical device ids"
+            )
+    return ring_axes, ring_sizes, _logical_id_fn(ring_axes, tuple(mesh_axes) if mesh_axes is not None else None)
+
+
+def mesh_axes_of(comm: Communicator) -> Tuple[Tuple[str, int], ...]:
+    """Full-mesh (name, size) pairs for a communicator's mesh — what the
+    ring kernels need to resolve LOGICAL device ids when the ring spans
+    a subset (or reordering) of the mesh axes."""
+    return tuple(
+        (name, int(comm.mesh.shape[name]))
+        for name in comm.mesh.axis_names
+    )
+
+
 def _interpret_arg(interpret: bool):
     """Pallas ``interpret=`` argument for the requested mode.
 
@@ -111,7 +211,7 @@ def _interpret_arg(interpret: bool):
     return pltpu.InterpretParams() if interpret else False
 
 
-def _neighbour_barrier(me, n: int):
+def _neighbour_barrier(me, n: int, to_logical):
     """Block until both ring neighbours entered the kernel, so no RDMA
     lands in a buffer that is still being initialized."""
     barrier = pltpu.get_barrier_semaphore()
@@ -119,22 +219,22 @@ def _neighbour_barrier(me, n: int):
     left = lax.rem(me - 1 + nn, nn)
     right = lax.rem(me + 1, nn)
     pltpu.semaphore_signal(
-        barrier, inc=1, device_id=left,
+        barrier, inc=1, device_id=to_logical(left),
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
     pltpu.semaphore_signal(
-        barrier, inc=1, device_id=right,
+        barrier, inc=1, device_id=to_logical(right),
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
     pltpu.semaphore_wait(barrier, 2)
 
 
-def _grant_slot(credit_sem, slot, me, n: int):
+def _grant_slot(credit_sem, slot, me, n: int, to_logical):
     """Tell the left neighbour (the writer into our comm_buf) that
     ``slot`` is free to be overwritten."""
     left = lax.rem(me - 1 + jnp.int32(n), jnp.int32(n))
     pltpu.semaphore_signal(
-        credit_sem.at[slot], inc=1, device_id=left,
+        credit_sem.at[slot], inc=1, device_id=to_logical(left),
         device_id_type=pltpu.DeviceIdType.LOGICAL,
     )
 
@@ -163,7 +263,7 @@ def _lift_payload(x: jax.Array) -> jax.Array:
 
 def _ring_all_gather_kernel(
     x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
-    *, axis_name: str, n: int, flow_control: bool
+    *, ring_axes, ring_sizes, to_logical, n: int, flow_control: bool
 ):
     """Each device forwards the chunk it most recently received to its
     right neighbour; after n-1 steps everyone holds every chunk.
@@ -178,13 +278,13 @@ def _ring_all_gather_kernel(
     forwarded onward (send complete), except on the final step, whose
     grant nobody would consume (credit balance must end at zero).
     """
-    me = lax.axis_index(axis_name)
+    me = _ring_rank(ring_axes, ring_sizes)
     if flow_control:
-        _neighbour_barrier(me, n)
+        _neighbour_barrier(me, n, to_logical)
     o_ref[pl.ds(me, 1), ...] = x_ref[...]
     comm_buf[0] = x_ref[...]
     if flow_control:
-        _grant_slot(credit_sem, 1, me, n)  # slot 1 starts empty
+        _grant_slot(credit_sem, 1, me, n, to_logical)  # slot 1 starts empty
 
     def step(s, _):
         nn = jnp.int32(n)
@@ -199,7 +299,7 @@ def _ring_all_gather_kernel(
             dst_ref=comm_buf.at[nslot],
             send_sem=send_sem.at[slot],
             recv_sem=recv_sem.at[nslot],
-            device_id=dst,
+            device_id=to_logical(dst),
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         rdma.start()
@@ -209,7 +309,7 @@ def _ring_all_gather_kernel(
             # the last step, where no further send would consume the credit
             @pl.when(s < n - 2)
             def _():
-                _grant_slot(credit_sem, slot, me, n)
+                _grant_slot(credit_sem, slot, me, n, to_logical)
         o_ref[pl.ds(src_rank, 1), ...] = comm_buf[nslot]
         return ()
 
@@ -218,11 +318,12 @@ def _ring_all_gather_kernel(
 
 def ring_all_gather(
     x: jax.Array,
-    axis_name: str,
+    axis_name: RingAxes,
     n: int,
     interpret: bool = False,
     flow_control: bool = True,
     stream: int = 0,
+    mesh_axes: MeshAxes = None,
 ) -> jax.Array:
     """All-gather ``x`` (this shard's chunk) along a ring.
 
@@ -235,8 +336,10 @@ def ring_all_gather(
     payload = _lift_payload(x)
     xu = payload[None]  # (1, *payload): one unit per rank
     out_shape = jax.ShapeDtypeStruct((n,) + payload.shape, x.dtype)
+    ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
-        _ring_all_gather_kernel, axis_name=axis_name, n=n,
+        _ring_all_gather_kernel, ring_axes=ring_axes,
+        ring_sizes=ring_sizes, to_logical=to_logical, n=n,
         flow_control=flow_control,
     )
     gathered = pl.pallas_call(
@@ -265,19 +368,20 @@ def ring_all_gather(
 
 def _ring_all_reduce_kernel(
     x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
-    *, axis_name: str, n: int, op: SmiOp, flow_control: bool
+    *, ring_axes, ring_sizes, to_logical, n: int, op: SmiOp,
+    flow_control: bool
 ):
     """Circulating-partial ring reduce: every rank simultaneously streams
     its running partial to its right neighbour and folds its own
     contribution into what arrives; after n-1 hops every rank holds the
     full reduction (each via a rotated association order)."""
     combine = _combine_fn(op)
-    me = lax.axis_index(axis_name)
+    me = _ring_rank(ring_axes, ring_sizes)
     if flow_control:
-        _neighbour_barrier(me, n)
+        _neighbour_barrier(me, n, to_logical)
     comm_buf[0] = x_ref[...]
     if flow_control:
-        _grant_slot(credit_sem, 1, me, n)
+        _grant_slot(credit_sem, 1, me, n, to_logical)
 
     # After step s each rank's live slot holds the combine of the s+2
     # contributions x_{me-s-1} ... x_{me}; after n-1 steps that is the
@@ -292,7 +396,7 @@ def _ring_all_reduce_kernel(
             dst_ref=comm_buf.at[nslot],
             send_sem=send_sem.at[slot],
             recv_sem=recv_sem.at[nslot],
-            device_id=dst,
+            device_id=to_logical(dst),
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         rdma.start()
@@ -300,7 +404,7 @@ def _ring_all_reduce_kernel(
         if flow_control:
             @pl.when(s < n - 2)
             def _():
-                _grant_slot(credit_sem, slot, me, n)
+                _grant_slot(credit_sem, slot, me, n, to_logical)
         comm_buf[nslot] = combine(comm_buf[nslot], x_ref[...])
         return ()
 
@@ -311,12 +415,13 @@ def _ring_all_reduce_kernel(
 
 def ring_all_reduce(
     x: jax.Array,
-    axis_name: str,
+    axis_name: RingAxes,
     n: int,
     op: Union[str, SmiOp] = SmiOp.ADD,
     interpret: bool = False,
     flow_control: bool = True,
     stream: int = 0,
+    mesh_axes: MeshAxes = None,
 ) -> jax.Array:
     """ADD/MAX/MIN all-reduce along a ring with explicit neighbour RDMA.
 
@@ -327,8 +432,10 @@ def ring_all_reduce(
     if n == 1:
         return x
     payload = _lift_payload(x)
+    ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
-        _ring_all_reduce_kernel, axis_name=axis_name, n=n,
+        _ring_all_reduce_kernel, ring_axes=ring_axes,
+        ring_sizes=ring_sizes, to_logical=to_logical, n=n,
         op=SmiOp.parse(op), flow_control=flow_control,
     )
     reduced = pl.pallas_call(
@@ -357,7 +464,8 @@ def ring_all_reduce(
 
 def _ring_reduce_scatter_kernel(
     x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
-    *, axis_name: str, n: int, op: SmiOp, flow_control: bool
+    *, ring_axes, ring_sizes, to_logical, n: int, op: SmiOp,
+    flow_control: bool
 ):
     """Standard ring reduce-scatter: at step ``s`` rank ``r`` sends the
     accumulated partial of chunk ``(r - s - 1) % n`` rightward and folds
@@ -369,17 +477,17 @@ def _ring_reduce_scatter_kernel(
     destination rank), so block selection is a unit slice of the untiled
     leading axis (see :func:`_lift_payload`)."""
     combine = _combine_fn(op)
-    me = lax.axis_index(axis_name)
+    me = _ring_rank(ring_axes, ring_sizes)
     nn = jnp.int32(n)
 
     def my_block(idx):
         return x_ref[pl.ds(idx, 1), ...]
 
     if flow_control:
-        _neighbour_barrier(me, n)
+        _neighbour_barrier(me, n, to_logical)
     comm_buf[0] = my_block(lax.rem(me - 1 + nn, nn))
     if flow_control:
-        _grant_slot(credit_sem, 1, me, n)
+        _grant_slot(credit_sem, 1, me, n, to_logical)
 
     def step(s, _):
         slot, nslot = s % 2, (s + 1) % 2
@@ -391,7 +499,7 @@ def _ring_reduce_scatter_kernel(
             dst_ref=comm_buf.at[nslot],
             send_sem=send_sem.at[slot],
             recv_sem=recv_sem.at[nslot],
-            device_id=dst,
+            device_id=to_logical(dst),
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         rdma.start()
@@ -399,7 +507,7 @@ def _ring_reduce_scatter_kernel(
         if flow_control:
             @pl.when(s < n - 2)
             def _():
-                _grant_slot(credit_sem, slot, me, n)
+                _grant_slot(credit_sem, slot, me, n, to_logical)
         # arriving partial is for chunk (me - s - 2) % n; fold our share in
         idx = lax.rem(me - s - 2 + 2 * nn, nn)
         comm_buf[nslot] = combine(comm_buf[nslot], my_block(idx))
@@ -411,12 +519,13 @@ def _ring_reduce_scatter_kernel(
 
 def ring_reduce_scatter(
     x: jax.Array,
-    axis_name: str,
+    axis_name: RingAxes,
     n: int,
     op: Union[str, SmiOp] = SmiOp.ADD,
     interpret: bool = False,
     flow_control: bool = True,
     stream: int = 0,
+    mesh_axes: MeshAxes = None,
 ) -> jax.Array:
     """Reduce-scatter along a ring: rank ``r`` returns the reduction of
     every rank's ``r``-th leading block of ``x``.
@@ -439,8 +548,10 @@ def ring_reduce_scatter(
         xu = x.reshape((n, chunk) + x.shape[1:])
     block = xu.shape[1:]
     out_shape = jax.ShapeDtypeStruct((1,) + block, x.dtype)
+    ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
-        _ring_reduce_scatter_kernel, axis_name=axis_name, n=n,
+        _ring_reduce_scatter_kernel, ring_axes=ring_axes,
+        ring_sizes=ring_sizes, to_logical=to_logical, n=n,
         op=SmiOp.parse(op), flow_control=flow_control,
     )
     scattered = pl.pallas_call(
@@ -469,8 +580,8 @@ def ring_reduce_scatter(
 
 def _neighbour_stream_kernel(
     x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
-    *, axis_name: str, n: int, chunks: int, direction: int,
-    flow_control: bool
+    *, ring_axes, ring_sizes, to_logical, n: int, chunks: int,
+    direction: int, flow_control: bool
 ):
     """Stream ``chunks`` chunks one hop around the ring, double-buffered.
 
@@ -485,12 +596,12 @@ def _neighbour_stream_kernel(
     receiver re-grants a slot to its upstream after copying it out, except
     for the final two chunks whose grants nobody would consume.
     """
-    me = lax.axis_index(axis_name)
+    me = _ring_rank(ring_axes, ring_sizes)
     nn = jnp.int32(n)
     dst = lax.rem(me + direction + 2 * nn, nn)
     upstream = lax.rem(me - direction + 2 * nn, nn)
     if flow_control:
-        _neighbour_barrier(me, n)
+        _neighbour_barrier(me, n, to_logical)
 
     def step(c, _):
         slot = c % 2
@@ -504,7 +615,7 @@ def _neighbour_stream_kernel(
             dst_ref=comm_buf.at[slot],
             send_sem=send_sem.at[slot],
             recv_sem=recv_sem.at[slot],
-            device_id=dst,
+            device_id=to_logical(dst),
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         rdma.start()
@@ -516,7 +627,8 @@ def _neighbour_stream_kernel(
             @pl.when(c + 2 < chunks)
             def _():
                 pltpu.semaphore_signal(
-                    credit_sem.at[slot], inc=1, device_id=upstream,
+                    credit_sem.at[slot], inc=1,
+                    device_id=to_logical(upstream),
                     device_id_type=pltpu.DeviceIdType.LOGICAL,
                 )
         rdma.wait_send()
@@ -527,12 +639,13 @@ def _neighbour_stream_kernel(
 
 def neighbour_stream(
     x: jax.Array,
-    axis_name: str,
+    axis_name: RingAxes,
     n: int,
     direction: int = 1,
     interpret: bool = False,
     flow_control: bool = True,
     stream: int = 0,
+    mesh_axes: MeshAxes = None,
 ) -> jax.Array:
     """Stream ``x`` chunk-by-chunk to the ring neighbour ``me+direction``.
 
@@ -551,8 +664,10 @@ def neighbour_stream(
     # per-chunk payloads must be >=2-D so the chunk/slot axes stay
     # untiled (see _lift_payload)
     xu = x.reshape(chunks, 1, -1) if x.ndim < 3 else x
+    ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
-        _neighbour_stream_kernel, axis_name=axis_name, n=n,
+        _neighbour_stream_kernel, ring_axes=ring_axes,
+        ring_sizes=ring_sizes, to_logical=to_logical, n=n,
         chunks=chunks, direction=direction, flow_control=flow_control,
     )
     streamed = pl.pallas_call(
@@ -581,11 +696,13 @@ def neighbour_stream(
 
 def make_ring_all_gather(comm: Communicator, interpret: bool = False):
     """Jitted wrapper: sharded input chunks → replicated gathered array."""
-    axis = comm.axis_names[0]
+    axis = comm.axis_names if len(comm.axis_names) > 1 else comm.axis_names[0]
     n = comm.size
+    mesh_axes = mesh_axes_of(comm)
 
     def shard(x):
-        return ring_all_gather(x, axis, n, interpret=interpret)
+        return ring_all_gather(x, axis, n, interpret=interpret,
+                               mesh_axes=mesh_axes)
 
     return jax.jit(
         jax.shard_map(
@@ -597,8 +714,9 @@ def make_ring_all_gather(comm: Communicator, interpret: bool = False):
 
 def make_ring_all_reduce(comm: Communicator, interpret: bool = False,
                          op: Union[str, SmiOp] = SmiOp.ADD):
-    axis = comm.axis_names[0]
+    axis = comm.axis_names if len(comm.axis_names) > 1 else comm.axis_names[0]
     n = comm.size
+    mesh_axes = mesh_axes_of(comm)
 
     def shard(x):
         if x.shape[0] != 1:
@@ -606,7 +724,8 @@ def make_ring_all_reduce(comm: Communicator, interpret: bool = False,
                 f"make_ring_all_reduce expects one row per shard (global "
                 f"leading dim == comm size {n}); got local shape {x.shape}"
             )
-        return ring_all_reduce(x[0], axis, n, op=op, interpret=interpret)[None]
+        return ring_all_reduce(x[0], axis, n, op=op, interpret=interpret,
+                               mesh_axes=mesh_axes)[None]
 
     return jax.jit(
         jax.shard_map(
@@ -619,11 +738,13 @@ def make_ring_all_reduce(comm: Communicator, interpret: bool = False,
 def make_ring_reduce_scatter(comm: Communicator, interpret: bool = False,
                              op: Union[str, SmiOp] = SmiOp.ADD):
     """Jitted wrapper: replicated (n*chunk, ...) input → sharded chunks."""
-    axis = comm.axis_names[0]
+    axis = comm.axis_names if len(comm.axis_names) > 1 else comm.axis_names[0]
     n = comm.size
+    mesh_axes = mesh_axes_of(comm)
 
     def shard(x):
-        return ring_reduce_scatter(x, axis, n, op=op, interpret=interpret)
+        return ring_reduce_scatter(x, axis, n, op=op, interpret=interpret,
+                                   mesh_axes=mesh_axes)
 
     return jax.jit(
         jax.shard_map(

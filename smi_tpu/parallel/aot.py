@@ -232,6 +232,57 @@ def _ring_cases(topology: str):
         )
 
 
+def _subset_ring_cases(topology: str):
+    """Rings over a subset / a pair of axes of a 2-D mesh: the logical
+    device-id reconstruction (``ring._logical_id_fn``) must survive
+    Mosaic lowering, not just interpret mode."""
+    from smi_tpu.kernels import ring
+
+    comm = topology_communicator(
+        topology, shape=(2, 4), axis_names=("mx", "my")
+    )
+    mesh_axes = ring.mesh_axes_of(comm)
+
+    def build_subset():
+        def shard(x):
+            return ring.ring_all_reduce(
+                x[0], "my", 4, mesh_axes=mesh_axes
+            )[None]
+
+        f = jax.jit(
+            jax.shard_map(
+                shard, mesh=comm.mesh,
+                in_specs=P(("mx", "my"), None),
+                out_specs=P(("mx", "my"), None), check_vma=False,
+            )
+        )
+        return compile_sharded(
+            f, shaped(comm, (8, 256), jnp.float32, P(("mx", "my"), None))
+        )
+
+    yield "ring_all_reduce_subset_axis", build_subset
+
+    def build_two_axis():
+        def shard(x):
+            return ring.ring_all_gather(
+                x, ("mx", "my"), 8, mesh_axes=mesh_axes
+            )
+
+        f = jax.jit(
+            jax.shard_map(
+                shard, mesh=comm.mesh,
+                in_specs=P(("mx", "my"), None),
+                out_specs=P(None, None), check_vma=False,
+            )
+        )
+        return compile_sharded(
+            f, shaped(comm, (8 * 16, 256), jnp.float32,
+                      P(("mx", "my"), None))
+        )
+
+    yield "ring_all_gather_two_axis", build_two_axis
+
+
 def _transformer_cases(topology: str):
     """Flash (dp, sp) train step at pod-real shapes, compile-only.
 
@@ -304,6 +355,7 @@ def _hierarchical_case(topology: str):
 def surface_cases(topology: str = DEFAULT_TOPOLOGY):
     """All (name, build) pairs of the multi-chip AOT surface."""
     yield from _ring_cases(topology)
+    yield from _subset_ring_cases(topology)
     yield from _transformer_cases(topology)
     yield from _hierarchical_case(topology)
 

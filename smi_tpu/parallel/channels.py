@@ -123,13 +123,21 @@ class P2PChannel:
         return [(self.src, self.dst)]
 
     def _axis(self):
+        """Collective axis argument: the name, or the ordered tuple for
+        a multi-axis communicator — ``lax.ppermute`` and the ring
+        kernels both treat the tuple as one flattened axis in row-major
+        rank order, matching the channel's flattened ``src``/``dst``."""
         names = self.comm.axis_names
-        if len(names) != 1:
-            raise NotImplementedError(
-                "P2P channels address ranks on a single communicator axis; "
-                "use comm.subcomm(axis) for multi-axis meshes"
-            )
-        return names[0]
+        return names[0] if len(names) == 1 else names
+
+    def _ring_stream(self) -> int:
+        """Barrier-semaphore stream slot of this channel's port — the
+        per-port FIFO independence of the reference's CK pairs (distinct
+        ports never share a semaphore domain up to the tier's domain
+        count; ``kernels/ring.py::ring_collective_id``)."""
+        from smi_tpu.kernels.ring import RING_STREAMS
+
+        return self.port % RING_STREAMS
 
     def _check_length(self, data: jax.Array) -> None:
         if data.shape[0] != self.count:
@@ -164,38 +172,47 @@ class P2PChannel:
             schedule.append(tail)
         return schedule
 
+    def _ring_payload(self, data: jax.Array, chunked: bool) -> jax.Array:
+        """Masked, zero-padded, ``(n_chunks, chunk, ...)``-shaped payload
+        for the ring tier (one row = one in-flight unit)."""
+        masked = jnp.where(self.comm.rank() == self.src, data,
+                           jnp.zeros_like(data))
+        if not chunked:
+            return masked[None]
+        chunk = min(self.chunk_elements, self.count)
+        n_chunks = -(-self.count // chunk)
+        pad = n_chunks * chunk - self.count
+        if pad:
+            masked = jnp.concatenate(
+                [masked, jnp.zeros((pad,) + masked.shape[1:],
+                                   masked.dtype)]
+            )
+        return masked.reshape((n_chunks, chunk) + data.shape[1:])
+
+    def _ring_move(self, chunked_payload: jax.Array) -> jax.Array:
+        """Drive a ``(rows, ...)`` payload hop-by-hop to ``dst`` over the
+        neighbour RDMA kernel (the shorter way around the ring), in this
+        channel's port stream slot."""
+        from smi_tpu.kernels import ring as _ring
+
+        direction, hops = self._hops()
+        mesh_axes = _ring.mesh_axes_of(self.comm)
+        out = chunked_payload
+        for _ in range(hops):
+            out = _ring.neighbour_stream(
+                out, self._axis(), self.comm.size, direction=direction,
+                interpret=not self.comm.is_tpu,
+                stream=self._ring_stream(), mesh_axes=mesh_axes,
+            )
+        return out
+
     def _ring_transfer(self, data: jax.Array, chunked: bool) -> jax.Array:
         """Move the masked message hop-by-hop over the neighbour RDMA
         kernel. Intermediate ranks forward zeros of their own, so only
         ``dst`` ends up with the payload — the SPMD rendition of packets
         transiting intermediate CK pairs (``ckr.cl:50-60``)."""
-        from smi_tpu.kernels import ring as _ring
-
-        direction, hops = self._hops()
-        n = self.comm.size
-        interpret = not self.comm.is_tpu
-        masked = jnp.where(self.comm.rank() == self.src, data,
-                           jnp.zeros_like(data))
-        if chunked:
-            chunk = min(self.chunk_elements, self.count)
-            n_chunks = -(-self.count // chunk)
-            pad = n_chunks * chunk - self.count
-            if pad:
-                masked = jnp.concatenate(
-                    [masked, jnp.zeros((pad,) + masked.shape[1:],
-                                       masked.dtype)]
-                )
-            masked = masked.reshape((n_chunks, chunk) + data.shape[1:])
-        else:
-            masked = masked[None]
-        out = masked
-        for _ in range(hops):
-            out = _ring.neighbour_stream(
-                out, self._axis(), n, direction=direction,
-                interpret=interpret,
-            )
-        out = out.reshape((-1,) + data.shape[1:])[: self.count]
-        return out
+        out = self._ring_move(self._ring_payload(data, chunked))
+        return out.reshape((-1,) + data.shape[1:])[: self.count]
 
     def transfer(self, data: jax.Array, backend: str = "xla") -> jax.Array:
         """Fused Push+Pop: send ``data`` (valid at ``src``) to ``dst``.
@@ -365,6 +382,7 @@ class P2PChannel:
 def stream_concurrent(
     channels: Sequence[P2PChannel],
     datas: Sequence[jax.Array],
+    backend: str = "xla",
 ) -> Tuple[jax.Array, ...]:
     """Move several P2P messages chunk-by-chunk *in lockstep*.
 
@@ -379,6 +397,15 @@ def stream_concurrent(
     burst (``READS_LIMIT``): a channel may move that many chunks per step
     before the other channels advance — exactly the reference CK loop's
     fairness bound between sources (``cks.cl:73-81``).
+
+    ``backend="ring"`` moves the bursts over the credit-flow-controlled
+    neighbour RDMA tier instead: the channels' bursts interleave at the
+    same ``READS_LIMIT`` granularity (a TPU core runs one kernel at a
+    time, so "concurrency" here is the reference's CK *fairness* —
+    no channel may starve another beyond one burst), and each channel's
+    kernels run in the barrier-semaphore domain of its port
+    (:meth:`P2PChannel._ring_stream` — the per-port FIFO independence
+    of ``multi_collectives.cl``).
 
     All channels must agree on message count, chunk size and burst width
     (the benchmark shape). Returns the received message per channel.
@@ -396,12 +423,16 @@ def stream_concurrent(
             f"sizes; got counts {sorted(counts)}, chunks {sorted(chunks)}, "
             f"consecutive_reads {sorted(reads)}"
         )
-    count, chunk = counts.pop(), chunks.pop() * reads.pop()
     datas = tuple(
         jnp.asarray(d, ch.jnp_dtype) for ch, d in zip(channels, datas)
     )
     for ch, d in zip(channels, datas):
         ch._check_length(d)
+    if check_backend(backend) == "ring":
+        return _stream_concurrent_ring(
+            channels, datas, counts.pop(), chunks.pop(), reads.pop()
+        )
+    count, chunk = counts.pop(), chunks.pop() * reads.pop()
 
     axes_perms = [(ch._axis(), ch._perm()) for ch in channels]
 
@@ -430,6 +461,39 @@ def stream_concurrent(
     return tuple(
         p[0] if len(p) == 1 else jnp.concatenate(p) for p in parts
     )
+
+
+def _stream_concurrent_ring(
+    channels: Sequence[P2PChannel],
+    datas: Sequence[jax.Array],
+    count: int,
+    chunk: int,
+    reads: int,
+) -> Tuple[jax.Array, ...]:
+    """Ring-tier concurrent streaming: burst-interleaved fair schedule.
+
+    Each round moves ONE ``reads``-chunk burst of every channel (in
+    channel order) over the neighbour RDMA kernel before any channel
+    advances to its next burst — the CK loop's ``READS_LIMIT`` fairness
+    between sources (``cks.cl:73-81``) made into the kernel schedule.
+    Per-channel stream slots keep the barrier-semaphore domains apart.
+    """
+    del chunk  # shared by validation; each channel re-derives it
+    per = [
+        ch._ring_payload(d, chunked=True)
+        for ch, d in zip(channels, datas)
+    ]
+    n_chunks = per[0].shape[0]
+    received: List[List[jax.Array]] = [[] for _ in channels]
+    for b0 in range(0, n_chunks, reads):
+        for i, ch in enumerate(channels):
+            received[i].append(ch._ring_move(per[i][b0:b0 + reads]))
+    outs = []
+    for i, d in enumerate(datas):
+        whole = (received[i][0] if len(received[i]) == 1
+                 else jnp.concatenate(received[i]))
+        outs.append(whole.reshape((-1,) + d.shape[1:])[:count])
+    return tuple(outs)
 
 
 def ring_shift(

@@ -90,13 +90,21 @@ def _stream_for(port: Optional[int], program, family: str) -> int:
     return port % RING_STREAMS
 
 
-def _axis(comm: Communicator) -> str:
-    if len(comm.axis_names) != 1:
-        raise NotImplementedError(
-            "rooted collectives run over a single communicator axis; "
-            "use comm.subcomm(axis) on multi-axis meshes"
-        )
-    return comm.axis_names[0]
+def _axis(comm: Communicator):
+    """Collective axis argument: the name, or the ordered tuple for a
+    multi-axis communicator (XLA collectives and the ring kernels both
+    treat a tuple as one flattened axis in row-major rank order — the
+    same flattening as ``Communicator.rank``)."""
+    names = comm.axis_names
+    return names[0] if len(names) == 1 else names
+
+
+def _mesh_axes(comm: Communicator):
+    """Full-mesh (name, size) context for the ring kernels' device-id
+    resolution (``kernels/ring.py::mesh_axes_of``)."""
+    from smi_tpu.kernels.ring import mesh_axes_of
+
+    return mesh_axes_of(comm)
 
 
 def _is_root(comm: Communicator, root: int) -> jax.Array:
@@ -127,6 +135,7 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
             contrib, _axis(comm), comm.size, op=SmiOp.ADD,
             interpret=not comm.is_tpu,
             stream=_stream_for(port, program, "broadcast"),
+            mesh_axes=_mesh_axes(comm),
         )
     # on the XLA tier the port is metadata only: distinct ports are
     # independent by dataflow
@@ -153,6 +162,7 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
         out = _ring().ring_all_reduce(
             x, name, comm.size, op=op, interpret=not comm.is_tpu,
             stream=_stream_for(port, program, "reduce"),
+            mesh_axes=_mesh_axes(comm),
         )
     elif op is SmiOp.ADD:
         out = lax.psum(x, name)
@@ -254,6 +264,7 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
             contrib, _axis(comm), size, op=SmiOp.ADD,
             interpret=not comm.is_tpu,
             stream=_stream_for(port, program, "scatter"),
+            mesh_axes=_mesh_axes(comm),
         )
     return lax.psum_scatter(contrib, _axis(comm), scatter_dimension=0,
                             tiled=True)
@@ -275,6 +286,7 @@ def gather(x: jax.Array, comm: Communicator, root: int = 0,
         out = _ring().ring_all_gather(
             x, _axis(comm), comm.size, interpret=not comm.is_tpu,
             stream=_stream_for(port, program, "gather"),
+            mesh_axes=_mesh_axes(comm),
         )
     else:
         out = lax.all_gather(x, _axis(comm), axis=0, tiled=True)

@@ -1,0 +1,215 @@
+"""Multi-axis communicators and concurrent ring streams.
+
+Reference parity: the SMI network addresses ranks globally whatever the
+physical topology — the stencil drives P2P ports across a 2-D FPGA grid
+(``examples/kernels/stencil_smi.cl:236-386``) and concurrent channels
+share the NoC regardless of shape (``microbenchmarks/kernels/
+bandwidth_0.cl:14-33``). Here the same holds on TPU meshes:
+
+- Rooted collectives and P2P channels accept a communicator spanning
+  SEVERAL mesh axes — the axis tuple is one flattened rank space (the
+  ``Communicator.rank`` row-major order) on both backends.
+- Ring kernels over a strict SUBSET of the mesh axes resolve remote
+  device ids globally (``kernels/ring.py::_logical_id_fn``); passing
+  the axis-local index instead cross-signals other rings' devices —
+  the interpret tier reported leaked semaphores and then deadlocked
+  (a silent data race on hardware) before the fix.
+- ``stream_concurrent(backend="ring")`` interleaves the channels'
+  bursts at READS_LIMIT granularity with per-port semaphore domains.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+import smi_tpu as smi  # noqa: E402
+from smi_tpu.kernels import ring  # noqa: E402
+from smi_tpu.parallel.channels import (  # noqa: E402
+    P2PChannel,
+    stream_concurrent,
+)
+from smi_tpu.parallel.mesh import Communicator  # noqa: E402
+
+BACKENDS = ["xla", "ring"]
+
+
+@pytest.fixture(scope="module")
+def comm2d(eight_devices):
+    return smi.make_communicator(
+        shape=(2, 4), axis_names=("mx", "my"), devices=eight_devices
+    )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("root", [0, 5])
+def test_rooted_collectives_two_axis(comm2d, backend, root):
+    """bcast/reduce address flattened ranks over BOTH mesh axes."""
+
+    @smi.smi_kernel(comm2d, in_specs=P(), out_specs=P(("mx", "my")),
+                    backend=backend)
+    def app(ctx, x):
+        contrib = x + ctx.rank().astype(x.dtype)
+        total = ctx.reduce(contrib, op="add", root=root, port=0)
+        return ctx.bcast(total, root=root, port=1)[None]
+
+    x = jnp.arange(16, dtype=jnp.float32)
+    out = np.asarray(app(x))
+    expected = np.arange(16) * 8 + sum(range(8))
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expected, err_msg=f"rank {r}")
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_scatter_gather_two_axis(comm2d, backend):
+    @smi.smi_kernel(comm2d, in_specs=P(), out_specs=P(("mx", "my")),
+                    backend=backend)
+    def app(ctx, x):
+        mine = ctx.scatter(
+            jnp.where(ctx.rank() == 3, x, jnp.zeros_like(x)),
+            root=3, port=0,
+        )
+        return ctx.gather(mine, root=2, port=1, all_ranks=True)[None]
+
+    x = jnp.arange(8 * 16, dtype=jnp.float32)
+    out = np.asarray(app(x))
+    for r in range(8):
+        np.testing.assert_allclose(out[r], np.arange(8 * 16))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_p2p_transfer_two_axis(comm2d, backend):
+    """src=1 -> dst=6 crosses the mx boundary of the (2, 4) mesh."""
+
+    @smi.smi_kernel(comm2d, in_specs=P(), out_specs=P(("mx", "my")),
+                    backend=backend)
+    def app(ctx, x):
+        ch = ctx.open_channel(port=0, src=1, dst=6, count=x.shape[0],
+                              dtype="float")
+        payload = x * (ctx.rank() + 1).astype(x.dtype)
+        return ctx.transfer(ch, payload)[None]
+
+    x = jnp.arange(32, dtype=jnp.float32)
+    out = np.asarray(app(x))
+    np.testing.assert_allclose(out[6], np.arange(32) * 2)
+    for r in range(8):
+        if r != 6:
+            np.testing.assert_array_equal(out[r], np.zeros(32))
+
+
+def test_subset_axis_ring_collective(comm2d):
+    """Independent ``my``-rings, one per ``mx`` row: remote device ids
+    must resolve to the caller's OWN row. Before the fix this leaked
+    credit semaphores across rows and deadlocked."""
+    mesh = comm2d.mesh
+    sub = Communicator(mesh=mesh, axis_names=("my",))
+    mesh_axes = ring.mesh_axes_of(sub)
+
+    def shard(x):
+        return ring.ring_all_reduce(
+            x[0], "my", 4, interpret=True, mesh_axes=mesh_axes
+        )[None]
+
+    f = jax.jit(
+        jax.shard_map(
+            shard, mesh=mesh, in_specs=P(("mx", "my"), None),
+            out_specs=P(("mx", "my"), None), check_vma=False,
+        )
+    )
+    x = jnp.arange(8, dtype=jnp.float32)[:, None] * jnp.ones((1, 128))
+    out = np.asarray(f(x))
+    # row 0 holds ranks 0-3 (sum 6), row 1 ranks 4-7 (sum 22)
+    np.testing.assert_allclose(out[:4, 0], 6.0)
+    np.testing.assert_allclose(out[4:, 0], 22.0)
+
+
+def test_subset_axis_ring_gather_outer_axis(comm2d):
+    """Rings over the OUTER axis (mx) with my varying: the non-ring
+    coordinate sits in the minor position of the logical id."""
+    mesh = comm2d.mesh
+    sub = Communicator(mesh=mesh, axis_names=("mx",))
+    mesh_axes = ring.mesh_axes_of(sub)
+
+    def shard(x):
+        return ring.ring_all_gather(
+            x, "mx", 2, interpret=True, mesh_axes=mesh_axes
+        )
+
+    f = jax.jit(
+        jax.shard_map(
+            shard, mesh=mesh, in_specs=P(("mx", "my"), None),
+            out_specs=P(None, None), check_vma=False,
+        )
+    )
+    # shard r holds one row of value r; gather over mx pairs r and r+4
+    x = jnp.arange(8, dtype=jnp.float32)[:, None] * jnp.ones((1, 128))
+    out = np.asarray(f(x))
+    # every column-ring returns (its row0 value, its row1 value); the
+    # out_specs=None reassembly keeps the first ring's copy
+    np.testing.assert_allclose(out[0, 0], 0.0)
+    np.testing.assert_allclose(out[1, 0], 4.0)
+
+
+@pytest.mark.parametrize("comm_kind", ["1d", "2d"])
+def test_stream_concurrent_ring_matches_xla(eight_devices, comm_kind):
+    """The ring tier's burst-interleaved concurrent streams deliver the
+    same messages as the XLA tier, with per-port semaphore domains."""
+    if comm_kind == "1d":
+        comm = smi.make_communicator(8, devices=eight_devices)
+        spec = P("smi")
+    else:
+        comm = smi.make_communicator(
+            shape=(2, 4), axis_names=("mx", "my"), devices=eight_devices
+        )
+        spec = P(("mx", "my"))
+
+    count = 48
+    chans = [
+        P2PChannel(comm=comm, port=0, src=0, dst=2, count=count,
+                   buffer_size=8, consecutive_reads=2),
+        P2PChannel(comm=comm, port=1, src=3, dst=1, count=count,
+                   buffer_size=8, consecutive_reads=2),
+    ]
+    x0 = jnp.arange(count, dtype=jnp.float32)
+    x1 = jnp.arange(count, dtype=jnp.float32) * 3
+
+    def shard(a, b, backend):
+        def payload(data, src):
+            return jnp.where(comm.rank() == src, data,
+                             jnp.zeros_like(data))
+        got = stream_concurrent(
+            chans, (payload(a, 0), payload(b, 3)), backend=backend,
+        )
+        return tuple(o[None] for o in got)
+
+    outs = {}
+    for backend in BACKENDS:
+        f = jax.jit(
+            jax.shard_map(
+                partial_shard(shard, backend), mesh=comm.mesh,
+                in_specs=(P(), P()),
+                out_specs=(spec, spec),
+                check_vma=False,
+            )
+        )
+        outs[backend] = tuple(np.asarray(o) for o in f(x0, x1))
+
+    for backend in BACKENDS:
+        a, b = outs[backend]
+        np.testing.assert_allclose(a[2], np.arange(count),
+                                   err_msg=backend)
+        np.testing.assert_allclose(b[1], np.arange(count) * 3,
+                                   err_msg=backend)
+        for r in range(8):
+            if r != 2:
+                np.testing.assert_array_equal(a[r], 0.0)
+            if r != 1:
+                np.testing.assert_array_equal(b[r], 0.0)
+
+
+def partial_shard(shard, backend):
+    def inner(a, b):
+        return shard(a, b, backend)
+    return inner
