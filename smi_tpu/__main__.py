@@ -387,10 +387,70 @@ def cmd_build(args: argparse.Namespace) -> int:
     ))
     if rc:
         return rc
-    return cmd_host(argparse.Namespace(
+    rc = cmd_host(argparse.Namespace(
         host_src=os.path.join(out, "smi_generated_host.py"),
         metadata=[program_json],
     ))
+    # --report-topology implies --report (the topology is only ever
+    # consumed by the report stage)
+    want_report = getattr(args, "report", False) or getattr(
+        args, "report_topology", None
+    )
+    if rc or not want_report:
+        return rc
+    return _build_report(args, out, program_json)
+
+
+def _build_report(args: argparse.Namespace, out: str,
+                  program_json: str) -> int:
+    """``build --report``: compile every manifest op and tabulate its
+    executable facts — the ``aoc -rtl -report`` stage of the pipeline
+    (reference ``CMakeLists.txt:113-118``; ``utils/report.py``)."""
+    import jax
+
+    from smi_tpu.ops.serialization import parse_program
+    from smi_tpu.utils.report import format_report, program_report
+
+    with open(program_json) as f:
+        program = parse_program(f.read())
+    topology = getattr(args, "report_topology", None)
+    if topology:
+        from smi_tpu.parallel import aot
+
+        comm = aot.topology_communicator(topology)
+    else:
+        from smi_tpu.parallel.mesh import make_communicator
+
+        # static-analysis stage: emulate the program's rank count on
+        # the CPU backend (the dryrun_multichip bootstrap); a live
+        # 1-chip mesh cannot host the P2P entries. Backends may already
+        # be initialized (RuntimeError) — use whatever devices exist.
+        try:
+            jax.config.update("jax_num_cpu_devices", args.max_ranks)
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass
+        n = min(args.max_ranks, len(jax.devices()))
+        if n < 2:
+            print(
+                "error: --report needs >= 2 devices to compile P2P "
+                "channels; pass --report-topology v5e:2x4 (abstract "
+                "slice, no hardware needed) or run on a multi-device "
+                "host",
+                file=sys.stderr,
+            )
+            return 1
+        comm = make_communicator(n)
+    report = program_report(program, comm)
+    report["program"] = args.name
+    report["target"] = topology or str(jax.devices()[0].platform)
+    path = os.path.join(out, "report.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(format_report(report))
+    print(f"report -> {path}")
+    return 0
 
 
 def cmd_bench(args: argparse.Namespace) -> int:
@@ -501,6 +561,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--consecutive-read-limit", type=int, default=8)
     p.add_argument("--max-ranks", type=int, default=8)
     p.add_argument("--no-rendezvous", action="store_true")
+    p.add_argument("--report", action="store_true",
+                   help="compile each manifest op and emit report.json "
+                        "(the aoc -rtl -report stage)")
+    p.add_argument("--report-topology", default=None, metavar="NAME",
+                   help="compile the report against an abstract TPU "
+                        "topology (e.g. v5e:2x4) instead of the local "
+                        "devices")
     p.set_defaults(fn=cmd_build)
 
     p = sub.add_parser(

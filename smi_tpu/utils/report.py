@@ -1,0 +1,156 @@
+"""Compiled-artifact reports: the ``aoc -report`` analog.
+
+Reference parity: the reference exposes first-class report targets that
+run the FPGA toolchain in analysis mode before anyone commits to a full
+bitstream build — ``aoc -rtl -report`` for area/Fmax inspection
+(``/root/reference/CMakeLists.txt:113-118``). The TPU equivalents exist
+in XLA (HLO cost analysis, compiled-executable memory analysis) but are
+ordinarily buried behind ``jax.stages`` internals; this module surfaces
+them per *program operation*: every (op, port, dtype) a program's
+manifest declares is compiled as its runtime collective/channel call and
+its executable facts are tabulated, so a user can sanity-check the
+resource story of a routed program on the emulator tier — and, given a
+topology communicator (``parallel/aot.py``), for a real TPU slice —
+before running anything.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from smi_tpu.ops.operations import (
+    Broadcast,
+    Gather,
+    Pop,
+    Push,
+    Reduce,
+    Scatter,
+)
+from smi_tpu.ops.types import dtype_to_jnp
+from smi_tpu.parallel.mesh import Communicator
+
+#: default message length per reported operation (elements)
+REPORT_COUNT = 4096
+
+
+def _compile(comm: Communicator, shard_fn, global_shape, dtype):
+    sharding = NamedSharding(comm.mesh, P())
+    jitted = jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=comm.mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    shape = jax.ShapeDtypeStruct(global_shape, dtype, sharding=sharding)
+    return jitted.lower(shape).compile()
+
+
+def _op_call(op, comm: Communicator, count: int, backend: str):
+    """(shard_fn, global_shape, jnp dtype) realizing one manifest op."""
+    from smi_tpu.parallel import collectives
+    from smi_tpu.parallel.channels import P2PChannel
+
+    dt = dtype_to_jnp(op.dtype)
+    if isinstance(op, (Push, Pop)):
+        ch = P2PChannel(
+            comm=comm, port=op.port, src=0, dst=comm.size - 1,
+            count=count, dtype=op.dtype, buffer_size=op.buffer_size,
+        )
+        return (lambda x: ch.transfer(x, backend=backend)), (count,), dt
+    if isinstance(op, Broadcast):
+        return (
+            lambda x: collectives.bcast(
+                x, comm, root=0, port=op.port, backend=backend
+            ),
+            (count,), dt,
+        )
+    if isinstance(op, Reduce):
+        return (
+            lambda x: collectives.reduce(
+                x, comm, op=op.op, root=0, port=op.port, backend=backend
+            ),
+            (count,), dt,
+        )
+    if isinstance(op, Scatter):
+        return (
+            lambda x: collectives.scatter(
+                x, comm, root=0, port=op.port, backend=backend
+            ),
+            (comm.size * count,), dt,
+        )
+    if isinstance(op, Gather):
+        return (
+            lambda x: collectives.gather(
+                x, comm, root=0, port=op.port, backend=backend
+            ),
+            (count,), dt,
+        )
+    raise ValueError(f"unreportable operation type {type(op).__name__}")
+
+
+def program_report(
+    program,
+    comm: Communicator,
+    count: int = REPORT_COUNT,
+    backend: str = "xla",
+) -> dict:
+    """Per-operation executable report of a routed program.
+
+    Each manifest operation is compiled as its runtime call over
+    ``comm`` and measured with XLA's own cost/memory analyses. ``comm``
+    may be a live mesh (emulator tier: numbers describe the CPU
+    executable) or an abstract topology communicator
+    (``aot.topology_communicator``: numbers describe the real TPU
+    executable, no hardware needed).
+    """
+    from smi_tpu.parallel.aot import executable_report
+
+    seen_p2p_ports = set()
+    ops_out = []
+    for op in program.operations:
+        if isinstance(op, (Push, Pop)):
+            # a push/pop pair is ONE channel; report it once per port
+            if op.port in seen_p2p_ports:
+                continue
+            seen_p2p_ports.add(op.port)
+        shard_fn, shape, dt = _op_call(op, comm, count, backend)
+        compiled = _compile(comm, shard_fn, shape, dt)
+        entry = {
+            "op": type(op).__name__.lower(),
+            "port": op.port,
+            "dtype": op.dtype.value,
+            "count": count,
+            **executable_report(compiled),
+        }
+        ops_out.append(entry)
+    return {
+        "backend": backend,
+        "comm_size": comm.size,
+        "count": count,
+        "operations": ops_out,
+    }
+
+
+def format_report(report: dict) -> str:
+    """Human-readable table (the ``aoc`` report's summary screen)."""
+    lines = [
+        f"program report — {report['comm_size']} ranks, "
+        f"{report['count']} elements/op, backend={report['backend']}",
+        f"{'op':<10} {'port':>4} {'dtype':<7} {'flops':>12} "
+        f"{'bytes':>14} {'code':>10} {'temp':>10}",
+    ]
+    for e in report["operations"]:
+        cost = e.get("cost", {})
+        mem = e.get("memory", {})
+        lines.append(
+            f"{e['op']:<10} {e['port']:>4} {e['dtype']:<7} "
+            f"{cost.get('flops', 0):>12.0f} "
+            f"{cost.get('bytes accessed', 0):>14.0f} "
+            f"{mem.get('generated_code_bytes', 0):>10} "
+            f"{mem.get('temp_bytes', 0):>10}"
+        )
+    return "\n".join(lines)

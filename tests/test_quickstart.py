@@ -61,8 +61,14 @@ def test_quickstart_runs_verbatim(tmp_path, eight_devices):
     for artifact in ("app.json", "smi-routes/hostfile",
                      "smi-routes/cks-rank0-channel0",
                      "smi_generated_device.py",
-                     "smi_generated_host.py"):
+                     "smi_generated_host.py", "report.json"):
         assert (tmp_path / "build" / artifact).exists(), artifact
+    import json
+    report = json.loads((tmp_path / "build" / "report.json").read_text())
+    ops = {(e["op"], e["port"]) for e in report["operations"]}
+    assert ops == {("push", 0), ("broadcast", 1)}, ops
+    for e in report["operations"]:
+        assert "cost" in e and "memory" in e
 
     # 3. the run script, as documented (same interpreter: the fake mesh
     # is already configured by conftest)

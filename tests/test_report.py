@@ -1,0 +1,61 @@
+"""Compiled-artifact report (the ``aoc -rtl -report`` analog).
+
+Reference: report targets let the reference inspect area/Fmax before a
+full hardware build (``CMakeLists.txt:113-118``); here every manifest op
+compiles through XLA and reports its cost/memory facts
+(``smi_tpu/utils/report.py``). The CPU tier golden-tests the structure;
+the numbers are informative on TPU (``build --report-topology``).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from smi_tpu.ops.operations import (  # noqa: E402
+    Broadcast,
+    Gather,
+    Pop,
+    Push,
+    Reduce,
+    Scatter,
+)
+from smi_tpu.ops.program import Program  # noqa: E402
+from smi_tpu.ops.types import SmiDtype, SmiOp  # noqa: E402
+from smi_tpu.utils.report import format_report, program_report  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def full_program():
+    return Program([
+        Push(port=0, dtype=SmiDtype.FLOAT, buffer_size=32),
+        Pop(port=0, dtype=SmiDtype.FLOAT, buffer_size=32),
+        Broadcast(port=1, dtype=SmiDtype.INT),
+        Reduce(port=2, dtype=SmiDtype.FLOAT, op=SmiOp.MAX),
+        Scatter(port=3, dtype=SmiDtype.FLOAT),
+        Gather(port=4, dtype=SmiDtype.FLOAT),
+    ])
+
+
+def test_program_report_covers_every_port(comm8, full_program):
+    report = program_report(full_program, comm8, count=64)
+    entries = {(e["op"], e["port"]) for e in report["operations"]}
+    # the push/pop pair is one channel, reported once
+    assert entries == {
+        ("push", 0), ("broadcast", 1), ("reduce", 2),
+        ("scatter", 3), ("gather", 4),
+    }
+    for e in report["operations"]:
+        assert e["count"] == 64
+        assert "cost" in e and "memory" in e
+        # XLA's cost model prices a reduction's flops > 0
+        if e["op"] == "reduce":
+            assert e["cost"].get("flops", 0) > 0
+
+
+def test_format_report_tabulates(comm8, full_program):
+    report = program_report(full_program, comm8, count=64)
+    text = format_report(report)
+    assert "8 ranks" in text
+    for op in ("push", "broadcast", "reduce", "scatter", "gather"):
+        assert op in text
