@@ -35,6 +35,12 @@ import numpy as np
 
 PEAK_BF16 = 197e12
 PEAK_HBM = 819e9
+#: reachable f32 matmul peak: the MXU has no f32 datapath, so an f32
+#: contraction under Precision.HIGHEST runs as 3 bf16 passes (hi/lo
+#: split: hi*hi + hi*lo + lo*hi). An f32 kernel can therefore reach at
+#: most a third of the bf16 peak — MFU against PEAK_BF16 alone would
+#: make every f32 number look 3x worse than it is.
+PEAK_F32_EFFECTIVE = PEAK_BF16 / 3
 MXU_FLOPS_PER_CYCLE = 4 * 128 * 128 * 2
 CLOCK = PEAK_BF16 / MXU_FLOPS_PER_CYCLE           # ≈ 1.5 GHz, derived
 PEAK_VPU_F32 = 4 * 8 * 128 * CLOCK                # ≈ 6.2e12, derived
@@ -72,16 +78,21 @@ def diff_rate(make_fn, work_per_rep: float, r1: int = 1, factor: int = 4,
 
     ``make_fn(r)`` must return a nullary callable running ``r`` reps and
     blocking on the result. Returns ``(rate, (r1, r2, t1, t2))``.
+
+    ``max_reps`` caps the rep count BEFORE a chain is ever built: some
+    harnesses grow per-rep state with ``r`` (a grad-of-reps chain stacks
+    its VJP residuals r-fold), so "time it first, notice the cap after"
+    can compile an HBM-OOM program on the way to the cap.
     """
+    r1 = min(r1, max_reps)
     t1 = _timed(make_fn(r1), runs)
-    r2 = r1 * factor
     while True:
+        r2 = min(r1 * factor, max_reps)
         t2 = _timed(make_fn(r2), runs)
         if t2 - t1 >= min_delta or r2 >= max_reps:
             rate = (r2 - r1) * work_per_rep / max(t2 - t1, 1e-9)
             return rate, (r1, r2, round(t1, 4), round(t2, 4))
         r1, t1 = r2, t2
-        r2 *= factor
 
 
 #: internal callers predate the public promotion
@@ -155,12 +166,18 @@ def flash_forward_points(comm, quick: bool = False):
         rate, trace = _diff_rate(make_fn, work)
         tflops = rate / 1e12
         name = "bf16" if dtype == jnp.bfloat16 else "f32"
+        roofline = {"mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16,
+                    "peak_bf16_tflops": PEAK_BF16 / 1e12}
+        if name == "f32":
+            roofline["mfu_vs_f32_effective_peak"] = (
+                tflops * 1e12 / PEAK_F32_EFFECTIVE
+            )
+            roofline["peak_f32_effective_tflops"] = PEAK_F32_EFFECTIVE / 1e12
         out.append(_result(
             f"flash_attn_fwd_s{s}_{name}", tflops, "TFLOP/s",
             {"S": s, "H": h, "D": d, "dtype": name, "causal": True,
              "timing": trace},
-            {"mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16,
-             "peak_bf16_tflops": PEAK_BF16 / 1e12},
+            roofline,
         ))
     return out
 
@@ -195,14 +212,22 @@ def flash_train_point(comm, quick: bool = False):
                 jnp.sum(grad(_q, _k, _v)[0].astype(jnp.float32)))
 
         work = _attention_flops(s, h, d, causal=True, train=True)
-        rate, trace = _diff_rate(make_fn, work)
+        # the grad chain stacks (q, out, stats) residuals per rep
+        # (~36 MB/rep at S=8192 bf16); 256 reps ≈ 9 GB is the most the
+        # 16 GB chip can carry next to the live buffers
+        rate, trace = _diff_rate(make_fn, work, max_reps=256)
         tflops = rate / 1e12
         tokens = rate / work * s
+        roofline = {"mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16}
+        if name == "f32":
+            roofline["mfu_vs_f32_effective_peak"] = (
+                tflops * 1e12 / PEAK_F32_EFFECTIVE
+            )
         out.append(_result(
             f"flash_attn_train_tflops_{name}", tflops, "TFLOP/s",
             {"S": s, "H": h, "D": d, "dtype": name, "causal": True,
              "timing": trace},
-            {"mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16},
+            roofline,
         ))
         out.append(_result(
             f"flash_attn_train_tokens_{name}", tokens / 1e6, "Mtoken/s",

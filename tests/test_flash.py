@@ -419,14 +419,21 @@ def test_causal_fetch_clamp_equivalence(eight_devices):
                                atol=2e-5)
 
 
-def test_ring_attention_window_chunk_offset(eight_devices):
+@pytest.mark.parametrize("n", [1, 2])
+def test_ring_attention_window_chunk_offset(eight_devices, n):
     """Windowed schedules with a live span much shorter than the K/V
     extent — the grid's streamed axis is *relative* (fewer grid chunks
     than total chunks) and the BlockSpec index maps offset it by a
     nonzero ``chunk0``. Guards the index-map/kernel agreement on which
     chunk each grid step fetched; every other windowed test resolves to
-    ``n_grid == n_total`` where the offset is identically zero."""
-    comm = smi.make_communicator(2, devices=eight_devices[:2])
+    ``n_grid == n_total`` where the offset is identically zero.
+
+    ``n=1`` routes through the FUSED single-shot kernel (its own grid
+    offset arithmetic; previously only the opt-in TPU tier compiled
+    it), ``n=2`` through the carried ring kernel. The window (24) is
+    deliberately not a multiple of the K/V chunk (16), so the live
+    span straddles chunk boundaries."""
+    comm = smi.make_communicator(n, devices=eight_devices[:n])
     s, h, d = 256, 2, 128
     window = 24
     rng = np.random.RandomState(23)
@@ -441,10 +448,11 @@ def test_ring_attention_window_chunk_offset(eight_devices):
         )
         # precondition: the relative axis is genuinely shorter than the
         # extent, so chunk0 takes nonzero values (the point of the test)
-        per_rank = s // 2
+        per_rank = s // n
         kc = flash._window_chunk(per_rank, 8, d, 4)
         n_kc, n_total = flash._window_chunks(per_rank, kc, 16, window)
         assert n_kc < n_total, (n_kc, n_total)
+        assert window % kc != 0, (window, kc)
         fn_f = ra.make_ring_attention_fn(
             comm, causal=True, window=window,
             use_flash=True, interpret=True,
