@@ -32,6 +32,10 @@ def main(argv=None):
                         help="write .dat/.json result files here")
     parser.add_argument("--trace", default=None, metavar="DIR",
                         help="collect a JAX profiler trace into DIR")
+    parser.add_argument("--backend", default="xla",
+                        choices=("xla", "ring"),
+                        help="communication tier: XLA collectives or the "
+                             "explicit credit-flow ring RDMA kernels")
     parser.add_argument("--cpu", action="store_true",
                         help="force the CPU backend")
     parser.add_argument("--fake-ranks", type=int, default=None,
@@ -54,6 +58,8 @@ def main(argv=None):
     comm = make_communicator(n_devices=args.ranks)
     names = sorted(BENCHMARKS) if args.name == "all" else [args.name]
     params = {"runs": args.runs}
+    if args.backend != "xla":
+        params["backend"] = args.backend
     if args.root is not None:
         params["root"] = args.root
     if args.elements is not None:
@@ -78,6 +84,7 @@ def main(argv=None):
         elif name.startswith("app_"):
             p.pop("root", None)
             p.pop("elements", None)
+            p.pop("backend", None)
             if name.startswith("app_ring_attention"):
                 if args.window is not None:
                     p["window"] = args.window

@@ -48,6 +48,7 @@ def _force(fn):
 def bench_bandwidth(
     comm: Communicator, size_kb: int = 512, runs: int = 10, repeats: int = 4,
     rendezvous: bool = False, buffer_size: int = 2048,
+    backend: str = "xla",
 ) -> Measurement:
     """Two concurrent P2P channels rank0→rank1; payload Gbit/s.
 
@@ -72,10 +73,11 @@ def bench_bandwidth(
             if rendezvous:
                 # lockstep chunking keeps the two channels concurrent
                 # (separate .stream calls would serialize their scans)
-                a, b = stream_concurrent((ch0, ch1), (x, x * 2))
+                a, b = stream_concurrent((ch0, ch1), (x, x * 2),
+                                         backend=backend)
             else:
-                a = ch0.transfer(x)
-                b = ch1.transfer(x * 2)
+                a = ch0.transfer(x, backend=backend)
+                b = ch1.transfer(x * 2, backend=backend)
             return carry + jnp.sum(a) + jnp.sum(b), ()
 
         total, _ = lax.scan(one, jnp.zeros((), jnp.float32), None,
@@ -93,21 +95,25 @@ def bench_bandwidth(
     name = "bandwidth" if rendezvous else "bandwidth-eager"
     return Measurement(name, "Gbit/s", gbits,
                        {"size_kb": size_kb, "channels": 2,
-                        "repeats": repeats, "rendezvous": rendezvous})
+                        "repeats": repeats, "rendezvous": rendezvous,
+                        "backend": backend})
 
 
 def bench_bandwidth_eager(comm, size_kb: int = 512, runs: int = 10,
-                          repeats: int = 4):
-    return bench_bandwidth(comm, size_kb, runs, repeats, rendezvous=False)
+                          repeats: int = 4, backend: str = "xla"):
+    return bench_bandwidth(comm, size_kb, runs, repeats, rendezvous=False,
+                           backend=backend)
 
 
 def bench_bandwidth_rendezvous(comm, size_kb: int = 512, runs: int = 10,
-                               repeats: int = 4):
-    return bench_bandwidth(comm, size_kb, runs, repeats, rendezvous=True)
+                               repeats: int = 4, backend: str = "xla"):
+    return bench_bandwidth(comm, size_kb, runs, repeats, rendezvous=True,
+                           backend=backend)
 
 
 def bench_latency(
-    comm: Communicator, pingpongs: int = 100, runs: int = 10
+    comm: Communicator, pingpongs: int = 100, runs: int = 10,
+    backend: str = "xla",
 ) -> Measurement:
     """1-element ping-pong rank0↔rank1; half round trip in usec."""
     axis = comm.axis_names[0]
@@ -119,8 +125,8 @@ def bench_latency(
                          dtype="int", rendezvous=False)
 
         def one(carry, _):
-            there = fwd.transfer(carry)
-            back = bwd.transfer(there + 1)
+            there = fwd.transfer(carry, backend=backend)
+            back = bwd.transfer(there + 1, backend=backend)
             return back, ()
 
         out, _ = lax.scan(one, x, None, length=pingpongs)
@@ -133,11 +139,13 @@ def bench_latency(
     x = jnp.zeros(1, jnp.int32)
     samples = timed_samples(_force(lambda: fn(x)), runs)
     usecs = [t / (2 * pingpongs) * 1e6 for t in samples]
-    return Measurement("latency", "usec", usecs, {"pingpongs": pingpongs})
+    return Measurement("latency", "usec", usecs,
+                       {"pingpongs": pingpongs, "backend": backend})
 
 
 def bench_injection(
-    comm: Communicator, messages: int = 100, runs: int = 10
+    comm: Communicator, messages: int = 100, runs: int = 10,
+    backend: str = "xla",
 ) -> Measurement:
     """Back-to-back 1-element sends; per-message overhead in usec."""
     axis = comm.axis_names[0]
@@ -147,7 +155,7 @@ def bench_injection(
                         dtype="int", rendezvous=False)
 
         def one(carry, _):
-            got = ch.transfer(carry)
+            got = ch.transfer(carry, backend=backend)
             return got + carry, ()
 
         out, _ = lax.scan(one, x, None, length=messages)
@@ -161,12 +169,12 @@ def bench_injection(
     samples = timed_samples(_force(lambda: fn(x)), runs)
     usecs = [t / messages * 1e6 for t in samples]
     return Measurement("injection", "usec/msg", usecs,
-                       {"messages": messages})
+                       {"messages": messages, "backend": backend})
 
 
 def _bench_collective(
     name: str, comm: Communicator, elements: int, root: int, runs: int,
-    op: Optional[str] = None,
+    op: Optional[str] = None, backend: str = "xla",
 ) -> Measurement:
     axis = comm.axis_names[0]
     size = comm.size
@@ -174,15 +182,19 @@ def _bench_collective(
     def shard_fn(x):
         r = comm.rank().astype(x.dtype)
         if name == "broadcast":
-            out = coll.bcast(x + r, root=root, comm=comm, port=0)
+            out = coll.bcast(x + r, root=root, comm=comm, port=0,
+                             backend=backend)
         elif name == "reduce":
-            out = coll.reduce(x + r, comm, op=op or "add", root=root, port=0)
+            out = coll.reduce(x + r, comm, op=op or "add", root=root,
+                              port=0, backend=backend)
         elif name == "scatter":
             out = coll.scatter(
-                jnp.tile(x, size) + r, comm, root=root, port=0
+                jnp.tile(x, size) + r, comm, root=root, port=0,
+                backend=backend,
             )
         else:  # gather
-            out = coll.gather(x + r, comm, root=root, port=0)
+            out = coll.gather(x + r, comm, root=root, port=0,
+                              backend=backend)
         return jnp.sum(out)[None]
 
     fn = jax.jit(jax.shard_map(
@@ -194,29 +206,38 @@ def _bench_collective(
     usecs = [t * 1e6 for t in samples]
     return Measurement(
         f"{name}-root{root}", "usec", usecs,
-        {"elements": elements, "root": root, "ranks": size, "op": op},
+        {"elements": elements, "root": root, "ranks": size, "op": op,
+         "backend": backend},
     )
 
 
-def bench_broadcast(comm, elements: int = 65536, root: int = 0, runs: int = 10):
-    return _bench_collective("broadcast", comm, elements, root, runs)
+def bench_broadcast(comm, elements: int = 65536, root: int = 0,
+                    runs: int = 10, backend: str = "xla"):
+    return _bench_collective("broadcast", comm, elements, root, runs,
+                             backend=backend)
 
 
 def bench_reduce(comm, elements: int = 65536, root: int = 0, runs: int = 10,
-                 op: str = "add"):
-    return _bench_collective("reduce", comm, elements, root, runs, op=op)
+                 op: str = "add", backend: str = "xla"):
+    return _bench_collective("reduce", comm, elements, root, runs, op=op,
+                             backend=backend)
 
 
-def bench_scatter(comm, elements: int = 8192, root: int = 0, runs: int = 10):
-    return _bench_collective("scatter", comm, elements, root, runs)
+def bench_scatter(comm, elements: int = 8192, root: int = 0, runs: int = 10,
+                  backend: str = "xla"):
+    return _bench_collective("scatter", comm, elements, root, runs,
+                             backend=backend)
 
 
-def bench_gather(comm, elements: int = 8192, root: int = 0, runs: int = 10):
-    return _bench_collective("gather", comm, elements, root, runs)
+def bench_gather(comm, elements: int = 8192, root: int = 0, runs: int = 10,
+                 backend: str = "xla"):
+    return _bench_collective("gather", comm, elements, root, runs,
+                             backend=backend)
 
 
 def bench_multi_collectives(
-    comm: Communicator, elements: int = 16384, runs: int = 10
+    comm: Communicator, elements: int = 16384, runs: int = 10,
+    backend: str = "xla",
 ) -> Measurement:
     """Overlap benefit: 3 independent broadcasts on distinct ports vs 3
     serialized ones (data-dependent chain)."""
@@ -225,15 +246,16 @@ def bench_multi_collectives(
     r1, r2 = 1 % comm.size, 2 % comm.size  # stay valid on tiny comms
 
     def overlapped(x):
-        a = coll.bcast(x, comm, root=0, port=0)
-        b = coll.bcast(x * 2, comm, root=r1, port=1)
-        c = coll.bcast(x * 3, comm, root=r2, port=2)
+        a = coll.bcast(x, comm, root=0, port=0, backend=backend)
+        b = coll.bcast(x * 2, comm, root=r1, port=1, backend=backend)
+        c = coll.bcast(x * 3, comm, root=r2, port=2, backend=backend)
         return (jnp.sum(a) + jnp.sum(b) + jnp.sum(c))[None]
 
     def serialized(x):
-        a = coll.bcast(x, comm, root=0, port=0)
-        b = coll.bcast(a * 2, comm, root=r1, port=0)  # depends on a
-        c = coll.bcast(b * 3, comm, root=r2, port=0)
+        a = coll.bcast(x, comm, root=0, port=0, backend=backend)
+        b = coll.bcast(a * 2, comm, root=r1, port=0,
+                       backend=backend)  # depends on a
+        c = coll.bcast(b * 3, comm, root=r2, port=0, backend=backend)
         return jnp.sum(c)[None]
 
     x = jnp.ones(elements, jnp.float32)
@@ -247,7 +269,7 @@ def bench_multi_collectives(
         results[tag] = [t * 1e6 for t in samples]
     # report the overlapped time; serialized mean lands in config
     m = Measurement("multi_collectives", "usec", results["overlapped"],
-                    {"elements": elements,
+                    {"elements": elements, "backend": backend,
                      "serialized_mean_usec":
                          sum(results["serialized"]) / runs})
     return m
@@ -255,7 +277,7 @@ def bench_multi_collectives(
 
 def bench_pipeline(
     comm: Communicator, elements: int = 4096, rounds: int = 16,
-    runs: int = 10, rendezvous: bool = True,
+    runs: int = 10, rendezvous: bool = True, backend: str = "xla",
 ) -> Measurement:
     """Ring pipeline: every rank forwards to rank+1 for R rounds."""
     axis = comm.axis_names[0]
@@ -268,13 +290,17 @@ def bench_pipeline(
                 n_chunks = max(1, elements // chunk)
                 parts = carry[: n_chunks * chunk].reshape(n_chunks, -1)
                 _, shifted = lax.scan(
-                    lambda c, part: (c, ring_shift(part, comm)), (), parts
+                    lambda c, part: (c, ring_shift(part, comm,
+                                                   backend=backend)),
+                    (), parts
                 )
                 out = jnp.concatenate(
-                    [shifted.reshape(-1), ring_shift(carry[n_chunks * chunk:], comm)]
+                    [shifted.reshape(-1),
+                     ring_shift(carry[n_chunks * chunk:], comm,
+                                backend=backend)]
                 ) if elements % chunk else shifted.reshape(-1)
             else:
-                out = ring_shift(carry, comm)
+                out = ring_shift(carry, comm, backend=backend)
             return out + 1.0, ()
 
         out, _ = lax.scan(one, x, None, length=rounds)
@@ -290,12 +316,12 @@ def bench_pipeline(
     name = "pipeline" if rendezvous else "pipeline-eager"
     return Measurement(name, "usec/round", usecs,
                        {"elements": elements, "rounds": rounds,
-                        "rendezvous": rendezvous})
+                        "rendezvous": rendezvous, "backend": backend})
 
 
 def bench_pipeline_double_rail(
     comm: Communicator, elements: int = 4096, rounds: int = 16,
-    runs: int = 10,
+    runs: int = 10, backend: str = "xla",
 ) -> Measurement:
     """Ring pipeline with the payload split into two messages per hop.
 
@@ -312,8 +338,8 @@ def bench_pipeline_double_rail(
     def shard_fn(x):
         def one(carry, _):
             a, b = carry[:half], carry[half:]
-            a = ring_shift(a, comm)      # rail 0
-            b = ring_shift(b, comm)      # rail 1 — independent ppermute
+            a = ring_shift(a, comm, backend=backend)      # rail 0
+            b = ring_shift(b, comm, backend=backend)      # rail 1
             return jnp.concatenate([a, b]) + 1.0, ()
 
         out, _ = lax.scan(one, x, None, length=rounds)
@@ -327,7 +353,8 @@ def bench_pipeline_double_rail(
     samples = timed_samples(_force(lambda: fn(x)), runs)
     usecs = [t / rounds * 1e6 for t in samples]
     return Measurement("pipeline-double-rail", "usec/round", usecs,
-                       {"elements": elements, "rounds": rounds, "rails": 2})
+                       {"elements": elements, "rounds": rounds, "rails": 2,
+                        "backend": backend})
 
 
 BENCHMARKS: Dict[str, Callable] = {
@@ -359,6 +386,13 @@ def run_benchmark(name: str, comm: Optional[Communicator] = None,
     if comm is None:
         comm = make_communicator()
     m = BENCHMARKS[name](comm, **params)
+    backend = params.get("backend", "xla")
+    if backend != "xla" and not m.name.endswith(f"-{backend}"):
+        # result files are keyed by name; a ring run must never
+        # clobber the xla run's .dat/.json in a shared out-dir
+        import dataclasses as _dc
+
+        m = _dc.replace(m, name=f"{m.name}-{backend}")
     print(m.summary())
     if out_dir:
         m.write_dat(out_dir)

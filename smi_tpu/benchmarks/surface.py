@@ -41,6 +41,20 @@ PEAK_HBM = 819e9
 #: most a third of the bf16 peak — MFU against PEAK_BF16 alone would
 #: make every f32 number look 3x worse than it is.
 PEAK_F32_EFFECTIVE = PEAK_BF16 / 3
+
+
+def _mfu_roofline(tflops: float, dtype_name: str) -> dict:
+    """Roofline ratios for a TFLOP/s metric: always vs the bf16 peak,
+    plus the reachable f32-effective peak for f32 points (one schema
+    for every consumer of PERF.json)."""
+    roofline = {"mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16,
+                "peak_bf16_tflops": PEAK_BF16 / 1e12}
+    if dtype_name == "f32":
+        roofline["mfu_vs_f32_effective_peak"] = (
+            tflops * 1e12 / PEAK_F32_EFFECTIVE
+        )
+        roofline["peak_f32_effective_tflops"] = PEAK_F32_EFFECTIVE / 1e12
+    return roofline
 MXU_FLOPS_PER_CYCLE = 4 * 128 * 128 * 2
 CLOCK = PEAK_BF16 / MXU_FLOPS_PER_CYCLE           # ≈ 1.5 GHz, derived
 PEAK_VPU_F32 = 4 * 8 * 128 * CLOCK                # ≈ 6.2e12, derived
@@ -166,18 +180,11 @@ def flash_forward_points(comm, quick: bool = False):
         rate, trace = _diff_rate(make_fn, work)
         tflops = rate / 1e12
         name = "bf16" if dtype == jnp.bfloat16 else "f32"
-        roofline = {"mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16,
-                    "peak_bf16_tflops": PEAK_BF16 / 1e12}
-        if name == "f32":
-            roofline["mfu_vs_f32_effective_peak"] = (
-                tflops * 1e12 / PEAK_F32_EFFECTIVE
-            )
-            roofline["peak_f32_effective_tflops"] = PEAK_F32_EFFECTIVE / 1e12
         out.append(_result(
             f"flash_attn_fwd_s{s}_{name}", tflops, "TFLOP/s",
             {"S": s, "H": h, "D": d, "dtype": name, "causal": True,
              "timing": trace},
-            roofline,
+            _mfu_roofline(tflops, name),
         ))
     return out
 
@@ -213,21 +220,17 @@ def flash_train_point(comm, quick: bool = False):
 
         work = _attention_flops(s, h, d, causal=True, train=True)
         # the grad chain stacks (q, out, stats) residuals per rep
-        # (~36 MB/rep at S=8192 bf16); 256 reps ≈ 9 GB is the most the
-        # 16 GB chip can carry next to the live buffers
-        rate, trace = _diff_rate(make_fn, work, max_reps=256)
+        # (~36 MB/rep at S=8192 bf16, ~2x that in f32); cap the chain
+        # so it stays under ~9 GB next to the live buffers
+        cap = 256 if dtype == jnp.bfloat16 else 128
+        rate, trace = _diff_rate(make_fn, work, max_reps=cap)
         tflops = rate / 1e12
         tokens = rate / work * s
-        roofline = {"mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16}
-        if name == "f32":
-            roofline["mfu_vs_f32_effective_peak"] = (
-                tflops * 1e12 / PEAK_F32_EFFECTIVE
-            )
         out.append(_result(
             f"flash_attn_train_tflops_{name}", tflops, "TFLOP/s",
             {"S": s, "H": h, "D": d, "dtype": name, "causal": True,
              "timing": trace},
-            roofline,
+            _mfu_roofline(tflops, name),
         ))
         out.append(_result(
             f"flash_attn_train_tokens_{name}", tokens / 1e6, "Mtoken/s",
@@ -432,15 +435,21 @@ def model_train_point(comm, quick: bool = False):
         devices=list(comm.mesh.devices.flat)[:1],
     )
     out = []
-    for s, window in ((8192, None), (32768, 4096)):
+    for s, window, layers in (
+        (8192, None, 1), (32768, 4096, 1),
+        # the 4-block stack (scan + per-block remat): composition
+        # overhead shown amortized, not per-block
+        (8192, 4096, 4), (32768, 4096, 4),
+    ):
         cfg = tf.BlockConfig(embed=e, heads=h, head_dim=d,
                              compute_dtype="bfloat16", window=window)
-        params = tf.init_params(cfg)
+        params = (tf.init_params(cfg) if layers == 1
+                  else tf.init_stack_params(cfg, layers))
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(1, s, e).astype(np.float32))
 
-        def make_fn(r, _cfg=cfg, _params=params, _x=x):
-            step = tf.make_train_step(comm2, _cfg)
+        def make_fn(r, _cfg=cfg, _params=params, _x=x, _layers=layers):
+            step = tf.make_train_step(comm2, _cfg, layers=_layers)
 
             def run():
                 p, loss = dict(_params), None
@@ -460,13 +469,18 @@ def model_train_point(comm, quick: bool = False):
         matmul = (2 * e * 3 * h * d + 2 * h * d * e
                   + 4 * cfg.mlp_ratio * e * e)
         attn = 4 * window * h * d if window else 4 * s * h * d / 2
-        tflops = rate * 3 * (matmul + attn) / 1e12
+        # fwd+bwd = 3x fwd flops per layer; per-block remat re-runs each
+        # forward once more under the backward (4x total) for layers > 1
+        passes = 3 if layers == 1 else 4
+        tflops = rate * layers * passes * (matmul + attn) / 1e12
         tag = "" if window is None else f"_s{s}_window{window}"
+        if layers > 1:
+            tag += f"_l{layers}"
         out.append(_result(
             f"transformer_train_tokens{tag}_bf16", rate / 1e6,
             "Mtoken/s",
             {"S": s, "embed": e, "H": h, "D": d, "compute": "bf16",
-             "window": window, "timing": trace},
+             "window": window, "layers": layers, "timing": trace},
             {"approx_tflops": tflops,
              "mfu_vs_bf16_peak": tflops * 1e12 / PEAK_BF16},
         ))

@@ -86,6 +86,19 @@ def init_params(config: BlockConfig, seed: int = 0) -> dict:
     }
 
 
+def init_stack_params(config: BlockConfig, layers: int,
+                      seed: int = 0) -> dict:
+    """Stacked parameters for a ``layers``-deep block stack: each leaf
+    is ``(layers, ...)`` — the ``lax.scan``-ready layout (one traced
+    block, not ``layers`` inlined copies)."""
+    per_layer = [
+        init_params(config, seed=seed + i) for i in range(layers)
+    ]
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_layer
+    )
+
+
 def _layernorm(x):
     mu = x.mean(axis=-1, keepdims=True)
     var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
@@ -138,18 +151,52 @@ def block_shard(
     return x + mlp.reshape(b, s, e)
 
 
+def stack_shard(
+    params: dict,                # stacked: every leaf (layers, ...)
+    x: jax.Array,
+    comm: Communicator,
+    config: BlockConfig,
+    sp_axis: str = "sp",
+    use_flash: Optional[bool] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """A ``layers``-deep stack of pre-norm blocks on this rank's shard.
+
+    ``lax.scan`` over the stacked parameters traces ONE block; each
+    block is rematerialized under differentiation (``jax.checkpoint``),
+    so training memory holds one block's residuals plus the per-layer
+    activations — the standard deep-stack recipe, required at 32k+
+    tokens where 4 layers of flash residuals would not fit otherwise.
+    """
+    block = jax.checkpoint(
+        lambda p, xc: block_shard(
+            p, xc, comm, config, sp_axis=sp_axis,
+            use_flash=use_flash, interpret=interpret,
+        )
+    )
+
+    def body(xc, p):
+        return block(p, xc), None
+
+    out, _ = lax.scan(body, x, params)
+    return out
+
+
 def make_train_step(
     comm: Communicator,
     config: BlockConfig,
     lr: float = 1e-3,
     use_flash: Optional[bool] = None,
     interpret: bool = False,
+    layers: int = 1,
 ):
     """Jitted SGD training step over the communicator's (dp, sp) mesh.
 
     ``(params, x, y) -> (new_params, loss)`` with ``x``/``y`` of global
     shape ``(B, S, E)`` — batch over the first mesh axis, sequence over
-    the second — and replicated parameters/loss.
+    the second — and replicated parameters/loss. With ``layers > 1``,
+    ``params`` is the stacked tree from :func:`init_stack_params` and
+    the model is that many blocks deep (scan + per-block remat).
     """
     dp_axis, sp_axis = comm.axis_names
     axes = (dp_axis, sp_axis)
@@ -158,7 +205,8 @@ def make_train_step(
         n_total = x.shape[0] * x.shape[1] * comm.size  # per-shard equal
 
         def local_loss(p):
-            pred = block_shard(
+            fwd = stack_shard if layers > 1 else block_shard
+            pred = fwd(
                 p, x, comm, config, sp_axis=sp_axis,
                 use_flash=use_flash, interpret=interpret,
             )

@@ -501,15 +501,33 @@ def ring_shift(
     comm: Communicator,
     offset: int = 1,
     axis_name: Optional[str] = None,
+    backend: str = "xla",
 ) -> jax.Array:
     """Shift ``x`` to rank ``(r + offset) % size`` along a comm axis.
 
     The TPU analog of the reference's rank-pipeline pattern
     (``microbenchmarks/kernels/pipeline.cl:16-31``): each rank pops from
     rank-1 and pushes to rank+1. One ``ppermute`` with the full ring
-    permutation rides neighbour ICI links.
+    permutation rides neighbour ICI links; ``backend="ring"`` makes the
+    same move over the explicit neighbour RDMA kernel, one hop per
+    offset step.
     """
     name = axis_name or comm.axis_names[0]
     n = comm.mesh.shape[name]
+    if check_backend(backend) == "ring" and x.size:
+        # (zero-size payloads fall through to the ppermute path: the
+        # ring kernel has no 0-element block shape, and moving nothing
+        # is backend-indifferent)
+        from smi_tpu.kernels import ring as _ring
+
+        direction = 1 if offset >= 0 else -1
+        out = x[None]
+        mesh_axes = _ring.mesh_axes_of(comm)
+        for _ in range(abs(offset) % n):
+            out = _ring.neighbour_stream(
+                out, name, n, direction=direction,
+                interpret=not comm.is_tpu, mesh_axes=mesh_axes,
+            )
+        return out[0]
     perm = [(i, (i + offset) % n) for i in range(n)]
     return lax.ppermute(x, name, perm)
