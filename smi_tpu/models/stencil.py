@@ -32,17 +32,20 @@ from smi_tpu.parallel.mesh import Communicator, make_communicator
 
 
 def jacobi_step_block(
-    block: jax.Array, comm: Communicator
+    block: jax.Array, comm: Communicator, backend: str = "xla"
 ) -> jax.Array:
     """One Jacobi sweep on this rank's tile, halos included.
 
     Domain boundary cells (global edge) are Dirichlet: held at their
     current values, as the reference stencil does by never writing the
-    outermost ring.
+    outermost ring. ``backend="ring"`` moves the four halo slabs over
+    the explicit neighbour RDMA tier — the faithful shape of the
+    reference's bridge kernels driving four P2P ports
+    (``stencil_smi.cl:236-386``).
     """
     row_axis, col_axis = comm.axis_names
     h, w = block.shape
-    halos = halo_exchange_2d(block, comm, depth=1)
+    halos = halo_exchange_2d(block, comm, depth=1, backend=backend)
     padded = pad_with_halos(block, halos, depth=1)
 
     avg = 0.25 * (
@@ -65,18 +68,22 @@ def jacobi_step_block(
     return jnp.where(boundary, block, avg)
 
 
-def make_stencil_fn(comm: Communicator, iterations: int):
+def make_stencil_fn(comm: Communicator, iterations: int,
+                    backend: str = "xla"):
     """Jitted distributed stencil: global grid in, global grid out.
 
     The grid is sharded ``P(row_axis, col_axis)``; all ``iterations``
-    sweeps run on-device inside one compiled program.
+    sweeps run on-device inside one compiled program. ``backend="ring"``
+    exchanges halos over the neighbour RDMA tier.
     """
     row_axis, col_axis = comm.axis_names
     spec = P(row_axis, col_axis)
 
     def shard_fn(block):
         return lax.fori_loop(
-            0, iterations, lambda _, b: jacobi_step_block(b, comm), block
+            0, iterations,
+            lambda _, b: jacobi_step_block(b, comm, backend=backend),
+            block,
         )
 
     return jax.jit(

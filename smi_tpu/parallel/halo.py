@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from smi_tpu.parallel.backend import check_backend
 from smi_tpu.parallel.mesh import Communicator
 
 
@@ -35,6 +36,9 @@ def shift_along(
     n: int,
     direction: int,
     ring: bool = False,
+    backend: str = "xla",
+    comm: Optional[Communicator] = None,
+    stream: int = 0,
 ) -> jax.Array:
     """Move ``x`` to the rank ``direction`` steps up the axis.
 
@@ -42,9 +46,38 @@ def shift_along(
     data); ``-1`` the opposite. Without ``ring``, edge ranks receive
     zeros; with it, the permutation wraps (the pipeline/ring pattern,
     ``pipeline.cl:16-31``).
+
+    ``backend="ring"`` moves the slab over the explicit neighbour RDMA
+    kernel instead of ``lax.ppermute`` — ``comm`` is then REQUIRED so
+    device ids resolve on the full mesh; ``stream`` selects the
+    barrier-semaphore domain (shifts that may run concurrently must not
+    share one — the reference's distinct P2P port per direction). The
+    kernel's ring wraps, so the non-``ring`` contract is restored by
+    zeroing the edge rank's received slab.
     """
     if direction not in (1, -1):
         raise ValueError(f"direction must be +1 or -1, got {direction}")
+    if check_backend(backend) == "ring" and x.size:
+        if comm is None:
+            raise ValueError(
+                "shift_along(backend='ring') needs comm= to resolve "
+                "device ids on the full mesh (identity ids would "
+                "cross-signal other rings' devices)"
+            )
+        from smi_tpu.kernels import ring as _ring
+
+        got = _ring.neighbour_stream(
+            x[None], axis_name, n, direction=direction,
+            interpret=not comm.is_tpu, stream=stream,
+            mesh_axes=_ring.mesh_axes_of(comm),
+        )[0]
+        if ring:
+            return got
+        # non-wrapping: the edge rank has no upstream — its received
+        # slab is the wrapped neighbour's and must read as zeros
+        edge = 0 if direction == 1 else n - 1
+        return jnp.where(lax.axis_index(axis_name) == edge,
+                         jnp.zeros_like(got), got)
     if ring:
         perm = [(i, (i + direction) % n) for i in range(n)]
     elif direction == 1:
@@ -73,6 +106,7 @@ def halo_exchange_2d(
     comm: Communicator,
     depth: int = 1,
     ring: bool = False,
+    backend: str = "xla",
 ) -> Halos:
     """Exchange ``depth``-deep halos with the four 2-D mesh neighbours.
 
@@ -95,10 +129,16 @@ def halo_exchange_2d(
     nrow = comm.mesh.shape[row_axis]
     ncol = comm.mesh.shape[col_axis]
 
-    top = shift_along(block[-depth:, :], row_axis, nrow, +1, ring)
-    bottom = shift_along(block[:depth, :], row_axis, nrow, -1, ring)
-    left = shift_along(block[:, -depth:], col_axis, ncol, +1, ring)
-    right = shift_along(block[:, :depth], col_axis, ncol, -1, ring)
+    # one stream (= barrier-semaphore domain) per direction, the
+    # reference's four bridge-kernel ports (stencil_smi.cl:236-386)
+    top = shift_along(block[-depth:, :], row_axis, nrow, +1, ring,
+                      backend=backend, comm=comm, stream=0)
+    bottom = shift_along(block[:depth, :], row_axis, nrow, -1, ring,
+                         backend=backend, comm=comm, stream=1)
+    left = shift_along(block[:, -depth:], col_axis, ncol, +1, ring,
+                       backend=backend, comm=comm, stream=2)
+    right = shift_along(block[:, :depth], col_axis, ncol, -1, ring,
+                        backend=backend, comm=comm, stream=3)
     return Halos(top=top, bottom=bottom, left=left, right=right)
 
 
@@ -107,6 +147,7 @@ def halo_exchange_2d_corners(
     comm: Communicator,
     depth: int = 1,
     ring: bool = False,
+    backend: str = "xla",
 ) -> Halos:
     """Corner-complete ``depth``-deep halo exchange (two-phase).
 
@@ -134,15 +175,19 @@ def halo_exchange_2d_corners(
     ncol = comm.mesh.shape[col_axis]
     d = depth
 
-    left = shift_along(block[:, -d:], col_axis, ncol, +1, ring)
-    right = shift_along(block[:, :d], col_axis, ncol, -1, ring)
+    left = shift_along(block[:, -d:], col_axis, ncol, +1, ring,
+                       backend=backend, comm=comm, stream=2)
+    right = shift_along(block[:, :d], col_axis, ncol, -1, ring,
+                        backend=backend, comm=comm, stream=3)
     # phase 2: only the edge rows of the side-extended array move
     ext_top = jnp.concatenate([left[:d], block[:d], right[:d]], axis=1)
     ext_bottom = jnp.concatenate(
         [left[-d:], block[-d:], right[-d:]], axis=1
     )
-    top = shift_along(ext_bottom, row_axis, nrow, +1, ring)
-    bottom = shift_along(ext_top, row_axis, nrow, -1, ring)
+    top = shift_along(ext_bottom, row_axis, nrow, +1, ring,
+                      backend=backend, comm=comm, stream=0)
+    bottom = shift_along(ext_top, row_axis, nrow, -1, ring,
+                         backend=backend, comm=comm, stream=1)
     return Halos(top=top, bottom=bottom, left=left, right=right)
 
 
