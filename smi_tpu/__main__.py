@@ -423,8 +423,15 @@ def _build_report(args: argparse.Namespace, out: str,
 
         # static-analysis stage: emulate the program's rank count on
         # the CPU backend (the dryrun_multichip bootstrap); a live
-        # 1-chip mesh cannot host the P2P entries. Backends may already
-        # be initialized (RuntimeError) — use whatever devices exist.
+        # 1-chip mesh cannot host the P2P entries. NOTE this override
+        # cannot be scoped: once jax.devices() initializes backends
+        # (unavoidably, just below), jax_num_cpu_devices can never be
+        # restored, so the whole process stays on the multi-device CPU
+        # backend — documented in the --report help text. In-process
+        # API callers who need their backend unchanged should pass
+        # --report-topology instead (abstract devices, no override).
+        # Backends may already be initialized (RuntimeError) — then
+        # use whatever devices exist.
         try:
             jax.config.update("jax_num_cpu_devices", args.max_ranks)
             jax.config.update("jax_platforms", "cpu")
@@ -563,7 +570,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-rendezvous", action="store_true")
     p.add_argument("--report", action="store_true",
                    help="compile each manifest op and emit report.json "
-                        "(the aoc -rtl -report stage)")
+                        "(the aoc -rtl -report stage); without "
+                        "--report-topology this stage switches the "
+                        "PROCESS to a multi-device CPU backend "
+                        "(jax_platforms/jax_num_cpu_devices cannot be "
+                        "restored once backends initialize) — pass "
+                        "--report-topology to keep the backend "
+                        "untouched")
     p.add_argument("--report-topology", default=None, metavar="NAME",
                    help="compile the report against an abstract TPU "
                         "topology (e.g. v5e:2x4) instead of the local "

@@ -385,7 +385,19 @@ def run_benchmark(name: str, comm: Optional[Communicator] = None,
         )
     if comm is None:
         comm = make_communicator()
-    m = BENCHMARKS[name](comm, **params)
+    fn = BENCHMARKS[name]
+    import inspect
+
+    sig = inspect.signature(fn)
+    if "backend" in params and "backend" not in sig.parameters and not any(
+        p.kind is inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values()
+    ):
+        # benchmarks without backend tiers (the app benchmarks) reject
+        # the kwarg; the CLI pops it for them — do the same for
+        # Python-API callers instead of raising TypeError
+        params = {k: v for k, v in params.items() if k != "backend"}
+    m = fn(comm, **params)
     backend = params.get("backend", "xla")
     if backend != "xla" and not m.name.endswith(f"-{backend}"):
         # result files are keyed by name; a ring run must never

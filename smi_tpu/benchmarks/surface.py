@@ -98,7 +98,12 @@ def diff_rate(make_fn, work_per_rep: float, r1: int = 1, factor: int = 4,
     its VJP residuals r-fold), so "time it first, notice the cap after"
     can compile an HBM-OOM program on the way to the cap.
     """
-    r1 = min(r1, max_reps)
+    if r1 >= max_reps:
+        raise ValueError(
+            f"diff_rate needs r1 < max_reps to escalate (got r1={r1}, "
+            f"max_reps={max_reps}); a same-rep pair has zero work delta "
+            f"and would silently record a 0-rate measurement"
+        )
     t1 = _timed(make_fn(r1), runs)
     while True:
         r2 = min(r1 * factor, max_reps)

@@ -256,6 +256,37 @@ def _lift_payload(x: jax.Array) -> jax.Array:
     return x.reshape(1, -1) if x.ndim < 2 else x
 
 
+#: Mosaic lane-tile width: the last dim of every VMEM buffer is padded
+#: to a multiple of this, and the kernels' slot/unit slices must match
+#: the padded width exactly.
+_LANES = 128
+
+
+def _pad_lanes(payload: jax.Array) -> Tuple[jax.Array, int]:
+    """Zero-pad the lane (last) dim to a multiple of 128.
+
+    Mosaic rejects the kernels' slot/unit slices whenever the payload's
+    logical lane width is not tile-aligned ("Slice shape along
+    dimension 2 must be aligned to tiling (128)") — caught by the AOT
+    topology tier on the corner-complete halo program, whose extended
+    slabs are ``W + 2*depth`` wide (``halo_ring_corners``,
+    ``tests/test_aot_tpu.py``); interpret mode has no tiling and
+    accepts any width. The wrappers pad here and slice the result back
+    to the logical width, so callers may stream any payload shape. The
+    padding is dead data: receivers only ever see their neighbours'
+    equally-padded buffers, and the pad region is dropped before any
+    reduction result is returned (safe for MAX/MIN, not just ADD).
+
+    Returns ``(padded, logical_width)``.
+    """
+    width = payload.shape[-1]
+    pad = (-width) % _LANES
+    if pad == 0:
+        return payload, width
+    widths = [(0, 0)] * (payload.ndim - 1) + [(0, pad)]
+    return jnp.pad(payload, widths), width
+
+
 # ---------------------------------------------------------------------------
 # All-gather
 # ---------------------------------------------------------------------------
@@ -333,7 +364,7 @@ def ring_all_gather(
     """
     if n == 1:
         return x
-    payload = _lift_payload(x)
+    payload, width = _pad_lanes(_lift_payload(x))
     xu = payload[None]  # (1, *payload): one unit per rank
     out_shape = jax.ShapeDtypeStruct((n,) + payload.shape, x.dtype)
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
@@ -358,6 +389,8 @@ def ring_all_gather(
         ),
         interpret=_interpret_arg(interpret),
     )(xu)
+    if width != payload.shape[-1]:
+        gathered = gathered[..., :width]
     return gathered.reshape((n * x.shape[0],) + x.shape[1:])
 
 
@@ -431,7 +464,7 @@ def ring_all_reduce(
     """
     if n == 1:
         return x
-    payload = _lift_payload(x)
+    payload, width = _pad_lanes(_lift_payload(x))
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
         _ring_all_reduce_kernel, ring_axes=ring_axes,
@@ -454,6 +487,8 @@ def ring_all_reduce(
         ),
         interpret=_interpret_arg(interpret),
     )(payload)
+    if width != payload.shape[-1]:
+        reduced = reduced[..., :width]
     return reduced.reshape(x.shape)
 
 
@@ -546,6 +581,7 @@ def ring_reduce_scatter(
         xu = x.reshape(n, 1, chunk)
     else:
         xu = x.reshape((n, chunk) + x.shape[1:])
+    xu, width = _pad_lanes(xu)
     block = xu.shape[1:]
     out_shape = jax.ShapeDtypeStruct((1,) + block, x.dtype)
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
@@ -570,6 +606,8 @@ def ring_reduce_scatter(
         ),
         interpret=_interpret_arg(interpret),
     )(xu)
+    if width != xu.shape[-1]:
+        scattered = scattered[..., :width]
     return scattered.reshape((chunk,) + x.shape[1:])
 
 
@@ -662,8 +700,9 @@ def neighbour_stream(
         return x
     chunks = x.shape[0]
     # per-chunk payloads must be >=2-D so the chunk/slot axes stay
-    # untiled (see _lift_payload)
+    # untiled (see _lift_payload), and lane-aligned (see _pad_lanes)
     xu = x.reshape(chunks, 1, -1) if x.ndim < 3 else x
+    xu, width = _pad_lanes(xu)
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
         _neighbour_stream_kernel, ring_axes=ring_axes,
@@ -686,6 +725,8 @@ def neighbour_stream(
         ),
         interpret=_interpret_arg(interpret),
     )(xu)
+    if width != xu.shape[-1]:
+        streamed = streamed[..., :width]
     return streamed.reshape(x.shape)
 
 

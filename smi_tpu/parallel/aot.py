@@ -22,9 +22,12 @@ Entry points: :func:`topology_communicator` /
 :func:`hybrid_topology_communicator` build communicators over abstract
 devices; :func:`compile_sharded` lowers one program;
 :func:`check_surface` compiles the framework's full multi-chip surface
-(all four ring kernels in both flow-control modes, the flash (dp, sp)
-transformer train step, the hierarchical two-tier allreduce) and
-returns per-program executable reports. ``python -m smi_tpu aot-verify``
+— the four ring kernels in both flow-control modes, the flash (dp, sp)
+transformer train step, the hierarchical two-tier allreduce, the
+multi-kernel-instance ring composites (4-direction halo exchange,
+concurrent streams, hop-by-hop P2P, rooted collectives), and the three
+reference applications at pod-real shapes — and returns per-program
+executable reports. ``python -m smi_tpu aot-verify``
 drives it and writes the evidence artifact; ``tests/test_aot_tpu.py``
 is the opt-in test tier.
 """
@@ -37,6 +40,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from smi_tpu.parallel.mesh import Communicator, DEFAULT_AXIS
@@ -174,6 +178,16 @@ def executable_report(compiled) -> dict:
         }
     except Exception as e:  # pragma: no cover - backend-dependent
         report["cost"] = {"unavailable": str(e)}
+    try:
+        from smi_tpu.parallel.traffic import collective_traffic
+
+        report["collectives"] = collective_traffic(compiled)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        # an empty (falsy) list + explicit error key: downstream guards
+        # (tests/test_traffic.py) fail loudly instead of reading a
+        # truthy sentinel as data
+        report["collectives"] = []
+        report["collectives_error"] = str(e)
     return report
 
 
@@ -351,6 +365,287 @@ def _hierarchical_case(topology: str):
 
     yield "allreduce_hierarchical", build
 
+    def build_flat():
+        # the comparison program for the crossing-bytes analysis
+        # (docs/perf_notes.md): one flat psum over both tiers, same
+        # shape — its slice-spanning replica group moves the FULL
+        # payload across the slow tier, where the hierarchical form
+        # crosses with 1/inner of it
+        f = jax.jit(
+            jax.shard_map(
+                lambda x: lax.psum(x[0], ("dcn", "ici"))[None],
+                mesh=comm.mesh,
+                in_specs=P(("dcn", "ici")),
+                out_specs=P(("dcn", "ici")),
+                check_vma=False,
+            )
+        )
+        return compile_sharded(
+            f, shaped(comm, (n, inner * 32), jnp.float32, P(("dcn", "ici")))
+        )
+
+    yield "allreduce_flat", build_flat
+
+
+def _xla_tier_cases(topology: str):
+    """XLA-tier collectives at the ring cases' exact shapes.
+
+    The comparison column of the ring-vs-XLA artifact table
+    (``docs/perf_notes.md``): same payloads, same mesh, the default
+    tier's ``lax`` collectives instead of the explicit RDMA kernels —
+    code size from ``memory_analysis``, ICI traffic from the compiled
+    HLO (``parallel/traffic.py``).
+    """
+    comm = topology_communicator(topology)
+    axis, n = comm.axis_names[0], comm.size
+    chunk, width = 16, 256
+
+    def case(name, shard, in_spec, out_spec, shape):
+        def build():
+            f = jax.jit(
+                jax.shard_map(
+                    shard, mesh=comm.mesh, in_specs=in_spec,
+                    out_specs=out_spec, check_vma=False,
+                )
+            )
+            return compile_sharded(
+                f, shaped(comm, shape, jnp.float32, in_spec)
+            )
+        return name, build
+
+    yield case(
+        "xla_all_gather",
+        lambda x: lax.all_gather(x, axis, axis=0, tiled=True),
+        P(axis, None), P(None, None), (n * chunk, width),
+    )
+    yield case(
+        "xla_all_reduce",
+        lambda x: lax.psum(x[0], axis)[None],
+        P(axis, None), P(axis, None), (n, width),
+    )
+    yield case(
+        "xla_reduce_scatter",
+        lambda x: lax.psum_scatter(x, axis, scatter_dimension=0,
+                                   tiled=True),
+        P(None, None), P(axis, None), (n * chunk, width),
+    )
+    yield case(
+        "xla_neighbour_shift",
+        lambda x: lax.ppermute(
+            x, axis, [(i, (i + 1) % n) for i in range(n)]
+        ),
+        P(axis, None, None), P(axis, None, None), (n * 4, 8, width),
+    )
+
+
+def _composite_ring_cases(topology: str):
+    """Multi-kernel-instance ring compositions.
+
+    The primitive ring kernels compile one Pallas instance each; these
+    programs instantiate SEVERAL ring kernels in one XLA program —
+    distinct ``collective_id`` domains, interleaved or dependent
+    schedules — which is where Mosaic semaphore/collective-id
+    allocation can reject what interpret mode accepts. Reference
+    analog: every composed app/test target goes through the hardware
+    toolchain, not just the communication library
+    (``/root/reference/CMakeLists.txt:38-196``).
+    """
+    from smi_tpu.parallel import collectives
+    from smi_tpu.parallel.channels import P2PChannel, stream_concurrent
+    from smi_tpu.parallel.halo import (
+        halo_exchange_2d,
+        halo_exchange_2d_corners,
+    )
+
+    comm2d = topology_communicator(
+        topology, shape=(2, 4), axis_names=("sx", "sy")
+    )
+    comm1d = topology_communicator(topology)
+    axis = comm1d.axis_names[0]
+    n = comm1d.size
+
+    def build_halo(corners: bool):
+        # all four ring-tier shift directions (4 neighbour-stream kernel
+        # instances on streams 0-3) in ONE program; the corners variant
+        # additionally makes the vertical shifts depend on the
+        # horizontal ones (two dependent RDMA rounds)
+        exchange = halo_exchange_2d_corners if corners else halo_exchange_2d
+
+        def shard(block):
+            h = exchange(block, comm2d, depth=1, backend="ring")
+            # return every slab so no direction is dead-code-eliminated
+            return h.top, h.bottom, h.left, h.right
+
+        f = jax.jit(
+            jax.shard_map(
+                shard, mesh=comm2d.mesh, in_specs=P("sx", "sy"),
+                out_specs=(P("sx", "sy"),) * 4, check_vma=False,
+            )
+        )
+        return compile_sharded(
+            f, shaped(comm2d, (512, 1024), jnp.float32, P("sx", "sy"))
+        )
+
+    yield "halo_ring_4dir", lambda: build_halo(corners=False)
+    yield "halo_ring_corners", lambda: build_halo(corners=True)
+
+    def build_concurrent():
+        # two concurrent multi-hop neighbour streams, distinct port ->
+        # stream slots -> barrier-semaphore domains, burst-interleaved
+        # in one program (the multi_collectives.cl overlap shape)
+        chans = [
+            P2PChannel(comm=comm1d, port=0, src=0, dst=2, count=1024,
+                       buffer_size=256, consecutive_reads=2),
+            P2PChannel(comm=comm1d, port=1, src=1, dst=3, count=1024,
+                       buffer_size=256, consecutive_reads=2),
+        ]
+
+        def shard(a, b):
+            return tuple(
+                o[None]
+                for o in stream_concurrent(chans, (a, b), backend="ring")
+            )
+
+        f = jax.jit(
+            jax.shard_map(
+                shard, mesh=comm1d.mesh, in_specs=(P(), P()),
+                out_specs=(P(axis), P(axis)), check_vma=False,
+            )
+        )
+        x = shaped(comm1d, (1024,), jnp.float32, P())
+        return compile_sharded(f, x, x)
+
+    yield "stream_concurrent_ring", build_concurrent
+
+    def build_p2p_transfer():
+        # hop-by-hop P2P between NON-adjacent ranks: three dependent
+        # neighbour-stream kernel instances sharing one stream slot
+        ch = P2PChannel(comm=comm1d, port=2, src=0, dst=3, count=2048,
+                        buffer_size=512)
+
+        def shard(x):
+            return ch.transfer(x, backend="ring")[None]
+
+        f = jax.jit(
+            jax.shard_map(
+                shard, mesh=comm1d.mesh, in_specs=P(),
+                out_specs=P(axis), check_vma=False,
+            )
+        )
+        return compile_sharded(f, shaped(comm1d, (2048,), jnp.float32, P()))
+
+    yield "p2p_transfer_ring_multihop", build_p2p_transfer
+
+    def build_rooted_reduce():
+        def shard(x):
+            return collectives.reduce(
+                x[0], comm1d, op="max", root=3, port=0, backend="ring"
+            )[None]
+
+        f = jax.jit(
+            jax.shard_map(
+                shard, mesh=comm1d.mesh, in_specs=P(axis, None),
+                out_specs=P(axis, None), check_vma=False,
+            )
+        )
+        return compile_sharded(
+            f, shaped(comm1d, (n, 256), jnp.float32, P(axis, None))
+        )
+
+    yield "reduce_ring_rooted", build_rooted_reduce
+
+    def build_rooted_gather():
+        def shard(x):
+            return collectives.gather(
+                x, comm1d, root=5, port=1, backend="ring"
+            )[None]
+
+        f = jax.jit(
+            jax.shard_map(
+                shard, mesh=comm1d.mesh, in_specs=P(axis, None),
+                out_specs=P(axis, None, None), check_vma=False,
+            )
+        )
+        return compile_sharded(
+            f, shaped(comm1d, (n * 16, 256), jnp.float32, P(axis, None))
+        )
+
+    yield "gather_ring_rooted", build_rooted_gather
+
+
+def _app_cases(topology: str):
+    """The three reference applications at pod-real shapes, compile-only.
+
+    Reference analog: ``smi_target()`` wires every example through the
+    aoc hardware toolchain at its hardware config
+    (``/root/reference/CMakeLists.txt:38-196``,
+    ``examples/CMakeLists.txt:2-7`` — stencil 8192x8192 on 2x4 ranks).
+    """
+    from smi_tpu.models import gesummv, kmeans, stencil
+
+    comm2d = topology_communicator(
+        topology, shape=(2, 4), axis_names=("sx", "sy")
+    )
+
+    def build_stencil():
+        # the reference's hardware config: 8192^2 on a 2x4 process grid
+        fn = stencil.make_stencil_fn(comm2d, iterations=4)
+        return compile_sharded(
+            fn, shaped(comm2d, (8192, 8192), jnp.float32, P("sx", "sy"))
+        )
+
+    yield "app_stencil_8192_2x4", build_stencil
+
+    def build_stencil_temporal():
+        # the flagship temporal-blocked Pallas tier at the same shape
+        from smi_tpu.kernels import stencil_temporal as kt
+
+        depth = kt.pick_temporal_depth(4096, 2048, jnp.float32, 16) or 8
+        fn = kt.make_temporal_stencil_fn(
+            comm2d, 16, 8192, 8192, depth=depth
+        )
+        return compile_sharded(
+            fn, shaped(comm2d, (8192, 8192), jnp.float32, P("sx", "sy"))
+        )
+
+    yield "app_stencil_temporal_8192_2x4", build_stencil_temporal
+
+    def build_stencil_ring():
+        # halos over the RDMA tier inside the sweep loop: 4 ring kernel
+        # instances per sweep x 2 sweeps under fori_loop
+        fn = stencil.make_stencil_fn(comm2d, iterations=2, backend="ring")
+        return compile_sharded(
+            fn, shaped(comm2d, (1024, 2048), jnp.float32, P("sx", "sy"))
+        )
+
+    yield "app_stencil_ring_2x4", build_stencil_ring
+
+    def build_gesummv():
+        # 2-rank operator split + streamed axpy combine, n=4096
+        comm2 = topology_communicator(topology, shape=(2,))
+        fn = gesummv.make_gesummv_fn(comm2, n=4096, alpha=1.5, beta=2.5)
+        return compile_sharded(
+            jax.jit(fn),
+            shaped(comm2, (2, 4096, 4096), jnp.float32,
+                   P(comm2.axis_names[0])),
+            shaped(comm2, (4096,), jnp.float32, P()),
+        )
+
+    yield "app_gesummv_4096", build_gesummv
+
+    def build_kmeans():
+        # rooted Reduce+Bcast inside the fori_loop, 512k points x 10 iters
+        comm1 = topology_communicator(topology)
+        fn = kmeans.make_kmeans_fn(comm1, iterations=10)
+        return compile_sharded(
+            fn,
+            shaped(comm1, (comm1.size * 65536, 2), jnp.float32,
+                   P(comm1.axis_names[0])),
+            shaped(comm1, (8, 2), jnp.float32, P()),
+        )
+
+    yield "app_kmeans_512k", build_kmeans
+
 
 def surface_cases(topology: str = DEFAULT_TOPOLOGY):
     """All (name, build) pairs of the multi-chip AOT surface."""
@@ -358,6 +653,9 @@ def surface_cases(topology: str = DEFAULT_TOPOLOGY):
     yield from _subset_ring_cases(topology)
     yield from _transformer_cases(topology)
     yield from _hierarchical_case(topology)
+    yield from _composite_ring_cases(topology)
+    yield from _app_cases(topology)
+    yield from _xla_tier_cases(topology)
 
 
 def check_surface(topology: str = DEFAULT_TOPOLOGY, verbose: bool = False):

@@ -27,6 +27,20 @@ checks the protocol invariants the hardware would punish:
 schedules, and demonstrates that with flow control *disabled* the
 simulator catches the clobber — evidence the harness can see the race the
 credits exist to prevent.
+
+Concurrent composites (the 4-direction ring halo exchange, the
+burst-interleaved ``stream_concurrent`` schedule) run SEVERAL kernel
+instances per rank; :func:`halo_generators` /
+:func:`concurrent_stream_generators` model them with scratch
+slots/semaphores shared across sequential instances (reused VMEM
+addresses) and the barrier semaphore keyed by the stream's domain
+(``collective_id``) — see the section comment below for what aliases
+and why. The mutation tests show the fuzzer catches a shared barrier
+domain between cross-axis streams (clobber), divergent per-rank
+instance order (deadlock — or clobber once a shared domain removes the
+loud failure), the pre-fix identity device-id mapping of subset-axis
+rings (clobber/deadlock — the round-3 ``_logical_id_fn`` bug), and
+surplus credit grants (leak).
 """
 
 from __future__ import annotations
@@ -103,11 +117,18 @@ class _Dma:
     recv_index: int
 
 
-def _barrier_steps(me: int, n: int):
+def _identity(rank: int) -> int:
+    return rank
+
+
+def _barrier_steps(me: int, n: int, to_global: Callable[[int], int] = _identity):
     """Signal both ring neighbours, wait for both — mirrors
-    ``ring._neighbour_barrier``."""
-    yield ("signal", (me - 1) % n, SEM_BARRIER, 0, 1)
-    yield ("signal", (me + 1) % n, SEM_BARRIER, 0, 1)
+    ``ring._neighbour_barrier``. ``to_global`` maps a ring-local rank to
+    the global simulator rank, mirroring ``ring._logical_id_fn`` (rings
+    over a subset of a mesh's axes must target the right global device;
+    the identity is only correct when the ring spans the whole mesh)."""
+    yield ("signal", to_global((me - 1) % n), SEM_BARRIER, 0, 1)
+    yield ("signal", to_global((me + 1) % n), SEM_BARRIER, 0, 1)
     yield ("wait", SEM_BARRIER, 0, 2)
 
 
@@ -116,13 +137,14 @@ def _barrier_steps(me: int, n: int):
 # ---------------------------------------------------------------------------
 
 
-def all_gather_rank(me: int, n: int, chunk, flow_control: bool = True):
+def all_gather_rank(me: int, n: int, chunk, flow_control: bool = True,
+                    to_global: Callable[[int], int] = _identity):
     """Mirrors ``_ring_all_gather_kernel``: forward the chunk received
     last step to the right neighbour; slots alternate; slot 1 granted at
     start; per-step re-grant after the onward send except the final step."""
-    left, right = (me - 1) % n, (me + 1) % n
+    left, right = to_global((me - 1) % n), to_global((me + 1) % n)
     if flow_control:
-        yield from _barrier_steps(me, n)
+        yield from _barrier_steps(me, n, to_global)
     yield ("output", me, chunk)
     yield ("write_slot", 0, chunk)
     if flow_control:
@@ -142,12 +164,13 @@ def all_gather_rank(me: int, n: int, chunk, flow_control: bool = True):
 
 
 def all_reduce_rank(me: int, n: int, value, combine: Callable,
-                    flow_control: bool = True):
+                    flow_control: bool = True,
+                    to_global: Callable[[int], int] = _identity):
     """Mirrors ``_ring_all_reduce_kernel``: circulate the running partial
     rightward, folding the local contribution into each arrival."""
-    left, right = (me - 1) % n, (me + 1) % n
+    left, right = to_global((me - 1) % n), to_global((me + 1) % n)
     if flow_control:
-        yield from _barrier_steps(me, n)
+        yield from _barrier_steps(me, n, to_global)
     yield ("write_slot", 0, value)
     if flow_control:
         yield ("signal", left, SEM_CREDIT, 1, 1)
@@ -168,13 +191,14 @@ def all_reduce_rank(me: int, n: int, value, combine: Callable,
 
 
 def reduce_scatter_rank(me: int, n: int, blocks: Sequence, combine: Callable,
-                        flow_control: bool = True):
+                        flow_control: bool = True,
+                        to_global: Callable[[int], int] = _identity):
     """Mirrors ``_ring_reduce_scatter_kernel``: at step ``s`` send the
     partial of block ``(me - s - 1) % n``, fold the local share into the
     arriving partial of block ``(me - s - 2) % n``."""
-    left, right = (me - 1) % n, (me + 1) % n
+    left, right = to_global((me - 1) % n), to_global((me + 1) % n)
     if flow_control:
-        yield from _barrier_steps(me, n)
+        yield from _barrier_steps(me, n, to_global)
     yield ("write_slot", 0, blocks[(me - 1) % n])
     if flow_control:
         yield ("signal", left, SEM_CREDIT, 1, 1)
@@ -195,14 +219,15 @@ def reduce_scatter_rank(me: int, n: int, blocks: Sequence, combine: Callable,
 
 
 def neighbour_stream_rank(me: int, n: int, chunks: Sequence,
-                          direction: int = 1, flow_control: bool = True):
+                          direction: int = 1, flow_control: bool = True,
+                          to_global: Callable[[int], int] = _identity):
     """Mirrors ``_neighbour_stream_kernel``: stream own chunks one hop
     downstream while consuming the upstream's; both slots start granted,
     waits begin at chunk 2, grants stop when nobody would consume them."""
-    dst = (me + direction) % n
-    upstream = (me - direction) % n
+    dst = to_global((me + direction) % n)
+    upstream = to_global((me - direction) % n)
     if flow_control:
-        yield from _barrier_steps(me, n)
+        yield from _barrier_steps(me, n, to_global)
     total = len(chunks)
     for c, chunk in enumerate(chunks):
         slot = c % 2
@@ -215,6 +240,242 @@ def neighbour_stream_rank(me: int, n: int, chunks: Sequence,
         if flow_control and c + 2 < total:
             yield ("signal", upstream, SEM_CREDIT, slot, 1)
         yield ("wait", SEM_SEND, slot, 1)
+
+
+# ---------------------------------------------------------------------------
+# Concurrent multi-stream composition
+# ---------------------------------------------------------------------------
+# A composite program (the 4-direction ring halo exchange, concurrent
+# P2P streams) runs SEVERAL kernel instances per rank in program order.
+# The hardware resources they touch alias in two different ways, and the
+# model must reproduce both:
+#
+# - comm-buffer slots and the send/recv/credit semaphores are
+#   kernel-local *scratch*: sequential same-shaped instances reuse the
+#   same VMEM/semaphore addresses. They are therefore NOT namespaced —
+#   instance k+1's RDMAs physically land on the addresses instance k
+#   used, and only protocol ordering keeps that safe.
+# - the cross-device BARRIER semaphore is keyed by ``collective_id``
+#   (the stream's semaphore domain, ``ring.ring_collective_id``). It is
+#   namespaced by the instance's declared *domain*: instances on
+#   distinct streams own distinct barriers; instances SHARING a domain
+#   share one — which lets a fast rank satisfy its barrier wait with
+#   signals meant for a neighbour's *other* instance, enter early, and
+#   clobber scratch the neighbour is still consuming. That is exactly
+#   the cross-stream hazard distinct domains exist to prevent, and
+#   :func:`simulate_halo_exchange` + the mutation tests fuzz it.
+
+
+def instance_steps(gen, domain, instance):
+    """Run one kernel-instance generator inside a composite program.
+
+    Namespaces the BARRIER semaphore by ``domain`` (collective_id) and
+    the output keys by ``instance`` (so verification can tell instances
+    apart); leaves slots and send/recv/credit semaphore indices alone —
+    they are scratch addresses shared across sequential instances.
+    """
+    value = None
+    while True:
+        try:
+            action = gen.send(value)
+        except StopIteration:
+            return
+        kind = action[0]
+        if kind == "signal" and action[2] == SEM_BARRIER:
+            _, target, name, index, inc = action
+            value = yield ("signal", target, name, (domain, index), inc)
+        elif kind == "wait" and action[1] == SEM_BARRIER:
+            _, name, index, amount = action
+            value = yield ("wait", name, (domain, index), amount)
+        elif kind == "output":
+            _, key, payload = action
+            value = yield ("output", (instance, key), payload)
+        else:
+            value = yield action
+
+
+def chain_programs(*gens):
+    """One rank's composite program: kernel instances in program order
+    (a TPU core launches them sequentially), ``send``-transparent."""
+    for gen in gens:
+        value = None
+        while True:
+            try:
+                action = gen.send(value)
+            except StopIteration:
+                break
+            value = yield action
+
+
+def halo_generators(
+    nrow: int,
+    ncol: int,
+    chunks: int = 1,
+    domains: Sequence[int] = (0, 1, 2, 3),
+    flow_control: bool = True,
+    wrong_ids: bool = False,
+):
+    """Per-rank composite programs of the 4-direction ring halo exchange.
+
+    Mirrors ``halo.halo_exchange_2d(backend="ring")`` on an
+    ``nrow x ncol`` mesh: per rank, four neighbour-stream instances in
+    program order — up/down along the row axis (one ring per column),
+    left/right along the column axis (one ring per row) — with stream
+    ``s`` on barrier domain ``domains[s]`` (the per-direction semaphore
+    domains, ``halo.py``). Rings span a SUBSET of the mesh axes, so
+    ring-local ranks resolve through ``to_global`` exactly as the
+    kernels' ``_logical_id_fn`` does; ``wrong_ids=True`` reinstates the
+    pre-fix identity mapping (the round-3 subset-axis bug) so tests can
+    prove the harness catches it.
+    """
+    programs = []
+    for g in range(nrow * ncol):
+        r, c = divmod(g, ncol)
+        subs = []
+        for stream, (axis, direction) in enumerate(
+            (("row", 1), ("row", -1), ("col", 1), ("col", -1))
+        ):
+            if axis == "row":
+                ring_n, ring_me = nrow, r
+                to_global = (lambda rr, c=c: rr * ncol + c)
+            else:
+                ring_n, ring_me = ncol, c
+                to_global = (lambda cc, r=r: r * ncol + cc)
+            if wrong_ids:
+                to_global = _identity
+            labels = [((g, stream), k) for k in range(chunks)]
+            subs.append(
+                instance_steps(
+                    neighbour_stream_rank(
+                        ring_me, ring_n, labels, direction=direction,
+                        flow_control=flow_control, to_global=to_global,
+                    ),
+                    domain=domains[stream], instance=stream,
+                )
+            )
+        programs.append(chain_programs(*subs))
+    return programs
+
+
+def simulate_halo_exchange(
+    nrow: int,
+    ncol: int,
+    strategy: Strategy,
+    chunks: int = 1,
+    domains: Sequence[int] = (0, 1, 2, 3),
+    flow_control: bool = True,
+    wrong_ids: bool = False,
+) -> None:
+    """Fuzz one schedule of the 4-direction halo composite and verify
+    per-stream delivery: stream ``s`` at rank ``g`` must receive its
+    ring-upstream's labels for that stream."""
+    outputs = RingSimulator(
+        halo_generators(nrow, ncol, chunks, domains, flow_control,
+                        wrong_ids),
+        strategy,
+    ).run()
+    for g in range(nrow * ncol):
+        r, c = divmod(g, ncol)
+        want = {}
+        for stream, (axis, direction) in enumerate(
+            (("row", 1), ("row", -1), ("col", 1), ("col", -1))
+        ):
+            if axis == "row":
+                up = ((r - direction) % nrow) * ncol + c
+            else:
+                up = r * ncol + (c - direction) % ncol
+            for k in range(chunks):
+                want[(stream, k)] = ((up, stream), k)
+        if outputs[g] != want:
+            raise ProtocolError(
+                f"rank {g} received {outputs[g]}, wanted {want}"
+            )
+
+
+def concurrent_stream_generators(
+    n: int,
+    channels: Sequence[Tuple[int, int]],
+    bursts: int = 2,
+    chunks_per_burst: int = 4,
+    domains: Optional[Sequence[int]] = None,
+    flow_control: bool = True,
+    swap_order_rank: Optional[int] = None,
+):
+    """Per-rank composite programs of burst-interleaved concurrent P2P
+    streams over one ``n``-ring.
+
+    Mirrors ``channels._stream_concurrent_ring``: each round moves one
+    burst of every channel (in channel order) before any channel
+    advances — each burst a fresh neighbour-stream kernel instance (one
+    ``_ring_move`` hop) in the channel's port stream domain. Every rank
+    runs every hop (SPMD), so a channel is just ``(port, direction)``;
+    ``domains`` overrides the per-channel barrier domains (defaults to
+    the ports — pass duplicates to model the shared-domain mutation).
+
+    ``swap_order_rank`` makes ONE rank run each burst's channels in
+    reversed order — the divergent-MPMD ordering bug the collective
+    schedule must never contain. With distinct domains that rank
+    deadlocks loudly at the misordered barrier; with a shared domain
+    the barrier lets it through and the fuzzer sees the resulting
+    scratch clobber instead — both detectable, which is the point.
+    """
+    if domains is None:
+        domains = [port for port, _ in channels]
+    programs = []
+    for g in range(n):
+        subs = []
+        for b in range(bursts):
+            order = list(enumerate(channels))
+            if g == swap_order_rank:
+                order = order[::-1]
+            for i, (port, direction) in order:
+                labels = [
+                    ((g, i, b), k) for k in range(chunks_per_burst)
+                ]
+                subs.append(
+                    instance_steps(
+                        neighbour_stream_rank(
+                            g, n, labels, direction=direction,
+                            flow_control=flow_control,
+                        ),
+                        domain=domains[i], instance=(i, b),
+                    )
+                )
+        programs.append(chain_programs(*subs))
+    return programs
+
+
+def simulate_stream_concurrent(
+    n: int,
+    strategy: Strategy,
+    bursts: int = 2,
+    chunks_per_burst: int = 4,
+    domains: Optional[Sequence[int]] = None,
+    flow_control: bool = True,
+    swap_order_rank: Optional[int] = None,
+) -> None:
+    """Fuzz one schedule of two burst-interleaved concurrent streams
+    (the ``stream_concurrent(backend="ring")`` shape: distinct ports,
+    opposite directions) and verify per-instance delivery."""
+    channels = [(0, 1), (1, -1)]
+    outputs = RingSimulator(
+        concurrent_stream_generators(
+            n, channels, bursts, chunks_per_burst, domains, flow_control,
+            swap_order_rank,
+        ),
+        strategy,
+    ).run()
+    for g in range(n):
+        want = {}
+        for b in range(bursts):
+            for i, (_, direction) in enumerate(channels):
+                up = (g - direction) % n
+                for k in range(chunks_per_burst):
+                    want[((i, b), k)] = ((up, i, b), k)
+        if outputs[g] != want:
+            raise ProtocolError(
+                f"rank {g} received {outputs[g]}, wanted {want}"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -255,6 +516,31 @@ class FavourRankStrategy(Strategy):
         ]
         if favoured and self.rng.random() < 0.85:
             return favoured[0]
+        return self.rng.choice(choices)
+
+
+class FavourSetStrategy(Strategy):
+    """Adversarial: a GROUP of ranks races ahead together.
+
+    A single favoured rank cannot get a whole kernel instance ahead of
+    its neighbours in a composite program — barrier counting holds it
+    back — but a contiguous *plateau* of favoured ranks can carry its
+    interior a full instance ahead of the trailing ranks, which is the
+    schedule shape that turns a shared barrier domain into a clobber
+    (see the shared-domain mutation tests)."""
+
+    def __init__(self, favoured, seed: int = 0, bias: float = 0.9):
+        super().__init__(seed)
+        self.favoured = set(favoured)
+        self.bias = bias
+
+    def pick(self, choices):
+        favoured = [
+            c for c in choices
+            if c[0] == "rank" and c[1] in self.favoured
+        ]
+        if favoured and self.rng.random() < self.bias:
+            return self.rng.choice(favoured)
         return self.rng.choice(choices)
 
 

@@ -66,11 +66,18 @@ def shift_along(
             )
         from smi_tpu.kernels import ring as _ring
 
+        # one chunk in flat row layout (1, 1, size): a column slab's
+        # natural (H, depth=1) shape has a width-1 lane dimension, and
+        # Mosaic rejects the width-1 slice of the lane-padded VMEM
+        # buffer ("Slice shape along dimension 2 must be aligned to
+        # tiling (128)") — caught by the AOT topology tier
+        # (halo_ring_4dir, tests/test_aot_tpu.py); interpret mode has
+        # no tiling and accepts the slab shape unchanged
         got = _ring.neighbour_stream(
-            x[None], axis_name, n, direction=direction,
+            x.reshape(1, 1, -1), axis_name, n, direction=direction,
             interpret=not comm.is_tpu, stream=stream,
             mesh_axes=_ring.mesh_axes_of(comm),
-        )[0]
+        ).reshape(x.shape)
         if ring:
             return got
         # non-wrapping: the edge rank has no upstream — its received

@@ -1,0 +1,187 @@
+"""Collective-traffic analysis of compiled multi-chip executables.
+
+Reference parity: the reference's offline report workflow — ``aoc``
+emits per-build area/Fmax reports that are read *before* committing
+hardware time (``/root/reference/CMakeLists.txt:113-118``, the
+``-rtl -report`` stage). The one multi-chip perf signal a single-chip
+host can produce is the compiled artifact itself: the optimized HLO of
+an AOT-compiled program names every XLA collective with its shape and
+replica groups, from which per-tier ICI/DCN traffic is exact — no pod
+required.
+
+:func:`collective_traffic` parses ``compiled.as_text()`` into a list of
+collective records; :func:`tier_crossing_bytes` folds them into
+per-device bytes that cross a given device partition (e.g. the slice
+boundary of a hybrid mesh), which is how ``docs/perf_notes.md`` proves
+the hierarchical allreduce moves ``1/inner`` of the flat volume across
+the slow tier.
+
+Ring-tier programs move their data inside Mosaic kernels (remote DMAs
+are invisible to HLO), so their traffic is *predicted* from the kernel
+schedule instead: :func:`ring_traffic` implements the per-hop formulas
+of ``kernels/ring.py``'s four protocols.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: HLO dtype -> bytes per element (the dtypes the framework emits)
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8,
+}
+
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+#: one HLO instruction line: ``%name = f32[8,128]{...} all-reduce(...)``
+_INSTR_RE = re.compile(
+    r"%(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?:\()?(?P<dtype>\w+)\[(?P<shape>[\d,]*)\]"
+    r"[^=]*?\s(?P<op>" + "|".join(_COLLECTIVES) + r")(?:-start|-done)?\("
+)
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,{}]*\})\}")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[\d,{}]*\})\}")
+
+
+def _parse_groups(text: str) -> List[List[int]]:
+    """``{{0,1},{2,3}}`` (inner part) -> [[0,1],[2,3]]."""
+    return [
+        [int(x) for x in grp.split(",") if x]
+        for grp in re.findall(r"\{([\d,]*)\}", text)
+    ]
+
+
+def collective_traffic(compiled) -> List[dict]:
+    """Every XLA collective of a compiled executable, with exact bytes.
+
+    Returns one record per collective instruction: ``op``, ``dtype``,
+    element count and payload ``bytes`` (per participating device's
+    operand), and the ``groups`` (replica groups, or source->target
+    pairs for collective-permute). ``-start``/``-done`` async halves are
+    deduplicated by instruction name.
+    """
+    records = []
+    seen: Set[Tuple[str, str]] = set()
+    for line in compiled.as_text().splitlines():
+        m = _INSTR_RE.search(line)
+        if not m:
+            continue
+        name = m.group("name")
+        # async halves share a base name and describe ONE collective;
+        # sync instructions are keyed by their full (unique) name so a
+        # sync 'all-gather.3' never collides with an async pair whose
+        # base normalizes to the same string
+        if re.search(r"-(start|done)(\.|$)", name):
+            key = ("async", re.sub(r"-(start|done)(\.|$)", r"\2", name))
+        else:
+            key = ("sync", name)
+        if key in seen:
+            continue
+        seen.add(key)
+        base = key[1]
+        dtype = m.group("dtype")
+        if dtype not in _DTYPE_BYTES:
+            continue  # token/tuple-typed line; payload appears elsewhere
+        elems = 1
+        for d in m.group("shape").split(","):
+            if d:
+                elems *= int(d)
+        rec = {
+            "op": m.group("op"),
+            "name": base,
+            "dtype": dtype,
+            "elements": elems,
+            "bytes": elems * _DTYPE_BYTES[dtype],
+        }
+        g = _GROUPS_RE.search(line)
+        if g:
+            rec["groups"] = _parse_groups(g.group(1))
+        p = _PAIRS_RE.search(line)
+        if p:
+            rec["pairs"] = _parse_groups(p.group(1))
+        records.append(rec)
+    return records
+
+
+def _group_crossing(group: Sequence[int], partition: Dict[int, int]) -> bool:
+    """Does a replica group span more than one partition cell?"""
+    return len({partition[d] for d in group}) > 1
+
+
+def tier_crossing_bytes(
+    records: Sequence[dict], partition: Dict[int, int]
+) -> Dict[str, int]:
+    """Per-device payload bytes whose collective spans the partition.
+
+    ``partition`` maps device id -> tier cell (e.g. slice index of the
+    hybrid mesh). A collective whose replica group stays inside one
+    cell rides the fast tier only; one that spans cells must move its
+    payload across the slow boundary. Returns
+    ``{"crossing": B, "local": B}`` — the result-shape bytes of each
+    class, the quantity the hierarchical-vs-flat comparison needs
+    (for an all-reduce, every participating device contributes and
+    receives the full result shape, so result bytes IS the per-device
+    volume; for collective-permute, pairs that cross count).
+
+    Accounting is proportional: a device's payload counts as crossing
+    when ITS replica group (or permute pair) spans the partition, so a
+    record whose groups are part-local part-crossing contributes
+    ``bytes * crossing_fraction`` to each bucket (e.g. a ring permute
+    on a two-slice mesh crosses on exactly the 2 of n wrap links). A
+    record whose group structure did not parse (``replica_groups={}``
+    meaning all replicas, or the iota ``[n,m]<=[k]`` form) is counted
+    as fully CROSSING — conservatively overstating the slow-tier
+    volume rather than silently dropping payload.
+    """
+    out = {"crossing": 0.0, "local": 0.0}
+    for rec in records:
+        sets = rec.get("groups") or rec.get("pairs")
+        if sets:
+            ncross = sum(
+                1 for g in sets if _group_crossing(g, partition)
+            )
+            frac = ncross / len(sets)
+        else:
+            frac = 1.0  # unknown structure: assume it crosses
+        out["crossing"] += rec["bytes"] * frac
+        out["local"] += rec["bytes"] * (1.0 - frac)
+    return out
+
+
+def ring_traffic(
+    kind: str,
+    n: int,
+    payload_bytes: int,
+    chunks: int = 1,
+    hops: int = 1,
+) -> Dict[str, int]:
+    """Predicted per-device ICI traffic of a ring-tier program.
+
+    The remote DMAs live inside Mosaic kernels, so HLO shows nothing;
+    the schedule, however, is static (``kernels/ring.py``), and each
+    protocol's per-device send volume follows from it:
+
+    - ``all_gather``: each device forwards ``n - 1`` units of the
+      per-rank payload around the ring.
+    - ``all_reduce``: the running partial makes ``n - 1`` hops.
+    - ``reduce_scatter``: ``n - 1`` block-sized partials leave each
+      device.
+    - ``neighbour_stream``: every chunk moves one hop per call;
+      ``hops`` calls move ``chunks * hops`` chunk payloads.
+
+    ``payload_bytes`` is the per-unit payload (the per-rank chunk for
+    all_gather/all_reduce, the per-destination block for
+    reduce_scatter, the chunk row for neighbour_stream). Returns
+    ``{"ici_send_bytes": B}`` per device; receives are symmetric.
+    """
+    if kind in ("all_gather", "all_reduce", "reduce_scatter"):
+        return {"ici_send_bytes": (n - 1) * payload_bytes}
+    if kind == "neighbour_stream":
+        return {"ici_send_bytes": chunks * hops * payload_bytes}
+    raise ValueError(f"unknown ring protocol {kind!r}")

@@ -57,6 +57,16 @@ SURFACE_NAMES = [
     "ring_all_reduce_subset_axis", "ring_all_gather_two_axis",
     "train_step_mha_bf16", "train_step_gqa_window_bf16",
     "allreduce_hierarchical",
+    # round-4 composites: several ring kernel instances per program
+    "halo_ring_4dir", "halo_ring_corners", "stream_concurrent_ring",
+    "p2p_transfer_ring_multihop", "reduce_ring_rooted",
+    "gather_ring_rooted",
+    # the three applications at pod-real shapes
+    "app_stencil_8192_2x4", "app_stencil_temporal_8192_2x4",
+    "app_stencil_ring_2x4", "app_gesummv_4096", "app_kmeans_512k",
+    # comparison programs for the artifact traffic analysis
+    "allreduce_flat", "xla_all_gather", "xla_all_reduce",
+    "xla_reduce_scatter", "xla_neighbour_shift",
 ]
 
 

@@ -147,3 +147,172 @@ def test_credit_leak_detection_works():
 
     with pytest.raises(C.CreditLeakError):
         C.RingSimulator([leaky_rank()], C.Strategy(0)).run()
+
+
+# ---------------------------------------------------------------------------
+# Concurrent multi-stream composites: the 4-direction ring halo exchange
+# and the burst-interleaved stream_concurrent schedule (the configs
+# __graft_entry__.dryrun_multichip executes), fuzzed as composite
+# per-rank programs with shared scratch and per-stream barrier domains.
+# Reference: the strict-depth emulator exercising interacting channels
+# (test/mixed/mixed.cl:15-27, multi_collectives.cl:1-12).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh", [(2, 2), (2, 4), (3, 3)])
+@pytest.mark.parametrize("seed", range(8))
+def test_halo_4dir_random_schedules(mesh, seed):
+    """Four ring-tier shifts on distinct barrier domains: no clobber, no
+    deadlock, no leak, correct per-stream delivery."""
+    C.simulate_halo_exchange(*mesh, C.Strategy(seed))
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_halo_4dir_adversarial(seed):
+    C.simulate_halo_exchange(3, 3, C.DelayDmaStrategy(seed), chunks=2)
+    for grp in ([0, 1, 2], [2, 4, 6], [3, 4, 5]):
+        C.simulate_halo_exchange(
+            3, 3, C.FavourSetStrategy(grp, seed), chunks=2
+        )
+
+
+@pytest.mark.parametrize("n", [4, 5, 8])
+@pytest.mark.parametrize("seed", range(8))
+def test_stream_concurrent_random_schedules(n, seed):
+    """Burst-interleaved opposite-direction streams on distinct port
+    domains (the stream_concurrent(backend='ring') schedule)."""
+    C.simulate_stream_concurrent(n, C.Strategy(seed))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_stream_concurrent_adversarial(seed):
+    C.simulate_stream_concurrent(5, C.DelayDmaStrategy(seed), bursts=3)
+    for lag in range(5):
+        grp = [r for r in range(5) if r != lag]
+        C.simulate_stream_concurrent(
+            5, C.FavourSetStrategy(grp, seed), bursts=3
+        )
+
+
+def test_mutation_halo_shared_cross_axis_domain_clobbers():
+    """A row-ring stream SHARING a barrier domain with a column-ring
+    stream lets a rank satisfy its barrier with the other ring's
+    signals, enter early, and clobber scratch a neighbour is still
+    consuming — the exact hazard the per-direction domains
+    (halo.py streams 0-3) exist to prevent."""
+    caught = 0
+    for seed in range(30):
+        strats = [C.Strategy(seed), C.DelayDmaStrategy(seed)] + [
+            C.FavourRankStrategy(f, seed) for f in range(9)
+        ]
+        for strat in strats:
+            try:
+                C.simulate_halo_exchange(
+                    3, 3, strat, chunks=3, domains=(0, 1, 1, 3)
+                )
+            except C.ProtocolError:
+                caught += 1
+    assert caught > 0
+
+
+def test_same_ring_shared_domain_is_counting_safe():
+    """Negative result, pinned deliberately: instances that all ride
+    ONE ring (same neighbour set) may share a barrier domain without
+    violating any invariant — the pooled counter still bounds
+    inter-rank skew to less than one instance, because entering
+    instance k needs 2(k+1) cumulative signals and the two neighbours
+    have sent at most their own entry counts. The distinct domains the
+    runtime still assigns (channels.py::_ring_stream) are required by
+    Mosaic's collective_id contract and by CROSS-ring composites (see
+    the cross-axis mutation above), not by this schedule semantics."""
+    for seed in range(10):
+        C.simulate_stream_concurrent(
+            5, C.Strategy(seed), bursts=3, domains=(0, 0)
+        )
+        for lag in range(5):
+            grp = [r for r in range(5) if r != lag]
+            C.simulate_stream_concurrent(
+                5, C.FavourSetStrategy(grp, seed), bursts=3,
+                domains=(0, 0),
+            )
+
+
+def test_mutation_misordered_program_deadlocks_loudly():
+    """One rank running its burst's channels in swapped order (the
+    divergent-MPMD ordering bug): with DISTINCT domains the misordered
+    barrier deadlocks loudly on every schedule."""
+    for seed in range(10):
+        with pytest.raises(C.DeadlockError):
+            C.simulate_stream_concurrent(
+                4, C.Strategy(seed), swap_order_rank=1
+            )
+
+
+def test_mutation_misordered_program_shared_domain_clobbers():
+    """The same ordering bug with a SHARED domain: the pooled barrier
+    lets the misordered rank through, and the failure degrades to the
+    silent-on-hardware scratch clobber — which the fuzzer still sees."""
+    kinds = set()
+    for seed in range(20):
+        try:
+            C.simulate_stream_concurrent(
+                4, C.Strategy(seed), domains=(0, 0), swap_order_rank=1
+            )
+        except C.ProtocolError as e:
+            kinds.add(type(e).__name__)
+    assert "ClobberError" in kinds
+
+
+def test_mutation_wrong_logical_ids_is_caught():
+    """The round-3 subset-axis bug, reinstated: identity device ids on
+    rings spanning a SUBSET of the mesh axes cross-signal other rings'
+    ranks. The fuzzer sees it as clobbers and deadlocks — the same
+    failure the interpret tier reported as semaphore corruption."""
+    kinds = set()
+    caught = 0
+    for seed in range(10):
+        strats = [C.Strategy(seed), C.DelayDmaStrategy(seed)] + [
+            C.FavourRankStrategy(f, seed) for f in range(8)
+        ]
+        for strat in strats:
+            try:
+                C.simulate_halo_exchange(2, 4, strat, wrong_ids=True)
+            except C.ProtocolError as e:
+                kinds.add(type(e).__name__)
+                caught += 1
+    assert caught > 0
+    assert "ClobberError" in kinds
+
+
+def test_mutation_overgranting_leaks():
+    """Dropping the kernels' final-grant suppression (``c + 2 < total``,
+    ring.py) leaves surplus credits at exit — the composite harness
+    reports the leak on every schedule."""
+
+    def overgrant_rank(me, n, chunks, direction=1):
+        dst = (me + direction) % n
+        upstream = (me - direction) % n
+        yield from C._barrier_steps(me, n)
+        for c, chunk in enumerate(chunks):
+            slot = c % 2
+            if c >= 2:
+                yield ("wait", C.SEM_CREDIT, slot, 1)
+            yield ("dma", dst, slot, chunk, slot, slot)
+            yield ("wait", C.SEM_RECV, slot, 1)
+            arrived = yield ("read_slot", slot)
+            yield ("output", c, arrived)
+            yield ("signal", upstream, C.SEM_CREDIT, slot, 1)
+            yield ("wait", C.SEM_SEND, slot, 1)
+
+    for seed in range(10):
+        gens = [
+            C.chain_programs(
+                C.instance_steps(
+                    overgrant_rank(g, 4, [(g, k) for k in range(4)]),
+                    domain=0, instance=0,
+                )
+            )
+            for g in range(4)
+        ]
+        with pytest.raises(C.CreditLeakError):
+            C.RingSimulator(gens, C.Strategy(seed)).run()

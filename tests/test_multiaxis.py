@@ -140,16 +140,20 @@ def test_subset_axis_ring_gather_outer_axis(comm2d):
     f = jax.jit(
         jax.shard_map(
             shard, mesh=mesh, in_specs=P(("mx", "my"), None),
-            out_specs=P(None, None), check_vma=False,
+            out_specs=P(("mx", "my"), None), check_vma=False,
         )
     )
     # shard r holds one row of value r; gather over mx pairs r and r+4
     x = jnp.arange(8, dtype=jnp.float32)[:, None] * jnp.ones((1, 128))
     out = np.asarray(f(x))
-    # every column-ring returns (its row0 value, its row1 value); the
-    # out_specs=None reassembly keeps the first ring's copy
-    np.testing.assert_allclose(out[0, 0], 0.0)
-    np.testing.assert_allclose(out[1, 0], 4.0)
+    # every rank's own gathered copy comes back (out rows [2r, 2r+2)),
+    # so the assertion does not depend on which replica a replicated
+    # out_spec would keep: rank (mx=a, my=b)'s column-ring holds rows
+    # of values b and 4+b, in mx order
+    for r in range(8):
+        b = r % 4
+        np.testing.assert_allclose(out[2 * r, 0], float(b))
+        np.testing.assert_allclose(out[2 * r + 1, 0], float(4 + b))
 
 
 @pytest.mark.parametrize("comm_kind", ["1d", "2d"])
