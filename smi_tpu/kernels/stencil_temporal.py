@@ -134,6 +134,43 @@ def temporal_supported(h: int, w: int, dtype, depth: int = 8) -> bool:
     )
 
 
+def _sweep_trapezoid(val, boundary, t: int, k: int, lane_w: int):
+    """``k`` Jacobi sweeps over a ``(t + 2k, lane_w)`` working tile with
+    the 8-aligned trapezoid shrink (r4, measured +3.7% same-session).
+
+    Sweep ``s`` only has to produce rows ``[s+1, R-s-1)`` — later
+    sweeps never read above/below that validity cone — so the working
+    array drops vreg-aligned 8-row bands as the sweeps advance
+    (``lo = 8*(s//8)`` per side). The slice-edge rows pick up roll wrap
+    garbage, but they sit strictly outside the cone (the full-tile loop
+    wrote garbage there too), so output is bit-identical. The fully
+    unaligned trapezoid (1 row/sweep, ~10% fewer rows) measures worse:
+    every slice would sit at a sublane offset ≢ 0 (mod 8), forcing a
+    realign on all four rolls (``docs/perf_notes.md``).
+
+    Returns ``(val, off)``: the shrunken array and its absolute row
+    offset; callers slice their output rows as
+    ``val[k - off : t + k - off]``.
+    """
+    off = 0
+    R = t + 2 * k
+    for s in range(k):
+        lo = 8 * (s // 8)
+        if lo > off:
+            d = lo - off
+            val = val[d : val.shape[0] - d, :]
+            off = lo
+        rows = R - 2 * off
+        avg = 0.25 * (
+            pltpu.roll(val, 1, axis=0)
+            + pltpu.roll(val, rows - 1, axis=0)
+            + pltpu.roll(val, 1, axis=1)
+            + pltpu.roll(val, lane_w - 1, axis=1)
+        )
+        val = jnp.where(boundary[off : R - off, :], val, avg)
+    return val, off
+
+
 def _temporal_kernel(
     offs_ref,    # scalar prefetch: [row0, col0] of this block
     x_ref,       # (T, W+256) one stripe of the extended block
@@ -193,16 +230,8 @@ def _temporal_kernel(
         boundary = row_b | col_b
 
         # ---- k sweeps in VMEM; valid region shrinks one ring each ----
-        val = a_ref[...]
-        for _ in range(k):
-            avg = 0.25 * (
-                pltpu.roll(val, 1, axis=0)
-                + pltpu.roll(val, t + 2 * k - 1, axis=0)
-                + pltpu.roll(val, 1, axis=1)
-                + pltpu.roll(val, wp - 1, axis=1)
-            )
-            val = jnp.where(boundary, val, avg)
-        o_ref[...] = val[k : t + k, :]
+        val, off = _sweep_trapezoid(a_ref[...], boundary, t, k, wp)
+        o_ref[...] = val[k - off : t + k - off, :]
 
     # Rotate the pipeline: save the carried stripe's last k rows as the
     # next step's upper boundary, then refill the centre with the stripe
@@ -382,16 +411,8 @@ def _tiled_kernel(
         col_b = (g_col == 0) | (g_col == gw - 1)
         boundary = row_b | col_b
 
-        val = a_ref[...]
-        for _ in range(k):
-            avg = 0.25 * (
-                pltpu.roll(val, 1, axis=0)
-                + pltpu.roll(val, t + 2 * k - 1, axis=0)
-                + pltpu.roll(val, 1, axis=1)
-                + pltpu.roll(val, wca - 1, axis=1)
-            )
-            val = jnp.where(boundary, val, avg)
-        o_ref[...] = val[k : t + k, pad : pad + wc]
+        val, off = _sweep_trapezoid(a_ref[...], boundary, t, k, wca)
+        o_ref[...] = val[k - off : t + k - off, pad : pad + wc]
 
     # rotate the pipeline; the carry holds this column tile plus k halo
     # columns from each neighbouring tile

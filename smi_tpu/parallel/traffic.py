@@ -40,11 +40,15 @@ _COLLECTIVES = (
 )
 
 #: one HLO instruction line: ``%name = f32[8,128]{...} all-reduce(...)``
+#: the ``type`` group spans the whole result type — possibly a tuple
+#: for async ``-start`` forms, whose LAST element is the result shape
+#: (the leading elements alias operands)
 _INSTR_RE = re.compile(
     r"%(?P<name>[\w.\-]+)\s*=\s*"
-    r"(?:\()?(?P<dtype>\w+)\[(?P<shape>[\d,]*)\]"
-    r"[^=]*?\s(?P<op>" + "|".join(_COLLECTIVES) + r")(?:-start|-done)?\("
+    r"(?P<type>[^=]+?)\s(?P<op>" + "|".join(_COLLECTIVES)
+    + r")(?:-start|-done)?\("
 )
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 _GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,{}]*\})\}")
 _PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[\d,{}]*\})\}")
 
@@ -83,13 +87,22 @@ def collective_traffic(compiled) -> List[dict]:
             key = ("sync", name)
         if key in seen:
             continue
-        seen.add(key)
         base = key[1]
-        dtype = m.group("dtype")
-        if dtype not in _DTYPE_BYTES:
-            continue  # token/tuple-typed line; payload appears elsewhere
+        # last array shape of the (possibly tuple) result type is the
+        # collective's result; async -start tuples lead with operand
+        # aliases whose bytes would understate e.g. an all-gather n-fold
+        shapes = [
+            s for s in _SHAPE_RE.findall(m.group("type"))
+            if s[0] in _DTYPE_BYTES
+        ]
+        if not shapes:
+            # token-typed line carries no payload shape; leave the key
+            # unseen so the paired half (e.g. the -done) can record it
+            continue
+        seen.add(key)
+        dtype, shape = shapes[-1]
         elems = 1
-        for d in m.group("shape").split(","):
+        for d in shape.split(","):
             if d:
                 elems *= int(d)
         rec = {

@@ -57,6 +57,20 @@ def test_async_halves_deduplicated():
     assert recs[0]["bytes"] == 512
 
 
+def test_async_tuple_start_records_result_bytes():
+    """An async -start's tuple type leads with operand aliases; the
+    record must book the LAST element (the gathered result), matching
+    what the sync form of the same op books."""
+    hlo = ("%all-gather-start.7 = (f32[16,256]{1,0:T(8,128)}, "
+           "f32[128,256]{1,0}) all-gather-start(%p0), channel_id=2, "
+           "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}\n"
+           "%all-gather-done.7 = f32[128,256]{1,0} "
+           "all-gather-done(%all-gather-start.7)")
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == 128 * 256 * 4
+
+
 def test_sync_name_does_not_collide_with_async_base():
     """Full HLO names are unique but a sync 'all-gather.3' and an async
     pair 'all-gather-start.3'/'-done.3' share a base — both collectives
