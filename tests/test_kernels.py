@@ -66,6 +66,67 @@ def test_ring_all_reduce_interpret(eight_devices):
         np.testing.assert_allclose(out[r], expected, rtol=1e-5)
 
 
+
+
+def test_ring_kernels_unaligned_lane_widths(eight_devices):
+    """Payloads whose lane width is not a 128-multiple stream correctly:
+    the wrappers pad to the Mosaic lane tile and slice back (r4 fix —
+    Mosaic rejects unaligned slot slices; the AOT tier caught it on the
+    corner halo's W+2-wide slabs while interpret mode accepted them)."""
+    from jax.sharding import PartitionSpec as P
+
+    n = 4
+    comm = smi.make_communicator(n, devices=eight_devices)
+    ma = kring.mesh_axes_of(comm)
+
+    def run(shard, in_s, out_s, x):
+        f = jax.jit(
+            jax.shard_map(shard, mesh=comm.mesh, in_specs=in_s,
+                          out_specs=out_s, check_vma=False)
+        )
+        return np.asarray(f(x))
+
+    # all_gather, width 37
+    x = jnp.arange(n * 37, dtype=jnp.float32).reshape(n, 37)
+    out = run(
+        lambda v: kring.ring_all_gather(
+            v.reshape(-1), "smi", n, interpret=True, mesh_axes=ma
+        ).reshape(1, -1),
+        P("smi", None), P("smi", None), x,
+    )
+    np.testing.assert_array_equal(out, np.tile(np.asarray(x).reshape(-1), (n, 1)))
+
+    # MAX all_reduce with all-negative values, width 33: the zero pad
+    # must never leak into the reduction result
+    x2 = -jnp.abs(jnp.arange(n * 33, dtype=jnp.float32).reshape(n, 33)) - 1.0
+    out2 = run(
+        lambda v: kring.ring_all_reduce(
+            v[0], "smi", n, op="max", interpret=True, mesh_axes=ma
+        )[None],
+        P("smi", None), P("smi", None), x2,
+    )
+    np.testing.assert_allclose(out2, np.tile(np.asarray(x2).max(0), (n, 1)))
+
+    # reduce_scatter, width 19 (replicated input: every rank contributes
+    # the same buffer, so rank r's shard is n * block_r)
+    x3 = jnp.arange(2 * n * 19, dtype=jnp.float32).reshape(2 * n, 19)
+    out3 = run(
+        lambda v: kring.ring_reduce_scatter(
+            v, "smi", n, interpret=True, mesh_axes=ma
+        ),
+        P(None, None), P("smi", None), x3,
+    )
+    np.testing.assert_allclose(out3, n * np.asarray(x3))
+
+    # neighbour stream, 3 chunks of width 45
+    x4 = jnp.arange(n * 3 * 45, dtype=jnp.float32).reshape(n, 3, 45)
+    out4 = run(
+        lambda v: kring.neighbour_stream(
+            v, "smi", n, interpret=True, mesh_axes=ma
+        ),
+        P("smi", None, None), P("smi", None, None), x4,
+    )
+    np.testing.assert_allclose(out4, np.roll(np.asarray(x4), 1, axis=0))
 # ------------------------------------------------- temporal blocking --
 
 
