@@ -262,29 +262,41 @@ def _lift_payload(x: jax.Array) -> jax.Array:
 _LANES = 128
 
 
-def _pad_lanes(payload: jax.Array) -> Tuple[jax.Array, int]:
-    """Zero-pad the lane (last) dim to a multiple of 128.
+def _pad_lanes(payload: jax.Array) -> Tuple[jax.Array, Tuple[int, int]]:
+    """Zero-pad the payload's trailing tile dims to Mosaic alignment.
 
-    Mosaic rejects the kernels' slot/unit slices whenever the payload's
-    logical lane width is not tile-aligned ("Slice shape along
-    dimension 2 must be aligned to tiling (128)") — caught by the AOT
-    topology tier on the corner-complete halo program, whose extended
-    slabs are ``W + 2*depth`` wide (``halo_ring_corners``,
-    ``tests/test_aot_tpu.py``); interpret mode has no tiling and
-    accepts any width. The wrappers pad here and slice the result back
-    to the logical width, so callers may stream any payload shape. The
-    padding is dead data: receivers only ever see their neighbours'
-    equally-padded buffers, and the pad region is dropped before any
-    reduction result is returned (safe for MAX/MIN, not just ADD).
+    Two constraints, both invisible to interpret mode and both caught
+    by the AOT topology tier (``tests/test_aot_tpu.py``):
 
-    Returns ``(padded, logical_width)``.
+    - the lane (last) dim must be a multiple of 128, or the kernels'
+      slot/unit slices are rejected ("Slice shape along dimension 2
+      must be aligned to tiling (128)") — caught on the
+      corner-complete halo program, whose extended slabs are
+      ``W + 2*depth`` wide;
+    - for sub-32-bit dtypes Mosaic packs ``32 / bitwidth`` sublanes
+      per tile row, so the sublane (second-to-last) dim must be a
+      multiple of that packing factor or the slot slice lands mid-tile
+      — caught on ``ring_all_reduce_bf16``, whose lifted ``(1, W)``
+      payload has a 1-sublane dim inside a 2-per-row bf16 tiling.
+
+    The wrappers pad here and slice the result back to the logical
+    shape, so callers may stream any payload shape/dtype. The padding
+    is dead data: receivers only ever see their neighbours' equally-
+    padded buffers, and the pad region is dropped before any reduction
+    result is returned (safe for MAX/MIN, not just ADD).
+
+    Returns ``(padded, (logical_sublanes, logical_width))``.
     """
-    width = payload.shape[-1]
-    pad = (-width) % _LANES
-    if pad == 0:
-        return payload, width
-    widths = [(0, 0)] * (payload.ndim - 1) + [(0, pad)]
-    return jnp.pad(payload, widths), width
+    sub, width = payload.shape[-2], payload.shape[-1]
+    packing = max(1, 32 // (jnp.dtype(payload.dtype).itemsize * 8))
+    pad_sub = (-sub) % packing
+    pad_w = (-width) % _LANES
+    if pad_sub == 0 and pad_w == 0:
+        return payload, (sub, width)
+    widths = (
+        [(0, 0)] * (payload.ndim - 2) + [(0, pad_sub)] + [(0, pad_w)]
+    )
+    return jnp.pad(payload, widths), (sub, width)
 
 
 # ---------------------------------------------------------------------------
@@ -364,7 +376,7 @@ def ring_all_gather(
     """
     if n == 1:
         return x
-    payload, width = _pad_lanes(_lift_payload(x))
+    payload, logical = _pad_lanes(_lift_payload(x))
     xu = payload[None]  # (1, *payload): one unit per rank
     out_shape = jax.ShapeDtypeStruct((n,) + payload.shape, x.dtype)
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
@@ -389,8 +401,8 @@ def ring_all_gather(
         ),
         interpret=_interpret_arg(interpret),
     )(xu)
-    if width != payload.shape[-1]:
-        gathered = gathered[..., :width]
+    if logical != payload.shape[-2:]:
+        gathered = gathered[..., : logical[0], : logical[1]]
     return gathered.reshape((n * x.shape[0],) + x.shape[1:])
 
 
@@ -464,7 +476,7 @@ def ring_all_reduce(
     """
     if n == 1:
         return x
-    payload, width = _pad_lanes(_lift_payload(x))
+    payload, logical = _pad_lanes(_lift_payload(x))
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
         _ring_all_reduce_kernel, ring_axes=ring_axes,
@@ -487,8 +499,8 @@ def ring_all_reduce(
         ),
         interpret=_interpret_arg(interpret),
     )(payload)
-    if width != payload.shape[-1]:
-        reduced = reduced[..., :width]
+    if logical != payload.shape[-2:]:
+        reduced = reduced[..., : logical[0], : logical[1]]
     return reduced.reshape(x.shape)
 
 
@@ -581,7 +593,7 @@ def ring_reduce_scatter(
         xu = x.reshape(n, 1, chunk)
     else:
         xu = x.reshape((n, chunk) + x.shape[1:])
-    xu, width = _pad_lanes(xu)
+    xu, logical = _pad_lanes(xu)
     block = xu.shape[1:]
     out_shape = jax.ShapeDtypeStruct((1,) + block, x.dtype)
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
@@ -606,8 +618,8 @@ def ring_reduce_scatter(
         ),
         interpret=_interpret_arg(interpret),
     )(xu)
-    if width != xu.shape[-1]:
-        scattered = scattered[..., :width]
+    if logical != xu.shape[-2:]:
+        scattered = scattered[..., : logical[0], : logical[1]]
     return scattered.reshape((chunk,) + x.shape[1:])
 
 
@@ -702,7 +714,7 @@ def neighbour_stream(
     # per-chunk payloads must be >=2-D so the chunk/slot axes stay
     # untiled (see _lift_payload), and lane-aligned (see _pad_lanes)
     xu = x.reshape(chunks, 1, -1) if x.ndim < 3 else x
-    xu, width = _pad_lanes(xu)
+    xu, logical = _pad_lanes(xu)
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
         _neighbour_stream_kernel, ring_axes=ring_axes,
@@ -725,8 +737,8 @@ def neighbour_stream(
         ),
         interpret=_interpret_arg(interpret),
     )(xu)
-    if width != xu.shape[-1]:
-        streamed = streamed[..., :width]
+    if logical != xu.shape[-2:]:
+        streamed = streamed[..., : logical[0], : logical[1]]
     return streamed.reshape(x.shape)
 
 

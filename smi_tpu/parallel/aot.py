@@ -246,6 +246,48 @@ def _ring_cases(topology: str):
         )
 
 
+def _ring_dtype_cases(topology: str):
+    """Ring kernels at the non-f32 payload dtypes of the header
+    library's surface (``ops/types.py``: int/float/double/char/short —
+    TPU-native analogs int32/float32/bf16/int8/int16). Mosaic's
+    dtype-specific tiling and DMA paths are exactly what interpret mode
+    cannot check (it accepted bf16 ``pltpu.roll``, which Mosaic rejects
+    — ``docs/perf_notes.md`` r4); the ring kernels use no rolls, and
+    this pins that their slot slices and RDMA stay legal per dtype."""
+    from smi_tpu.kernels import ring
+
+    comm = topology_communicator(topology)
+    axis, n = comm.axis_names[0], comm.size
+
+    def case(name, shard, in_spec, out_spec, shape, dtype):
+        def build():
+            f = jax.jit(
+                jax.shard_map(
+                    shard, mesh=comm.mesh, in_specs=in_spec,
+                    out_specs=out_spec, check_vma=False,
+                )
+            )
+            return compile_sharded(f, shaped(comm, shape, dtype, in_spec))
+        return name, build
+
+    yield case(
+        "ring_all_reduce_bf16",
+        lambda x: ring.ring_all_reduce(x[0], axis, n)[None],
+        P(axis, None), P(axis, None), (n, 256), jnp.bfloat16,
+    )
+    yield case(
+        "ring_all_gather_int32",
+        lambda x: ring.ring_all_gather(x, axis, n),
+        P(axis, None), P(None, None), (n * 16, 256), jnp.int32,
+    )
+    yield case(
+        "neighbour_stream_bf16",
+        lambda x: ring.neighbour_stream(x, axis, n),
+        P(axis, None, None), P(axis, None, None), (n * 4, 8, 256),
+        jnp.bfloat16,
+    )
+
+
 def _subset_ring_cases(topology: str):
     """Rings over a subset / a pair of axes of a 2-D mesh: the logical
     device-id reconstruction (``ring._logical_id_fn``) must survive
@@ -650,6 +692,7 @@ def _app_cases(topology: str):
 def surface_cases(topology: str = DEFAULT_TOPOLOGY):
     """All (name, build) pairs of the multi-chip AOT surface."""
     yield from _ring_cases(topology)
+    yield from _ring_dtype_cases(topology)
     yield from _subset_ring_cases(topology)
     yield from _transformer_cases(topology)
     yield from _hierarchical_case(topology)

@@ -316,3 +316,30 @@ def test_mutation_overgranting_leaks():
         ]
         with pytest.raises(C.CreditLeakError):
             C.RingSimulator(gens, C.Strategy(seed)).run()
+
+
+def test_exhaustive_tiny_concurrent_composite():
+    """EVERY scheduler interleaving (communication-boundary granularity)
+    of the smallest concurrent composite — a 2-rank ring running two
+    back-to-back streams on distinct barrier domains with shared
+    scratch — passes all invariants. (The 2x2 halo's 4-instance
+    composite is beyond exhaustive reach; the random/adversarial
+    sweeps above cover it.)"""
+
+    def make():
+        progs = []
+        for g in range(2):
+            subs = []
+            for stream, direction in ((0, 1), (1, -1)):
+                labels = [((g, stream), k) for k in range(2)]
+                subs.append(C.instance_steps(
+                    C.neighbour_stream_rank(
+                        g, 2, labels, direction=direction
+                    ),
+                    domain=stream, instance=stream,
+                ))
+            progs.append(C.chain_programs(*subs))
+        return progs
+
+    explored = C.explore_all_schedules(make, max_schedules=400_000)
+    assert explored > 1000  # genuinely many distinct schedules
