@@ -267,7 +267,7 @@ def longcontext_points(comm, quick: bool = False):
     # chained q carry, which no longer fits beside the gradients
     for s, window, h_kv in (
         (32768, None, h), (32768, w, h), (65536, w, h), (131072, w, h),
-        (262144, w, 1), (524288, w, 1),
+        (262144, w, 1), (524288, w, 1), (1048576, w, 1),
     ):
         rng = np.random.RandomState(0)
         q = jnp.asarray(rng.randn(s, h, d), jnp.bfloat16)
@@ -331,6 +331,57 @@ def longcontext_points(comm, quick: bool = False):
             rate / 1e6, "Mtoken/s",
             {"S": s, "H": h, "D": d, "kv_heads": h_kv, "dtype": "bf16",
              "window": w, "timing": trace},
+        ))
+
+    # 512k training: the rep-chained grad harness would need reps ×
+    # ~1 GiB of chained-q residuals, which stopped fitting at this
+    # length (the r2/r3 "trains but can't be timed" footnote). Chain
+    # SGD *steps* instead — gradients complete inside each fori_loop
+    # iteration, so memory stays at one step's working set. NOTE the
+    # harness semantics differ: at 256k, where both run, step-chaining
+    # reads ~1.24 vs the rep-chain's ~1.01 Mtoken/s (the rep-chain's
+    # stacked residuals pressure HBM) — recorded with
+    # harness="step-chain"; 1M training does not fit (f32 dq alone is
+    # 4 GiB) — that rung needs a second chip's sequence parallelism.
+    import jax as _jax
+    from jax import lax as _lax
+
+    for s, h_kv in ((524288, 1),):
+        rng = np.random.RandomState(0)
+        q0 = jnp.asarray(rng.randn(s, h, d), jnp.bfloat16)
+        k0, v0 = (
+            jnp.asarray(rng.randn(s, h_kv, d), jnp.bfloat16)
+            for _ in range(2)
+        )
+        attn = ra.make_ring_attention_fn(
+            comm, causal=True, use_flash=True, window=w
+        )
+        grad = _jax.grad(
+            lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2),
+        )
+
+        def make_steps(r, _q0=q0, _k0=k0, _v0=v0):
+            @_jax.jit
+            def chain(q, k, v):
+                def body(i, carry):
+                    qq, kk, vv = carry
+                    dq, dk, dv = grad(qq, kk, vv)
+                    return (qq - 1e-6 * dq.astype(qq.dtype),
+                            kk - 1e-6 * dk.astype(kk.dtype),
+                            vv - 1e-6 * dv.astype(vv.dtype))
+                return _lax.fori_loop(0, r, body, (q, k, v))
+
+            return lambda: np.asarray(
+                jnp.sum(chain(_q0, _k0, _v0)[0].astype(jnp.float32)))
+
+        rate, trace = _diff_rate(make_steps, s, r1=1, factor=3,
+                                 max_reps=6, min_delta=1.0)
+        out.append(_result(
+            f"flash_attn_train_tokens_s{s}_gqa{h // h_kv}_window{w}_bf16",
+            rate / 1e6, "Mtoken/s",
+            {"S": s, "H": h, "D": d, "kv_heads": h_kv, "dtype": "bf16",
+             "window": w, "harness": "step-chain", "timing": trace},
         ))
     return out
 
