@@ -493,6 +493,10 @@ def model_train_point(comm, quick: bool = False):
     out = []
     for s, window, layers in (
         (8192, None, 1), (32768, 4096, 1),
+        # the windowed 1-layer row: the PROPER per-layer baseline for
+        # the 4-block stack below (same attention config — the r4
+        # stack budget in docs/perf_notes.md is measured against it)
+        (8192, 4096, 1),
         # the 4-block stack (scan + per-block remat): composition
         # overhead shown amortized, not per-block
         (8192, 4096, 4), (32768, 4096, 4),
