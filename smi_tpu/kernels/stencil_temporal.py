@@ -161,6 +161,11 @@ def _sweep_trapezoid(val, boundary, t: int, k: int, lane_w: int):
             val = val[d : val.shape[0] - d, :]
             off = lo
         rows = R - 2 * off
+        # association order matters to Mosaic's port scheduling: this
+        # sublane-first left-assoc tree measures 4-6% faster than
+        # lane-first or interleaved pairings (r4 A/B, same session:
+        # 132.1 vs 124.4 / 126.9 Gcell/s) — the trailing lane rolls
+        # overlap the adds of the cheap sublane pair
         avg = 0.25 * (
             pltpu.roll(val, 1, axis=0)
             + pltpu.roll(val, rows - 1, axis=0)
