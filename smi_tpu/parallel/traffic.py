@@ -88,23 +88,38 @@ def collective_traffic(compiled) -> List[dict]:
         if key in seen:
             continue
         base = key[1]
-        # last array shape of the (possibly tuple) result type is the
-        # collective's result; async -start tuples lead with operand
-        # aliases whose bytes would understate e.g. an all-gather n-fold
-        shapes = [
-            s for s in _SHAPE_RE.findall(m.group("type"))
-            if s[0] in _DTYPE_BYTES
-        ]
+        # Payload bytes from a possibly-tuple result type. Two tuple
+        # flavors exist and need opposite rules:
+        # - async ``-start`` tuples are (operand aliases..., result,
+        #   u32[] contexts...): the payload is the LARGEST array
+        #   (picking "last" once recorded a 4 MB permute as its 4-byte
+        #   context scalar; picking "first" understates an all-gather
+        #   by its operand/result ratio);
+        # - a SYNC tuple is a fused collective (XLA combines gradient
+        #   psums into one all-reduce over many tensors): the payload
+        #   is the SUM of the arrays (the max rule recorded a fused
+        #   3-tensor psum as its largest member).
+        shapes = []
+        for dtype, shape in _SHAPE_RE.findall(m.group("type")):
+            if dtype not in _DTYPE_BYTES:
+                continue
+            elems = 1
+            for dim in shape.split(","):
+                if dim:
+                    elems *= int(dim)
+            shapes.append((dtype, elems, elems * _DTYPE_BYTES[dtype]))
         if not shapes:
             # token-typed line carries no payload shape; leave the key
             # unseen so the paired half (e.g. the -done) can record it
             continue
         seen.add(key)
-        dtype, shape = shapes[-1]
-        elems = 1
-        for d in shape.split(","):
-            if d:
-                elems *= int(d)
+        if key[0] == "async":
+            dtype, elems, _ = max(shapes, key=lambda t: t[2])
+        else:
+            dtype = max(shapes, key=lambda t: t[2])[0]
+            elems = sum(
+                e * _DTYPE_BYTES[dt] for dt, e, _ in shapes
+            ) // _DTYPE_BYTES[dtype]
         rec = {
             "op": m.group("op"),
             "name": base,

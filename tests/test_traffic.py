@@ -58,9 +58,9 @@ def test_async_halves_deduplicated():
 
 
 def test_async_tuple_start_records_result_bytes():
-    """An async -start's tuple type leads with operand aliases; the
-    record must book the LAST element (the gathered result), matching
-    what the sync form of the same op books."""
+    """An async -start's tuple type leads with operand aliases and can
+    trail with u32 barrier/context scalars; the record must book the
+    LARGEST array (the payload), matching the sync form."""
     hlo = ("%all-gather-start.7 = (f32[16,256]{1,0:T(8,128)}, "
            "f32[128,256]{1,0}) all-gather-start(%p0), channel_id=2, "
            "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}\n"
@@ -69,6 +69,32 @@ def test_async_tuple_start_records_result_bytes():
     recs = T.collective_traffic(FakeCompiled(hlo))
     assert len(recs) == 1
     assert recs[0]["bytes"] == 128 * 256 * 4
+
+
+def test_async_permute_with_context_scalars():
+    """collective-permute-start tuples trail with u32[] contexts; the
+    4-byte scalars must not be mistaken for the payload (a real v5e
+    artifact once recorded a 4 MiB permute as 4 bytes)."""
+    hlo = ("%collective-permute-start.2 = (bf16[1,4096,128]{2,1,0}, "
+           "bf16[1,4096,128]{2,1,0}, u32[], u32[]) "
+           "collective-permute-start(%x), channel_id=5, "
+           "source_target_pairs={{0,1},{1,2},{2,3},{3,0}}")
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == 4096 * 128 * 2
+    assert recs[0]["pairs"] == [[0, 1], [1, 2], [2, 3], [3, 0]]
+
+
+def test_fused_sync_tuple_sums_all_payloads():
+    """XLA fuses gradient psums into ONE tuple-typed all-reduce; the
+    payload is the sum of the tuple's arrays, not its largest member."""
+    hlo = ("%all-reduce.3 = (f32[384,1024]{1,0}, f32[256,768]{1,0}, "
+           "f32[256]{0}) all-reduce(%a, %b, %c), channel_id=4, "
+           "replica_groups={{0,1,2,3,4,5,6,7}}, "
+           "use_global_device_ids=true, to_apply=%add")
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == (384 * 1024 + 256 * 768 + 256) * 4
 
 
 def test_sync_name_does_not_collide_with_async_base():
