@@ -200,6 +200,24 @@ def mesh_axes_of(comm: Communicator) -> Tuple[Tuple[str, int], ...]:
     )
 
 
+def _check_reducible(x: jax.Array, interpret: bool) -> None:
+    """Reducing ring kernels cannot lower 8-bit arithmetic on TPU.
+
+    Mosaic has no 8-bit vector ALU path ("Only vector<i16> and
+    vector<i32> are supported, but got 'i8'") — caught by the AOT
+    topology tier; interpret mode happily adds i8 and would hide the
+    failure until a real pod hits it. Movement kernels (all_gather,
+    neighbour_stream) carry 8-bit payloads fine; reductions must widen
+    to >=16 bits or use the XLA tier (``lax.psum`` handles int8).
+    """
+    if not interpret and jnp.dtype(x.dtype).itemsize == 1:
+        raise NotImplementedError(
+            f"ring-tier reductions cannot compile for 8-bit dtype "
+            f"{x.dtype} (Mosaic has no 8-bit vector arithmetic); widen "
+            f"the payload to int16/int32 or use backend='xla'"
+        )
+
+
 def _interpret_arg(interpret: bool):
     """Pallas ``interpret=`` argument for the requested mode.
 
@@ -476,6 +494,7 @@ def ring_all_reduce(
     """
     if n == 1:
         return x
+    _check_reducible(x, interpret)
     payload, logical = _pad_lanes(_lift_payload(x))
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     kernel = functools.partial(
@@ -588,6 +607,7 @@ def ring_reduce_scatter(
         )
     if n == 1:
         return x
+    _check_reducible(x, interpret)
     chunk = x.shape[0] // n
     if x.ndim == 1:
         xu = x.reshape(n, 1, chunk)

@@ -286,6 +286,23 @@ def _ring_dtype_cases(topology: str):
         P(axis, None, None), P(axis, None, None), (n * 4, 8, 256),
         jnp.bfloat16,
     )
+    # 8/16-bit integer payloads (char/short): packing factors 4 and 2.
+    # int8 covers MOVEMENT kernels only — Mosaic has no 8-bit vector
+    # arithmetic ("Only vector<i16> and vector<i32> are supported"),
+    # so the REDUCING ring kernels reject int8 with a clear error and
+    # point at the XLA tier (caught by this tier as bug #7; interpret
+    # mode happily adds i8)
+    yield case(
+        "neighbour_stream_int8",
+        lambda x: ring.neighbour_stream(x, axis, n),
+        P(axis, None, None), P(axis, None, None), (n * 4, 8, 256),
+        jnp.int8,
+    )
+    yield case(
+        "ring_all_reduce_int16",
+        lambda x: ring.ring_all_reduce(x[0], axis, n)[None],
+        P(axis, None), P(axis, None), (n, 256), jnp.int16,
+    )
 
 
 def _subset_ring_cases(topology: str):

@@ -16,6 +16,12 @@ boundary of a hybrid mesh), which is how ``docs/perf_notes.md`` proves
 the hierarchical allreduce moves ``1/inner`` of the flat volume across
 the slow tier.
 
+Scope caveat: records are per HLO *occurrence*, not per execution — a
+collective inside a ``while``/``fori_loop`` body prints once but runs
+trip-count times (e.g. ``app_kmeans_512k``'s in-loop Reduce+Bcast), so
+volume comparisons must use loop-free programs (the perf_notes tables
+do) or scale by the known trip count themselves.
+
 Ring-tier programs move their data inside Mosaic kernels (remote DMAs
 are invisible to HLO), so their traffic is *predicted* from the kernel
 schedule instead: :func:`ring_traffic` implements the per-hop formulas
@@ -113,7 +119,11 @@ def collective_traffic(compiled) -> List[dict]:
             # unseen so the paired half (e.g. the -done) can record it
             continue
         seen.add(key)
-        if key[0] == "async":
+        # an all-reduce's (sync or -start) tuple holds only results —
+        # XLA fuses several reduced tensors into one op — so SUM them;
+        # other async -start tuples mix operand aliases and context
+        # scalars around the result, so take the largest array
+        if key[0] == "async" and m.group("op") != "all-reduce":
             dtype, elems, _ = max(shapes, key=lambda t: t[2])
         else:
             dtype = max(shapes, key=lambda t: t[2])[0]

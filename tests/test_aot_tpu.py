@@ -55,7 +55,8 @@ SURFACE_NAMES = [
     "ring_all_gather_nofc", "ring_all_reduce_nofc",
     "ring_reduce_scatter_nofc", "neighbour_stream_nofc",
     "ring_all_reduce_bf16", "ring_all_gather_int32",
-    "neighbour_stream_bf16",
+    "neighbour_stream_bf16", "neighbour_stream_int8",
+    "ring_all_reduce_int16",
     "ring_all_reduce_subset_axis", "ring_all_gather_two_axis",
     "train_step_mha_bf16", "train_step_gqa_window_bf16",
     "allreduce_hierarchical",

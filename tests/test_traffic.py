@@ -188,3 +188,17 @@ def test_live_artifact_carries_collectives():
         recs = progs[name]["collectives"]
         assert recs and all("op" in r and "bytes" in r for r in recs), name
         assert "collectives_error" not in progs[name], name
+
+
+def test_async_fused_all_reduce_sums_results():
+    """An async all-reduce-start's tuple holds only RESULTS (XLA fuses
+    several reduced tensors), so the payload is their sum — unlike
+    other -start tuples whose extra elements are operand aliases."""
+    hlo = ("%all-reduce-start.9 = (f32[384,1024]{1,0}, f32[256]{0}) "
+           "all-reduce-start(%a, %b), channel_id=6, "
+           "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add\n"
+           "%all-reduce-done.9 = (f32[384,1024]{1,0}, f32[256]{0}) "
+           "all-reduce-done(%all-reduce-start.9)")
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == (384 * 1024 + 256) * 4
