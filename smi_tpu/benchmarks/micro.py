@@ -394,9 +394,15 @@ def run_benchmark(name: str, comm: Optional[Communicator] = None,
         for p in sig.parameters.values()
     ):
         # benchmarks without backend tiers (the app benchmarks) reject
-        # the kwarg; the CLI pops it for them — do the same for
-        # Python-API callers instead of raising TypeError
-        params = {k: v for k, v in params.items() if k != "backend"}
+        # the kwarg; dropping backend='xla' is harmless (it IS the
+        # default tier), but a requested non-default tier must never be
+        # silently substituted with an XLA measurement
+        dropped = params.pop("backend")
+        if dropped != "xla":
+            raise ValueError(
+                f"benchmark {name!r} has no backend tiers; refusing to "
+                f"record backend={dropped!r} as an XLA measurement"
+            )
     m = fn(comm, **params)
     backend = params.get("backend", "xla")
     if backend != "xla" and not m.name.endswith(f"-{backend}"):

@@ -179,9 +179,20 @@ def executable_report(compiled) -> dict:
     except Exception as e:  # pragma: no cover - backend-dependent
         report["cost"] = {"unavailable": str(e)}
     try:
-        from smi_tpu.parallel.traffic import collective_traffic
+        from smi_tpu.parallel.traffic import (
+            collective_traffic,
+            has_collectives,
+        )
 
-        report["collectives"] = collective_traffic(compiled)
+        text = compiled.as_text()
+        records = collective_traffic(compiled, text)
+        report["collectives"] = records
+        if not records and has_collectives(text):
+            # mark a parser miss so the empty list never ships as data
+            report["collectives_error"] = (
+                "HLO contains collective instructions but none "
+                "parsed — traffic parser miss"
+            )
     except Exception as e:  # pragma: no cover - backend-dependent
         # an empty (falsy) list + explicit error key: downstream guards
         # (tests/test_traffic.py) fail loudly instead of reading a

@@ -50,7 +50,7 @@ _COLLECTIVES = (
 #: for async ``-start`` forms, whose LAST element is the result shape
 #: (the leading elements alias operands)
 _INSTR_RE = re.compile(
-    r"%(?P<name>[\w.\-]+)\s*=\s*"
+    r"%?(?P<name>[\w.\-]+)\s*=\s*"
     r"(?P<type>[^=]+?)\s(?P<op>" + "|".join(_COLLECTIVES)
     + r")(?:-start|-done)?\("
 )
@@ -67,18 +67,22 @@ def _parse_groups(text: str) -> List[List[int]]:
     ]
 
 
-def collective_traffic(compiled) -> List[dict]:
+def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
     """Every XLA collective of a compiled executable, with exact bytes.
 
     Returns one record per collective instruction: ``op``, ``dtype``,
     element count and payload ``bytes`` (per participating device's
     operand), and the ``groups`` (replica groups, or source->target
     pairs for collective-permute). ``-start``/``-done`` async halves are
-    deduplicated by instruction name.
+    deduplicated by instruction name. ``hlo_text`` lets a caller that
+    already rendered ``compiled.as_text()`` (a multi-MB string for
+    large programs) avoid a second render.
     """
     records = []
     seen: Set[Tuple[str, str]] = set()
-    for line in compiled.as_text().splitlines():
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    for line in hlo_text.splitlines():
         m = _INSTR_RE.search(line)
         if not m:
             continue
@@ -121,21 +125,31 @@ def collective_traffic(compiled) -> List[dict]:
         seen.add(key)
         # an all-reduce's (sync or -start) tuple holds only results —
         # XLA fuses several reduced tensors into one op — so SUM them;
-        # other async -start tuples mix operand aliases and context
-        # scalars around the result, so take the largest array
+        # other async -start tuples are POSITIONALLY (operand
+        # aliases..., results..., u32[] context scalars...): drop the
+        # context scalars and sum the second half — the results. The
+        # split is by position, not size: a reduce-scatter-start's
+        # result is SMALLER than its operand (1/n), so "take the
+        # largest array" overbooked it n-fold, and booked a fused pair
+        # of gathers as one. ("Take the last" once recorded a 4 MB
+        # permute as its 4-byte context scalar.) Bytes are summed
+        # directly per-array so mixed-dtype fusions don't truncate
+        # through one dtype's width; ``dtype`` reports the largest
+        # member's, ``elements`` the summed element count.
         if key[0] == "async" and m.group("op") != "all-reduce":
-            dtype, elems, _ = max(shapes, key=lambda t: t[2])
+            arrays = [
+                s for s in shapes
+                if not (s[0] in ("u32", "s32") and s[1] == 1)
+            ] or shapes
+            selected = arrays[(len(arrays) + 1) // 2:] or arrays
         else:
-            dtype = max(shapes, key=lambda t: t[2])[0]
-            elems = sum(
-                e * _DTYPE_BYTES[dt] for dt, e, _ in shapes
-            ) // _DTYPE_BYTES[dtype]
+            selected = shapes
         rec = {
             "op": m.group("op"),
             "name": base,
-            "dtype": dtype,
-            "elements": elems,
-            "bytes": elems * _DTYPE_BYTES[dtype],
+            "dtype": max(selected, key=lambda t: t[2])[0],
+            "elements": sum(e for _, e, _ in selected),
+            "bytes": sum(b for _, _, b in selected),
         }
         g = _GROUPS_RE.search(line)
         if g:
@@ -147,6 +161,21 @@ def collective_traffic(compiled) -> List[dict]:
     return records
 
 
+def has_collectives(hlo_text: str) -> bool:
+    """Does the HLO text name any collective instruction?
+
+    The companion check for :func:`collective_traffic`, kept next to
+    the parser so the two rule sets stay in sync: text for which this
+    is true but ``collective_traffic`` returns zero records is a
+    parser miss (e.g. a print-option variant), not a collective-free
+    program.
+    """
+    return any(
+        f"{op}(" in hlo_text or f"{op}-start(" in hlo_text
+        for op in _COLLECTIVES
+    )
+
+
 def _group_crossing(group: Sequence[int], partition: Dict[int, int]) -> bool:
     """Does a replica group span more than one partition cell?"""
     return len({partition[d] for d in group}) > 1
@@ -154,7 +183,7 @@ def _group_crossing(group: Sequence[int], partition: Dict[int, int]) -> bool:
 
 def tier_crossing_bytes(
     records: Sequence[dict], partition: Dict[int, int]
-) -> Dict[str, int]:
+) -> Dict[str, float]:
     """Per-device payload bytes whose collective spans the partition.
 
     ``partition`` maps device id -> tier cell (e.g. slice index of the
@@ -162,10 +191,15 @@ def tier_crossing_bytes(
     cell rides the fast tier only; one that spans cells must move its
     payload across the slow boundary. Returns
     ``{"crossing": B, "local": B}`` — the result-shape bytes of each
-    class, the quantity the hierarchical-vs-flat comparison needs
-    (for an all-reduce, every participating device contributes and
-    receives the full result shape, so result bytes IS the per-device
-    volume; for collective-permute, pairs that cross count).
+    class (floats: proportional accounting splits a record's bytes
+    fractionally), the quantity the hierarchical-vs-flat comparison
+    needs. For an all-reduce, every participating device contributes
+    and receives the full result shape, so result bytes IS the
+    per-device volume; for collective-permute, pairs that cross count.
+    For gather-type collectives (all-gather, reduce-scatter) the
+    per-device LINK traffic is smaller than the result shape — using
+    result bytes is a deliberate upper-bound approximation, consistent
+    across the programs being compared.
 
     Accounting is proportional: a device's payload counts as crossing
     when ITS replica group (or permute pair) spans the partition, so a

@@ -55,6 +55,18 @@ def test_unknown_benchmark_rejected(comm8):
         run_benchmark("warp-speed", comm=comm8)
 
 
+def test_backendless_benchmark_rejects_non_default_tier(comm8):
+    """A benchmark without backend tiers must refuse a requested
+    non-default tier rather than silently recording XLA; the default
+    'xla' is dropped harmlessly."""
+    with pytest.raises(ValueError, match="no backend tiers"):
+        run_benchmark("app_gesummv", comm=comm8, n=64, runs=2,
+                      backend="ring")
+    m = run_benchmark("app_gesummv", comm=comm8, n=64, runs=2,
+                      backend="xla")
+    assert m.mean > 0
+
+
 def test_bandwidth_rendezvous_vs_eager(comm8):
     r = run_benchmark("bandwidth", comm=comm8, size_kb=8, runs=2)
     e = run_benchmark("bandwidth_eager", comm=comm8, size_kb=8, runs=2)

@@ -60,7 +60,7 @@ def test_async_halves_deduplicated():
 def test_async_tuple_start_records_result_bytes():
     """An async -start's tuple type leads with operand aliases and can
     trail with u32 barrier/context scalars; the record must book the
-    LARGEST array (the payload), matching the sync form."""
+    larger half (the results), matching the sync form."""
     hlo = ("%all-gather-start.7 = (f32[16,256]{1,0:T(8,128)}, "
            "f32[128,256]{1,0}) all-gather-start(%p0), channel_id=2, "
            "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}\n"
@@ -188,6 +188,80 @@ def test_live_artifact_carries_collectives():
         recs = progs[name]["collectives"]
         assert recs and all("op" in r and "bytes" in r for r in recs), name
         assert "collectives_error" not in progs[name], name
+
+
+def test_async_fused_all_gather_sums_both_results():
+    """A fused all-gather-start tuple is (op1, op2, res1, res2): the
+    payload is the SUM of the result half, not one largest array (the
+    max rule booked a fused pair of gathers as one gather)."""
+    hlo = ("%all-gather-start.4 = (f32[16,256]{1,0}, f32[8,128]{1,0}, "
+           "f32[128,256]{1,0}, f32[64,128]{1,0}) "
+           "all-gather-start(%a, %b), channel_id=3, "
+           "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}")
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == (128 * 256 + 64 * 128) * 4
+    assert recs[0]["elements"] == 128 * 256 + 64 * 128
+
+
+def test_async_reduce_scatter_books_small_result():
+    """A reduce-scatter-start's result is SMALLER than its operand
+    (1/n of it) — the positional (operands..., results...) split must
+    book the result, not the largest array."""
+    hlo = ("%reduce-scatter-start.1 = (f32[1024,256]{1,0}, "
+           "f32[128,256]{1,0}, u32[], u32[]) "
+           "reduce-scatter-start(%x), channel_id=7, "
+           "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, "
+           "to_apply=%add")
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == 128 * 256 * 4
+
+
+def test_mixed_dtype_fused_sum_is_exact():
+    """A fused sync tuple with mixed dtypes sums bytes per-array —
+    the old round-trip through the widest dtype's width truncated."""
+    hlo = ("%all-reduce.5 = (f32[10]{0}, bf16[3]{0}) "
+           "all-reduce(%a, %b), channel_id=2, "
+           "replica_groups={{0,1}}, to_apply=%add")
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert len(recs) == 1
+    assert recs[0]["bytes"] == 10 * 4 + 3 * 2  # 46, not 44 (11*4)
+    assert recs[0]["elements"] == 13
+
+
+def test_parses_without_percent_sigil():
+    """XLA print options may omit the leading '%' on instruction
+    names; the parser must not return an empty list for those."""
+    hlo = ("ar.1 = f32[128]{0} all-reduce(x), channel_id=2, "
+           "replica_groups={{0,1,2,3}}, to_apply=add")
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert len(recs) == 1
+    assert recs[0]["name"] == "ar.1"
+    assert recs[0]["bytes"] == 512
+
+
+def test_executable_report_flags_parser_miss():
+    """A compiled program whose HLO names collectives but parses to
+    zero records must carry collectives_error, not ship [] as data."""
+    from smi_tpu.parallel.aot import executable_report
+
+    class NoMemCompiled(FakeCompiled):
+        def memory_analysis(self):
+            raise RuntimeError("n/a")
+
+        def cost_analysis(self):
+            raise RuntimeError("n/a")
+
+    # a line shape the parser does not recognize (no '=' form)
+    weird = "call to all-reduce( something unparseable"
+    rep = executable_report(NoMemCompiled(weird))
+    assert rep["collectives"] == []
+    assert "collectives_error" in rep
+    # and a genuinely collective-free program stays clean
+    rep2 = executable_report(NoMemCompiled("fusion.1 = f32[8]{0} add(...)"))
+    assert rep2["collectives"] == []
+    assert "collectives_error" not in rep2
 
 
 def test_async_fused_all_reduce_sums_results():
