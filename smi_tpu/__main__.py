@@ -481,18 +481,42 @@ def cmd_aot_verify(args: argparse.Namespace) -> int:
 
     from smi_tpu.parallel import aot
 
-    topology = args.topology or aot.DEFAULT_TOPOLOGY
-    print(f"AOT-compiling the multi-chip surface for {topology}")
-    payload = {"topology": topology, "jax": jax.__version__}
+    topos = args.topology or [
+        aot.DEFAULT_TOPOLOGY, "v5e:4x4", f"{aot.DEFAULT_TOPOLOGY}*2",
+    ]
+    payload = {"jax": jax.__version__, "topologies": {}}
     rc = 0
-    try:
-        reports = aot.check_surface(topology, verbose=True)
-        payload.update(ok=True, programs=reports)
-        print(f"{len(reports)} programs compiled ok -> {args.out}")
-    except Exception as e:
-        payload.update(ok=False, error=f"{type(e).__name__}: {e}")
-        print(f"FAILED: {type(e).__name__}: {e}", file=sys.stderr)
-        rc = 1
+    for topo in topos:
+        print(f"AOT-compiling the multi-chip surface for {topo}")
+        entry: dict = {"devices": None}
+        try:
+            entry["devices"] = len(aot.topology_devices(topo))
+            if aot.is_multislice(topo):
+                # the crossing-bytes consumers need the device->slice
+                # map of the REAL slice boundary
+                entry["slice_partition"] = {
+                    str(k): v
+                    for k, v in aot.slice_partition(topo).items()
+                }
+            reports = aot.check_surface(topo, verbose=True)
+            entry.update(ok=True, programs=reports)
+            print(f"  {len(reports)} programs compiled ok [{topo}]")
+        except Exception as e:
+            entry.update(ok=False, error=f"{type(e).__name__}: {e}")
+            print(f"FAILED [{topo}]: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            rc = 1
+        payload["topologies"][topo] = entry
+    # the primary topology name and overall ok stay at top level for
+    # r4-era consumers; program tables live ONLY under topologies[...]
+    # (aliasing the primary's table here would serialize the multi-MB
+    # report set twice)
+    payload["topology"] = topos[0]
+    payload["ok"] = all(
+        e.get("ok") for e in payload["topologies"].values()
+    )
+    if payload["ok"]:
+        print(f"all topologies ok -> {args.out}")
     with open(args.out, "w") as f:
         json.dump(payload, f, indent=2)
         f.write("\n")
@@ -587,8 +611,10 @@ def build_parser() -> argparse.ArgumentParser:
         "aot-verify",
         help="AOT-compile the multi-chip surface against a TPU topology",
     )
-    p.add_argument("--topology", default=None,
-                   help="TPU topology name (default: aot.DEFAULT_TOPOLOGY)")
+    p.add_argument("--topology", nargs="*", default=None,
+                   help="TPU topology names; a '*2' suffix asks for a "
+                        "genuine 2-slice topology (default: v5e:2x4, "
+                        "v5e:4x4, and v5e:2x4*2 — the r5 sweep)")
     p.add_argument("-o", "--out", default="AOT_TPU.json",
                    help="evidence JSON path")
     p.set_defaults(fn=cmd_aot_verify)

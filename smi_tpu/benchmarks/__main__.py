@@ -84,7 +84,18 @@ def main(argv=None):
         elif name.startswith("app_"):
             p.pop("root", None)
             p.pop("elements", None)
-            p.pop("backend", None)
+            if p.pop("backend", "xla") != "xla":
+                # app benchmarks have no ring tier; never record an
+                # XLA measurement under a requested non-default tier
+                # (run_benchmark's own guard, reachable from the
+                # Python API, enforces the same rule)
+                msg = (f"{name}: no backend tiers — skipping under "
+                       f"backend={args.backend!r}")
+                if args.name == "all":
+                    print(msg, file=sys.stderr)
+                    continue
+                print(f"error: {msg}", file=sys.stderr)
+                return 1
             if name.startswith("app_ring_attention"):
                 if args.window is not None:
                     p["window"] = args.window

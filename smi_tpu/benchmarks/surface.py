@@ -520,6 +520,76 @@ def flash_vs_stock(comm, quick: bool = False):
     return out
 
 
+def roll_chain_points(comm, quick: bool = False):
+    """Isolated roll-port rates: dependent ``pltpu.roll`` chains with
+    NOTHING else in the kernel body (no adds, no loads beyond the tile).
+
+    The stencil ceiling analysis (``docs/perf_notes.md``) rests on the
+    lane-roll rate; the r3 probes measured it inside a mixed-op class
+    whose small members spread 1.2-4.5 ps/elem between sessions. This
+    pins the port rate alone: two chain lengths (R and R/4) per axis,
+    each timed differentially over data-dependently chained reps, and
+    the per-element rate taken from the R-difference — per-rep HBM
+    traffic and dispatch overhead cancel exactly in the subtraction.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if quick:
+        return []
+    rows, cols = 512, 2048
+    elems = rows * cols
+    r_hi, r_lo = 4096, 1024
+    out = []
+    for axis, name in ((1, "lane"), (0, "sublane")):
+        def make_fn_for(R, _axis=axis):
+            from jax.experimental.pallas import tpu as pltpu
+
+            def kernel(x_ref, o_ref, *, _R=R):
+                o_ref[...] = jax.lax.fori_loop(
+                    0, _R,
+                    lambda i, v: pltpu.roll(v, 1, axis=_axis),
+                    x_ref[...],
+                )
+
+            call = pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
+            )
+
+            def make_fn(r):
+                @jax.jit
+                def chain(x):
+                    return jax.lax.fori_loop(
+                        0, r, lambda i, v: call(v), x
+                    )
+
+                x = jnp.ones((rows, cols), jnp.float32)
+                return lambda: np.asarray(jnp.sum(chain(x)))
+
+            return make_fn
+
+        per_rep = {}
+        traces = {}
+        for R in (r_lo, r_hi):
+            rate, trace = _diff_rate(
+                make_fn_for(R), 1.0, r1=4, factor=4, max_reps=1024
+            )
+            per_rep[R], traces[R] = 1.0 / rate, trace
+        ps = (per_rep[r_hi] - per_rep[r_lo]) / (
+            (r_hi - r_lo) * elems
+        ) * 1e12
+        out.append(_result(
+            f"roll_chain_{name}_ps_per_elem", ps, "ps/elem",
+            {"rows": rows, "cols": cols, "chain_lengths": [r_lo, r_hi],
+             "per_rep_s": {str(k): round(v, 6)
+                           for k, v in per_rep.items()},
+             "timing": traces[r_hi]},
+        ))
+    return out
+
+
 def model_train_point(comm, quick: bool = False):
     """Whole-model training throughput: the transformer block (QKV/O +
     MLP matmuls + ring attention + layernorms + SGD) in mixed precision
@@ -764,6 +834,7 @@ def main(argv=None):
         "ratio": flash_vs_jnp,
         "stock": flash_vs_stock,
         "tiers": stencil_tiers,
+        "rolls": roll_chain_points,
         "apps": onchip_apps,
     }
     selected = args.only or list(sections)

@@ -51,6 +51,21 @@ from smi_tpu.parallel.mesh import Communicator, DEFAULT_AXIS
 DEFAULT_TOPOLOGY = "v5e:2x4"
 
 
+def parse_topology(topology: str):
+    """``"v5e:2x4*2"`` -> ``("v5e:2x4", {"num_slices": 2})``.
+
+    The ``*s`` suffix names a GENUINE multi-slice topology: the PJRT
+    TPU plugin materializes ``s`` slices of the base shape, each
+    abstract device carrying a real ``slice_index`` — so the SPMD
+    partitioner sees an actual DCN boundary, not a virtual split of
+    one slice's flat device list.
+    """
+    if "*" in topology:
+        name, s = topology.split("*", 1)
+        return name, {"num_slices": int(s)}
+    return topology, {}
+
+
 def topology_devices(topology: str = DEFAULT_TOPOLOGY):
     """Abstract devices of a named TPU topology (no hardware needed).
 
@@ -59,7 +74,38 @@ def topology_devices(topology: str = DEFAULT_TOPOLOGY):
     """
     from jax.experimental import topologies
 
-    return topologies.get_topology_desc(topology, platform="tpu").devices
+    name, kwargs = parse_topology(topology)
+    return topologies.get_topology_desc(
+        name, platform="tpu", **kwargs
+    ).devices
+
+
+def slice_partition(topology: str):
+    """``{logical_device_index: slice_index}`` of a (possibly
+    multi-slice) topology — the partition
+    :func:`traffic.tier_crossing_bytes` folds crossing bytes over.
+
+    Keys are positions in the hybrid mesh's device-assignment order
+    (slice-major, the :func:`hybrid_topology_communicator` layout),
+    NOT PJRT device ids: HLO replica groups with
+    ``use_global_device_ids`` number devices by their flattened
+    assignment index (multi-slice abstract devices carry ids like
+    100000 that never appear in the HLO)."""
+    devices = topology_devices(topology)
+    slices = [getattr(d, "slice_index", 0) or 0 for d in devices]
+    order = sorted(range(len(devices)), key=lambda i: (slices[i], i))
+    return {pos: slices[i] for pos, i in enumerate(order)}
+
+
+def grid2d(n: int):
+    """Near-square 2-D factorization of a power-of-two extent:
+    8 -> (2, 4), 16 -> (4, 4), 32 -> (4, 8)."""
+    px = 1
+    while px * px * 4 <= n:
+        px *= 2
+    if n % px:
+        raise ValueError(f"cannot factor {n} devices into a 2-D grid")
+    return px, n // px
 
 
 def topology_communicator(
@@ -100,19 +146,21 @@ def hybrid_topology_communicator(
 ) -> Communicator:
     """Two-tier (slice x in-slice) communicator over abstract devices.
 
-    A single topology description is one slice, so like the CPU
-    emulator tier the flat device list splits evenly into ``n_slices``
-    virtual slices (``mesh._slice_groups`` semantics).
+    A GENUINE multi-slice topology (``"v5e:2x4*2"``) groups devices by
+    their real ``slice_index`` — the mesh's outer axis is the actual
+    DCN boundary the partitioner lowers against. A single-slice
+    topology falls back to the CPU emulator tier's convention: the
+    flat device list splits evenly into ``n_slices`` virtual slices
+    (``mesh._slice_groups`` semantics).
     """
+    from smi_tpu.parallel.mesh import _slice_groups
+
     devices = list(topology_devices(topology))
     if len(devices) % n_slices:
         raise ValueError(
             f"{len(devices)} devices do not split into {n_slices} slices"
         )
-    per = len(devices) // n_slices
-    dev_array = np.array(
-        [devices[i * per : (i + 1) * per] for i in range(n_slices)]
-    )
+    dev_array = np.array(_slice_groups(devices, n_slices, None))
     return Communicator(
         mesh=Mesh(dev_array, tuple(axis_names)),
         axis_names=tuple(axis_names),
@@ -161,6 +209,13 @@ def executable_report(compiled) -> dict:
             "output_bytes": int(mem.output_size_in_bytes),
             "alias_bytes": int(mem.alias_size_in_bytes),
             "temp_bytes": int(mem.temp_size_in_bytes),
+            # live per-chip HBM at peak: arguments + outputs + XLA
+            # temporaries, minus donated/aliased buffers counted twice
+            # — the number the fits-in-HBM claims are judged against
+            "per_chip_hbm_bytes": int(
+                mem.argument_size_in_bytes + mem.output_size_in_bytes
+                + mem.temp_size_in_bytes - mem.alias_size_in_bytes
+            ),
         }
     except Exception as e:  # pragma: no cover - backend-dependent
         report["memory"] = {"unavailable": str(e)}
@@ -187,6 +242,15 @@ def executable_report(compiled) -> dict:
         text = compiled.as_text()
         records = collective_traffic(compiled, text)
         report["collectives"] = records
+        if records:
+            # bandwidth-only v5e wall-clock bound of the program's
+            # collectives — the compiled-evidence column the ring
+            # tier's schedule predictions are compared against
+            from smi_tpu.parallel.traffic import predicted_program_us
+
+            report["ici_predicted_us"] = round(
+                predicted_program_us(records), 4
+            )
         if not records and has_collectives(text):
             # mark a parser miss so the empty list never ships as data
             report["collectives_error"] = (
@@ -322,15 +386,17 @@ def _subset_ring_cases(topology: str):
     Mosaic lowering, not just interpret mode."""
     from smi_tpu.kernels import ring
 
+    px, py = grid2d(len(topology_devices(topology)))
     comm = topology_communicator(
-        topology, shape=(2, 4), axis_names=("mx", "my")
+        topology, shape=(px, py), axis_names=("mx", "my")
     )
+    n = px * py
     mesh_axes = ring.mesh_axes_of(comm)
 
     def build_subset():
         def shard(x):
             return ring.ring_all_reduce(
-                x[0], "my", 4, mesh_axes=mesh_axes
+                x[0], "my", py, mesh_axes=mesh_axes
             )[None]
 
         f = jax.jit(
@@ -341,7 +407,7 @@ def _subset_ring_cases(topology: str):
             )
         )
         return compile_sharded(
-            f, shaped(comm, (8, 256), jnp.float32, P(("mx", "my"), None))
+            f, shaped(comm, (n, 256), jnp.float32, P(("mx", "my"), None))
         )
 
     yield "ring_all_reduce_subset_axis", build_subset
@@ -349,7 +415,7 @@ def _subset_ring_cases(topology: str):
     def build_two_axis():
         def shard(x):
             return ring.ring_all_gather(
-                x, ("mx", "my"), 8, mesh_axes=mesh_axes
+                x, ("mx", "my"), n, mesh_axes=mesh_axes
             )
 
         f = jax.jit(
@@ -360,7 +426,7 @@ def _subset_ring_cases(topology: str):
             )
         )
         return compile_sharded(
-            f, shaped(comm, (8 * 16, 256), jnp.float32,
+            f, shaped(comm, (n * 16, 256), jnp.float32,
                       P(("mx", "my"), None))
         )
 
@@ -378,7 +444,8 @@ def _transformer_cases(topology: str):
     from smi_tpu.models import transformer as tf
 
     comm = topology_communicator(
-        topology, shape=(2, 4), axis_names=("dp", "sp")
+        topology, shape=grid2d(len(topology_devices(topology))),
+        axis_names=("dp", "sp"),
     )
     dp, sp = comm.axis_sizes
 
@@ -408,6 +475,49 @@ def _transformer_cases(topology: str):
                        window=4096, compute_dtype="bfloat16"),
         s_global=8192 * sp, batch=dp,
     )
+
+
+def _longcontext_sp_case(topology: str):
+    """The 1M-token rung: the (dp, sp) sequence-parallel train step.
+
+    One chip tops out at 512k-token training (f32 dq alone is 4 GiB at
+    1M — ``docs/perf_notes.md``); the framework's answer, like the
+    reference's decomposition-with-halo-exchange answer to a grid that
+    outgrows one FPGA (``/root/reference/examples/include/stencil.h.in:32-38``),
+    is sequence parallelism: shard S over the sp axis so every per-chip
+    tensor (q/k/v shards, flash residuals, the f32 dq shard) divides by
+    sp. This case compiles the TRUE 1M-token config — window 4096,
+    GQA 8:1, bf16 compute, embed 1024 — against the topology;
+    ``executable_report``'s per-chip memory analysis proves the
+    footprint fits HBM and its collectives table records the ring K/V
+    exchange (collective-permutes over sp) plus the gradient psums.
+    The scaled-shape correctness run lives in
+    ``__graft_entry__.dryrun_multichip``.
+    """
+    from smi_tpu.models import transformer as tf
+
+    comm = topology_communicator(
+        topology, shape=grid2d(len(topology_devices(topology))),
+        axis_names=("dp", "sp"),
+    )
+    dp, sp = comm.axis_sizes
+
+    def build():
+        cfg = tf.BlockConfig(
+            embed=1024, heads=8, head_dim=128, kv_heads=1,
+            window=4096, compute_dtype="bfloat16",
+        )
+        params = jax.tree_util.tree_map(
+            lambda a: shaped(comm, a.shape, a.dtype, P()),
+            tf.init_params(cfg),
+        )
+        x = shaped(
+            comm, (dp, 1048576, cfg.embed), jnp.float32, P("dp", "sp")
+        )
+        step = tf.make_train_step(comm, cfg, use_flash=True)
+        return compile_sharded(step, params, x, x)
+
+    yield "train_step_1m_sp", build
 
 
 def _hierarchical_case(topology: str):
@@ -528,7 +638,8 @@ def _composite_ring_cases(topology: str):
     )
 
     comm2d = topology_communicator(
-        topology, shape=(2, 4), axis_names=("sx", "sy")
+        topology, shape=grid2d(len(topology_devices(topology))),
+        axis_names=("sx", "sy"),
     )
     comm1d = topology_communicator(topology)
     axis = comm1d.axis_names[0]
@@ -653,24 +764,28 @@ def _app_cases(topology: str):
     """
     from smi_tpu.models import gesummv, kmeans, stencil
 
+    px, py = grid2d(len(topology_devices(topology)))
     comm2d = topology_communicator(
-        topology, shape=(2, 4), axis_names=("sx", "sy")
+        topology, shape=(px, py), axis_names=("sx", "sy")
     )
 
     def build_stencil():
-        # the reference's hardware config: 8192^2 on a 2x4 process grid
+        # the reference's hardware config: 8192^2 on its process grid
+        # (2x4 at the reference's shape; scales with the topology)
         fn = stencil.make_stencil_fn(comm2d, iterations=4)
         return compile_sharded(
             fn, shaped(comm2d, (8192, 8192), jnp.float32, P("sx", "sy"))
         )
 
-    yield "app_stencil_8192_2x4", build_stencil
+    yield f"app_stencil_8192_{px}x{py}", build_stencil
 
     def build_stencil_temporal():
         # the flagship temporal-blocked Pallas tier at the same shape
         from smi_tpu.kernels import stencil_temporal as kt
 
-        depth = kt.pick_temporal_depth(4096, 2048, jnp.float32, 16) or 8
+        depth = kt.pick_temporal_depth(
+            8192 // px, 8192 // py, jnp.float32, 16
+        ) or 8
         fn = kt.make_temporal_stencil_fn(
             comm2d, 16, 8192, 8192, depth=depth
         )
@@ -678,7 +793,7 @@ def _app_cases(topology: str):
             fn, shaped(comm2d, (8192, 8192), jnp.float32, P("sx", "sy"))
         )
 
-    yield "app_stencil_temporal_8192_2x4", build_stencil_temporal
+    yield f"app_stencil_temporal_8192_{px}x{py}", build_stencil_temporal
 
     def build_stencil_ring():
         # halos over the RDMA tier inside the sweep loop: 4 ring kernel
@@ -688,7 +803,7 @@ def _app_cases(topology: str):
             fn, shaped(comm2d, (1024, 2048), jnp.float32, P("sx", "sy"))
         )
 
-    yield "app_stencil_ring_2x4", build_stencil_ring
+    yield f"app_stencil_ring_{px}x{py}", build_stencil_ring
 
     def build_gesummv():
         # 2-rank operator split + streamed axpy combine, n=4096
@@ -717,28 +832,130 @@ def _app_cases(topology: str):
     yield "app_kmeans_512k", build_kmeans
 
 
+def ring_case_predictions(topology: str = DEFAULT_TOPOLOGY) -> dict:
+    """Schedule-predicted ICI traffic for the ring-tier programs.
+
+    The ring kernels' remote DMAs live inside Mosaic, invisible to HLO
+    — but their schedules are static (``kernels/ring.py``), so per-
+    device send bytes follow from the very case parameters the surface
+    compiles (``_ring_cases``/``_ring_dtype_cases``/
+    ``_composite_ring_cases``: chunk=16, width=256, and the composite
+    channel configs). Each entry carries the ICI bytes and the
+    bandwidth-only time bound at the v5e link rate
+    (``traffic.V5E_ICI_LINK_BYTES_PER_S``) — the column that lets the
+    ring tier and the XLA tier (whose ``ici_predicted_us`` comes from
+    parsed HLO) be compared on compiled evidence alone.
+    """
+    from smi_tpu.parallel.traffic import predicted_us, ring_traffic
+
+    n = len(topology_devices(topology))
+    chunk, width = 16, 256  # _ring_cases' shapes
+
+    preds = {}
+
+    def put(name, kind, payload_bytes, chunks=1, hops=1):
+        b = ring_traffic(
+            kind, n, payload_bytes, chunks=chunks, hops=hops
+        )["ici_send_bytes"]
+        preds[name] = {
+            "ici_send_bytes": int(b),
+            "predicted_us": round(predicted_us(b), 4),
+        }
+
+    for tag in ("fc", "nofc"):
+        put(f"ring_all_gather_{tag}", "all_gather", chunk * width * 4)
+        put(f"ring_all_reduce_{tag}", "all_reduce", width * 4)
+        put(f"ring_reduce_scatter_{tag}", "reduce_scatter",
+            chunk * width * 4)
+        # per-shard (4, 8, width) f32: 4 chunks of one 8-row slab
+        put(f"neighbour_stream_{tag}", "neighbour_stream",
+            8 * width * 4, chunks=4)
+    put("ring_all_reduce_bf16", "all_reduce", width * 2)
+    put("ring_all_gather_int32", "all_gather", chunk * width * 4)
+    put("neighbour_stream_bf16", "neighbour_stream", 8 * width * 2,
+        chunks=4)
+    put("neighbour_stream_int8", "neighbour_stream", 8 * width * 1,
+        chunks=4)
+    put("ring_all_reduce_int16", "all_reduce", width * 2)
+    # hop-by-hop P2P 0 -> 3: 2048 f32 in 512-element chunks, 3 hops
+    # (aggregate forwarded bytes at one link's rate)
+    put("p2p_transfer_ring_multihop", "neighbour_stream", 512 * 4,
+        chunks=4, hops=3)
+    # two concurrent streams 0->2 / 1->3: 1024 f32 in 256-element
+    # chunks, 2 hops each; distinct ports ride distinct slots, so the
+    # bound is ONE stream's bytes (they overlap), not the sum
+    put("stream_concurrent_ring", "neighbour_stream", 256 * 4,
+        chunks=4, hops=2)
+    # rooted ring reduce: dispatches to ring_all_reduce on the (width,)
+    # per-rank shard — the running partial makes n-1 hops
+    put("reduce_ring_rooted", "all_reduce", width * 4)
+    # rooted ring gather: rank r's (16, width) block travels its ring
+    # distance to the root; the root's inbound link carries all n-1
+    # blocks — that link is the bound
+    put("gather_ring_rooted", "neighbour_stream", chunk * width * 4,
+        chunks=1, hops=n - 1)
+    return preds
+
+
 def surface_cases(topology: str = DEFAULT_TOPOLOGY):
     """All (name, build) pairs of the multi-chip AOT surface."""
     yield from _ring_cases(topology)
     yield from _ring_dtype_cases(topology)
     yield from _subset_ring_cases(topology)
     yield from _transformer_cases(topology)
+    yield from _longcontext_sp_case(topology)
     yield from _hierarchical_case(topology)
     yield from _composite_ring_cases(topology)
     yield from _app_cases(topology)
     yield from _xla_tier_cases(topology)
 
 
-def check_surface(topology: str = DEFAULT_TOPOLOGY, verbose: bool = False):
-    """Compile the full multi-chip surface; return per-program reports.
+def hybrid_cases(topology: str):
+    """The case subset for a genuine multi-slice topology.
 
-    Raises on the first lowering failure — the test tier wants a loud
-    FAIL, not a summary with holes.
+    Only XLA collectives are legal across a DCN boundary (the ring
+    kernels' remote DMAs are an ICI mechanism), so a ``*s`` topology
+    compiles the two-tier programs: the hierarchical allreduce against
+    its flat comparison, with the mesh's outer axis on the REAL slice
+    boundary.
     """
+    yield from _hierarchical_case(topology)
+
+
+def is_multislice(topology: str) -> bool:
+    return parse_topology(topology)[1].get("num_slices", 1) > 1
+
+
+def check_surface(
+    topology: str = DEFAULT_TOPOLOGY,
+    verbose: bool = False,
+    cases=None,
+):
+    """Compile the multi-chip surface for a topology; return reports.
+
+    ``cases`` selects the case generator (default: the full surface
+    for single-slice topologies, :func:`hybrid_cases` for genuine
+    multi-slice ones). Raises on the first lowering failure — the test
+    tier wants a loud FAIL, not a summary with holes.
+    """
+    if cases is None:
+        cases = hybrid_cases if is_multislice(topology) else surface_cases
     reports = {}
-    for name, build in surface_cases(topology):
+    for name, build in cases(topology):
         if verbose:
             print(f"  aot-compile {name} ...", flush=True)
         compiled = build()
         reports[name] = executable_report(compiled)
+    if is_multislice(topology):
+        # the hybrid subset has no ring-tier program to annotate, and
+        # its collectives cross the REAL DCN boundary — pricing those
+        # at the ICI link rate would misrank flat vs hierarchical, so
+        # the single-rate column is withheld (the crossing/local split
+        # via tier_crossing_bytes is the meaningful signal here)
+        for rep in reports.values():
+            rep.pop("ici_predicted_us", None)
+    else:
+        for name, pred in ring_case_predictions(topology).items():
+            if name in reports:
+                reports[name]["ring_predicted"] = pred
     return reports

@@ -257,3 +257,54 @@ def ring_traffic(
     if kind == "neighbour_stream":
         return {"ici_send_bytes": chunks * hops * payload_bytes}
     raise ValueError(f"unknown ring protocol {kind!r}")
+
+
+#: v5e one-way ICI bandwidth per link (the public scaling-book figure,
+#: jax-ml.github.io/scaling-book: ~4.5e10 B/s one-way per link, 4 links
+#: per chip in the 2-D torus). All predictions below are BANDWIDTH-ONLY
+#: lower bounds at one link's rate — no per-hop latency, no multi-link
+#: credit, no compute overlap — the compiled-evidence column that lets
+#: the ring and XLA tiers be compared without owning a pod.
+V5E_ICI_LINK_BYTES_PER_S = 4.5e10
+
+
+def predicted_us(
+    send_bytes: float,
+    link_bytes_per_s: float = V5E_ICI_LINK_BYTES_PER_S,
+) -> float:
+    """Bandwidth-only wall-clock bound of moving ``send_bytes`` over
+    one ICI link at the v5e rate, in microseconds."""
+    return send_bytes / link_bytes_per_s * 1e6
+
+
+def collective_wire_bytes(rec: dict) -> float:
+    """Per-device ICI wire bytes of one HLO collective record under the
+    standard ring algorithms (the basis of the predicted wall-clock
+    column): all-reduce moves ``2(n-1)/n`` of its payload per device,
+    all-gather and all-to-all ``(n-1)/n`` of the result, reduce-scatter
+    ``(n-1)x`` its scattered output piece, collective-permute one hop
+    of its payload. ``n`` comes from the record's largest replica
+    group (default 2 when the group structure did not parse —
+    a conservative under-count flagged by the default's rarity)."""
+    op, b = rec["op"], rec["bytes"]
+    groups = rec.get("groups")
+    n = max((len(g) for g in groups), default=2) if groups else 2
+    if op == "all-reduce":
+        return 2 * (n - 1) / n * b
+    if op in ("all-gather", "all-to-all"):
+        return (n - 1) / n * b
+    if op == "reduce-scatter":
+        return (n - 1) * b
+    return float(b)
+
+
+def predicted_program_us(
+    records: Sequence[dict],
+    link_bytes_per_s: float = V5E_ICI_LINK_BYTES_PER_S,
+) -> float:
+    """Predicted per-device ICI wall-clock of a program's collectives,
+    summed serially (no overlap credit) at the v5e link rate."""
+    return sum(
+        predicted_us(collective_wire_bytes(r), link_bytes_per_s)
+        for r in records
+    )

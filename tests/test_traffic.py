@@ -137,6 +137,51 @@ def test_tier_crossing_bytes_hybrid_partition():
     assert out["local"] == 128 * 4 + 8 * 256 * 2
 
 
+def test_collective_wire_bytes_model():
+    """The per-op ring-algorithm wire model behind the predicted
+    wall-clock column."""
+    ar = {"op": "all-reduce", "bytes": 800, "groups": [list(range(8))]}
+    assert T.collective_wire_bytes(ar) == 2 * 7 / 8 * 800
+    ag = {"op": "all-gather", "bytes": 800, "groups": [[0, 1, 2, 3]]}
+    assert T.collective_wire_bytes(ag) == 3 / 4 * 800
+    rs = {"op": "reduce-scatter", "bytes": 100, "groups": [[0, 1, 2, 3]]}
+    assert T.collective_wire_bytes(rs) == 300
+    cp = {"op": "collective-permute", "bytes": 500, "pairs": [[0, 1]]}
+    assert T.collective_wire_bytes(cp) == 500
+
+
+def test_predicted_us_at_link_rate():
+    """One link-second of bytes predicts 1e6 us; programs sum serially."""
+    assert T.predicted_us(T.V5E_ICI_LINK_BYTES_PER_S) == 1e6
+    recs = [
+        {"op": "collective-permute", "bytes": 45000, "pairs": [[0, 1]]},
+        {"op": "collective-permute", "bytes": 45000, "pairs": [[1, 2]]},
+    ]
+    assert abs(T.predicted_program_us(recs) - 2.0) < 1e-9
+
+
+def test_ring_predictions_name_surface_programs():
+    """Every ring-tier prediction must name a program the AOT surface
+    actually compiles — a renamed case must not silently detach its
+    prediction row."""
+    import jax
+
+    from smi_tpu.parallel import aot
+
+    try:
+        names = {name for name, _ in aot.surface_cases()}
+    except Exception as e:  # topology registry unavailable on this host
+        pytest.skip(f"abstract topology unavailable: {e}")
+    preds = aot.ring_case_predictions()
+    missing = set(preds) - names
+    assert not missing, missing
+    # and the schedule formulas scale with the ring extent as expected:
+    # all_gather moves (n-1) per-rank payloads
+    n = 8
+    ag = preds["ring_all_gather_fc"]["ici_send_bytes"]
+    assert ag == (n - 1) * 16 * 256 * 4
+
+
 def test_ring_traffic_formulas():
     assert T.ring_traffic("all_gather", 8, 1000) == {
         "ici_send_bytes": 7000
@@ -262,6 +307,103 @@ def test_executable_report_flags_parser_miss():
     rep2 = executable_report(NoMemCompiled("fusion.1 = f32[8]{0} add(...)"))
     assert rep2["collectives"] == []
     assert "collectives_error" not in rep2
+
+
+def _load_artifact(name):
+    path = os.path.join(os.path.dirname(__file__), "..", name)
+    if not os.path.exists(path):
+        pytest.skip(f"{name} not generated yet")
+    with open(path) as f:
+        data = json.load(f)
+    if not data.get("ok"):
+        pytest.skip(f"{name} records a failed run")
+    return data
+
+
+def test_r05_artifact_traffic_scales_with_n():
+    """The XLA-tier comparison programs' HLO-parsed traffic must follow
+    the analytic per-n laws across topologies: all-gather results grow
+    as n x the per-rank chunk (wire (n-1)x), the all-reduce payload is
+    n-invariant (wire 2(n-1)/n), reduce-scatter keeps its per-device
+    piece (wire (n-1)x), and the neighbour shift moves one per-shard
+    payload regardless of n."""
+    data = _load_artifact("AOT_TPU_r05.json")
+    singles = {
+        t: e for t, e in data["topologies"].items()
+        if "*" not in t and e.get("ok")
+    }
+    assert len(singles) >= 2, sorted(data["topologies"])
+    chunk_bytes = 16 * 256 * 4  # the per-rank payload of _xla_tier_cases
+    for t, e in singles.items():
+        n, progs = e["devices"], e["programs"]
+
+        def one(prog, op):
+            recs = [r for r in progs[prog]["collectives"]
+                    if r["op"] == op]
+            assert len(recs) == 1, (t, prog, op, recs)
+            return recs[0]
+
+        ag = one("xla_all_gather", "all-gather")
+        assert ag["bytes"] == n * chunk_bytes, (t, ag)
+        assert T.collective_wire_bytes(ag) == pytest.approx(
+            (n - 1) * chunk_bytes)
+        ar = one("xla_all_reduce", "all-reduce")
+        assert ar["bytes"] == 256 * 4, (t, ar)
+        assert T.collective_wire_bytes(ar) == pytest.approx(
+            2 * (n - 1) / n * 256 * 4)
+        rs = one("xla_reduce_scatter", "reduce-scatter")
+        assert rs["bytes"] == chunk_bytes, (t, rs)
+        assert T.collective_wire_bytes(rs) == pytest.approx(
+            (n - 1) * chunk_bytes)
+        cp = one("xla_neighbour_shift", "collective-permute")
+        assert cp["bytes"] == 4 * 8 * 256 * 4, (t, cp)
+        # the predicted wall-clock column is present wherever records are
+        assert progs["xla_all_gather"]["ici_predicted_us"] > 0
+        # and the ring tier's schedule prediction matches the XLA
+        # all-gather's wire bytes at the same payload — the two tiers
+        # on one compiled yardstick
+        ring_ag = progs["ring_all_gather_fc"]["ring_predicted"]
+        assert ring_ag["ici_send_bytes"] == (n - 1) * chunk_bytes
+
+
+def test_r05_1m_sp_train_step_evidence():
+    """The committed artifact carries the 1M-token sequence-parallel
+    rung with per-chip memory under HBM and the ring K/V + gradient
+    traffic table (VERDICT r4 #1)."""
+    data = _load_artifact("AOT_TPU_r05.json")
+    for t, e in data["topologies"].items():
+        if "*" in t or not e.get("ok"):
+            continue
+        prog = e["programs"].get("train_step_1m_sp")
+        assert prog is not None, (t, sorted(e["programs"]))
+        per_chip = prog["memory"]["per_chip_hbm_bytes"]
+        assert 0 < per_chip < 15.5e9, (t, per_chip)
+        ops = {r["op"] for r in prog["collectives"]}
+        assert "collective-permute" in ops, (t, ops)
+        assert "all-reduce" in ops, (t, ops)
+
+
+def test_r05_two_slice_hierarchical_crossing():
+    """On the GENUINE two-slice topology the hierarchical allreduce
+    crosses the real DCN boundary with 1/inner of the flat psum's
+    volume."""
+    data = _load_artifact("AOT_TPU_r05.json")
+    multi = {
+        t: e for t, e in data["topologies"].items()
+        if "*" in t and e.get("ok")
+    }
+    assert multi, sorted(data["topologies"])
+    for t, e in multi.items():
+        part = {int(k): v for k, v in e["slice_partition"].items()}
+        assert len(set(part.values())) == 2, part
+        progs = e["programs"]
+        flat = T.tier_crossing_bytes(
+            progs["allreduce_flat"]["collectives"], part)
+        hier = T.tier_crossing_bytes(
+            progs["allreduce_hierarchical"]["collectives"], part)
+        assert flat["crossing"] > 0
+        assert hier["crossing"] > 0
+        assert hier["crossing"] * 4 <= flat["crossing"]
 
 
 def test_async_fused_all_reduce_sums_results():
