@@ -611,7 +611,9 @@ def build_parser() -> argparse.ArgumentParser:
         "aot-verify",
         help="AOT-compile the multi-chip surface against a TPU topology",
     )
-    p.add_argument("--topology", nargs="*", default=None,
+    # nargs='+': a bare `--topology` (e.g. an empty shell variable) is
+    # a parse error, not a silent fall-through to the 3-topology sweep
+    p.add_argument("--topology", nargs="+", default=None,
                    help="TPU topology names; a '*2' suffix asks for a "
                         "genuine 2-slice topology (default: v5e:2x4, "
                         "v5e:4x4, and v5e:2x4*2 — the r5 sweep)")

@@ -531,6 +531,15 @@ def roll_chain_points(comm, quick: bool = False):
     each timed differentially over data-dependently chained reps, and
     the per-element rate taken from the R-difference — per-rep HBM
     traffic and dispatch overhead cancel exactly in the subtraction.
+
+    Two variants per axis. ``ilp=1`` is ONE dependent chain: every roll
+    waits on the previous, so the rate folds in any per-roll latency the
+    scheduler cannot hide — a *latency* pin. ``ilp=2`` runs TWO
+    independent chains (half-height arrays, same total elements per
+    step), giving the scheduler a second in-flight roll to overlap with
+    the first — the *throughput* pin, and the rate the stencil's two
+    per-sweep (independent, opposite-direction) rotations actually see.
+    The port bound in the notes must use the ilp=2 number.
     """
     import jax
     import jax.numpy as jnp
@@ -541,32 +550,42 @@ def roll_chain_points(comm, quick: bool = False):
     rows, cols = 512, 2048
     elems = rows * cols
     r_hi, r_lo = 4096, 1024
-    out = []
-    for axis, name in ((1, "lane"), (0, "sublane")):
-        def make_fn_for(R, _axis=axis):
-            from jax.experimental.pallas import tpu as pltpu
 
-            def kernel(x_ref, o_ref, *, _R=R):
-                o_ref[...] = jax.lax.fori_loop(
-                    0, _R,
-                    lambda i, v: pltpu.roll(v, 1, axis=_axis),
-                    x_ref[...],
+    def measure(metric, body, ilp):
+        """Chain ``body`` (one whole-array step) ``ilp`` independent
+        ways over half-height arrays — total elements per chain step is
+        ilp-invariant (ilp arrays of rows/ilp x cols) — and return the
+        ps/elem row from the R-differential."""
+        n_rows = rows // ilp
+
+        def make_fn_for(R):
+            def kernel(*refs):
+                ins, outs = refs[:ilp], refs[ilp:]
+                final = jax.lax.fori_loop(
+                    0, R,
+                    lambda i, vs: tuple(body(v) for v in vs),
+                    tuple(r[...] for r in ins),
                 )
+                for o, v in zip(outs, final):
+                    o[...] = v
 
-            call = pl.pallas_call(
-                kernel,
-                out_shape=jax.ShapeDtypeStruct((rows, cols), jnp.float32),
-            )
+            shape = jax.ShapeDtypeStruct((n_rows, cols), jnp.float32)
+            call = pl.pallas_call(kernel, out_shape=(shape,) * ilp)
 
             def make_fn(r):
                 @jax.jit
-                def chain(x):
+                def chain(*xs):
                     return jax.lax.fori_loop(
-                        0, r, lambda i, v: call(v), x
+                        0, r, lambda i, vs: call(*vs), xs
                     )
 
-                x = jnp.ones((rows, cols), jnp.float32)
-                return lambda: np.asarray(jnp.sum(chain(x)))
+                xs = tuple(
+                    jnp.full((n_rows, cols), 1.0 + i, jnp.float32)
+                    for i in range(ilp)
+                )
+                return lambda: np.asarray(
+                    sum(jnp.sum(v) for v in chain(*xs))
+                )
 
             return make_fn
 
@@ -580,13 +599,37 @@ def roll_chain_points(comm, quick: bool = False):
         ps = (per_rep[r_hi] - per_rep[r_lo]) / (
             (r_hi - r_lo) * elems
         ) * 1e12
-        out.append(_result(
-            f"roll_chain_{name}_ps_per_elem", ps, "ps/elem",
-            {"rows": rows, "cols": cols, "chain_lengths": [r_lo, r_hi],
+        return _result(
+            metric, ps, "ps/elem",
+            {"rows": n_rows, "cols": cols, "chains": ilp,
+             "chain_lengths": [r_lo, r_hi],
              "per_rep_s": {str(k): round(v, 6)
                            for k, v in per_rep.items()},
              "timing": traces[r_hi]},
-        ))
+        )
+
+    def roll_body(axis):
+        from jax.experimental.pallas import tpu as pltpu
+
+        return lambda v: pltpu.roll(v, 1, axis=axis)
+
+    out = [
+        measure(f"roll_chain_{name}{'' if ilp == 1 else f'_ilp{ilp}'}"
+                "_ps_per_elem", roll_body(axis), ilp)
+        for axis, name in ((1, "lane"), (0, "sublane"))
+        for ilp in (1, 2)
+    ]
+    # Harness floor: the same chain with a pure elementwise add body.
+    # A whole-array op chained through ``fori_loop`` cannot keep the
+    # 4 MB intermediate in registers, so EVERY chain step pays a VMEM
+    # round-trip (8 B/elem) on top of its compute port. The add chain
+    # prices that round-trip (plus one ALU add, ~0.05 ps at the VPU
+    # rate) — subtracting it from the roll rates isolates the
+    # crossbar-port component the stencil bound needs.
+    out.append(measure(
+        "roll_chain_baseline_add_ps_per_elem",
+        lambda v: v + jnp.float32(1.0), 1,
+    ))
     return out
 
 

@@ -90,11 +90,24 @@ def slice_partition(topology: str):
     NOT PJRT device ids: HLO replica groups with
     ``use_global_device_ids`` number devices by their flattened
     assignment index (multi-slice abstract devices carry ids like
-    100000 that never appear in the HLO)."""
-    devices = topology_devices(topology)
-    slices = [getattr(d, "slice_index", 0) or 0 for d in devices]
-    order = sorted(range(len(devices)), key=lambda i: (slices[i], i))
-    return {pos: slices[i] for pos, i in enumerate(order)}
+    100000 that never appear in the HLO).
+
+    Derived from the SAME ``_slice_groups`` flattening the hybrid
+    communicator builds its mesh from, so the partition can never
+    drift from the actual device assignment."""
+    from smi_tpu.parallel.mesh import _slice_groups
+
+    devices = list(topology_devices(topology))
+    n_slices = len({getattr(d, "slice_index", 0) or 0 for d in devices})
+    if n_slices == 1:
+        return {i: 0 for i in range(len(devices))}
+    groups = _slice_groups(devices, n_slices, None)
+    return {
+        pos: s
+        for pos, s in enumerate(
+            s for s, group in enumerate(groups) for _ in group
+        )
+    }
 
 
 def grid2d(n: int):
@@ -242,7 +255,9 @@ def executable_report(compiled) -> dict:
         text = compiled.as_text()
         records = collective_traffic(compiled, text)
         report["collectives"] = records
-        if records:
+        in_loop = any(r.get("in_loop") for r in records)
+        megascale = any(r.get("megascale") for r in records)
+        if records and not in_loop and not megascale:
             # bandwidth-only v5e wall-clock bound of the program's
             # collectives — the compiled-evidence column the ring
             # tier's schedule predictions are compared against
@@ -250,6 +265,21 @@ def executable_report(compiled) -> dict:
 
             report["ici_predicted_us"] = round(
                 predicted_program_us(records), 4
+            )
+        elif in_loop:
+            # a while-loop collective's record is per HLO occurrence —
+            # a prediction would be low by the trip count, so the
+            # column is withheld rather than shipped wrong
+            report["ici_predicted_error"] = (
+                "collectives inside a while loop: per-occurrence "
+                "bytes under-count by the trip count"
+            )
+        elif megascale:
+            # megascale sends cross the DCN boundary — pricing them at
+            # the ICI link rate would misrank flat vs hierarchical
+            report["ici_predicted_error"] = (
+                "program crosses a slice boundary: megascale DCN "
+                "sends cannot be priced at the ICI link rate"
             )
         if not records and has_collectives(text):
             # mark a parser miss so the empty list never ships as data
@@ -947,11 +977,13 @@ def check_surface(
         compiled = build()
         reports[name] = executable_report(compiled)
     if is_multislice(topology):
-        # the hybrid subset has no ring-tier program to annotate, and
-        # its collectives cross the REAL DCN boundary — pricing those
-        # at the ICI link rate would misrank flat vs hierarchical, so
-        # the single-rate column is withheld (the crossing/local split
-        # via tier_crossing_bytes is the meaningful signal here)
+        # the hybrid subset has no ring-tier program to annotate; the
+        # single-rate column is already withheld per-program by
+        # executable_report (megascale sends cross the REAL DCN
+        # boundary — the crossing/local split via tier_crossing_bytes
+        # is the meaningful signal here). Belt-and-braces in case a
+        # hybrid program's crossing stage lowered without megascale
+        # sends (it would price DCN at the ICI rate):
         for rep in reports.values():
             rep.pop("ici_predicted_us", None)
     else:

@@ -20,7 +20,10 @@ Scope caveat: records are per HLO *occurrence*, not per execution — a
 collective inside a ``while``/``fori_loop`` body prints once but runs
 trip-count times (e.g. ``app_kmeans_512k``'s in-loop Reduce+Bcast), so
 volume comparisons must use loop-free programs (the perf_notes tables
-do) or scale by the known trip count themselves.
+do) or scale by the known trip count themselves. The parser marks such
+records ``in_loop: True`` (:func:`_loop_computations`), and
+:func:`~smi_tpu.parallel.aot.executable_report` withholds the
+``ici_predicted_us`` column for programs containing one.
 
 Ring-tier programs move their data inside Mosaic kernels (remote DMAs
 are invisible to HLO), so their traffic is *predicted* from the kernel
@@ -58,6 +61,17 @@ _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 _GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,{}]*\})\}")
 _PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[\d,{}]*\})\}")
 
+#: megascale DCN egress: on a GENUINE multi-slice topology XLA compiles
+#: one ``num_partitions=n_per_slice`` module per slice and lowers the
+#: cross-slice stage of a collective to host-transfer ``send``/``recv``
+#: pairs handled by the megascale runtime (frontend attribute
+#: ``_xla_host_transfer_handler_name="xla_megascale_runtime"``) — the
+#: slice-crossing payload never appears in any replica group, so the
+#: parser must book the send's tuple payload instead
+_SEND_RE = re.compile(
+    r"%?(?P<name>[\w.\-]+)\s*=\s*(?P<type>[^=]+?)\ssend\("
+)
+
 
 def _parse_groups(text: str) -> List[List[int]]:
     """``{{0,1},{2,3}}`` (inner part) -> [[0,1],[2,3]]."""
@@ -65,6 +79,62 @@ def _parse_groups(text: str) -> List[List[int]]:
         [int(x) for x in grp.split(",") if x]
         for grp in re.findall(r"\{([\d,]*)\}", text)
     ]
+
+
+def _elems(shape: str) -> int:
+    """``"2,1,128"`` -> 256 (empty shape = scalar = 1)."""
+    n = 1
+    for dim in shape.split(","):
+        if dim:
+            n *= int(dim)
+    return n
+
+
+#: computation header: ``%name (params) -> type {`` or ``ENTRY %name ...{``.
+#: Params may nest parens (tuple-typed while carries), so the regex
+#: stops at the opening paren — headers are the only lines whose name
+#: is followed by ``(`` with no ``=`` (instructions are ``%name = ...``),
+#: and the caller additionally requires the line to end with ``{``.
+_COMP_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(")
+#: computation references on an instruction line
+_CALLED_RE = re.compile(r"(?:body|condition|to_apply|calls)=%?([\w.\-]+)")
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+
+
+def _loop_computations(hlo_text: str) -> Set[str]:
+    """Computation names reachable from any ``while`` instruction's
+    body/condition — the regions whose instructions execute trip-count
+    times per program run, not once per HLO occurrence."""
+    refs: Dict[str, Set[str]] = {}
+    roots: List[str] = []
+    cur: Optional[str] = None
+    for line in hlo_text.splitlines():
+        mc = _COMP_RE.match(line)
+        if mc and line.rstrip().endswith("{"):
+            cur = mc.group(1)
+            refs.setdefault(cur, set())
+            continue
+        if cur is None:
+            continue
+        called = _CALLED_RE.findall(line)
+        mb = _BRANCHES_RE.search(line)
+        if mb:
+            called += [
+                c.strip().lstrip("%")
+                for c in mb.group(1).split(",") if c.strip()
+            ]
+        refs[cur].update(called)
+        if re.search(r"\swhile\(", line):
+            roots.extend(called)
+    reachable: Set[str] = set()
+    stack = roots
+    while stack:
+        c = stack.pop()
+        if c in reachable:
+            continue
+        reachable.add(c)
+        stack.extend(refs.get(c, ()))
+    return reachable
 
 
 def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
@@ -77,14 +147,47 @@ def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
     deduplicated by instruction name. ``hlo_text`` lets a caller that
     already rendered ``compiled.as_text()`` (a multi-MB string for
     large programs) avoid a second render.
+
+    A record whose instruction lives inside a ``while`` body (directly
+    or through nested calls) carries ``in_loop: True`` — its bytes are
+    per HLO occurrence, an under-count by the loop trip count, so
+    volume columns must either exclude it or scale it themselves.
     """
     records = []
     seen: Set[Tuple[str, str]] = set()
     if hlo_text is None:
         hlo_text = compiled.as_text()
+    loop_comps = _loop_computations(hlo_text)
+    cur_comp: Optional[str] = None
     for line in hlo_text.splitlines():
+        mc = _COMP_RE.match(line)
+        if mc and line.rstrip().endswith("{"):
+            cur_comp = mc.group(1)
         m = _INSTR_RE.search(line)
         if not m:
+            ms = _SEND_RE.search(line)
+            if (
+                ms
+                and "is_host_transfer=true" in line
+                and "_xla_megascale" in line
+            ):
+                # DCN egress of a multi-slice collective: payload is
+                # the largest array of the (data, u32[], token[]) tuple
+                shapes = [
+                    (dt, _elems(sh), _elems(sh) * _DTYPE_BYTES[dt])
+                    for dt, sh in _SHAPE_RE.findall(ms.group("type"))
+                    if dt in _DTYPE_BYTES
+                ]
+                if shapes:
+                    dt, el, by = max(shapes, key=lambda t: t[2])
+                    rec = {
+                        "op": "megascale-send", "name": ms.group("name"),
+                        "dtype": dt, "elements": el, "bytes": by,
+                        "megascale": True,
+                    }
+                    if cur_comp in loop_comps:
+                        rec["in_loop"] = True
+                    records.append(rec)
             continue
         name = m.group("name")
         # async halves share a base name and describe ONE collective;
@@ -109,15 +212,11 @@ def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
         #   psums into one all-reduce over many tensors): the payload
         #   is the SUM of the arrays (the max rule recorded a fused
         #   3-tensor psum as its largest member).
-        shapes = []
-        for dtype, shape in _SHAPE_RE.findall(m.group("type")):
-            if dtype not in _DTYPE_BYTES:
-                continue
-            elems = 1
-            for dim in shape.split(","):
-                if dim:
-                    elems *= int(dim)
-            shapes.append((dtype, elems, elems * _DTYPE_BYTES[dtype]))
+        shapes = [
+            (dtype, _elems(shape), _elems(shape) * _DTYPE_BYTES[dtype])
+            for dtype, shape in _SHAPE_RE.findall(m.group("type"))
+            if dtype in _DTYPE_BYTES
+        ]
         if not shapes:
             # token-typed line carries no payload shape; leave the key
             # unseen so the paired half (e.g. the -done) can record it
@@ -151,6 +250,8 @@ def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
             "elements": sum(e for _, e, _ in selected),
             "bytes": sum(b for _, _, b in selected),
         }
+        if cur_comp in loop_comps:
+            rec["in_loop"] = True
         g = _GROUPS_RE.search(line)
         if g:
             rec["groups"] = _parse_groups(g.group(1))
@@ -213,6 +314,11 @@ def tier_crossing_bytes(
     """
     out = {"crossing": 0.0, "local": 0.0}
     for rec in records:
+        if rec.get("megascale"):
+            # a megascale send exists ONLY to cross the slice boundary
+            # (in-slice traffic stays in replica-grouped collectives)
+            out["crossing"] += rec["bytes"]
+            continue
         sets = rec.get("groups") or rec.get("pairs")
         if sets:
             ncross = sum(

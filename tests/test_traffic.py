@@ -57,6 +57,59 @@ def test_async_halves_deduplicated():
     assert recs[0]["bytes"] == 512
 
 
+LOOP_HLO = """
+HloModule jit_kmeans
+
+%region_body.10 (arg.1: f32[64]) -> f32[64] {
+  %p = f32[64]{0} parameter(0)
+  %loop-psum.3 = f32[64]{0} all-reduce(%p), channel_id=5, replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, to_apply=%add.2
+  ROOT %r = f32[64]{0} add(%loop-psum.3, %p)
+}
+
+%region_cond.11 (arg.2: f32[64]) -> pred[] {
+  ROOT %lt = pred[] constant(true)
+}
+
+ENTRY %main.20 (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %entry-ag.1 = f32[64]{0} all-gather(%p0), channel_id=4, replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  ROOT %w = f32[64]{0} while(%entry-ag.1), condition=%region_cond.11, body=%region_body.10
+}
+"""
+
+
+def test_in_loop_collectives_flagged():
+    """A collective inside a while body is per-occurrence data (runs
+    trip-count times); the parser must mark it so the predicted
+    wall-clock column can refuse to price it (`aot.executable_report`
+    withholds `ici_predicted_us` for such programs)."""
+    recs = T.collective_traffic(FakeCompiled(LOOP_HLO))
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["loop-psum.3"].get("in_loop") is True
+    assert "in_loop" not in by_name["entry-ag.1"]
+
+
+def test_loop_computations_transitive():
+    """A collective nested one call deeper than the while body is still
+    loop-resident."""
+    hlo = """
+%inner.5 (a: f32[8]) -> f32[8] {
+  %nested-ar.9 = f32[8]{0} all-reduce(%a), channel_id=7, replica_groups={{0,1}}, to_apply=%add.1
+}
+
+%body.6 (b: f32[8]) -> f32[8] {
+  ROOT %c = f32[8]{0} call(%b), to_apply=%inner.5
+}
+
+ENTRY %main (p: f32[8]) -> f32[8] {
+  ROOT %w = f32[8]{0} while(%p), condition=%cond.7, body=%body.6
+}
+"""
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    assert recs[0]["name"] == "nested-ar.9"
+    assert recs[0].get("in_loop") is True
+
+
 def test_async_tuple_start_records_result_bytes():
     """An async -start's tuple type leads with operand aliases and can
     trail with u32 barrier/context scalars; the record must book the
@@ -351,10 +404,23 @@ def test_r05_artifact_traffic_scales_with_n():
         assert ar["bytes"] == 256 * 4, (t, ar)
         assert T.collective_wire_bytes(ar) == pytest.approx(
             2 * (n - 1) / n * 256 * 4)
-        rs = one("xla_reduce_scatter", "reduce-scatter")
-        assert rs["bytes"] == chunk_bytes, (t, rs)
-        assert T.collective_wire_bytes(rs) == pytest.approx(
-            (n - 1) * chunk_bytes)
+        # psum_scatter's lowering is XLA's choice per size: a true
+        # reduce-scatter (seen at n=8) keeps the per-device piece; at
+        # n=16 the combiner picks all-reduce + slice of the FULL
+        # (n x chunk) operand — the artifact records whichever the
+        # compiler emitted, and the wire formula follows that op
+        rs_recs = progs["xla_reduce_scatter"]["collectives"]
+        assert len(rs_recs) == 1, (t, rs_recs)
+        rs = rs_recs[0]
+        if rs["op"] == "reduce-scatter":
+            assert rs["bytes"] == chunk_bytes, (t, rs)
+            assert T.collective_wire_bytes(rs) == pytest.approx(
+                (n - 1) * chunk_bytes)
+        else:
+            assert rs["op"] == "all-reduce", (t, rs)
+            assert rs["bytes"] == n * chunk_bytes, (t, rs)
+            assert T.collective_wire_bytes(rs) == pytest.approx(
+                2 * (n - 1) / n * n * chunk_bytes)
         cp = one("xla_neighbour_shift", "collective-permute")
         assert cp["bytes"] == 4 * 8 * 256 * 4, (t, cp)
         # the predicted wall-clock column is present wherever records are
@@ -385,8 +451,18 @@ def test_r05_1m_sp_train_step_evidence():
 
 def test_r05_two_slice_hierarchical_crossing():
     """On the GENUINE two-slice topology the hierarchical allreduce
-    crosses the real DCN boundary with 1/inner of the flat psum's
-    volume."""
+    crosses the real DCN boundary with less than the flat psum's
+    volume.
+
+    XLA compiles a multi-slice program as one ``num_partitions=inner``
+    module per slice and lowers the cross-slice stage to megascale
+    host-transfer sends (parsed as ``megascale-send`` records, always
+    crossing). The flat form sends its FULL payload (1024 B); the
+    hierarchical form sends only the reduce-scattered shard — 128 B of
+    data, floored to 512 B by the f32 128-lane tile at this demo
+    payload, so the observed ratio is 2x where the analytic 1/inner is
+    8x; at real payloads (shard >= one lane tile) the send shape is the
+    shard itself and the full 1/inner materializes."""
     data = _load_artifact("AOT_TPU_r05.json")
     multi = {
         t: e for t, e in data["topologies"].items()
@@ -397,13 +473,16 @@ def test_r05_two_slice_hierarchical_crossing():
         part = {int(k): v for k, v in e["slice_partition"].items()}
         assert len(set(part.values())) == 2, part
         progs = e["programs"]
-        flat = T.tier_crossing_bytes(
-            progs["allreduce_flat"]["collectives"], part)
-        hier = T.tier_crossing_bytes(
-            progs["allreduce_hierarchical"]["collectives"], part)
-        assert flat["crossing"] > 0
-        assert hier["crossing"] > 0
-        assert hier["crossing"] * 4 <= flat["crossing"]
+        flat_recs = progs["allreduce_flat"]["collectives"]
+        hier_recs = progs["allreduce_hierarchical"]["collectives"]
+        # the DCN egress is visible as megascale sends on both forms
+        assert any(r["op"] == "megascale-send" for r in flat_recs), flat_recs
+        assert any(r["op"] == "megascale-send" for r in hier_recs), hier_recs
+        flat = T.tier_crossing_bytes(flat_recs, part)
+        hier = T.tier_crossing_bytes(hier_recs, part)
+        payload = 8 * 32 * 4  # the (inner*32,) f32 reduced vector
+        assert flat["crossing"] == payload, flat
+        assert 0 < hier["crossing"] <= flat["crossing"] / 2, (flat, hier)
 
 
 def test_async_fused_all_reduce_sums_results():
