@@ -102,12 +102,9 @@ def slice_partition(topology: str):
     if n_slices == 1:
         return {i: 0 for i in range(len(devices))}
     groups = _slice_groups(devices, n_slices, None)
-    return {
-        pos: s
-        for pos, s in enumerate(
-            s for s, group in enumerate(groups) for _ in group
-        )
-    }
+    return dict(enumerate(
+        idx for idx, group in enumerate(groups) for _ in group
+    ))
 
 
 def grid2d(n: int):

@@ -21,7 +21,7 @@ collective inside a ``while``/``fori_loop`` body prints once but runs
 trip-count times (e.g. ``app_kmeans_512k``'s in-loop Reduce+Bcast), so
 volume comparisons must use loop-free programs (the perf_notes tables
 do) or scale by the known trip count themselves. The parser marks such
-records ``in_loop: True`` (:func:`_loop_computations`), and
+records ``in_loop: True`` (:func:`_scan_computations`), and
 :func:`~smi_tpu.parallel.aot.executable_report` withholds the
 ``ici_predicted_us`` column for programs containing one.
 
@@ -101,31 +101,38 @@ _CALLED_RE = re.compile(r"(?:body|condition|to_apply|calls)=%?([\w.\-]+)")
 _BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
 
 
-def _loop_computations(hlo_text: str) -> Set[str]:
-    """Computation names reachable from any ``while`` instruction's
-    body/condition — the regions whose instructions execute trip-count
-    times per program run, not once per HLO occurrence."""
+def _scan_computations(
+    lines: Sequence[str],
+) -> Tuple[Set[str], List[Optional[str]]]:
+    """One pass over pre-split HLO lines: ``(loop_comps, comp_of_line)``.
+
+    ``loop_comps`` — computation names reachable from any ``while``
+    instruction's body/condition (regions whose instructions execute
+    trip-count times per run, not once per HLO occurrence).
+    ``comp_of_line[i]`` — the computation containing line ``i``, so the
+    instruction parser shares this scan instead of re-matching headers
+    over the multi-MB text."""
     refs: Dict[str, Set[str]] = {}
     roots: List[str] = []
     cur: Optional[str] = None
-    for line in hlo_text.splitlines():
+    comp_of_line: List[Optional[str]] = []
+    for line in lines:
         mc = _COMP_RE.match(line)
         if mc and line.rstrip().endswith("{"):
             cur = mc.group(1)
             refs.setdefault(cur, set())
-            continue
-        if cur is None:
-            continue
-        called = _CALLED_RE.findall(line)
-        mb = _BRANCHES_RE.search(line)
-        if mb:
-            called += [
-                c.strip().lstrip("%")
-                for c in mb.group(1).split(",") if c.strip()
-            ]
-        refs[cur].update(called)
-        if re.search(r"\swhile\(", line):
-            roots.extend(called)
+        elif cur is not None:
+            called = _CALLED_RE.findall(line)
+            mb = _BRANCHES_RE.search(line)
+            if mb:
+                called += [
+                    c.strip().lstrip("%")
+                    for c in mb.group(1).split(",") if c.strip()
+                ]
+            refs[cur].update(called)
+            if re.search(r"\swhile\(", line):
+                roots.extend(called)
+        comp_of_line.append(cur)
     reachable: Set[str] = set()
     stack = roots
     while stack:
@@ -134,7 +141,7 @@ def _loop_computations(hlo_text: str) -> Set[str]:
             continue
         reachable.add(c)
         stack.extend(refs.get(c, ()))
-    return reachable
+    return reachable, comp_of_line
 
 
 def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
@@ -157,12 +164,10 @@ def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
     seen: Set[Tuple[str, str]] = set()
     if hlo_text is None:
         hlo_text = compiled.as_text()
-    loop_comps = _loop_computations(hlo_text)
-    cur_comp: Optional[str] = None
-    for line in hlo_text.splitlines():
-        mc = _COMP_RE.match(line)
-        if mc and line.rstrip().endswith("{"):
-            cur_comp = mc.group(1)
+    lines = hlo_text.splitlines()
+    loop_comps, comp_of_line = _scan_computations(lines)
+    for lineno, line in enumerate(lines):
+        cur_comp = comp_of_line[lineno]
         m = _INSTR_RE.search(line)
         if not m:
             ms = _SEND_RE.search(line)
@@ -311,8 +316,19 @@ def tier_crossing_bytes(
     meaning all replicas, or the iota ``[n,m]<=[k]`` form) is counted
     as fully CROSSING — conservatively overstating the slow-tier
     volume rather than silently dropping payload.
+
+    If any record carries ``in_loop`` the result gains an
+    ``in_loop_records`` count: those records' bytes are per HLO
+    occurrence (an under-count by the loop trip count), so both
+    buckets are lower bounds for such programs.
     """
     out = {"crossing": 0.0, "local": 0.0}
+    in_loop = sum(1 for rec in records if rec.get("in_loop"))
+    if in_loop:
+        # per-occurrence bytes of a while-body collective under-count
+        # by the trip count — the volumes below are LOWER BOUNDS; the
+        # key makes the understatement visible instead of silent
+        out["in_loop_records"] = in_loop
     for rec in records:
         if rec.get("megascale"):
             # a megascale send exists ONLY to cross the slice boundary

@@ -89,6 +89,17 @@ def test_in_loop_collectives_flagged():
     assert "in_loop" not in by_name["entry-ag.1"]
 
 
+def test_tier_crossing_flags_in_loop_records():
+    """Loop-resident records make the crossing/local volumes lower
+    bounds; the result must say so instead of staying silent."""
+    recs = T.collective_traffic(FakeCompiled(LOOP_HLO))
+    out = T.tier_crossing_bytes(recs, {d: d // 4 for d in range(8)})
+    assert out["in_loop_records"] == 1
+    loop_free = [r for r in recs if not r.get("in_loop")]
+    assert "in_loop_records" not in T.tier_crossing_bytes(
+        loop_free, {d: d // 4 for d in range(8)})
+
+
 def test_loop_computations_transitive():
     """A collective nested one call deeper than the while body is still
     loop-resident."""
