@@ -82,6 +82,13 @@ BLOCK_Q_BF16_FWD = 1024
 #: fractionally *slower* at 1024, so it keeps 512.
 BLOCK_K = 512
 BLOCK_K_BF16 = 1024
+#: bf16 WINDOWED-forward key tile: the r5 interleaved A/B at S=32k/
+#: window=4096 reads bk=512 at a consistent +3% over 1024 (107.5 vs
+#: 104.5 TF/s) — the windowed grid's live span covers few tiles, so
+#: finer tiles waste less dead span at the window edges; the stable
+#: S=16k causal gate prefers 1024 (pairwise +4%), so only the windowed
+#: forward narrows.
+BLOCK_K_BF16_WINDOW = 512
 #: VMEM budget for a K/V chunk pair. Empirical Mosaic limit (v5e,
 #: d=128): double-buffered chunks at 8 MB (k+v x 2 bufs) fail to
 #: compile, 4 MB compiles — and a chunk covering the whole extent is
@@ -112,6 +119,15 @@ def _block_q_fwd(dtype) -> int:
     """Forward query-tile target (the backward uses :data:`BLOCK_Q`
     directly — its VMEM frame does not fit the wide tile)."""
     return BLOCK_Q_BF16_FWD if dtype == jnp.bfloat16 else BLOCK_Q
+
+
+def _block_k_fwd(dtype, window) -> int:
+    """Forward key-tile target; the bf16 windowed schedule narrows to
+    :data:`BLOCK_K_BF16_WINDOW` (backward kernels keep :func:`_block_k`
+    — their inner sub-tile was not part of the windowed A/B)."""
+    if dtype == jnp.bfloat16 and window is not None:
+        return BLOCK_K_BF16_WINDOW
+    return _block_k(dtype)
 
 
 def _chunk_for(extent: int, block: int, d: int, itemsize: int) -> int:
@@ -536,7 +552,7 @@ def flash_attend_fused(
     group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, _block_q_fwd(q.dtype), mult)
-    bk = _pick_block(s_k, _block_k(q.dtype), mult)
+    bk = _pick_block(s_k, _block_k_fwd(q.dtype, window), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
     # one block-sized K/V tile per grid step (streamed double-buffered;
@@ -621,7 +637,7 @@ def flash_block_attend(
     group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
     bq = _pick_block(s_q, _block_q_fwd(q.dtype), mult)
-    bk = _pick_block(s_k, _block_k(q.dtype), mult)
+    bk = _pick_block(s_k, _block_k_fwd(q.dtype, window), mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
     kc = bk

@@ -141,16 +141,21 @@ def format_report(report: dict) -> str:
         f"program report — {report['comm_size']} ranks, "
         f"{report['count']} elements/op, backend={report['backend']}",
         f"{'op':<10} {'port':>4} {'dtype':<7} {'flops':>12} "
-        f"{'bytes':>14} {'code':>10} {'temp':>10}",
+        f"{'bytes':>14} {'code':>10} {'temp':>10} {'ici_pred_us':>12}",
     ]
     for e in report["operations"]:
         cost = e.get("cost", {})
         mem = e.get("memory", {})
+        # the bandwidth-only v5e wall-clock bound of the op's parsed
+        # collectives; '-' where withheld (loop-resident or DCN) or
+        # where the op compiled to no collective
+        pred = e.get("ici_predicted_us")
         lines.append(
             f"{e['op']:<10} {e['port']:>4} {e['dtype']:<7} "
             f"{cost.get('flops', 0):>12.0f} "
             f"{cost.get('bytes accessed', 0):>14.0f} "
             f"{mem.get('generated_code_bytes', 0):>10} "
-            f"{mem.get('temp_bytes', 0):>10}"
+            f"{mem.get('temp_bytes', 0):>10} "
+            f"{pred if pred is not None else '-':>12}"
         )
     return "\n".join(lines)
