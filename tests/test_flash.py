@@ -39,6 +39,22 @@ def test_flash_supported_gating():
     assert flash._pick_block(32, 512, multiple=16) == 32
 
 
+def test_tuned_tile_selection():
+    """The r5 measured tile policy (docs/perf_notes.md): bf16 forward
+    takes wide 1024-row query tiles (the backward cannot — its VMEM
+    frame overflows at 1024, so it keeps BLOCK_Q=512); the bf16
+    WINDOWED forward narrows its key tile to 512 while the causal
+    forward keeps 1024; f32 is untouched by both."""
+    bf16, f32 = jnp.bfloat16, jnp.float32
+    assert flash._block_q_fwd(bf16) == 1024
+    assert flash._block_q_fwd(f32) == flash.BLOCK_Q == 512
+    assert flash._block_k_fwd(bf16, None) == 1024
+    assert flash._block_k_fwd(bf16, 4096) == 512
+    assert flash._block_k_fwd(f32, 4096) == flash.BLOCK_K == 512
+    # the backward's pick is the unsplit constants
+    assert flash._block_k(bf16) == 1024
+
+
 @pytest.mark.parametrize("n", [1, 2])
 def test_flash_ring_attention_bf16(eight_devices, n):
     """bf16 inputs, f32 online-softmax state; bf16-level tolerance."""
