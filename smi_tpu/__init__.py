@@ -37,6 +37,12 @@ Public API (mirrors ``include/smi.h``; see each submodule for details)::
         return ctx.bcast(received, root=1)[None]
 """
 
+from smi_tpu.utils.compile import install_jax_compat as _install_jax_compat
+
+# older pinned JAX: alias jax.experimental.shard_map to jax.shard_map
+# (the API every module and example targets) before anything traces
+_install_jax_compat()
+
 from smi_tpu.ops.types import (
     SmiDtype,
     SmiOp,
@@ -75,6 +81,9 @@ from smi_tpu.parallel.mesh import (
 )
 from smi_tpu.parallel.channels import P2PChannel, stream_concurrent
 from smi_tpu.parallel.context import SmiContext, smi_kernel
+from smi_tpu.parallel.faults import FaultPlan
+from smi_tpu.parallel.routing import FailureSet, RouteCutError
+from smi_tpu.utils.watchdog import Deadline, WatchdogTimeout
 
 __version__ = "0.1.0"
 
@@ -109,4 +118,9 @@ __all__ = [
     "stream_concurrent",
     "SmiContext",
     "smi_kernel",
+    "FaultPlan",
+    "FailureSet",
+    "RouteCutError",
+    "Deadline",
+    "WatchdogTimeout",
 ]

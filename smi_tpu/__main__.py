@@ -96,6 +96,96 @@ def cmd_manifest(args: argparse.Namespace) -> int:
     return 0
 
 
+def _parse_down_links(topology, specs):
+    """``--down node:dev:chN`` specs → a routing FailureSet."""
+    from smi_tpu.ops.serialization import _parse_endpoint
+    from smi_tpu.parallel.routing import FailureSet
+
+    links = set()
+    devices = set()
+    known = set(topology.devices)
+    for spec in specs:
+        # "node:dev:chN" (two colons) = one wire endpoint;
+        # "node:dev" = the whole device
+        if spec.count(":") >= 2:
+            dev, link = _parse_endpoint(spec)
+            links.add((dev, link))
+        else:
+            from smi_tpu.ops.program import Device
+
+            dev = Device.parse(spec)
+            devices.add(dev)
+        if dev not in known:
+            raise ValueError(
+                f"--down {spec!r} names device {dev}, which is not in "
+                f"the topology"
+            )
+    return FailureSet(links=frozenset(links), devices=frozenset(devices))
+
+
+def _route_check(args: argparse.Namespace, topology, ctx) -> int:
+    """``route --check``: fail fast before a launcher grabs a pod.
+
+    Validates that (a) every device pair is routable — around the
+    ``--down`` failure set when one is given, with the cut named when
+    not — and (b) the hostfile (given or freshly derivable) passes the
+    strict bootstrap validation and matches the topology's rank count.
+    Exit is nonzero on any violation; output is one line per check so
+    launch scripts can log it.
+    """
+    from smi_tpu.parallel.bootstrap import HostfileError, parse_hostfile
+    from smi_tpu.parallel.routing import (
+        NoRouteFound,
+        build_routing_context,
+    )
+
+    rc = 0
+    excluded = None
+    if args.down:
+        excluded = _parse_down_links(topology, args.down)
+        ctx = build_routing_context(
+            topology, ctx.links_per_device, excluded=excluded
+        )
+    healthy = [
+        d for d in topology.devices
+        if excluded is None or d not in excluded.devices
+    ]
+    try:
+        # down devices are routed *around*, not *to*: validate the
+        # healthy subset only
+        from smi_tpu.parallel.routing import check_all_pairs_routable
+
+        check_all_pairs_routable(ctx, healthy)
+        print(
+            f"routes: ok ({len(healthy)} devices all-pairs "
+            f"routable{' around ' + str(excluded) if excluded else ''})"
+        )
+    except NoRouteFound as e:
+        print(f"routes: FAIL — {e}")
+        rc = 1
+    if args.hostfile:
+        try:
+            with open(args.hostfile) as f:
+                nodes = parse_hostfile(f.read())
+            want = len(topology.devices)
+            if len(nodes) != want:
+                raise HostfileError(
+                    f"hostfile lists {len(nodes)} ranks but the "
+                    f"topology has {want} devices"
+                )
+            topo_nodes = [d.node for d in topology.devices]
+            if nodes != topo_nodes:
+                raise HostfileError(
+                    f"hostfile node order {nodes} does not match the "
+                    f"topology's rank order {topo_nodes}"
+                )
+            print(f"hostfile: ok ({len(nodes)} ranks)")
+        except (OSError, HostfileError) as e:
+            print(f"hostfile: FAIL — {e}")
+            rc = 1
+    return rc
+
+
 def cmd_route(args: argparse.Namespace) -> int:
     from smi_tpu.parallel.routing import (
         NoRouteFound,
@@ -103,6 +193,23 @@ def cmd_route(args: argparse.Namespace) -> int:
         write_routing_tables,
     )
 
+    if not args.check and args.dest_dir is None:
+        print("error: dest_dir is required unless --check is given",
+              file=sys.stderr)
+        return 2
+    if not args.check and (args.down or args.hostfile):
+        # writing healthy tables while silently ignoring a declared
+        # failure set would hand the launcher routes over dead wires
+        print("error: --down/--hostfile only apply with --check",
+              file=sys.stderr)
+        return 2
+    if args.check and args.dest_dir is not None:
+        # in check mode there is no output directory: the second
+        # positional is really the first metadata file (argparse's
+        # optional dest_dir captures it) — reclassify rather than
+        # silently dropping it from the validation
+        args.metadata = [args.dest_dir] + list(args.metadata)
+        args.dest_dir = None
     try:
         with open(args.topology) as f:
             topology = parse_topology_file(
@@ -110,6 +217,8 @@ def cmd_route(args: argparse.Namespace) -> int:
                 ignore_programs=not args.metadata,
             )
         ctx = build_routing_context(topology)
+        if args.check:
+            return _route_check(args, topology, ctx)
         write_routing_tables(args.dest_dir, topology, ctx)
     except (NoRouteFound, KeyError, OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -545,12 +654,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_manifest)
 
     p = sub.add_parser(
-        "route", help="write binary routing tables + hostfile"
+        "route", help="write binary routing tables + hostfile, or "
+                      "--check a topology/hostfile without writing"
     )
     p.add_argument("topology", help="topology JSON (connections + programs)")
-    p.add_argument("dest_dir", help="output directory for tables + hostfile")
+    p.add_argument("dest_dir", nargs="?", default=None,
+                   help="output directory for tables + hostfile "
+                        "(optional with --check)")
     p.add_argument("metadata", nargs="*",
                    help="program metadata JSON files (basename = name)")
+    p.add_argument("--check", action="store_true",
+                   help="validate only: all device pairs routable "
+                        "(around any --down failures; exit nonzero on an "
+                        "unroutable cut, naming it) and the --hostfile "
+                        "strictly valid — a fail-fast for launch scripts "
+                        "before they grab a pod")
+    p.add_argument("--down", action="append", default=[],
+                   metavar="NODE:DEV[:chN]",
+                   help="with --check: treat this wire endpoint (or whole "
+                        "device, without :chN) as failed; repeatable")
+    p.add_argument("--hostfile", default=None,
+                   help="with --check: hostfile to validate against the "
+                        "topology's rank order")
     p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser(

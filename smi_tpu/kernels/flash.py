@@ -63,6 +63,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from smi_tpu.utils.compile import pallas_compiler_params
+
 NEG_INF = -1e30
 #: register lane width — softmax statistics are kept this wide
 LANES = 128
@@ -522,7 +524,7 @@ def _flash_fused_kernel(
         l_out_ref[0] = jnp.transpose(l[:, :1])
 
 
-_FWD_DIM_SEMANTICS = pltpu.CompilerParams(
+_FWD_DIM_SEMANTICS = pallas_compiler_params(
     dimension_semantics=("parallel", "parallel", "arbitrary"),
 )
 
@@ -1132,7 +1134,7 @@ def flash_block_backward_dkdv(
             jax.ShapeDtypeStruct((h_kv, s_k, d), jnp.float32),
             jax.ShapeDtypeStruct((h_kv, s_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,

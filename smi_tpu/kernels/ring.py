@@ -95,12 +95,14 @@ def _compiler_params(family_base: int, stream: int, flow_control: bool):
     ``tests/test_aot_tpu.py``: interpret mode accepted the stray id,
     real lowering does not.)
     """
+    from smi_tpu.utils.compile import pallas_compiler_params
+
     if flow_control:
-        return pltpu.CompilerParams(
+        return pallas_compiler_params(
             collective_id=ring_collective_id(family_base, stream),
             has_side_effects=True,
         )
-    return pltpu.CompilerParams(has_side_effects=True)
+    return pallas_compiler_params(has_side_effects=True)
 
 
 #: ring axes: a single mesh axis name, or an ordered tuple of names the
@@ -218,6 +220,12 @@ def _check_reducible(x: jax.Array, interpret: bool) -> None:
         )
 
 
+def interpret_available() -> bool:
+    """Whether this JAX can emulate the ring tier on CPU (Pallas TPU
+    interpret mode with cross-device remote DMA semantics)."""
+    return getattr(pltpu, "InterpretParams", None) is not None
+
+
 def _interpret_arg(interpret: bool):
     """Pallas ``interpret=`` argument for the requested mode.
 
@@ -225,8 +233,23 @@ def _interpret_arg(interpret: bool):
     than plain interpret mode: only the former simulates remote DMA +
     semaphore semantics across the fake-mesh devices, which the credit
     protocol needs. It also checks that semaphores drain to zero.
+
+    A JAX without TPU interpret mode cannot emulate the ring tier on
+    CPU at all (the plain interpreter rejects remote semaphore signals)
+    — gate with a named error rather than an AttributeError mid-kernel.
     """
-    return pltpu.InterpretParams() if interpret else False
+    if not interpret:
+        return False
+    params = getattr(pltpu, "InterpretParams", None)
+    if params is None:
+        raise NotImplementedError(
+            "this JAX has no Pallas TPU interpret mode "
+            "(pltpu.InterpretParams), which the ring tier's CPU "
+            "emulation requires; run on real TPU chips or use "
+            "backend='xla' — the protocol itself is still validated "
+            "hardware-free by smi_tpu.parallel.credits/faults"
+        )
+    return params()
 
 
 def _neighbour_barrier(me, n: int, to_logical):

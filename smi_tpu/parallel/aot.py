@@ -66,18 +66,61 @@ def parse_topology(topology: str):
     return topology, {}
 
 
+#: Hard bound (seconds) on the abstract-topology lookup. On a host
+#: with a TPU compile client the call returns in well under a second;
+#: on a TPU-less host it normally raises quickly — but some PJRT
+#: states *hang* instead (observed mid-suite on the CPU tier, where it
+#: stalled the whole run until the test watchdog aborted the process).
+#: Override with $SMI_AOT_TOPOLOGY_TIMEOUT_S.
+TOPOLOGY_LOOKUP_TIMEOUT_S = 45.0
+
+
 def topology_devices(topology: str = DEFAULT_TOPOLOGY):
     """Abstract devices of a named TPU topology (no hardware needed).
 
     Raises whatever the platform raises when no TPU compile client is
-    reachable — callers (the test tier) turn that into a skip.
+    reachable — callers (the test tier) turn that into a skip. The
+    lookup runs under a hard watchdog
+    (:func:`smi_tpu.utils.watchdog.run_with_deadline`), which bounds
+    hangs that block with the GIL released. It CANNOT bound the
+    GIL-holding spin some libtpu states enter on a TPU-less host — for
+    that, set ``SMI_TPU_DISABLE_AOT_TOPOLOGY=1`` (the pytest emulator
+    tier does, ``tests/conftest.py``) so the lookup fails fast instead
+    of starting.
     """
+    import os
+
+    if os.environ.get("SMI_TPU_DISABLE_AOT_TOPOLOGY", "").strip() not in (
+        "", "0", "false", "no"
+    ):
+        # the CPU test tier sets this (tests/conftest.py): with libtpu
+        # installed but no TPU attached, the topology client can spin
+        # for minutes holding the GIL mid-suite — the AOT tier is its
+        # own opt-in pytest invocation (SMI_TPU_RUN_AOT_TESTS=1), so
+        # the emulator tier fails the lookup fast and skips instead
+        raise RuntimeError(
+            "AOT topology lookup disabled on this test tier "
+            "(SMI_TPU_DISABLE_AOT_TOPOLOGY is set); run the AOT tier "
+            "with SMI_TPU_RUN_AOT_TESTS=1 to enable it"
+        )
+
     from jax.experimental import topologies
 
+    from smi_tpu.utils.watchdog import run_with_deadline
+
     name, kwargs = parse_topology(topology)
-    return topologies.get_topology_desc(
-        name, platform="tpu", **kwargs
-    ).devices
+    budget = float(
+        os.environ.get(
+            "SMI_AOT_TOPOLOGY_TIMEOUT_S", TOPOLOGY_LOOKUP_TIMEOUT_S
+        )
+    )
+    return run_with_deadline(
+        lambda: topologies.get_topology_desc(
+            name, platform="tpu", **kwargs
+        ).devices,
+        budget if budget > 0 else None,
+        context=f"abstract topology lookup for {topology}",
+    )
 
 
 def slice_partition(topology: str):

@@ -23,22 +23,91 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import List, Optional, Union
+import random
+import re
+import time
+from typing import Callable, List, Optional, Union
 
 DEFAULT_COORDINATOR_PORT = 8476
+
+#: Retry/backoff defaults for coordinator connection (see
+#: :func:`init_distributed`): total deadline, first backoff, cap, and
+#: the ± jitter fraction applied to every sleep.
+DEFAULT_INIT_DEADLINE_S = 300.0
+DEFAULT_INITIAL_BACKOFF_S = 1.0
+DEFAULT_MAX_BACKOFF_S = 30.0
+DEFAULT_BACKOFF_JITTER = 0.25
+
+
+class HostfileError(ValueError):
+    """A hostfile failed validation; the message says how to fix it."""
+
+
+class BootstrapTimeout(TimeoutError):
+    """Coordinator connection did not succeed within the deadline."""
+
+
+_RANK_RE = re.compile(r"\brank\s*(\d+)\s*$")
 
 
 def parse_hostfile(text: str) -> List[str]:
     """Hostfile lines → ordered node list (one entry per rank).
 
     Mirrors the writer (``smi_tpu.__main__.write_nodefile``): node name
-    first, optional ``# device, rank`` comment.
+    first, optional ``# device, rankN`` comment. Validation is strict —
+    a malformed hostfile must fail *here*, before a launcher grabs a
+    pod and hangs on a bad node list:
+
+    - an empty (or comments-only) file raises :class:`HostfileError`;
+    - a node entry containing whitespace (two tokens on one line)
+      raises — the writer never emits it, it is a hand-edit gone wrong;
+    - when rank comments are present, duplicate or non-contiguous rank
+      numbers raise (a duplicated rank would silently double-assign a
+      process id).
+
+    CRLF line endings and trailing whitespace are tolerated (hostfiles
+    get scp'd through Windows-touched tooling).
     """
-    nodes = []
-    for line in text.splitlines():
-        line = line.split("#", 1)[0].strip()
-        if line:
-            nodes.append(line)
+    nodes: List[str] = []
+    ranks: List[Optional[int]] = []
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line, _, comment = raw.partition("#")
+        line = line.strip()  # also eats the \r of CRLF files
+        if not line:
+            continue
+        if len(line.split()) != 1:
+            raise HostfileError(
+                f"hostfile line {lineno}: expected one node name, got "
+                f"{line!r} (one rank per line, node first, '#' comments)"
+            )
+        match = _RANK_RE.search(comment.strip())
+        nodes.append(line)
+        ranks.append(int(match.group(1)) if match else None)
+    if not nodes:
+        raise HostfileError(
+            "hostfile lists no nodes (empty or comments-only); expected "
+            "one line per rank, e.g. 'node-a  # node-a:0, rank0'"
+        )
+    annotated = [r for r in ranks if r is not None]
+    if annotated:
+        dupes = sorted({r for r in annotated if annotated.count(r) > 1})
+        if dupes:
+            raise HostfileError(
+                f"hostfile assigns rank(s) {dupes} more than once; each "
+                f"rank comment must be unique"
+            )
+        # even a partially annotated file must not name impossible
+        # ranks (a mangled comment on a hand-edited file). Combined
+        # with the duplicate check this also forces fully annotated
+        # files to be exactly the contiguous set 0..n-1.
+        out_of_range = sorted(r for r in annotated if r >= len(nodes))
+        if out_of_range:
+            raise HostfileError(
+                f"hostfile rank comment(s) {out_of_range} out of range "
+                f"for {len(nodes)} listed rank(s); ranks must be "
+                f"0..{len(nodes) - 1} — regenerate with "
+                f"`python -m smi_tpu route`"
+            )
     return nodes
 
 
@@ -77,9 +146,7 @@ def distributed_options(
     if os.path.exists(str(hostfile)):
         with open(hostfile) as f:
             text = f.read()
-    nodes = parse_hostfile(str(text))
-    if not nodes:
-        raise ValueError("hostfile lists no nodes")
+    nodes = parse_hostfile(str(text))  # raises HostfileError when empty
     distinct = list(dict.fromkeys(nodes))
     if process_id is None:
         process_id = int(os.environ.get("SMI_PROCESS_ID", "0"))
@@ -90,19 +157,120 @@ def distributed_options(
     )
 
 
-def init_distributed(opts: DistributedOptions) -> None:
-    """``jax.distributed.initialize`` with the derived options.
+def backoff_schedule(
+    initial_backoff_s: float = DEFAULT_INITIAL_BACKOFF_S,
+    max_backoff_s: float = DEFAULT_MAX_BACKOFF_S,
+    jitter: float = DEFAULT_BACKOFF_JITTER,
+    seed: Optional[int] = None,
+):
+    """Yield sleep durations: exponential growth, capped, ± jitter.
+
+    Jitter decorrelates the retry storms of many hosts restarting at
+    once (every rank of a preempted pod reconnects together; without
+    jitter they hammer the coordinator in lockstep). ``seed`` makes the
+    schedule reproducible for tests; the default seeds from process
+    entropy. The generator is infinite — the *caller* owns the total
+    deadline.
+    """
+    rng = random.Random(seed)
+    delay = initial_backoff_s
+    while True:
+        yield max(0.0, delay * (1.0 + jitter * (2.0 * rng.random() - 1.0)))
+        delay = min(delay * 2.0, max_backoff_s)
+
+
+def init_distributed(
+    opts: DistributedOptions,
+    total_deadline_s: float = DEFAULT_INIT_DEADLINE_S,
+    initial_backoff_s: float = DEFAULT_INITIAL_BACKOFF_S,
+    max_backoff_s: float = DEFAULT_MAX_BACKOFF_S,
+    jitter: float = DEFAULT_BACKOFF_JITTER,
+    initialize: Optional[Callable[..., None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    seed: Optional[int] = None,
+) -> None:
+    """``jax.distributed.initialize`` with retry, backoff, and a deadline.
+
+    The reference's control plane (mpirun over the hostfile) retries
+    connection at the launcher layer; ``jax.distributed.initialize``
+    does not — a coordinator that is still booting (or a transiently
+    unroutable DCN path) fails the whole job, and a *hung* connect
+    stalls it forever. Here every attempt gets a per-attempt timeout
+    (the remaining budget), failures back off exponentially with
+    jitter (:func:`backoff_schedule`), and the total budget is a hard
+    deadline: on expiry a :class:`BootstrapTimeout` names the
+    coordinator, the attempt count, and the last error — actionable
+    from a launch log.
 
     Single-process pools (one node) skip initialization entirely — the
     local runtime already owns every chip, and initialize() would block
-    waiting for peers.
+    waiting for peers. ``initialize``/``sleep``/``clock`` are
+    injectable for tests.
     """
     if opts.num_processes <= 1:
         return
-    import jax
+    if initialize is None:
+        import jax
 
-    jax.distributed.initialize(
-        coordinator_address=opts.coordinator_address,
-        num_processes=opts.num_processes,
-        process_id=opts.process_id,
+        initialize = jax.distributed.initialize
+
+    # probe ONCE whether the initializer takes initialization_timeout=
+    # (older jax.distributed.initialize does not) — probing per attempt
+    # would double every call and make a genuine TypeError from a real
+    # bug indistinguishable from the signature gap
+    import inspect
+
+    try:
+        params = inspect.signature(initialize).parameters
+        supports_timeout = "initialization_timeout" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in params.values()
+        )
+    except (TypeError, ValueError):  # no introspectable signature
+        supports_timeout = True
+
+    start = clock()
+    attempts = 0
+    last_error: Optional[BaseException] = None
+    delays = backoff_schedule(
+        initial_backoff_s, max_backoff_s, jitter, seed
+    )
+    while True:
+        remaining = total_deadline_s - (clock() - start)
+        if remaining <= 0:
+            break
+        attempts += 1
+        kwargs = dict(
+            coordinator_address=opts.coordinator_address,
+            num_processes=opts.num_processes,
+            process_id=opts.process_id,
+        )
+        if supports_timeout:
+            # each attempt gets the REMAINING budget: a hung connect
+            # cannot eat more than the total deadline
+            kwargs["initialization_timeout"] = max(1, int(remaining))
+        try:
+            initialize(**kwargs)
+            return
+        except TypeError as e:
+            if supports_timeout and "initialization_timeout" in str(e):
+                # signature introspection lied (e.g. a wrapper): drop
+                # the kwarg for all further attempts
+                supports_timeout = False
+                continue
+            last_error = e
+        except Exception as e:
+            last_error = e
+        delay = next(delays)
+        remaining = total_deadline_s - (clock() - start)
+        if remaining <= 0:
+            break
+        sleep(min(delay, remaining))
+    raise BootstrapTimeout(
+        f"could not connect to coordinator {opts.coordinator_address} as "
+        f"process {opts.process_id}/{opts.num_processes} within "
+        f"{total_deadline_s:.3g}s ({attempts} attempts); last error: "
+        f"{type(last_error).__name__ if last_error else 'none'}: "
+        f"{last_error}"
     )

@@ -60,6 +60,7 @@ from smi_tpu.parallel.backend import (
     reduction_fn,
 )
 from smi_tpu.parallel.mesh import Communicator
+from smi_tpu.utils.watchdog import Deadline
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,16 +190,41 @@ class P2PChannel:
             )
         return masked.reshape((n_chunks, chunk) + data.shape[1:])
 
-    def _ring_move(self, chunked_payload: jax.Array) -> jax.Array:
+    def _deadline(self, deadline: Optional[Deadline],
+                  what: str) -> Optional[Deadline]:
+        """Attach this channel's protocol mirror to a caller deadline, so
+        a timeout dumps the per-rank state of the matching protocol
+        (``what`` is the faults.FAMILY_PROTOCOL key — "transfer" and
+        "stream" both mirror the neighbour-stream machine)."""
+        if deadline is None:
+            return None
+        from smi_tpu.parallel.faults import mirror_state_provider
+
+        return deadline.with_provider(
+            mirror_state_provider(what, self.comm.size)
+        )
+
+    def _ring_move(self, chunked_payload: jax.Array,
+                   deadline: Optional[Deadline] = None) -> jax.Array:
         """Drive a ``(rows, ...)`` payload hop-by-hop to ``dst`` over the
         neighbour RDMA kernel (the shorter way around the ring), in this
-        channel's port stream slot."""
+        channel's port stream slot. The deadline is checked before every
+        hop AT HOST DISPATCH TIME — each Python-level hop issue, which
+        under ``jit`` means while tracing (a compiled, cached program
+        re-executes without re-checking). It bounds stuck multi-hop
+        *dispatch*; to bound blocking *execution*, wrap the readback in
+        :func:`smi_tpu.utils.watchdog.run_with_deadline`."""
         from smi_tpu.kernels import ring as _ring
 
         direction, hops = self._hops()
         mesh_axes = _ring.mesh_axes_of(self.comm)
         out = chunked_payload
-        for _ in range(hops):
+        for hop in range(hops):
+            if deadline is not None:
+                deadline.check(
+                    f"ring hop {hop + 1}/{hops} of port-{self.port} "
+                    f"channel {self.src}->{self.dst}"
+                )
             out = _ring.neighbour_stream(
                 out, self._axis(), self.comm.size, direction=direction,
                 interpret=not self.comm.is_tpu,
@@ -206,15 +232,17 @@ class P2PChannel:
             )
         return out
 
-    def _ring_transfer(self, data: jax.Array, chunked: bool) -> jax.Array:
+    def _ring_transfer(self, data: jax.Array, chunked: bool,
+                       deadline: Optional[Deadline] = None) -> jax.Array:
         """Move the masked message hop-by-hop over the neighbour RDMA
         kernel. Intermediate ranks forward zeros of their own, so only
         ``dst`` ends up with the payload — the SPMD rendition of packets
         transiting intermediate CK pairs (``ckr.cl:50-60``)."""
-        out = self._ring_move(self._ring_payload(data, chunked))
+        out = self._ring_move(self._ring_payload(data, chunked), deadline)
         return out.reshape((-1,) + data.shape[1:])[: self.count]
 
-    def transfer(self, data: jax.Array, backend: str = "xla") -> jax.Array:
+    def transfer(self, data: jax.Array, backend: str = "xla",
+                 deadline: Optional[Deadline] = None) -> jax.Array:
         """Fused Push+Pop: send ``data`` (valid at ``src``) to ``dst``.
 
         Every rank calls this at the same program point (SPMD); the rank
@@ -223,11 +251,22 @@ class P2PChannel:
         the packets (``ckr.cl:50-60``); here they see a zero buffer.
         ``backend="ring"`` sends over the explicit credit-controlled
         neighbour RDMA tier instead of ``lax.ppermute``.
+
+        ``deadline`` (:class:`smi_tpu.utils.watchdog.Deadline`) bounds
+        the host-side dispatch (under ``jit``, the trace — compiled
+        re-executions are not re-checked): expiry raises
+        ``WatchdogTimeout`` with the protocol's per-rank state mirror
+        attached. Hard-bound blocking execution with
+        :func:`smi_tpu.utils.watchdog.run_with_deadline`.
         """
         data = jnp.asarray(data, self.jnp_dtype)
         self._check_length(data)
+        deadline = self._deadline(deadline, "transfer")
+        if deadline is not None:
+            deadline.check(f"transfer on port-{self.port} channel")
         if check_backend(backend) == "ring":
-            return self._ring_transfer(data, chunked=False)
+            return self._ring_transfer(data, chunked=False,
+                                       deadline=deadline)
         return lax.ppermute(data, self._axis(), self._perm())
 
     def stream(
@@ -236,6 +275,7 @@ class P2PChannel:
         consumer: Optional[Callable] = None,
         init_carry=None,
         backend: str = "xla",
+        deadline: Optional[Deadline] = None,
     ):
         """Streamed transfer: move the message chunk-by-chunk.
 
@@ -263,8 +303,11 @@ class P2PChannel:
         data = jnp.asarray(data, self.jnp_dtype)
         self._check_length(data)
         check_backend(backend)
+        deadline = self._deadline(deadline, "stream")
+        if deadline is not None:
+            deadline.check(f"stream on port-{self.port} channel")
         if not self.rendezvous:
-            out = self.transfer(data, backend=backend)
+            out = self.transfer(data, backend=backend, deadline=deadline)
             if consumer is not None:
                 carry = consumer(init_carry, out)
                 return out, carry
@@ -273,7 +316,8 @@ class P2PChannel:
         chunk = min(self.chunk_elements, self.count)
 
         if backend == "ring":
-            received = self._ring_transfer(data, chunked=True)
+            received = self._ring_transfer(data, chunked=True,
+                                           deadline=deadline)
             carry = init_carry
             if consumer is not None:
                 n_full = self.count // chunk
@@ -322,6 +366,8 @@ class P2PChannel:
         # non-additive reductions)
         remaining = self.count - used
         for _ in range(remaining // chunk):
+            if deadline is not None:
+                deadline.check(f"stream tail on port-{self.port} channel")
             carry, got = step(carry, data[used:used + chunk])
             parts.append(got)
             used += chunk
@@ -337,6 +383,7 @@ class P2PChannel:
         op: Union[str, SmiOp] = SmiOp.ADD,
         lanes: Optional[int] = None,
         backend: str = "xla",
+        deadline: Optional[Deadline] = None,
     ):
         """Streamed reduction: pop each arriving chunk and fold it into
         ``lanes`` independent partial accumulators, combined at the end.
@@ -373,7 +420,7 @@ class P2PChannel:
 
         received, (partials, _) = self.stream(
             data, consumer=consumer, init_carry=(partials0, jnp.int32(0)),
-            backend=backend,
+            backend=backend, deadline=deadline,
         )
         total = chunk_reduce(partials, axis=0)
         return received, total

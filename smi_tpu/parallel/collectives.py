@@ -46,6 +46,26 @@ from jax import lax
 from smi_tpu.ops.types import SmiOp
 from smi_tpu.parallel.backend import BACKENDS, check_backend as _check_backend
 from smi_tpu.parallel.mesh import Communicator
+from smi_tpu.utils.watchdog import Deadline
+
+
+def _check_deadline(deadline: Optional[Deadline], family: str,
+                    comm: Communicator) -> None:
+    """Ring-tier watchdog gate: before dispatching an explicit-schedule
+    collective, an expired deadline raises ``WatchdogTimeout`` carrying
+    the protocol's per-rank state mirror
+    (:func:`smi_tpu.parallel.faults.mirror_state_provider`) — the
+    degraded-mode analog of an indefinite device hang becoming a named,
+    debuggable error. Host-side only: under ``jit`` this fires at trace
+    time; compiled re-executions are not re-checked (hard-bound those
+    with ``watchdog.run_with_deadline`` around the readback)."""
+    if deadline is None:
+        return
+    from smi_tpu.parallel.faults import mirror_state_provider
+
+    deadline.with_provider(
+        mirror_state_provider(family, comm.size)
+    ).check(f"ring {family} over {comm.size} ranks")
 
 
 def _ring():
@@ -117,7 +137,7 @@ def _is_root(comm: Communicator, root: int) -> jax.Array:
 
 def bcast(x: jax.Array, comm: Communicator, root: int = 0,
           port: Optional[int] = None, backend: str = "xla",
-          program=None) -> jax.Array:
+          program=None, deadline: Optional[Deadline] = None) -> jax.Array:
     """One-to-all: every rank returns the root's ``x``.
 
     Reference: ``SMI_Bcast`` (``bcast.h:43-63``); the root's support kernel
@@ -128,6 +148,8 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
     ring).
     """
     _check_backend(backend)
+    if backend == "ring":
+        _check_deadline(deadline, "broadcast", comm)
     mask = _is_root(comm, root)
     contrib = jnp.where(mask, x, jnp.zeros_like(x))
     if backend == "ring":
@@ -145,7 +167,7 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
 def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
            root: int = 0, port: Optional[int] = None,
            all_ranks: bool = False, backend: str = "xla",
-           program=None) -> jax.Array:
+           program=None, deadline: Optional[Deadline] = None) -> jax.Array:
     """All-to-one reduction with ADD/MAX/MIN.
 
     Reference: ``SMI_Reduce`` (``reduce.h:18-76``): every rank contributes,
@@ -157,6 +179,8 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
     """
     _check_backend(backend)
     op = SmiOp.parse(op)
+    if backend == "ring":
+        _check_deadline(deadline, "reduce", comm)
     name = _axis(comm)
     if backend == "ring":
         out = _ring().ring_all_reduce(
@@ -177,11 +201,12 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
 
 def allreduce(x: jax.Array, comm: Communicator,
               op: Union[str, SmiOp] = SmiOp.ADD,
-              backend: str = "xla", program=None) -> jax.Array:
+              backend: str = "xla", program=None,
+              deadline: Optional[Deadline] = None) -> jax.Array:
     """Reduce + Bcast in one collective (convenience; no reference analog
     because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``)."""
     return reduce(x, comm, op=op, all_ranks=True, backend=backend,
-                  program=program)
+                  program=program, deadline=deadline)
 
 
 def allreduce_hierarchical(x: jax.Array, comm: Communicator,
@@ -238,7 +263,7 @@ def allreduce_hierarchical(x: jax.Array, comm: Communicator,
 
 def scatter(x: jax.Array, comm: Communicator, root: int = 0,
             port: Optional[int] = None, backend: str = "xla",
-            program=None) -> jax.Array:
+            program=None, deadline: Optional[Deadline] = None) -> jax.Array:
     """Root distributes contiguous slices; rank r returns slice r.
 
     Reference: ``SMI_Scatter`` (``scatter.h:49-72``) — the root splits its
@@ -258,6 +283,8 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
             f"scatter buffer leading dim {x.shape[0]} not divisible by "
             f"comm size {size}"
         )
+    if backend == "ring":
+        _check_deadline(deadline, "scatter", comm)
     contrib = jnp.where(_is_root(comm, root), x, jnp.zeros_like(x))
     if backend == "ring":
         return _ring().ring_reduce_scatter(
@@ -272,7 +299,8 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
 
 def gather(x: jax.Array, comm: Communicator, root: int = 0,
            port: Optional[int] = None, all_ranks: bool = False,
-           backend: str = "xla", program=None) -> jax.Array:
+           backend: str = "xla", program=None,
+           deadline: Optional[Deadline] = None) -> jax.Array:
     """Root collects contiguous slices; returns ``size * count`` at root.
 
     Reference: ``SMI_Gather`` (``gather.h:47-68``) — the root pulls each
@@ -283,6 +311,7 @@ def gather(x: jax.Array, comm: Communicator, root: int = 0,
     """
     _check_backend(backend)
     if backend == "ring":
+        _check_deadline(deadline, "gather", comm)
         out = _ring().ring_all_gather(
             x, _axis(comm), comm.size, interpret=not comm.is_tpu,
             stream=_stream_for(port, program, "gather"),

@@ -36,6 +36,7 @@ from smi_tpu.ops.types import SmiDtype, SmiOp
 from smi_tpu.parallel import collectives as _coll
 from smi_tpu.parallel.channels import P2PChannel, ring_shift
 from smi_tpu.parallel.mesh import Communicator
+from smi_tpu.utils.watchdog import Deadline
 
 
 @dataclasses.dataclass(frozen=True)
@@ -54,6 +55,14 @@ class SmiContext:
     #: or ``"ring"`` (explicit credit-controlled neighbour RDMA,
     #: :mod:`smi_tpu.kernels.ring`).
     backend: str = "xla"
+    #: Watchdog deadline applied to every channel transfer/stream and
+    #: every ring-tier collective dispatched through this context: an
+    #: expired deadline raises ``WatchdogTimeout`` with the protocol's
+    #: per-rank state mirror instead of hanging the job. The checks are
+    #: host-side (dispatch/trace time — compiled re-executions are not
+    #: re-checked); hard-bound blocking execution with
+    #: ``watchdog.run_with_deadline`` (:mod:`smi_tpu.utils.watchdog`).
+    deadline: Optional[Deadline] = None
 
     # -- communicator (include/smi/communicator.h) ---------------------
     def rank(self) -> jax.Array:
@@ -102,14 +111,16 @@ class SmiContext:
     def transfer(self, channel: P2PChannel, data: jax.Array,
                  backend: Optional[str] = None) -> jax.Array:
         """Fused Push(all elements)+Pop: message at dst, zeros elsewhere."""
-        return channel.transfer(data, backend=self._backend(backend))
+        return channel.transfer(data, backend=self._backend(backend),
+                                deadline=self.deadline)
 
     def stream(self, channel: P2PChannel, data: jax.Array,
                consumer: Optional[Callable] = None, init_carry=None,
                backend: Optional[str] = None):
         """Chunked streaming transfer with optional per-chunk consumer."""
         return channel.stream(data, consumer=consumer, init_carry=init_carry,
-                              backend=self._backend(backend))
+                              backend=self._backend(backend),
+                              deadline=self.deadline)
 
     def stream_reduce(self, channel: P2PChannel, data: jax.Array,
                       op="add", lanes: Optional[int] = None,
@@ -117,7 +128,8 @@ class SmiContext:
         """Streamed reduction with ``lanes`` partial accumulators
         (``Reduce.accumulation_lanes`` by default)."""
         return channel.stream_reduce(data, op=op, lanes=lanes,
-                                     backend=self._backend(backend))
+                                     backend=self._backend(backend),
+                                     deadline=self.deadline)
 
     def ring_shift(self, x: jax.Array, offset: int = 1,
                    axis_name: Optional[str] = None) -> jax.Array:
@@ -136,7 +148,7 @@ class SmiContext:
               backend: Optional[str] = None):
         return _coll.bcast(x, self.comm, root=root, port=port,
                            backend=self._backend(backend),
-                           program=self.program)
+                           program=self.program, deadline=self.deadline)
 
     def reduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD, root: int = 0,
                port: Optional[int] = None, all_ranks: bool = False,
@@ -144,26 +156,42 @@ class SmiContext:
         return _coll.reduce(x, self.comm, op=op, root=root, port=port,
                             all_ranks=all_ranks,
                             backend=self._backend(backend),
-                            program=self.program)
+                            program=self.program, deadline=self.deadline)
 
     def allreduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD,
                   backend: Optional[str] = None):
         return _coll.allreduce(x, self.comm, op=op,
                                backend=self._backend(backend),
-                               program=self.program)
+                               program=self.program,
+                               deadline=self.deadline)
 
     def scatter(self, x, root: int = 0, port: Optional[int] = None,
                 backend: Optional[str] = None):
         return _coll.scatter(x, self.comm, root=root, port=port,
                              backend=self._backend(backend),
-                             program=self.program)
+                             program=self.program, deadline=self.deadline)
 
     def gather(self, x, root: int = 0, port: Optional[int] = None,
                all_ranks: bool = False, backend: Optional[str] = None):
         return _coll.gather(x, self.comm, root=root, port=port,
                             all_ranks=all_ranks,
                             backend=self._backend(backend),
-                            program=self.program)
+                            program=self.program, deadline=self.deadline)
+
+    # -- degraded mode -------------------------------------------------
+    def shrink(self, excluded_ranks) -> "SmiContext":
+        """Rebuild this context over the healthy-subset mesh.
+
+        ULFM-style shrinking communicator: after a failure is detected
+        (watchdog timeout, unroutable cut), the job continues on the
+        surviving ranks — see :meth:`Communicator.shrink` for the mesh
+        semantics (survivors keep rank order; the shrunk mesh is 1-D).
+        The program metadata and backend tier carry over; the deadline
+        is NOT carried (a new recovery phase deserves a fresh budget).
+        """
+        return dataclasses.replace(
+            self, comm=self.comm.shrink(excluded_ranks), deadline=None
+        )
 
     # -- MPMD: per-rank divergent local compute ------------------------
     def select(self, branches, operand):
@@ -192,13 +220,16 @@ def smi_kernel(
     program: Optional[Program] = None,
     check_vma: bool = False,
     backend: str = "xla",
+    deadline: Optional[Deadline] = None,
 ):
     """Decorator: run ``fn(ctx, *args)`` per-shard over the communicator.
 
     The TPU analog of launching an SMI kernel with its communicator arg
     (``templates/host_hlslib.cl:87-89`` hands ``SMI_Comm`` to app kernels).
     ``in_specs``/``out_specs`` are ``PartitionSpec``s as for
-    ``jax.shard_map``; defaults replicate.
+    ``jax.shard_map``; defaults replicate. ``deadline`` arms the
+    runtime watchdog on every channel/collective the kernel dispatches
+    (:mod:`smi_tpu.utils.watchdog`).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -210,7 +241,7 @@ def smi_kernel(
     from smi_tpu.parallel.backend import check_backend
 
     ctx = SmiContext(comm=comm, program=program,
-                     backend=check_backend(backend))
+                     backend=check_backend(backend), deadline=deadline)
 
     def decorator(fn: Callable) -> Callable:
         def shard_fn(*args):

@@ -28,6 +28,17 @@ schedules, and demonstrates that with flow control *disabled* the
 simulator catches the clobber — evidence the harness can see the race the
 credits exist to prevent.
 
+Fault injection: the simulator optionally executes under a *fault plan*
+(:mod:`smi_tpu.parallel.faults`) that drops or duplicates credit grants,
+delays DMA completions, crash-stops ranks, and takes links down — the
+unhealthy schedules the reference's strict-depth emulator cannot
+express. The plan is consulted through a narrow hook interface
+(``grant_multiplier`` / ``dma_hold`` / ``stall_after`` / ``link_down``)
+so this module never imports the fault layer; with no plan the simulator
+behaves bit-identically to the healthy fuzzer. Every deadlock now
+carries a per-rank protocol-state dump (:meth:`RingSimulator.state_dump`)
+— the same dump the runtime watchdogs attach to timeout errors.
+
 Concurrent composites (the 4-direction ring halo exchange, the
 burst-interleaved ``stream_concurrent`` schedule) run SEVERAL kernel
 instances per rank; :func:`halo_generators` /
@@ -93,11 +104,43 @@ class ClobberError(ProtocolError):
 
 
 class DeadlockError(ProtocolError):
-    pass
+    """No entity can make progress.
+
+    ``state`` carries the per-rank protocol-state dump taken at the
+    moment of the deadlock (:meth:`RingSimulator.state_dump`), so a
+    failure names *where* every rank stood — the same dump the runtime
+    watchdogs attach to timeout errors."""
+
+    def __init__(self, message: str, state: Optional[dict] = None):
+        super().__init__(message)
+        self.state = state
 
 
 class CreditLeakError(ProtocolError):
     pass
+
+
+def format_state_dump(state: dict) -> str:
+    """Render a :meth:`RingSimulator.state_dump` as indented text."""
+    lines = []
+    for r in sorted(k for k in state if isinstance(k, int)):
+        entry = state[r]
+        pending = entry.get("pending")
+        desc = entry["state"]
+        if pending is not None:
+            desc += f" at {pending}"
+        lines.append(
+            f"  rank {r}: {desc} ({entry['outputs']} outputs)"
+        )
+    if state.get("inflight"):
+        lines.append(f"  in-flight DMAs: {state['inflight']}")
+    if state.get("undeliverable"):
+        lines.append(
+            f"  undeliverable DMAs (down links): {state['undeliverable']}"
+        )
+    if state.get("sems"):
+        lines.append(f"  non-zero semaphores: {state['sems']}")
+    return "\n".join(lines)
 
 
 @dataclasses.dataclass
@@ -555,18 +598,40 @@ class RingSimulator:
     interleavings carry nondeterminism. It shrinks the schedule space
     enough for :func:`explore_all_schedules` to cover tiny configurations
     completely without losing any detectable race.
+
+    ``faults`` is an optional fault plan (duck-typed; the canonical
+    implementation is :class:`smi_tpu.parallel.faults.FaultPlan`)
+    providing four hooks:
+
+    - ``grant_multiplier(rank, nth) -> int`` — 0 drops / 2 duplicates
+      the ``nth`` credit grant signalled by ``rank`` (1 = healthy);
+    - ``dma_hold(src, nth) -> int`` — scheduler events for which the
+      ``nth`` DMA started by ``src`` may not land (delay, never loss:
+      a held DMA becomes landable when nothing else can run);
+    - ``stall_after(rank) -> Optional[int]`` — crash-stop ``rank``
+      after that many executed actions (None = healthy);
+    - ``link_down(a, b) -> bool`` — all traffic between global ranks
+      ``a`` and ``b`` (signals and DMAs, both directions) is lost.
     """
 
     def __init__(self, generators: Sequence[Iterator], strategy: Strategy,
-                 coarse: bool = False):
+                 coarse: bool = False, faults=None):
         self.gens = list(generators)
         self.n = len(self.gens)
         self.strategy = strategy
         self.coarse = coarse
+        self.faults = faults
         self.sems: Dict[Tuple[int, str, int], int] = {}
         self.slots: Dict[Tuple[int, int], _Slot] = {}
         self.inflight: List[Optional[_Dma]] = []
         self.outputs: List[Dict] = [dict() for _ in range(self.n)]
+        # fault bookkeeping: per-rank executed actions / issued credit
+        # grants / started DMAs, per-DMA remaining hold, lost DMAs
+        self.actions_done: List[int] = [0] * self.n
+        self.grants_done: List[int] = [0] * self.n
+        self.dmas_started: List[int] = [0] * self.n
+        self.dma_holds: Dict[int, int] = {}
+        self.undeliverable: List[_Dma] = []
         # (pending_action, value_to_send) per rank; None action = finished
         self.state: List[Optional[Tuple]] = []
         for gen in self.gens:
@@ -587,11 +652,21 @@ class RingSimulator:
     def _slot(self, rank: int, index: int) -> _Slot:
         return self.slots.setdefault((rank, index), _Slot())
 
+    # -- fault hooks --
+    def _stalled(self, r: int) -> bool:
+        if self.faults is None:
+            return False
+        after = self.faults.stall_after(r)
+        return after is not None and self.actions_done[r] >= after
+
+    def _link_down(self, a: int, b: int) -> bool:
+        return self.faults is not None and self.faults.link_down(a, b)
+
     # -- execution --
     def _runnable(self) -> List:
         out = []
         for r, st in enumerate(self.state):
-            if st is None:
+            if st is None or self._stalled(r):
                 continue
             action, _ = st
             if action[0] == "wait":
@@ -600,9 +675,17 @@ class RingSimulator:
                     out.append(("rank", r))
             else:
                 out.append(("rank", r))
+        held = []
         for i, dma in enumerate(self.inflight):
             if dma is not None:
-                out.append(("dma", i))
+                if self.dma_holds.get(i, 0) > 0:
+                    held.append(("dma", i))
+                else:
+                    out.append(("dma", i))
+        if not out and held:
+            # a delayed DMA is slow, never lost: once nothing else can
+            # run, the oldest held copy completes rather than deadlock
+            return held[:1]
         return out
 
     def _advance(self, r: int, value=None) -> None:
@@ -620,7 +703,7 @@ class RingSimulator:
                 return  # dma start is a boundary: its landing must be
                         # schedulable before this rank continues
             st = self.state[r]
-            if st is None:
+            if st is None or self._stalled(r):
                 return
             nxt = st[0]
             if nxt[0] == "wait":
@@ -631,20 +714,44 @@ class RingSimulator:
     def _execute_one(self, r: int) -> None:
         action, _ = self.state[r]
         kind = action[0]
+        self.actions_done[r] += 1
         if kind == "wait":
             _, name, index, amount = action
             self._add(r, name, index, -amount)
             self._advance(r)
         elif kind == "signal":
             _, target, name, index, inc = action
-            self._add(target, name, index, inc)
+            mult = 1
+            if self.faults is not None:
+                if target != r and self._link_down(r, target):
+                    mult = 0  # lost on the dead wire
+                elif name == SEM_CREDIT:
+                    mult = self.faults.grant_multiplier(
+                        r, self.grants_done[r]
+                    )
+            if name == SEM_CREDIT:
+                self.grants_done[r] += 1
+            if mult:
+                self._add(target, name, index, inc * mult)
             self._advance(r)
         elif kind == "dma":
             _, target, slot, payload, send_index, recv_index = action
-            self.inflight.append(
-                _Dma(src=r, target=target, slot=slot, payload=payload,
-                     send_index=send_index, recv_index=recv_index)
-            )
+            dma = _Dma(src=r, target=target, slot=slot, payload=payload,
+                       send_index=send_index, recv_index=recv_index)
+            nth = self.dmas_started[r]
+            self.dmas_started[r] += 1
+            if target != r and self._link_down(r, target):
+                # the wire is dead: neither the remote landing nor the
+                # local send completion ever fires — the writer's
+                # wait(SEM_SEND) is where the loss becomes visible
+                self.undeliverable.append(dma)
+                self._advance(r)
+                return
+            self.inflight.append(dma)
+            if self.faults is not None:
+                hold = self.faults.dma_hold(r, nth)
+                if hold:
+                    self.dma_holds[len(self.inflight) - 1] = hold
             # send completion = source buffer reusable; worst case this is
             # immediate, long before the remote landing
             self._add(r, SEM_SEND, send_index, 1)
@@ -691,17 +798,58 @@ class RingSimulator:
                 return self.outputs
             choices = self._runnable()
             if not choices:
-                blocked = [
-                    (r, st[0]) for r, st in enumerate(self.state)
-                    if st is not None
-                ]
-                raise DeadlockError(f"no runnable entity; blocked: {blocked}")
+                state = self.state_dump()
+                raise DeadlockError(
+                    "no runnable entity; per-rank protocol state:\n"
+                    + format_state_dump(state),
+                    state=state,
+                )
+            if self.dma_holds:
+                # delayed DMAs age one scheduler event per iteration
+                self.dma_holds = {
+                    i: h - 1 for i, h in self.dma_holds.items() if h > 1
+                }
             kind, idx = self.strategy.pick(choices)
             if kind == "rank":
                 self._execute_rank(idx)
             else:
                 self._land_dma(idx)
         raise ProtocolError("simulation did not terminate")
+
+    def state_dump(self) -> Dict:
+        """Per-rank protocol state: what each rank is doing (finished /
+        stalled / blocked-at-wait / runnable), its output count, plus
+        in-flight and lost DMAs and non-zero semaphores. Attached to
+        every :class:`DeadlockError` and surfaced by the runtime
+        watchdogs (:mod:`smi_tpu.utils.watchdog`)."""
+        dump: Dict = {}
+        for r, st in enumerate(self.state):
+            if st is None:
+                dump[r] = {"state": "finished", "pending": None,
+                           "outputs": len(self.outputs[r])}
+                continue
+            action = st[0]
+            if self._stalled(r):
+                state = "stalled"
+            elif action[0] == "wait":
+                _, name, index, amount = action
+                state = (
+                    "blocked"
+                    if self._sem(r, name, index) < amount else "runnable"
+                )
+            else:
+                state = "runnable"
+            dump[r] = {"state": state, "pending": action,
+                       "outputs": len(self.outputs[r])}
+        dump["inflight"] = [
+            (d.src, d.target, d.slot)
+            for d in self.inflight if d is not None
+        ]
+        dump["undeliverable"] = [
+            (d.src, d.target, d.slot) for d in self.undeliverable
+        ]
+        dump["sems"] = {k: v for k, v in self.sems.items() if v != 0}
+        return dump
 
     def _check_drained(self) -> None:
         leaked = {k: v for k, v in self.sems.items() if v != 0}
@@ -772,12 +920,12 @@ def explore_all_schedules(make_generators: Callable[[], Sequence[Iterator]],
 
 
 def simulate_all_gather(n: int, strategy: Strategy,
-                        flow_control: bool = True) -> None:
+                        flow_control: bool = True, faults=None) -> None:
     gens = [
         all_gather_rank(r, n, f"chunk{r}", flow_control=flow_control)
         for r in range(n)
     ]
-    outputs = RingSimulator(gens, strategy).run()
+    outputs = RingSimulator(gens, strategy, faults=faults).run()
     expected = {i: f"chunk{i}" for i in range(n)}
     for r in range(n):
         if outputs[r] != expected:
@@ -787,13 +935,13 @@ def simulate_all_gather(n: int, strategy: Strategy,
 
 
 def simulate_all_reduce(n: int, strategy: Strategy,
-                        flow_control: bool = True) -> None:
+                        flow_control: bool = True, faults=None) -> None:
     gens = [
         all_reduce_rank(r, n, frozenset([r]), lambda a, b: a | b,
                         flow_control=flow_control)
         for r in range(n)
     ]
-    outputs = RingSimulator(gens, strategy).run()
+    outputs = RingSimulator(gens, strategy, faults=faults).run()
     want = frozenset(range(n))
     for r in range(n):
         if outputs[r] != {0: want}:
@@ -801,7 +949,8 @@ def simulate_all_reduce(n: int, strategy: Strategy,
 
 
 def simulate_reduce_scatter(n: int, strategy: Strategy,
-                            flow_control: bool = True) -> None:
+                            flow_control: bool = True,
+                            faults=None) -> None:
     gens = [
         reduce_scatter_rank(
             r, n, [frozenset([(r, b)]) for b in range(n)],
@@ -809,7 +958,7 @@ def simulate_reduce_scatter(n: int, strategy: Strategy,
         )
         for r in range(n)
     ]
-    outputs = RingSimulator(gens, strategy).run()
+    outputs = RingSimulator(gens, strategy, faults=faults).run()
     for r in range(n):
         want = frozenset((src, r) for src in range(n))
         if outputs[r] != {r: want}:
@@ -820,7 +969,8 @@ def simulate_reduce_scatter(n: int, strategy: Strategy,
 
 def simulate_neighbour_stream(n: int, chunks: int, strategy: Strategy,
                               direction: int = 1,
-                              flow_control: bool = True) -> None:
+                              flow_control: bool = True,
+                              faults=None) -> None:
     gens = [
         neighbour_stream_rank(
             r, n, [(r, c) for c in range(chunks)],
@@ -828,7 +978,7 @@ def simulate_neighbour_stream(n: int, chunks: int, strategy: Strategy,
         )
         for r in range(n)
     ]
-    outputs = RingSimulator(gens, strategy).run()
+    outputs = RingSimulator(gens, strategy, faults=faults).run()
     for r in range(n):
         upstream = (r - direction) % n
         want = {c: (upstream, c) for c in range(chunks)}

@@ -1,0 +1,352 @@
+"""Fault injection for the credit protocol: plans, verdicts, mirrors.
+
+Reference gap this fills: the SMI emulator validates the NoC only under
+*healthy* schedules — strict channel depths make races reproduce
+(``CMakeLists.txt:188-191``) but nothing in the reference ever drops a
+credit, stalls a rank, or cuts a link. Production collective stacks
+treat those as table stakes (ULFM-style shrinking communicators in MPI,
+datacenter fabrics routing around failed links), so the TPU port's
+executable protocol spec (:mod:`smi_tpu.parallel.credits`) is extended
+here with a deterministic, seedable :class:`FaultPlan` and a verdict
+harness over all four ring protocols.
+
+Fault classes (the matrix ``tests/test_faults.py`` sweeps):
+
+- **dropped credit grant** — a slot re-grant is lost; the upstream
+  writer waits forever → detected as :class:`~credits.DeadlockError`
+  with a per-rank state dump.
+- **duplicated credit grant** — a surplus credit lets the writer RDMA
+  into a slot the receiver may still be consuming → detected as
+  :class:`~credits.ClobberError`, or (when the schedule dodges the
+  race) as the surplus count at exit, :class:`~credits.CreditLeakError`.
+- **delayed DMA completion** — a copy is slow but not lost →
+  **tolerated**: the credit protocol is correct under arbitrary landing
+  order, delivery stays intact.
+- **stalled rank** — crash-stop after N actions; neighbours block on
+  its barrier/credits → detected as a deadlock whose dump names the
+  stalled rank.
+- **down link** — all traffic between two ranks is lost (signals and
+  DMAs, both directions) → detected as a deadlock at the first
+  wait that needed the dead wire.
+
+The invariant the harness enforces for every cell: the run either
+completes with verified delivery (**tolerated**) or raises a *named*
+invariant violation carrying enough state to debug it (**detected**) —
+never silent corruption. A wrong-output completion is re-raised as
+:class:`SilentCorruption` so no test can accidentally bless it.
+
+:func:`mirror_stall_dump` is the runtime watchdogs' "state-machine
+mirror": for a hung device collective it reports where each rank of the
+matching protocol stands when no remote traffic completes — the
+protocol-level picture a timeout error should carry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, FrozenSet, Optional, Tuple
+
+from smi_tpu.parallel import credits as C
+
+#: The four ring protocols the plan can execute, keyed as the fault
+#: matrix names them. Values: (simulate_fn, kwargs_builder) — see
+#: :func:`run_under_faults`.
+PROTOCOLS = ("all_gather", "all_reduce", "reduce_scatter",
+             "neighbour_stream")
+
+#: Fault classes the matrix is exhaustive over.
+FAULT_CLASSES = ("dropped_grant", "duplicated_grant", "delayed_dma",
+                 "stalled_rank", "down_link")
+
+#: Named invariant violations that count as *detection*. A bare
+#: ProtocolError (wrong delivery) is NOT in this set — that is silent
+#: corruption and fails the matrix.
+DETECTED_ERRORS = (C.ClobberError, C.DeadlockError, C.CreditLeakError)
+
+
+class SilentCorruption(AssertionError):
+    """A faulted run completed but delivered wrong data — the one
+    outcome the fault matrix forbids (on hardware it would be
+    invisible)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DroppedGrant:
+    """Lose the ``nth`` credit grant signalled by ``rank``."""
+
+    rank: int
+    nth: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DuplicatedGrant:
+    """Deliver the ``nth`` credit grant signalled by ``rank`` twice."""
+
+    rank: int
+    nth: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DelayedDma:
+    """Hold the ``nth`` DMA started by ``src`` for ``hold`` scheduler
+    events (slow, never lost: it lands once nothing else can run)."""
+
+    src: int
+    nth: int = 0
+    hold: int = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class StalledRank:
+    """Crash-stop ``rank`` after ``after`` executed actions."""
+
+    rank: int
+    after: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DownLink:
+    """All traffic between ranks ``a`` and ``b`` is lost, both ways."""
+
+    a: int
+    b: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Deterministic fault schedule for one simulator run.
+
+    Implements the hook interface :class:`credits.RingSimulator`
+    consults (``grant_multiplier`` / ``dma_hold`` / ``stall_after`` /
+    ``link_down``). An empty plan is behaviourally identical to
+    ``faults=None`` — the healthy fuzzer.
+    """
+
+    dropped_grants: Tuple[DroppedGrant, ...] = ()
+    duplicated_grants: Tuple[DuplicatedGrant, ...] = ()
+    delayed_dmas: Tuple[DelayedDma, ...] = ()
+    stalled_ranks: Tuple[StalledRank, ...] = ()
+    down_links: FrozenSet[Tuple[int, int]] = frozenset()
+
+    # -- hook interface (credits.RingSimulator) ------------------------
+    def grant_multiplier(self, rank: int, nth: int) -> int:
+        for f in self.dropped_grants:
+            if f.rank == rank and f.nth == nth:
+                return 0
+        for f in self.duplicated_grants:
+            if f.rank == rank and f.nth == nth:
+                return 2
+        return 1
+
+    def dma_hold(self, src: int, nth: int) -> int:
+        for f in self.delayed_dmas:
+            if f.src == src and f.nth == nth:
+                return f.hold
+        return 0
+
+    def stall_after(self, rank: int) -> Optional[int]:
+        for f in self.stalled_ranks:
+            if f.rank == rank:
+                return f.after
+        return None
+
+    def link_down(self, a: int, b: int) -> bool:
+        return (a, b) in self.down_links or (b, a) in self.down_links
+
+    # -- construction ---------------------------------------------------
+    @property
+    def empty(self) -> bool:
+        return not (
+            self.dropped_grants or self.duplicated_grants
+            or self.delayed_dmas or self.stalled_ranks or self.down_links
+        )
+
+    @classmethod
+    def single(cls, fault) -> "FaultPlan":
+        """A plan with exactly one fault."""
+        if isinstance(fault, DroppedGrant):
+            return cls(dropped_grants=(fault,))
+        if isinstance(fault, DuplicatedGrant):
+            return cls(duplicated_grants=(fault,))
+        if isinstance(fault, DelayedDma):
+            return cls(delayed_dmas=(fault,))
+        if isinstance(fault, StalledRank):
+            return cls(stalled_ranks=(fault,))
+        if isinstance(fault, DownLink):
+            return cls(down_links=frozenset({(fault.a, fault.b)}))
+        raise TypeError(f"unknown fault {fault!r}")
+
+    @classmethod
+    def random(cls, fault_class: str, n: int, seed: int) -> "FaultPlan":
+        """One deterministic random fault of ``fault_class`` for an
+        ``n``-ring — the seeded generator the matrix sweeps. The same
+        (class, n, seed) triple always builds the same plan."""
+        rng = random.Random(f"{fault_class}:{n}:{seed}")
+        rank = rng.randrange(n)
+        if fault_class == "dropped_grant":
+            return cls.single(DroppedGrant(rank, nth=rng.randrange(3)))
+        if fault_class == "duplicated_grant":
+            return cls.single(DuplicatedGrant(rank, nth=rng.randrange(3)))
+        if fault_class == "delayed_dma":
+            return cls.single(DelayedDma(
+                rank, nth=rng.randrange(3), hold=rng.randrange(8, 120),
+            ))
+        if fault_class == "stalled_rank":
+            return cls.single(StalledRank(rank, after=rng.randrange(12)))
+        if fault_class == "down_link":
+            return cls.single(DownLink(rank, (rank + 1) % n))
+        raise ValueError(
+            f"unknown fault class {fault_class!r}; "
+            f"known: {FAULT_CLASSES}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Verdicts: run one protocol under one plan, classify the outcome
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Verdict:
+    """Outcome of one fault-matrix cell."""
+
+    kind: str  # "tolerated" | "detected"
+    error: Optional[C.ProtocolError] = None
+
+    @property
+    def tolerated(self) -> bool:
+        return self.kind == "tolerated"
+
+    @property
+    def detected(self) -> bool:
+        return self.kind == "detected"
+
+    @property
+    def error_name(self) -> Optional[str]:
+        return None if self.error is None else type(self.error).__name__
+
+
+def _simulate(protocol: str, n: int, strategy: C.Strategy,
+              plan: Optional[FaultPlan], chunks: int) -> None:
+    if protocol == "all_gather":
+        C.simulate_all_gather(n, strategy, faults=plan)
+    elif protocol == "all_reduce":
+        C.simulate_all_reduce(n, strategy, faults=plan)
+    elif protocol == "reduce_scatter":
+        C.simulate_reduce_scatter(n, strategy, faults=plan)
+    elif protocol == "neighbour_stream":
+        C.simulate_neighbour_stream(n, chunks, strategy, faults=plan)
+    else:
+        raise ValueError(
+            f"unknown protocol {protocol!r}; known: {PROTOCOLS}"
+        )
+
+
+def run_under_faults(
+    protocol: str,
+    n: int,
+    plan: Optional[FaultPlan],
+    strategy: Optional[C.Strategy] = None,
+    chunks: int = 5,
+) -> Verdict:
+    """Execute one ring protocol under a fault plan and classify.
+
+    Returns a *tolerated* verdict only when the run completed AND the
+    harness verified delivery; a *detected* verdict for any named
+    invariant violation (clobber / deadlock / credit leak). A completed
+    run with wrong payloads raises :class:`SilentCorruption` — that
+    outcome must never be classified, it must fail the build.
+    """
+    strategy = strategy if strategy is not None else C.Strategy(0)
+    try:
+        _simulate(protocol, n, strategy, plan, chunks)
+    except DETECTED_ERRORS as e:
+        return Verdict("detected", e)
+    except C.ProtocolError as e:
+        raise SilentCorruption(
+            f"{protocol} n={n} under {plan!r} completed with corrupt "
+            f"delivery: {e}"
+        ) from e
+    return Verdict("tolerated")
+
+
+# ---------------------------------------------------------------------------
+# State-machine mirror for the runtime watchdogs
+# ---------------------------------------------------------------------------
+
+#: Maps a runtime collective family to its protocol state machine.
+FAMILY_PROTOCOL = {
+    "broadcast": "all_reduce",   # bcast rides the masked all-reduce ring
+    "reduce": "all_reduce",
+    "allreduce": "all_reduce",
+    "scatter": "reduce_scatter",
+    "gather": "all_gather",
+    "stream": "neighbour_stream",
+    "transfer": "neighbour_stream",
+}
+
+
+def _protocol_generators(protocol: str, n: int, chunks: int):
+    if protocol == "all_gather":
+        return [C.all_gather_rank(r, n, f"chunk{r}") for r in range(n)]
+    if protocol == "all_reduce":
+        return [
+            C.all_reduce_rank(r, n, frozenset([r]), lambda a, b: a | b)
+            for r in range(n)
+        ]
+    if protocol == "reduce_scatter":
+        return [
+            C.reduce_scatter_rank(
+                r, n, [frozenset([(r, b)]) for b in range(n)],
+                lambda a, b: a | b,
+            )
+            for r in range(n)
+        ]
+    if protocol == "neighbour_stream":
+        return [
+            C.neighbour_stream_rank(r, n, [(r, c) for c in range(chunks)])
+            for r in range(n)
+        ]
+    raise ValueError(f"unknown protocol {protocol!r}; known: {PROTOCOLS}")
+
+
+def mirror_stall_dump(protocol: str, n: int, chunks: int = 4) -> Dict:
+    """Per-rank protocol state when no remote traffic ever completes.
+
+    The watchdogs' state-machine mirror: advance every rank of the
+    named protocol as far as it can go without landing a single DMA,
+    then dump where each stands — the protocol-level silhouette of an
+    indefinite device hang (every rank parked at its first wait that
+    needed the wire). Deterministic; pure Python; cheap enough to build
+    inside an error path.
+    """
+    if protocol in FAMILY_PROTOCOL:
+        protocol = FAMILY_PROTOCOL[protocol]
+    sim = C.RingSimulator(
+        _protocol_generators(protocol, n, chunks), C.Strategy(0)
+    )
+    for _ in range(100_000):
+        ranks = [c for c in sim._runnable() if c[0] == "rank"]
+        if not ranks:
+            break
+        sim._execute_rank(ranks[0][1])
+    return sim.state_dump()
+
+
+def mirror_state_provider(family: str, n: int, chunks: int = 4):
+    """A zero-arg callable producing the formatted mirror dump — the
+    ``state_provider`` shape :mod:`smi_tpu.utils.watchdog` consumes."""
+
+    def provide() -> str:
+        protocol = FAMILY_PROTOCOL.get(family, family)
+        try:
+            dump = mirror_stall_dump(protocol, n, chunks)
+        except Exception as e:  # the mirror must never mask the timeout
+            return f"(state mirror unavailable: {type(e).__name__}: {e})"
+        return (
+            f"protocol mirror [{protocol}, n={n}] with no remote "
+            f"traffic completing:\n" + C.format_state_dump(dump)
+        )
+
+    return provide

@@ -105,6 +105,60 @@ class Communicator:
             mesh=self.mesh, axis_names=tuple(axis_names), topology=self.topology
         )
 
+    def shrink(self, excluded_ranks) -> "Communicator":
+        """Rebuild a healthy-subset communicator without the given ranks.
+
+        The ULFM-style degraded-mode primitive (MPI fault-tolerance
+        extensions' ``MPI_Comm_shrink``): after a failure is detected —
+        a watchdog timeout naming a stalled rank, an unroutable cut from
+        the routing layer — the job continues on the survivors.
+        Survivors keep their relative rank order (the flattened order of
+        this communicator), and the shrunk mesh is 1-D over the default
+        axis: axis structure cannot survive arbitrary holes, and a
+        recovery phase re-deriving a 2-D layout should build a fresh
+        communicator from the surviving devices explicitly.
+
+        The topology (if any) is dropped: its rank numbering no longer
+        matches the shrunk mesh; degraded *routing* keeps the full rank
+        space instead (:class:`smi_tpu.parallel.routing.FailureSet`).
+        """
+        excluded = set(excluded_ranks)
+        size = self.size
+        bad = sorted(r for r in excluded if not (0 <= r < size))
+        if bad:
+            raise ValueError(
+                f"excluded ranks {bad} out of range for comm size {size}"
+            )
+        if len(excluded) >= size:
+            raise ValueError(
+                f"cannot shrink a {size}-rank communicator by "
+                f"{len(excluded)} ranks: no survivors"
+            )
+        if not excluded:
+            return self
+        # flatten devices in this communicator's rank order: transpose
+        # the mesh array to (comm axes..., other axes...) and read the
+        # comm-axes block row-major
+        mesh_names = list(self.mesh.axis_names)
+        order = [mesh_names.index(a) for a in self.axis_names] + [
+            i for i, n in enumerate(mesh_names) if n not in self.axis_names
+        ]
+        flat = np.transpose(self.mesh.devices, order).reshape(self.size, -1)
+        if flat.shape[1] != 1:
+            raise ValueError(
+                "shrink() needs a communicator spanning all mesh axes "
+                f"(mesh axes {tuple(mesh_names)}, comm axes "
+                f"{self.axis_names}); shrink the full communicator and "
+                "rebuild sub-axes from the survivors"
+            )
+        survivors = [
+            flat[r, 0] for r in range(size) if r not in excluded
+        ]
+        mesh = Mesh(
+            np.array(survivors).reshape(len(survivors)), (DEFAULT_AXIS,)
+        )
+        return Communicator(mesh=mesh, axis_names=(DEFAULT_AXIS,))
+
     def program_of_rank(self, rank: int):
         """The program rank ``rank`` runs under MPMD (None if no topology)."""
         if self.topology is None:

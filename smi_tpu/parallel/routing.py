@@ -55,6 +55,68 @@ class NoRouteFound(Exception):
     """No path exists between two devices in the topology graph."""
 
 
+class RouteCutError(NoRouteFound):
+    """A route exists in the healthy topology but the excluded
+    links/devices cut it. ``cut`` names the exclusion set responsible —
+    the reference's static tables have no answer to this (a compiled
+    CKS entry points at a dead wire forever); the TPU layer recomputes
+    around the failure and names the cut when it cannot."""
+
+    def __init__(self, message: str, cut: "FailureSet"):
+        super().__init__(message)
+        self.cut = cut
+
+
+@dataclasses.dataclass(frozen=True)
+class FailureSet:
+    """Failed hardware to route around.
+
+    ``links`` are wire *endpoints* ``(device, link_index)`` — excluding
+    either endpoint takes the whole physical wire down (both directions;
+    a dead QSFP/ICI link is dead both ways). ``devices`` are whole
+    devices: their wires go down and nothing may transit them, but they
+    KEEP their rank slot — table shape and rank numbering must stay
+    stable so healthy ranks' tables remain valid (shrinking the rank
+    space itself is :meth:`Communicator.shrink`'s job).
+    """
+
+    links: frozenset = frozenset()    # of (Device, link_index)
+    devices: frozenset = frozenset()  # of Device
+
+    def __post_init__(self):
+        object.__setattr__(self, "links", frozenset(self.links))
+        object.__setattr__(self, "devices", frozenset(self.devices))
+
+    @property
+    def empty(self) -> bool:
+        return not self.links and not self.devices
+
+    def wire_down(self, a: Link, b: Link) -> bool:
+        """Is the physical wire between endpoints ``a`` and ``b`` down?"""
+        for end in (a, b):
+            if end.device in self.devices:
+                return True
+            if (end.device, end.index) in self.links:
+                return True
+        return False
+
+    def __str__(self) -> str:
+        parts = []
+        if self.links:
+            parts.append(
+                "links {"
+                + ", ".join(
+                    sorted(f"{d}:ch{i}" for d, i in self.links)
+                )
+                + "}"
+            )
+        if self.devices:
+            parts.append(
+                "devices {" + ", ".join(sorted(map(str, self.devices))) + "}"
+            )
+        return " + ".join(parts) if parts else "(none)"
+
+
 @dataclasses.dataclass(frozen=True, order=True)
 class Link:
     """One physical link endpoint of a device."""
@@ -90,6 +152,8 @@ class RoutingContext:
     devices: List[Device]
     links_per_device: int = LINKS_PER_DEVICE
     topology: Optional[Topology] = None
+    #: Failure set this context was built around (None = healthy).
+    excluded: Optional["FailureSet"] = None
 
     def rank_of(self, device: Device) -> int:
         return self.devices.index(device)
@@ -99,13 +163,19 @@ class RoutingContext:
 
 
 def build_routing_context(
-    topology: Topology, links_per_device: int = LINKS_PER_DEVICE
+    topology: Topology,
+    links_per_device: int = LINKS_PER_DEVICE,
+    excluded: Optional[FailureSet] = None,
 ) -> RoutingContext:
     """Build the weighted link graph and solve all-pairs shortest paths.
 
     Inter-device edges come from the topology's connection list; every
     device's links are additionally fully meshed at intra-device cost
     (``routing.py:49-54``) — the analog of the CK interconnect.
+
+    ``excluded`` (a :class:`FailureSet`) builds the *degraded* context:
+    down wires are omitted, down devices lose all edges (no transit) but
+    keep their rank slot so table shapes and rank numbering stay stable.
     """
     graph = networkx.Graph()
     devices = topology.devices
@@ -122,10 +192,16 @@ def build_routing_context(
                     f"device {dev} appears in connections but has no "
                     f"program mapping"
                 )
+        if excluded is not None and excluded.wire_down(
+            Link(src_dev, src_l), Link(dst_dev, dst_l)
+        ):
+            continue
         graph.add_edge(
             Link(src_dev, src_l), Link(dst_dev, dst_l), weight=COST_INTER_DEVICE
         )
     for device in devices:
+        if excluded is not None and device in excluded.devices:
+            continue  # a dead device forwards nothing, not even internally
         for a in range(links_per_device):
             for b in range(a + 1, links_per_device):
                 graph.add_edge(
@@ -135,6 +211,25 @@ def build_routing_context(
     return RoutingContext(
         graph=graph, paths=paths, devices=devices,
         links_per_device=links_per_device, topology=topology,
+        excluded=excluded,
+    )
+
+
+def degraded_context(
+    ctx: RoutingContext, excluded: FailureSet
+) -> RoutingContext:
+    """Rebuild a routing context with a failure set applied.
+
+    Requires the context to carry its topology (contexts built by
+    :func:`build_routing_context` from a parsed topology file do).
+    """
+    if ctx.topology is None:
+        raise ValueError(
+            "degraded routing needs the context's topology; build the "
+            "context with build_routing_context(topology)"
+        )
+    return build_routing_context(
+        ctx.topology, ctx.links_per_device, excluded=excluded
     )
 
 
@@ -186,7 +281,14 @@ def _paths_to_device(
     """All shortest full paths (source link included) from ``link`` to the
     links of ``dst``, deterministically ordered (``routing_table.py:108-122``
     analog; the source stays on the path so device-hop counting matches the
-    reference's ``path_fpga_length``)."""
+    reference's ``path_fpga_length``).
+
+    In a degraded context (``ctx.excluded``) a missing route is
+    classified: if the *healthy* topology routes the pair, the failure
+    set is the cause and a :class:`RouteCutError` names it; only a
+    topology that never routed the pair raises plain
+    :class:`NoRouteFound`.
+    """
     routes = ctx.paths.get(link, {})
     found = [
         path
@@ -194,6 +296,20 @@ def _paths_to_device(
         if target.device == dst and len(path) > 1
     ]
     if not found:
+        if ctx.excluded is not None and ctx.topology is not None:
+            healthy = build_routing_context(
+                ctx.topology, ctx.links_per_device
+            )
+            try:
+                _paths_to_device(healthy, link, dst)
+            except NoRouteFound:
+                pass  # never routable: not the cut's fault
+            else:
+                raise RouteCutError(
+                    f"no route from {link} to {dst}: the failure set "
+                    f"[{ctx.excluded}] cuts every path",
+                    cut=ctx.excluded,
+                )
         raise NoRouteFound(f"no route from {link} to {dst}")
     found.sort(key=lambda p: (len(p), [(l.device.key, l.index) for l in p]))
     return found
@@ -218,7 +334,8 @@ def _exit_link(link: Link, path: Sequence[Link]) -> Link:
 
 
 def egress_tables(
-    device: Device, ctx: RoutingContext, program: Program
+    device: Device, ctx: RoutingContext, program: Program,
+    excluded: Optional[FailureSet] = None,
 ) -> Dict[Link, EgressTable]:
     """Build the per-link egress tables for one device, two-pass.
 
@@ -229,7 +346,15 @@ def egress_tables(
     allocated to each link's outgoing streams, re-decide among all routes
     that are equally short in *device* hops, picking the least-occupied
     exit link — spreading traffic across the device's wires.
+
+    ``excluded`` computes *degraded-mode* tables: routes avoid the
+    failed links/devices when a path exists, and a destination the
+    failure set cuts off raises :class:`RouteCutError` naming the cut —
+    a place the TPU design is strictly stronger than the reference,
+    whose compiled static tables cannot reroute at all.
     """
+    if excluded is not None and not excluded.empty:
+        ctx = degraded_context(ctx, excluded)
     _check_stream_count(ctx, program)
     n_ranks = len(ctx.devices)
     n_ports = program.logical_port_count
@@ -311,7 +436,8 @@ class IngressTable:
 
 
 def ingress_table(
-    link: Link, ctx: RoutingContext, program: Program
+    link: Link, ctx: RoutingContext, program: Program,
+    excluded: Optional[FailureSet] = None,
 ) -> IngressTable:
     """Build one link's ingress table.
 
@@ -320,7 +446,21 @@ def ingress_table(
     with no local consumer); 1 + sibling = forward to a sibling link's
     ingress; ``links_per_device + j`` = deliver to the j-th local op slot
     served by this link.
+
+    Ingress delivery is intra-device (the CK interconnect, not a
+    physical wire), so a failure set cannot change the entries — but a
+    table for a link or device the set declares dead is a contradiction
+    the caller should hear about, not a silently valid artifact.
     """
+    if excluded is not None and (
+        link.device in excluded.devices
+        or (link.device, link.index) in excluded.links
+    ):
+        raise RouteCutError(
+            f"ingress table requested for {link}, which the failure set "
+            f"[{excluded}] declares down",
+            cut=excluded,
+        )
     _check_stream_count(ctx, program)
     n = ctx.links_per_device
     consumers: Dict[Tuple[int, str], int] = {}
@@ -400,6 +540,82 @@ def write_routing_tables(
                 f.write(
                     serialize_table(ingress_table(link, ctx, program).flat())
                 )
+
+
+def check_all_pairs_routable(
+    ctx: RoutingContext, devices: Optional[Sequence[Device]] = None
+) -> None:
+    """Assert every (src link, dst) pair among ``devices`` routes.
+
+    The same granularity table building demands: every link of every
+    source must reach every destination. Raises :class:`RouteCutError`
+    (naming the cut) when the context's failure set severs a pair, or
+    plain :class:`NoRouteFound` when the topology never routed it —
+    the public surface behind ``python -m smi_tpu route --check``.
+    ``devices`` defaults to all of the context's devices; pass the
+    healthy subset to validate a degraded context whose down devices
+    are expected to be unreachable.
+    """
+    devices = ctx.devices if devices is None else list(devices)
+    for src in devices:
+        for dst in devices:
+            if src == dst:
+                continue
+            for link in ctx.links(src):
+                _paths_to_device(ctx, link, dst)
+
+
+def grid_topology(
+    nrow: int,
+    ncol: int,
+    wrap: bool = True,
+    program: Optional[Program] = None,
+) -> Topology:
+    """Build an ``nrow x ncol`` grid/torus topology (1-D ring when
+    ``nrow == 1``).
+
+    Link convention per device: 0 = east, 1 = west, 2 = south,
+    3 = north — each physical endpoint used exactly once, matching the
+    topology-file invariant. ``wrap`` closes each row/column into a
+    ring, the ICI-torus shape the degraded-routing property tests cut
+    links out of. All devices run ``program`` (default: a minimal
+    Push/Pop pair), mirroring the SPMD common case.
+    """
+    from smi_tpu.ops.operations import Pop, Push
+    from smi_tpu.ops.program import ProgramMapping
+
+    if nrow < 1 or ncol < 1:
+        raise ValueError(f"grid must be >= 1x1, got {nrow}x{ncol}")
+    if program is None:
+        program = Program([Push(0), Pop(0)])
+    devices = {
+        (r, c): Device(node=f"node-{r}-{c}", index=0)
+        for r in range(nrow)
+        for c in range(ncol)
+    }
+    connections: Dict[Tuple[Device, int], Tuple[Device, int]] = {}
+
+    def wire(a: Device, la: int, b: Device, lb: int) -> None:
+        connections[(a, la)] = (b, lb)
+        connections[(b, lb)] = (a, la)
+
+    for r in range(nrow):
+        for c in range(ncol):
+            if ncol > 1:
+                if c + 1 < ncol:
+                    wire(devices[(r, c)], 0, devices[(r, c + 1)], 1)
+                elif wrap:
+                    wire(devices[(r, c)], 0, devices[(r, 0)], 1)
+            if nrow > 1:
+                if r + 1 < nrow:
+                    wire(devices[(r, c)], 2, devices[(r + 1, c)], 3)
+                elif wrap:
+                    wire(devices[(r, c)], 2, devices[(0, c)], 3)
+    mapping = ProgramMapping(
+        programs=[program],
+        device_to_program={d: program for d in devices.values()},
+    )
+    return Topology(connections=connections, mapping=mapping)
 
 
 def egress_link_toward(

@@ -29,6 +29,67 @@ from typing import Optional
 #: scoped-VMEM ceiling (KiB) for TPU compiles — see module docstring
 SCOPED_VMEM_KIB = 64 * 1024
 
+
+def install_jax_compat() -> None:
+    """Bridge the pinned JAX to the API surface the framework targets.
+
+    The framework is written against ``jax.shard_map(...,
+    check_vma=...)``; older JAX ships it as
+    ``jax.experimental.shard_map.shard_map(..., check_rep=...)``. When
+    the top-level name is missing, install a signature-adapting alias so
+    every call site (and user code following the README) works on both.
+    Idempotent; called once from ``smi_tpu.__init__``.
+    """
+    import jax
+
+    if getattr(jax, "shard_map", None) is not None:
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+    except ImportError:  # pragma: no cover - no known JAX lacks both
+        return
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kwargs,
+        )
+
+    shard_map.__doc__ = _shard_map.__doc__
+    jax.shard_map = shard_map
+
+
+def pallas_compiler_params(**kwargs):
+    """Version-compat constructor for Pallas TPU compiler params.
+
+    JAX renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``
+    (and grew fields like ``has_side_effects``) across the versions this
+    framework spans; the pinned JAX has only the old name. Every Pallas
+    call site builds its params through this shim, which picks whichever
+    class exists and drops only the known-safe-to-drop fields when the
+    class predates them (``has_side_effects`` suppresses elision of
+    kernels whose outputs go unused; every framework kernel's outputs
+    are consumed, so older JAX without the field behaves identically).
+    Any other unknown keyword is an error — a typo must not silently
+    compile with default semantics.
+    """
+    import dataclasses
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    droppable = {"has_side_effects"}
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(kwargs) - known - droppable
+    if unknown:
+        raise TypeError(
+            f"unknown Pallas compiler param(s) {sorted(unknown)}; "
+            f"{cls.__name__} accepts {sorted(known)}"
+        )
+    return cls(**{k: v for k, v in kwargs.items() if k in known})
+
 TPU_COMPILER_OPTIONS = {
     "xla_tpu_scoped_vmem_limit_kib": str(SCOPED_VMEM_KIB),
 }

@@ -31,11 +31,16 @@ def trace(log_dir: str, host_tracer_level: Optional[int] = None) -> Iterator[Non
     View with TensorBoard's profile plugin or xprof. ``host_tracer_level``
     is forwarded to the profiler options when given.
     """
-    options = None
-    if host_tracer_level is not None:
+    kwargs = {}
+    if host_tracer_level is not None and hasattr(
+        jax.profiler, "ProfileOptions"
+    ):
+        # older JAX has neither ProfileOptions nor the
+        # profiler_options= kwarg — trace with defaults there
         options = jax.profiler.ProfileOptions()
         options.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(log_dir, profiler_options=options)
+        kwargs["profiler_options"] = options
+    jax.profiler.start_trace(log_dir, **kwargs)
     try:
         yield
     finally:
@@ -52,7 +57,11 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
-def timed(fn: Callable[[], Any]) -> Tuple[Any, float]:
+def timed(
+    fn: Callable[[], Any],
+    deadline_s: Optional[float] = None,
+    state_provider: Optional[Callable[[], str]] = None,
+) -> Tuple[Any, float]:
     """Run ``fn`` and return (result, elapsed seconds).
 
     Completion is forced with a host readback of every array leaf (not
@@ -60,10 +69,31 @@ def timed(fn: Callable[[], Any]) -> Tuple[Any, float]:
     execution finishes — see ``smi_tpu.benchmarks.stats``), so on-device
     async dispatch doesn't fake a fast time — the role of the reference's
     event-completion waits.
+
+    ``deadline_s`` arms a hard watchdog
+    (:func:`smi_tpu.utils.watchdog.run_with_deadline`): an indefinite
+    device hang becomes a ``WatchdogTimeout`` — carrying the
+    ``state_provider``'s protocol-state dump when one is given (e.g.
+    :func:`smi_tpu.parallel.faults.mirror_state_provider`) — instead of
+    a stuck host. Defaults to ``$SMI_WATCHDOG_SECS`` when unset.
     """
     import numpy as np
 
+    from smi_tpu.utils import watchdog as _watchdog
+
+    if deadline_s is None:
+        default = _watchdog.default_deadline()
+        deadline_s = default.budget if default is not None else None
+
+    # fn() runs in THIS thread (it may trace, and JAX trace contexts
+    # are thread-local); only the blocking readback — the sync point an
+    # indefinite device hang actually parks on — crosses into the
+    # watchdog worker
     t0 = time.perf_counter()
     result = fn()
-    jax.tree_util.tree_map(np.asarray, result)
+    _watchdog.run_with_deadline(
+        lambda: jax.tree_util.tree_map(np.asarray, result),
+        deadline_s, state_provider=state_provider,
+        context="timed() readback",
+    )
     return result, time.perf_counter() - t0

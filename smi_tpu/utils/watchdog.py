@@ -1,0 +1,202 @@
+"""Runtime watchdogs: deadlines that turn indefinite hangs into errors.
+
+The reference guards every test collective with a detached-thread
+duration assert (``test/p2p/test_p2p.cpp:30-42`` — hang ⇒ abort); the
+framework's own test tier keeps that behaviour (``tests/conftest.py``).
+This module is the *runtime* analog for production entry points: a
+:class:`Deadline` is threaded through channel transfers and ring-tier
+collective dispatch, and :func:`run_with_deadline` hard-bounds
+host-side blocking work (execution + readback, e.g.
+:func:`smi_tpu.utils.tracing.timed`).
+
+What a deadline can and cannot interrupt, honestly stated:
+
+- **dispatch-level checks** (``Deadline.check`` between collective
+  launches / ring hops / stream bursts) are cooperative — they fire at
+  the next host-side step, converting a stuck multi-hop pipeline into
+  an early, named timeout instead of a silent stall;
+- **hard watchdogs** (:func:`run_with_deadline`) run the blocking call
+  in a worker thread and abandon it on expiry. The XLA call cannot be
+  cancelled — the worker leaks until the runtime returns — but the
+  caller gets a :class:`WatchdogTimeout` instead of hanging forever,
+  which is what CI and launch scripts need.
+
+Every timeout carries a *state dump* when a provider is given; the ring
+tier wires :func:`smi_tpu.parallel.faults.mirror_state_provider` in, so
+a hung collective reports the per-rank protocol state of its credit
+state machine — which wait each rank parks at when no remote traffic
+completes — rather than a bare "timed out".
+
+No JAX import here: the module is usable from the pure-Python protocol
+layer and from test tooling alike.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+#: Environment knob: a default watchdog budget (seconds) applied when
+#: callers construct :func:`default_deadline`. Unset/empty = no default
+#: watchdog (zero overhead on the healthy path).
+WATCHDOG_ENV = "SMI_WATCHDOG_SECS"
+
+
+class WatchdogTimeout(TimeoutError):
+    """A deadline expired; carries the protocol-state dump if known.
+
+    ``state_dump`` is the formatted per-rank dump (or None); ``elapsed``
+    and ``budget`` are seconds.
+    """
+
+    def __init__(self, message: str, state_dump: Optional[str] = None,
+                 elapsed: Optional[float] = None,
+                 budget: Optional[float] = None):
+        if state_dump:
+            message = f"{message}\n{state_dump}"
+        super().__init__(message)
+        self.state_dump = state_dump
+        self.elapsed = elapsed
+        self.budget = budget
+
+
+class Deadline:
+    """A monotonic time budget shared across the steps of one operation.
+
+    Construct once at the entry point, pass down: each dispatch step
+    calls :meth:`check` (or reads :meth:`remaining` for a blocking
+    wait's own timeout). ``state_provider`` is a zero-arg callable
+    returning the dump to attach on expiry (e.g.
+    ``faults.mirror_state_provider("reduce", n)``).
+    """
+
+    def __init__(self, seconds: Optional[float],
+                 state_provider: Optional[Callable[[], str]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if seconds is not None and seconds < 0:
+            raise ValueError(f"deadline must be >= 0, got {seconds}")
+        self.budget = seconds
+        self.state_provider = state_provider
+        self._clock = clock
+        self._start = clock()
+
+    def elapsed(self) -> float:
+        return self._clock() - self._start
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left (None = unbounded; never negative)."""
+        if self.budget is None:
+            return None
+        return max(0.0, self.budget - self.elapsed())
+
+    def expired(self) -> bool:
+        return self.budget is not None and self.elapsed() >= self.budget
+
+    def _dump(self) -> Optional[str]:
+        if self.state_provider is None:
+            return None
+        try:
+            return self.state_provider()
+        except Exception as e:  # the dump must never mask the timeout
+            return f"(state dump unavailable: {type(e).__name__}: {e})"
+
+    def check(self, context: str = "") -> None:
+        """Raise :class:`WatchdogTimeout` if the budget is spent."""
+        if not self.expired():
+            return
+        where = f" during {context}" if context else ""
+        raise WatchdogTimeout(
+            f"deadline of {self.budget:.3g}s exceeded{where} "
+            f"(elapsed {self.elapsed():.3g}s)",
+            state_dump=self._dump(),
+            elapsed=self.elapsed(), budget=self.budget,
+        )
+
+    def with_provider(self, state_provider: Callable[[], str]) -> "Deadline":
+        """Same running clock, different dump source — lets inner layers
+        attach their own protocol mirror without restarting the budget."""
+        d = Deadline.__new__(Deadline)
+        d.budget = self.budget
+        d.state_provider = state_provider
+        d._clock = self._clock
+        d._start = self._start
+        return d
+
+
+def default_deadline(
+    state_provider: Optional[Callable[[], str]] = None,
+) -> Optional[Deadline]:
+    """A :class:`Deadline` from ``$SMI_WATCHDOG_SECS``, or None.
+
+    Unset, empty, and non-positive values all mean "no watchdog" —
+    ``SMI_WATCHDOG_SECS=0`` is off, not an instantly-expired budget.
+    """
+    raw = os.environ.get(WATCHDOG_ENV, "").strip()
+    if not raw:
+        return None
+    seconds = float(raw)
+    if seconds <= 0:
+        return None
+    return Deadline(seconds, state_provider=state_provider)
+
+
+def run_with_deadline(
+    fn: Callable[[], Any],
+    seconds: Optional[float],
+    state_provider: Optional[Callable[[], str]] = None,
+    context: str = "",
+) -> Any:
+    """Run ``fn()`` with a hard time budget.
+
+    The call runs in a daemon worker thread; on expiry the caller gets
+    a :class:`WatchdogTimeout` (with the state dump) while the worker is
+    abandoned — a hung XLA call cannot be cancelled from Python, but the
+    host stops waiting on it. ``seconds=None`` runs inline (no thread,
+    no overhead). Exceptions from ``fn`` propagate unchanged.
+
+    NOTE: do not wrap *tracing* in this — JAX trace contexts are
+    thread-local. Wrap the blocking *execution/readback* step (that is
+    what :func:`smi_tpu.utils.tracing.timed` does).
+
+    The worker is a *daemon* thread on purpose: a non-daemon thread (or
+    a ThreadPoolExecutor worker) is joined at interpreter exit, so an
+    abandoned hung call would stall process shutdown — the exact hang
+    the watchdog exists to bound.
+    """
+    if seconds is None:
+        return fn()
+    results: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def worker() -> None:
+        try:
+            results.put(("ok", fn()))
+        except BaseException as e:  # deliver, don't die silently
+            results.put(("err", e))
+
+    start = time.monotonic()
+    thread = threading.Thread(
+        target=worker, name="smi-watchdog-worker", daemon=True
+    )
+    thread.start()
+    try:
+        kind, value = results.get(timeout=seconds)
+    except queue.Empty:
+        dump = None
+        if state_provider is not None:
+            try:
+                dump = state_provider()
+            except Exception as e:
+                dump = f"(state dump unavailable: {type(e).__name__}: {e})"
+        where = f" during {context}" if context else ""
+        raise WatchdogTimeout(
+            f"hard watchdog of {seconds:.3g}s exceeded{where} — the "
+            f"device call did not complete (worker thread abandoned)",
+            state_dump=dump,
+            elapsed=time.monotonic() - start, budget=seconds,
+        ) from None
+    if kind == "err":
+        raise value
+    return value

@@ -39,6 +39,13 @@ _tpu_tier = _opted_in("SMI_TPU_RUN_TPU_TESTS")
 _aot_tier = _opted_in("SMI_TPU_RUN_AOT_TESTS")
 if not _tpu_tier:
     os.environ["JAX_PLATFORMS"] = "cpu"
+if not _tpu_tier and not _aot_tier:
+    # emulator tier: AOT topology lookups must fail FAST. With libtpu
+    # installed but no TPU attached, the topology client can spin for
+    # minutes holding the GIL, which stalls the whole suite — the
+    # aot-touching tests expect a quick raise and skip themselves
+    # (see smi_tpu.parallel.aot.topology_devices).
+    os.environ.setdefault("SMI_TPU_DISABLE_AOT_TOPOLOGY", "1")
 
 import jax  # noqa: E402
 

@@ -132,10 +132,10 @@ def test_aot_detects_mosaic_rejection(topology_ok):
     import jax.numpy as jnp
     import numpy as np
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from smi_tpu.parallel import aot
+    from smi_tpu.utils.compile import pallas_compiler_params
 
     devs = np.array(aot.topology_devices()).reshape(8)
     mesh = Mesh(devs, ("x",))
@@ -147,7 +147,7 @@ def test_aot_detects_mosaic_rejection(topology_ok):
         return pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
-            compiler_params=pltpu.CompilerParams(collective_id=1),
+            compiler_params=pallas_compiler_params(collective_id=1),
         )(x)
 
     f = jax.jit(

@@ -5,6 +5,7 @@ import pytest
 
 from smi_tpu.parallel.bootstrap import (
     DistributedOptions,
+    HostfileError,
     distributed_options,
     init_distributed,
     parse_hostfile,
@@ -50,3 +51,76 @@ def test_process_id_range_checked():
 def test_init_distributed_single_process_noop():
     # must not call jax.distributed.initialize (which would block)
     init_distributed(DistributedOptions("solo:8476", 1, 0))
+
+
+# ---------------------------------------------------------------------
+# strict hostfile validation (robustness tier; retry/backoff behaviour
+# is covered in tests/test_faults.py)
+# ---------------------------------------------------------------------
+
+
+def test_parse_hostfile_crlf_and_trailing_whitespace():
+    text = "node-a  # node-a:0, rank0\r\nnode-b\t \r\n"
+    assert parse_hostfile(text) == ["node-a", "node-b"]
+
+
+def test_parse_hostfile_comments_only_rejected():
+    with pytest.raises(HostfileError, match="no nodes"):
+        parse_hostfile("# a comment\n   \n# another\n")
+
+
+def test_parse_hostfile_empty_rejected():
+    with pytest.raises(HostfileError, match="no nodes"):
+        parse_hostfile("")
+
+
+def test_parse_hostfile_duplicate_rank_rejected():
+    text = (
+        "node-a  # node-a:0, rank0\n"
+        "node-b  # node-b:0, rank1\n"
+        "node-c  # node-c:0, rank1\n"
+    )
+    with pytest.raises(HostfileError, match=r"rank\(s\) \[1\]"):
+        parse_hostfile(text)
+
+
+def test_parse_hostfile_noncontiguous_ranks_rejected():
+    # a hole in the rank numbering necessarily puts some rank out of
+    # range (distinct + bounded ⇒ contiguous), so the range check
+    # rejects it
+    text = "node-a  # rank0\nnode-b  # rank2\n"
+    with pytest.raises(HostfileError, match="out of range"):
+        parse_hostfile(text)
+
+
+def test_parse_hostfile_partial_annotation_out_of_range_rejected():
+    # even with only SOME lines annotated, an impossible rank (here 7
+    # in a 2-rank file — a mangled hand edit) must be rejected
+    with pytest.raises(HostfileError, match="out of range"):
+        parse_hostfile("node-a  # rank7\nnode-b\n")
+
+
+def test_parse_hostfile_two_tokens_rejected():
+    with pytest.raises(HostfileError, match="one node name"):
+        parse_hostfile("node-a node-b\n")
+
+
+def test_parse_hostfile_free_text_comments_not_rank_annotations():
+    # a comment word merely ENDING in "rank<digits>" is prose, not an
+    # annotation — must not trip the range/duplicate checks
+    assert parse_hostfile("node-a  # crank 7\nnode-b  # shrank 9\n") == [
+        "node-a", "node-b",
+    ]
+
+
+def test_parse_hostfile_unannotated_lines_still_parse():
+    # hand-written hostfiles without rank comments stay legal
+    assert parse_hostfile("node-a\nnode-b\nnode-a\n") == [
+        "node-a", "node-b", "node-a",
+    ]
+
+
+def test_hostfile_error_is_a_valueerror():
+    # callers catching the historical ValueError keep working
+    with pytest.raises(ValueError):
+        parse_hostfile("")

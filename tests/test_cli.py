@@ -207,6 +207,89 @@ def test_route_missing_topology_fails(tmp_path, capsys):
     assert "error:" in capsys.readouterr().err
 
 
+@pytest.fixture()
+def ring_topo(tmp_path):
+    topo = tmp_path / "ring.json"
+    assert run_cli("topology", "-n", "4", "-p", "app", "--ring",
+                   "-f", str(topo)) == 0
+    return topo
+
+
+def test_route_check_healthy_ring(ring_topo, capsys):
+    assert run_cli("route", str(ring_topo), "--check") == 0
+    assert "routes: ok" in capsys.readouterr().out
+
+
+def test_route_check_single_cut_reroutes(ring_topo, capsys):
+    # a ring survives one cut wire: the long way around remains
+    assert run_cli("route", str(ring_topo), "--check",
+                   "--down", "device-0:0:ch0") == 0
+    out = capsys.readouterr().out
+    assert "routable around" in out
+
+
+def test_route_check_partition_fails_naming_cut(ring_topo, capsys):
+    # two cuts partition a ring: fail fast, name the cut
+    assert run_cli("route", str(ring_topo), "--check",
+                   "--down", "device-0:0:ch0",
+                   "--down", "device-2:0:ch0") == 1
+    out = capsys.readouterr().out
+    assert "routes: FAIL" in out and "device-0:0:ch0" in out
+
+
+def test_route_check_down_device_routed_around(ring_topo, capsys):
+    assert run_cli("route", str(ring_topo), "--check",
+                   "--down", "device-1:0") == 0
+    assert "3 devices" in capsys.readouterr().out
+
+
+def test_route_check_unknown_down_device(ring_topo, capsys):
+    assert run_cli("route", str(ring_topo), "--check",
+                   "--down", "ghost-9:0") == 1
+    assert "not in" in capsys.readouterr().err
+
+
+def test_route_check_validates_hostfile(tmp_path, ring_topo, capsys):
+    good = tmp_path / "hostfile"
+    good.write_text("".join(
+        f"device-{i}  # device-{i}:0, rank{i}\n" for i in range(4)
+    ))
+    assert run_cli("route", str(ring_topo), "--check",
+                   "--hostfile", str(good)) == 0
+    assert "hostfile: ok" in capsys.readouterr().out
+
+    bad = tmp_path / "bad-hostfile"
+    bad.write_text("device-0\ndevice-0\n")
+    assert run_cli("route", str(ring_topo), "--check",
+                   "--hostfile", str(bad)) == 1
+    assert "hostfile: FAIL" in capsys.readouterr().out
+
+
+def test_route_without_dest_dir_requires_check(ring_topo, capsys):
+    assert run_cli("route", str(ring_topo)) == 2
+    assert "dest_dir" in capsys.readouterr().err
+
+
+def test_route_check_flags_require_check(tmp_path, ring_topo, capsys):
+    assert run_cli("route", str(ring_topo), str(tmp_path / "out"),
+                   "--down", "device-0:0:ch0") == 2
+    assert "--check" in capsys.readouterr().err
+
+
+def test_route_check_second_positional_is_metadata(tmp_path, ring_topo,
+                                                   capsys):
+    # under --check the optional dest_dir slot is really metadata; a
+    # program JSON given there must be used, not silently dropped
+    meta = tmp_path / "app.json"
+    with open(os.path.join(DATA_DIR, "cli-program.json"), "rb") as f:
+        meta.write_bytes(f.read())
+    assert run_cli("route", str(ring_topo), str(meta), "--check") == 0
+    assert "routes: ok" in capsys.readouterr().out
+    # and a bogus path fails loudly instead of validating program-less
+    assert run_cli("route", str(ring_topo), str(tmp_path / "ghost.json"),
+                   "--check") == 1
+
+
 def test_host_duplicate_program_name(tmp_path, capsys):
     a = tmp_path / "app.json"
     b = tmp_path / "sub" / "app.json"

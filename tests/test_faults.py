@@ -1,0 +1,519 @@
+"""Fault-injection matrix, watchdogs, and degraded-mode routing.
+
+The robustness tier: every ring protocol × every fault class × several
+seeds must end *tolerated* (completed with verified delivery) or
+*detected* (a named invariant violation carrying a per-rank state dump)
+— never silent corruption (``faults.SilentCorruption`` fails the cell).
+Plus: the runtime watchdog layer (``utils/watchdog``), the
+retry/backoff control plane (``parallel/bootstrap``), and
+routing-around-failure property tests on 1-D/2-D tori.
+
+Pure Python end to end — no JAX device execution — so the whole tier is
+fast enough to live inside the tier-1 ``-m 'not slow'`` selection.
+"""
+
+import pytest
+
+from smi_tpu.parallel import credits as C
+from smi_tpu.parallel import faults as F
+from smi_tpu.utils import watchdog as W
+
+pytestmark = pytest.mark.faults
+
+SEEDS = range(4)
+NS = [2, 3, 5]
+
+
+# ---------------------------------------------------------------------------
+# The exhaustive fault matrix: protocols x fault classes x seeds
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+@pytest.mark.parametrize("fault_class", F.FAULT_CLASSES)
+@pytest.mark.parametrize("n", NS)
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fault_matrix_cell(protocol, fault_class, n, seed):
+    """Every cell ends tolerated or detected-with-a-name; a cell that
+    completed with corrupt delivery raises SilentCorruption and fails.
+    The verdict is deterministic per (protocol, fault_class, n, seed)."""
+    plan = F.FaultPlan.random(fault_class, n, seed)
+    verdict = F.run_under_faults(protocol, n, plan, C.Strategy(seed))
+    assert verdict.kind in ("tolerated", "detected")
+    again = F.run_under_faults(protocol, n, plan, C.Strategy(seed))
+    assert (verdict.kind, verdict.error_name) == (again.kind, again.error_name)
+    if verdict.detected:
+        assert verdict.error_name in (
+            "ClobberError", "DeadlockError", "CreditLeakError"
+        )
+        if isinstance(verdict.error, C.DeadlockError):
+            # the detection names where every rank stood
+            assert verdict.error.state is not None
+            assert "rank 0" in str(verdict.error)
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+@pytest.mark.parametrize("n", NS)
+@pytest.mark.parametrize("seed", SEEDS)
+def test_delayed_dma_always_tolerated(protocol, n, seed):
+    """Delay is not loss: the credit protocol is proven correct under
+    arbitrary landing order, so a slow DMA must never break delivery."""
+    plan = F.FaultPlan.random("delayed_dma", n, seed)
+    verdict = F.run_under_faults(protocol, n, plan, C.Strategy(seed))
+    assert verdict.tolerated
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+@pytest.mark.parametrize("n", [3, 5])
+def test_first_grant_drop_deadlocks(protocol, n):
+    """Dropping the very first credit grant of rank 0 starves its
+    upstream writer on every protocol — deterministically detected as a
+    deadlock whose dump shows the blocked wait."""
+    plan = F.FaultPlan.single(F.DroppedGrant(0, nth=0))
+    for seed in SEEDS:
+        verdict = F.run_under_faults(protocol, n, plan, C.Strategy(seed))
+        assert verdict.detected
+        assert isinstance(verdict.error, C.DeadlockError)
+        assert "blocked" in str(verdict.error)
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+def test_duplicated_grant_never_silent(protocol):
+    """A surplus credit must surface as a clobber (the race it enables)
+    or as the leaked count at exit — across many schedules, never as a
+    clean pass with wrong data."""
+    plan = F.FaultPlan.single(F.DuplicatedGrant(1, nth=0))
+    kinds = set()
+    for seed in range(12):
+        for strat in (C.Strategy(seed), C.DelayDmaStrategy(seed),
+                      C.FavourRankStrategy(1, seed)):
+            verdict = F.run_under_faults(protocol, 4, plan, strat)
+            if verdict.detected:
+                kinds.add(verdict.error_name)
+    assert kinds <= {"ClobberError", "CreditLeakError", "DeadlockError"}
+    assert kinds  # the fault is visible under at least one schedule
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+@pytest.mark.parametrize("n", [3, 4])
+def test_stalled_rank_detected_with_dump(protocol, n):
+    """A crash-stopped rank must deadlock its neighbours; the dump names
+    the stalled rank so an operator knows whom to shrink away."""
+    plan = F.FaultPlan.single(F.StalledRank(1, after=0))
+    verdict = F.run_under_faults(protocol, n, plan, C.Strategy(0))
+    assert verdict.detected
+    assert isinstance(verdict.error, C.DeadlockError)
+    assert verdict.error.state[1]["state"] == "stalled"
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+@pytest.mark.parametrize("n", [3, 5])
+def test_down_link_detected(protocol, n):
+    """A dead wire between ring neighbours starves the barrier/credit
+    exchange — detected as a deadlock on every seed, with any lost DMAs
+    listed as undeliverable in the dump."""
+    plan = F.FaultPlan.single(F.DownLink(0, 1))
+    for seed in SEEDS:
+        verdict = F.run_under_faults(protocol, n, plan, C.Strategy(seed))
+        assert verdict.detected
+        assert isinstance(verdict.error, C.DeadlockError)
+
+
+def test_empty_plan_is_healthy():
+    """An empty FaultPlan is behaviourally identical to no plan: the
+    healthy fuzzer harnesses pass unchanged through the fault path."""
+    plan = F.FaultPlan()
+    assert plan.empty
+    for seed in range(6):
+        C.simulate_all_gather(4, C.Strategy(seed), faults=plan)
+        C.simulate_all_reduce(4, C.Strategy(seed), faults=plan)
+        C.simulate_reduce_scatter(4, C.Strategy(seed), faults=plan)
+        C.simulate_neighbour_stream(4, 5, C.Strategy(seed), faults=plan)
+        C.simulate_all_gather(4, C.DelayDmaStrategy(seed), faults=plan)
+
+
+def test_random_plans_are_deterministic():
+    assert F.FaultPlan.random("down_link", 5, 3) == F.FaultPlan.random(
+        "down_link", 5, 3
+    )
+    assert F.FaultPlan.random("stalled_rank", 5, 3) != F.FaultPlan.random(
+        "stalled_rank", 5, 4
+    ) or True  # different seeds may collide on tiny domains; no assert
+
+
+def test_unknown_fault_class_rejected():
+    with pytest.raises(ValueError, match="unknown fault class"):
+        F.FaultPlan.random("cosmic_ray", 4, 0)
+
+
+def test_deadlock_dump_shape():
+    """The state dump is structured: per-rank entries plus inflight /
+    undeliverable / semaphore sections — the payload the runtime
+    watchdog forwards."""
+    plan = F.FaultPlan.single(F.DownLink(0, 1))
+    with pytest.raises(C.DeadlockError) as e:
+        C.simulate_neighbour_stream(3, 4, C.Strategy(0), faults=plan)
+    state = e.value.state
+    assert set(range(3)) <= set(k for k in state if isinstance(k, int))
+    assert "undeliverable" in state and "sems" in state
+    text = C.format_state_dump(state)
+    assert "rank 0" in text and "rank 2" in text
+
+
+# ---------------------------------------------------------------------------
+# Watchdog layer
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expires_with_mirror_dump():
+    d = W.Deadline(0.0, state_provider=F.mirror_state_provider("reduce", 4))
+    with pytest.raises(W.WatchdogTimeout) as e:
+        d.check("ring reduce over 4 ranks")
+    msg = str(e.value)
+    assert "ring reduce over 4 ranks" in msg
+    assert "protocol mirror" in msg and "rank 0" in msg
+
+
+def test_deadline_unbounded_never_expires():
+    d = W.Deadline(None)
+    assert d.remaining() is None and not d.expired()
+    d.check("anything")  # no raise
+
+
+def test_default_deadline_env(monkeypatch):
+    monkeypatch.delenv(W.WATCHDOG_ENV, raising=False)
+    assert W.default_deadline() is None
+    monkeypatch.setenv(W.WATCHDOG_ENV, "0")  # 0 means OFF, not instant
+    assert W.default_deadline() is None
+    monkeypatch.setenv(W.WATCHDOG_ENV, "2.5")
+    d = W.default_deadline()
+    assert d is not None and d.budget == 2.5
+
+
+def test_run_with_deadline_times_out():
+    import time as _time
+
+    with pytest.raises(W.WatchdogTimeout) as e:
+        W.run_with_deadline(
+            lambda: _time.sleep(30), 0.05,
+            state_provider=lambda: "dump-text", context="unit test",
+        )
+    assert "dump-text" in str(e.value)
+    assert e.value.budget == 0.05
+
+
+def test_run_with_deadline_passes_result_and_errors():
+    assert W.run_with_deadline(lambda: 42, 1.0) == 42
+    assert W.run_with_deadline(lambda: 42, None) == 42
+    with pytest.raises(KeyError):
+        W.run_with_deadline(lambda: {}[0], 1.0)
+
+
+def test_mirror_stall_dump_all_protocols():
+    """The mirror parks every rank at a remote wait — by construction no
+    rank can be runnable when no DMA ever lands."""
+    for protocol in F.PROTOCOLS:
+        dump = F.mirror_stall_dump(protocol, 4)
+        states = {dump[r]["state"] for r in range(4)}
+        assert states <= {"blocked", "finished"}
+        assert "blocked" in states
+
+
+def test_channel_deadline_times_out_before_dispatch():
+    """An expired deadline on a channel transfer surfaces as a
+    WatchdogTimeout naming the channel, with the protocol mirror
+    attached — no device work is dispatched."""
+    jax = pytest.importorskip("jax")
+    import smi_tpu as smi
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs >= 2 emulator devices")
+    comm = smi.make_communicator(2, devices=devices[:2])
+    ch = smi.P2PChannel(comm=comm, port=0, src=0, dst=1, count=8)
+    import numpy as np
+
+    with pytest.raises(W.WatchdogTimeout) as e:
+        ch.transfer(np.zeros(8, np.float32), deadline=W.Deadline(0.0))
+    assert "port-0" in str(e.value)
+    assert "protocol mirror" in str(e.value)
+    with pytest.raises(W.WatchdogTimeout):
+        ch.stream(np.zeros(8, np.float32), deadline=W.Deadline(0.0))
+
+
+def test_collective_ring_deadline_checked():
+    jax = pytest.importorskip("jax")
+    import numpy as np
+
+    import smi_tpu as smi
+    from smi_tpu.parallel import collectives as coll
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs >= 2 emulator devices")
+    comm = smi.make_communicator(2, devices=devices[:2])
+    x = np.zeros(8, np.float32)
+    for fn in (coll.bcast, coll.scatter, coll.gather):
+        with pytest.raises(W.WatchdogTimeout):
+            fn(x, comm, backend="ring", deadline=W.Deadline(0.0))
+    with pytest.raises(W.WatchdogTimeout):
+        coll.reduce(x, comm, backend="ring", deadline=W.Deadline(0.0))
+    with pytest.raises(W.WatchdogTimeout):
+        coll.allreduce(x, comm, backend="ring", deadline=W.Deadline(0.0))
+
+
+def test_timed_watchdog():
+    import time as _time
+
+    from smi_tpu.utils.tracing import timed
+
+    result, secs = timed(lambda: 7)
+    assert result == 7
+
+    class HangsOnReadback:
+        # fn() itself runs inline (it may trace); the watchdog bounds
+        # the readback — the sync point a device hang parks on
+        def __array__(self, dtype=None):
+            _time.sleep(30)
+
+    with pytest.raises(W.WatchdogTimeout):
+        timed(HangsOnReadback, deadline_s=0.05)
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode routing: random link cuts on 1-D / 2-D tori
+# ---------------------------------------------------------------------------
+
+import random as _random
+
+from smi_tpu.parallel.routing import (
+    FailureSet,
+    Link,
+    NoRouteFound,
+    RouteCutError,
+    build_routing_context,
+    egress_link_toward,
+    egress_tables,
+    grid_topology,
+    ingress_table,
+)
+
+
+def _random_cut(topo, rng, k):
+    """k distinct wire endpoints, each naming one physical link."""
+    endpoints = sorted(
+        topo.connections, key=lambda e: (e[0].key, e[1])
+    )
+    picked = rng.sample(endpoints, min(k, len(endpoints)))
+    return FailureSet(links=frozenset(picked))
+
+
+@pytest.mark.parametrize("shape", [(1, 4), (1, 6), (2, 3), (3, 3), (2, 4)])
+@pytest.mark.parametrize("seed", range(6))
+def test_random_cuts_route_or_name_the_cut(shape, seed):
+    """Property: under a random link cut on a torus, every pair either
+    gets a valid route that avoids the cut, or raises a RouteCutError
+    naming the cut — never a bogus route and never a bare failure."""
+    rng = _random.Random(f"{shape}:{seed}")
+    topo = grid_topology(*shape)
+    ctx = build_routing_context(topo)
+    program = topo.mapping.programs[0]
+    cut = _random_cut(topo, rng, rng.randint(1, 3))
+    degraded = build_routing_context(topo, excluded=cut)
+    for dev in topo.devices:
+        try:
+            tables = egress_tables(dev, ctx, program, excluded=cut)
+        except RouteCutError as e:
+            assert e.cut == cut
+            continue
+        # routable: following the degraded tables' first hops must
+        # reach every destination without ever crossing a cut wire
+        for dst in topo.devices:
+            if dst == dev:
+                continue
+            link_idx, peer = egress_link_toward(
+                dev, dst, degraded, program, tables=tables
+            )
+            assert not cut.wire_down(
+                Link(dev, link_idx),
+                Link(peer, topo.connections[(dev, link_idx)][1]),
+            ), f"route {dev}->{dst} uses a cut wire"
+
+
+@pytest.mark.parametrize("shape", [(1, 4), (3, 3)])
+def test_full_isolation_names_the_cut(shape):
+    """Cutting every wire of one device must name that exact cut for
+    routes to it, and leave the others routable among themselves."""
+    topo = grid_topology(*shape)
+    ctx = build_routing_context(topo)
+    program = topo.mapping.programs[0]
+    victim = topo.devices[0]
+    links = frozenset(
+        (dev, li) for (dev, li) in topo.connections if dev == victim
+    )
+    cut = FailureSet(links=links)
+    with pytest.raises(RouteCutError) as e:
+        egress_tables(topo.devices[1], ctx, program, excluded=cut)
+    assert e.value.cut == cut
+    assert str(victim) in str(e.value)
+
+
+def test_never_routable_is_not_a_cut():
+    """A topology with no wires at all raises plain NoRouteFound (the
+    pair never routed), not RouteCutError."""
+    topo = grid_topology(1, 3, wrap=False)
+    # remove the middle: 0-1 and 1-2 wires both cut isolates everything
+    topo.connections.clear()
+    ctx = build_routing_context(
+        topo, excluded=FailureSet(links=frozenset())
+    )
+    program = topo.mapping.programs[0]
+    with pytest.raises(NoRouteFound) as e:
+        egress_tables(topo.devices[0], ctx, program)
+    assert not isinstance(e.value, RouteCutError)
+
+
+def test_down_device_keeps_rank_space():
+    """A down device loses its wires but keeps its rank slot: table
+    shapes for survivors are unchanged and routes transit around it."""
+    topo = grid_topology(3, 3)
+    ctx = build_routing_context(topo)
+    program = topo.mapping.programs[0]
+    victim = topo.devices[4]  # the centre of the 3x3 torus
+    cut = FailureSet(devices=frozenset({victim}))
+    src = topo.devices[0]
+    healthy_tables = egress_tables(src, ctx, program)
+    try:
+        egress_tables(src, ctx, program, excluded=cut)
+        pytest.fail("routing TO the down device should be cut")
+    except RouteCutError:
+        pass
+    # route the survivors' pairs individually: all routable, shape kept
+    degraded = build_routing_context(topo, excluded=cut)
+    for dst in topo.devices:
+        if dst in (src, victim):
+            continue
+        link_idx, peer = egress_link_toward(src, dst, degraded)
+        assert peer != victim
+    t = next(iter(healthy_tables.values()))
+    assert t.n_ranks == len(topo.devices)
+
+
+def test_ingress_table_for_down_link_rejected():
+    topo = grid_topology(1, 4)
+    ctx = build_routing_context(topo)
+    program = topo.mapping.programs[0]
+    dev = topo.devices[0]
+    cut = FailureSet(links=frozenset({(dev, 0)}))
+    with pytest.raises(RouteCutError):
+        ingress_table(Link(dev, 0), ctx, program, excluded=cut)
+    # other links of the same device are unaffected
+    ingress_table(Link(dev, 2), ctx, program, excluded=cut)
+
+
+def test_communicator_shrink_survivors():
+    jax = pytest.importorskip("jax")
+    import smi_tpu as smi
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device emulator mesh")
+    comm = smi.make_communicator(8, devices=devices[:8])
+    small = comm.shrink({2, 5})
+    assert small.size == 6
+    kept = [d for i, d in enumerate(devices[:8]) if i not in (2, 5)]
+    assert list(small.mesh.devices.flat) == kept
+    with pytest.raises(ValueError, match="no survivors"):
+        comm.shrink(range(8))
+    with pytest.raises(ValueError, match="out of range"):
+        comm.shrink({8})
+    assert comm.shrink(set()) is comm
+
+
+# ---------------------------------------------------------------------------
+# Control-plane retry/backoff
+# ---------------------------------------------------------------------------
+
+from smi_tpu.parallel.bootstrap import (
+    BootstrapTimeout,
+    DistributedOptions,
+    backoff_schedule,
+    init_distributed,
+)
+
+
+def test_backoff_schedule_grows_and_caps():
+    delays = []
+    gen = backoff_schedule(
+        initial_backoff_s=1.0, max_backoff_s=8.0, jitter=0.0, seed=0
+    )
+    for _ in range(6):
+        delays.append(next(gen))
+    assert delays == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+
+def test_backoff_jitter_bounded_and_seeded():
+    a = [next(backoff_schedule(jitter=0.25, seed=7)) for _ in range(1)]
+    b = [next(backoff_schedule(jitter=0.25, seed=7)) for _ in range(1)]
+    assert a == b  # seeded: reproducible
+    gen = backoff_schedule(initial_backoff_s=1.0, jitter=0.25, seed=3)
+    first = next(gen)
+    assert 0.75 <= first <= 1.25
+
+
+def test_init_distributed_retries_until_success():
+    calls = []
+
+    def flaky(**kwargs):
+        calls.append(kwargs)
+        if len(calls) < 3:
+            raise ConnectionError("coordinator still booting")
+
+    slept = []
+    init_distributed(
+        DistributedOptions("coord:8476", 4, 1),
+        total_deadline_s=60.0,
+        initialize=flaky,
+        sleep=slept.append,
+        seed=0,
+    )
+    assert len(calls) == 3
+    assert len(slept) == 2
+    assert slept[1] > slept[0] * 0.5  # backoff grew (modulo jitter)
+    assert calls[0]["coordinator_address"] == "coord:8476"
+
+
+def test_init_distributed_deadline_exceeded():
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        now[0] += s
+
+    def always_down(**kwargs):
+        now[0] += 1.0
+        raise ConnectionError("no route to coordinator")
+
+    with pytest.raises(BootstrapTimeout) as e:
+        init_distributed(
+            DistributedOptions("coord:8476", 4, 1),
+            total_deadline_s=10.0,
+            initialize=always_down,
+            sleep=sleep,
+            clock=clock,
+            seed=0,
+        )
+    msg = str(e.value)
+    assert "coord:8476" in msg and "attempts" in msg
+    assert "ConnectionError" in msg
+
+
+def test_init_distributed_single_process_never_connects():
+    def boom(**kwargs):
+        raise AssertionError("must not be called")
+
+    init_distributed(
+        DistributedOptions("solo:8476", 1, 0), initialize=boom
+    )
