@@ -79,9 +79,17 @@ from smi_tpu.parallel.mesh import (
     make_hybrid_communicator,
     mesh_from_topology,
 )
-from smi_tpu.parallel.channels import P2PChannel, stream_concurrent
+from smi_tpu.parallel.channels import FrameCheck, P2PChannel, stream_concurrent
 from smi_tpu.parallel.context import SmiContext, smi_kernel
+from smi_tpu.parallel.credits import IntegrityError
 from smi_tpu.parallel.faults import FaultPlan
+from smi_tpu.parallel.recovery import (
+    ProgressLog,
+    RecoveryOutcome,
+    chaos_campaign,
+    recover_communicator,
+    run_with_recovery,
+)
 from smi_tpu.parallel.routing import FailureSet, RouteCutError
 from smi_tpu.utils.watchdog import Deadline, WatchdogTimeout
 
@@ -115,12 +123,19 @@ __all__ = [
     "make_hybrid_communicator",
     "mesh_from_topology",
     "P2PChannel",
+    "FrameCheck",
+    "IntegrityError",
     "stream_concurrent",
     "SmiContext",
     "smi_kernel",
     "FaultPlan",
     "FailureSet",
     "RouteCutError",
+    "ProgressLog",
+    "RecoveryOutcome",
+    "chaos_campaign",
+    "recover_communicator",
+    "run_with_recovery",
     "Deadline",
     "WatchdogTimeout",
 ]

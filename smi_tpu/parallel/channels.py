@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Callable, List, Optional, Sequence, Tuple, Union
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -59,8 +59,28 @@ from smi_tpu.parallel.backend import (
     identity_for,
     reduction_fn,
 )
+from smi_tpu.parallel.credits import IntegrityError
 from smi_tpu.parallel.mesh import Communicator
 from smi_tpu.utils.watchdog import Deadline
+
+
+
+class FrameCheck(NamedTuple):
+    """Host-side verdict material of one verified transfer.
+
+    All three fields are arrays produced inside the traced collective
+    (a pytree, so ``shard_map``/``jit`` pass it through): ``expected``
+    is the per-chunk checksum vector computed at ``src`` and moved to
+    ``dst`` over the same tier as the payload; ``got`` recomputes the
+    checksums from the delivered message; ``at_dst`` masks the ranks
+    where the comparison is meaningful (everyone else holds zeros).
+    :meth:`P2PChannel.verify_frames` turns a mismatch into a named
+    :class:`~smi_tpu.parallel.credits.IntegrityError` after readback.
+    """
+
+    expected: jax.Array
+    got: jax.Array
+    at_dst: jax.Array
 
 
 @dataclasses.dataclass(frozen=True)
@@ -201,7 +221,7 @@ class P2PChannel:
         from smi_tpu.parallel.faults import mirror_state_provider
 
         return deadline.with_provider(
-            mirror_state_provider(what, self.comm.size)
+            mirror_state_provider(what, self.comm.size, structured=True)
         )
 
     def _ring_move(self, chunked_payload: jax.Array,
@@ -424,6 +444,145 @@ class P2PChannel:
         )
         total = chunk_reduce(partials, axis=0)
         return received, total
+
+    # ------------------------------------------------------------------
+    # Verified transport: per-chunk sequence-keyed checksums
+    # ------------------------------------------------------------------
+
+    def chunk_checksums(self, data: jax.Array) -> jax.Array:
+        """Per-chunk int32 checksums of a message.
+
+        Chunk ``k``'s payload words (the dtype's raw bits) are summed
+        with int32 wraparound under ODD pseudo-random position weights
+        (``i * 2654435761 | 1`` — multiplicative hashing). Odd weights
+        make any single-bit flip visible (an odd multiple of a power
+        of two is never 0 mod 2**32); a truncated landing (zeros where
+        payload was) changes the weighted sum; and the well-mixed
+        position dependence makes the checksum content-order-
+        sensitive: swapped chunks, a reordering *within* a chunk, and
+        structured patterns a linear weighting misses (reversals of
+        symmetric data) all compare unequal unless the weighted sums
+        collide — a ~2**-32-shaped accident, the same class as any
+        32-bit checksum. Deterministic and identical at both
+        endpoints — the comparison in :meth:`verify_frames` is exact,
+        not approximate.
+        """
+        data = jnp.asarray(data, self.jnp_dtype)
+        chunk = min(self.chunk_elements, self.count)
+        n_chunks = -(-self.count // chunk)
+        pad = n_chunks * chunk - self.count
+        x = data[: self.count]
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]
+            )
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            nbits = x.dtype.itemsize * 8
+            x = lax.bitcast_convert_type(
+                x, jnp.dtype(f"int{nbits}")
+            )
+        words = x.astype(jnp.int32).reshape(n_chunks, -1)
+        # Knuth's 32-bit golden-ratio multiplier, int32 wraparound;
+        # | 1 keeps every weight odd
+        weights = jnp.bitwise_or(
+            jnp.arange(words.shape[1], dtype=jnp.int32)
+            * jnp.int32(-1640531527),
+            jnp.int32(1),
+        )
+        return jnp.sum(words * weights[None, :], axis=1,
+                       dtype=jnp.int32)
+
+    def _move_checksums(self, sums: jax.Array, backend: str) -> jax.Array:
+        """Deliver the src's checksum vector to dst over the payload's
+        tier (zeros elsewhere) — the frame header riding its own
+        message."""
+        masked = jnp.where(self.comm.rank() == self.src, sums,
+                           jnp.zeros_like(sums))
+        if backend == "ring":
+            return self._ring_move(masked[None])[0]
+        return lax.ppermute(masked, self._axis(), self._perm())
+
+    def _frame_check(self, data: jax.Array, received: jax.Array,
+                     backend: str) -> FrameCheck:
+        return FrameCheck(
+            expected=self._move_checksums(
+                self.chunk_checksums(data), backend
+            ),
+            got=self.chunk_checksums(received),
+            at_dst=(self.comm.rank() == self.dst).astype(jnp.int32),
+        )
+
+    def transfer_verified(
+        self, data: jax.Array, backend: str = "xla",
+        deadline: Optional[Deadline] = None,
+    ) -> Tuple[jax.Array, FrameCheck]:
+        """:meth:`transfer` plus end-to-end integrity evidence.
+
+        Returns ``(received, check)``; after readback, pass the
+        concrete ``check`` to :meth:`verify_frames` — a corrupted,
+        truncated, or reordered chunk raises a named
+        :class:`~smi_tpu.parallel.credits.IntegrityError` (chunk
+        index, expected vs got) instead of flowing silently into the
+        consumer.
+        """
+        data = jnp.asarray(data, self.jnp_dtype)
+        received = self.transfer(data, backend=backend,
+                                 deadline=deadline)
+        return received, self._frame_check(data, received, backend)
+
+    def stream_verified(
+        self,
+        data: jax.Array,
+        consumer: Optional[Callable] = None,
+        init_carry=None,
+        backend: str = "xla",
+        deadline: Optional[Deadline] = None,
+    ):
+        """:meth:`stream` plus end-to-end integrity evidence.
+
+        Returns ``(received, carry, check)``. The checksum vector is
+        computed over the same chunking the stream moves, so the check
+        localizes damage to the in-flight unit that suffered it.
+        """
+        data = jnp.asarray(data, self.jnp_dtype)
+        received, carry = self.stream(
+            data, consumer=consumer, init_carry=init_carry,
+            backend=backend, deadline=deadline,
+        )
+        return received, carry, self._frame_check(data, received,
+                                                  backend)
+
+    def verify_frames(self, check: FrameCheck,
+                      context: str = "") -> None:
+        """Host-side verdict: raise on any chunk whose delivered
+        checksum differs from the one computed at the source.
+
+        Call after readback with concrete arrays (inside a trace the
+        comparison has no value yet). No-op at ranks other than
+        ``dst`` — their buffers are zeros by contract.
+        """
+        import numpy as np
+
+        if not bool(np.any(np.asarray(check.at_dst))):
+            return
+        expected = np.asarray(check.expected)
+        got = np.asarray(check.got)
+        bad = np.nonzero(expected != got)[0]
+        if bad.size == 0:
+            return
+        k = int(bad[0])
+        where = f" during {context}" if context else ""
+        raise IntegrityError(
+            f"verified transfer on port-{self.port} channel "
+            f"{self.src}->{self.dst}{where}: chunk {k} (of "
+            f"{expected.size}) arrived corrupted: checksum expected "
+            f"{int(expected[k]):#010x}, got {int(got[k]):#010x}"
+            + (f"; {bad.size - 1} further chunk(s) also damaged"
+               if bad.size > 1 else ""),
+            rank=self.dst, src=self.src, seq=k,
+            expected=int(expected[k]), got=int(got[k]),
+            kind="checksum",
+        )
 
 
 def stream_concurrent(

@@ -63,8 +63,11 @@ def _check_deadline(deadline: Optional[Deadline], family: str,
         return
     from smi_tpu.parallel.faults import mirror_state_provider
 
+    # structured=True rides the raw dump on WatchdogTimeout.state, so
+    # a caller can hand the error straight to
+    # recovery.recover_communicator for a ULFM-style shrink-and-retry
     deadline.with_provider(
-        mirror_state_provider(family, comm.size)
+        mirror_state_provider(family, comm.size, structured=True)
     ).check(f"ring {family} over {comm.size} ranks")
 
 

@@ -59,6 +59,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import random
+import zlib
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 # ---------------------------------------------------------------------------
@@ -118,6 +119,31 @@ class DeadlockError(ProtocolError):
 
 class CreditLeakError(ProtocolError):
     pass
+
+
+class IntegrityError(ProtocolError):
+    """The verified-transport framing caught a corrupted, truncated, or
+    missequenced chunk.
+
+    Carries enough to debug the wire: the receiving ``rank``, the
+    claimed source ``src``, the frame's sequence number ``seq``, the
+    detection ``kind`` (``"checksum"`` or ``"sequence"``), and the
+    ``expected`` vs ``got`` values (CRCs for a checksum miss, sequence
+    numbers for a reorder). Payload corruption must surface HERE, never
+    as silently wrong delivery — the invariant
+    :mod:`smi_tpu.parallel.faults` extends its matrix with.
+    """
+
+    def __init__(self, message: str, rank: Optional[int] = None,
+                 src: Optional[int] = None, seq: Optional[int] = None,
+                 expected=None, got=None, kind: Optional[str] = None):
+        super().__init__(message)
+        self.rank = rank
+        self.src = src
+        self.seq = seq
+        self.expected = expected
+        self.got = got
+        self.kind = kind
 
 
 def format_state_dump(state: dict) -> str:
@@ -283,6 +309,157 @@ def neighbour_stream_rank(me: int, n: int, chunks: Sequence,
         if flow_control and c + 2 < total:
             yield ("signal", upstream, SEM_CREDIT, slot, 1)
         yield ("wait", SEM_SEND, slot, 1)
+
+
+# ---------------------------------------------------------------------------
+# Verified-transport framing
+# ---------------------------------------------------------------------------
+# The credit protocol guarantees ORDERING and FLOW CONTROL, but it
+# trusts the wire: a payload corrupted in flight lands as cleanly as a
+# healthy one and becomes silently wrong delivery — the one outcome the
+# fault matrix forbids, and the one the simulator alone cannot catch at
+# the point of damage. The framing layer closes that hole the way every
+# production collective transport does: each chunk moves as a Frame
+# carrying (src, per-source sequence number, CRC over src+seq+payload),
+# and the receiver verifies both on consumption. Corruption or
+# truncation → checksum mismatch; reordering or loss-then-replay →
+# sequence mismatch; either raises a named IntegrityError instead of
+# propagating bad data into a reduction.
+#
+# Framing is an adapter around a rank's protocol generator
+# (:func:`verified_steps`), exactly like :func:`instance_steps`: the
+# protocol state machines stay byte-identical, and with no tampering
+# the framed run is behaviourally identical to the bare one. Local
+# slot writes are framed too (a rank's own scratch re-reads verify on
+# a separate per-rank "local" lane), so every read_slot in the system
+# is covered.
+
+
+@dataclasses.dataclass(frozen=True)
+class Frame:
+    """One verified-transport chunk: payload + integrity envelope.
+
+    ``wire`` separates the two sequence lanes a rank emits on: True for
+    RDMA'd chunks (the receiver checks them against the sender's wire
+    lane), False for the rank's own local slot writes (checked against
+    its local lane) — the lanes interleave arbitrarily in slot usage
+    but are each strictly ordered.
+    """
+
+    src: int
+    seq: int
+    wire: bool
+    payload: object
+    crc: int
+
+
+def frame_crc(src: int, seq: int, wire: bool, payload) -> int:
+    """Deterministic checksum over the frame's identity and payload.
+
+    ``repr`` keys the CRC: the simulator's payloads are plain Python
+    values (strings, ints, frozensets, tuples of those) whose repr is
+    stable within a run — and across runs for everything the harnesses
+    use. Sorting frozensets would be needed for cross-process
+    stability; within one campaign process this is exact.
+    """
+    return zlib.crc32(
+        repr((src, seq, wire, payload)).encode()
+    ) & 0xFFFFFFFF
+
+
+def make_frame(src: int, seq: int, payload, wire: bool = True) -> Frame:
+    return Frame(src, seq, wire, payload,
+                 frame_crc(src, seq, wire, payload))
+
+
+def _verify_frame(me: int, frame, next_seq: Dict,
+                  accepted: Dict) -> object:
+    """Receiver-side check: CRC then per-source sequence. Returns the
+    unwrapped payload; raises :class:`IntegrityError` naming the miss.
+
+    A re-read of the exact frame last accepted on a lane is legal (the
+    all-gather kernel reads a slot once to deliver and once to forward);
+    only a DIFFERENT frame with a non-successor sequence number is a
+    reordering violation.
+    """
+    if not isinstance(frame, Frame):
+        raise IntegrityError(
+            f"rank {me} consumed an unframed payload {frame!r} on the "
+            f"verified transport",
+            rank=me, kind="unframed", got=frame,
+        )
+    want = frame_crc(frame.src, frame.seq, frame.wire, frame.payload)
+    if want != frame.crc:
+        raise IntegrityError(
+            f"rank {me}: checksum mismatch on chunk seq={frame.seq} "
+            f"from rank {frame.src}: frame declares crc={frame.crc:#010x}"
+            f" but payload hashes to {want:#010x} (payload corrupted or"
+            f" truncated in flight)",
+            rank=me, src=frame.src, seq=frame.seq,
+            expected=frame.crc, got=want, kind="checksum",
+        )
+    lane = (frame.src, frame.wire)
+    if frame == accepted.get(lane):
+        return frame.payload  # verified re-read of the same chunk
+    expected = next_seq.get(lane, 0)
+    if frame.seq != expected:
+        raise IntegrityError(
+            f"rank {me}: out-of-sequence chunk from rank {frame.src}: "
+            f"expected seq={expected}, got seq={frame.seq} (chunks "
+            f"reordered or lost in flight)",
+            rank=me, src=frame.src, seq=frame.seq,
+            expected=expected, got=frame.seq, kind="sequence",
+        )
+    next_seq[lane] = expected + 1
+    accepted[lane] = frame
+    return frame.payload
+
+
+def verified_steps(gen, me: int):
+    """Verified-transport framing around one rank's protocol generator.
+
+    Outgoing ``dma`` payloads are framed on the rank's wire lane,
+    local ``write_slot`` payloads on its local lane; every ``read_slot``
+    result is CRC- and sequence-checked, then unwrapped before the
+    inner generator sees it. All other actions pass through untouched,
+    so a framed healthy run is behaviourally identical to a bare one —
+    only tampering (:class:`smi_tpu.parallel.faults.FaultPlan`'s
+    ``tamper`` hook) can make the checks fire.
+
+    Sequence checking relies on the credit protocol's own ordering
+    guarantee: within one (src, lane) the four ring protocols consume
+    chunks in send order, so a regression is genuine reordering. The
+    composite multi-instance programs re-use scratch across instances
+    with their own ordering rules; frame those per instance, not across
+    a whole composite.
+    """
+    wire_seq = 0
+    local_seq = 0
+    next_seq: Dict = {}
+    accepted: Dict = {}
+    value = None
+    while True:
+        try:
+            action = gen.send(value)
+        except StopIteration:
+            return
+        kind = action[0]
+        if kind == "dma":
+            _, target, slot, payload, send_index, recv_index = action
+            frame = make_frame(me, wire_seq, payload, wire=True)
+            wire_seq += 1
+            value = yield ("dma", target, slot, frame, send_index,
+                           recv_index)
+        elif kind == "write_slot":
+            _, slot, payload = action
+            frame = make_frame(me, local_seq, payload, wire=False)
+            local_seq += 1
+            value = yield ("write_slot", slot, frame)
+        elif kind == "read_slot":
+            frame = yield action
+            value = _verify_frame(me, frame, next_seq, accepted)
+        else:
+            value = yield action
 
 
 # ---------------------------------------------------------------------------
@@ -611,7 +788,12 @@ class RingSimulator:
     - ``stall_after(rank) -> Optional[int]`` — crash-stop ``rank``
       after that many executed actions (None = healthy);
     - ``link_down(a, b) -> bool`` — all traffic between global ranks
-      ``a`` and ``b`` (signals and DMAs, both directions) is lost.
+      ``a`` and ``b`` (signals and DMAs, both directions) is lost;
+    - ``tamper(src, nth, payload) -> payload`` (optional) — damage the
+      ``nth`` DMA payload started by ``src`` in flight (bit flip,
+      truncation, sequence swap). The simulator applies it blindly;
+      detection is the verified-transport framing's job
+      (:func:`verified_steps`).
     """
 
     def __init__(self, generators: Sequence[Iterator], strategy: Strategy,
@@ -736,10 +918,19 @@ class RingSimulator:
             self._advance(r)
         elif kind == "dma":
             _, target, slot, payload, send_index, recv_index = action
-            dma = _Dma(src=r, target=target, slot=slot, payload=payload,
-                       send_index=send_index, recv_index=recv_index)
             nth = self.dmas_started[r]
             self.dmas_started[r] += 1
+            if self.faults is not None:
+                # in-flight payload tampering (bit flips, truncation,
+                # reordering): the wire damages the snapshot, the
+                # protocol machinery never notices — only the framing
+                # layer (verified_steps) can turn this into a named
+                # IntegrityError instead of silent corruption
+                tamper = getattr(self.faults, "tamper", None)
+                if tamper is not None:
+                    payload = tamper(r, nth, payload)
+            dma = _Dma(src=r, target=target, slot=slot, payload=payload,
+                       send_index=send_index, recv_index=recv_index)
             if target != r and self._link_down(r, target):
                 # the wire is dead: neither the remote landing nor the
                 # local send completion ever fires — the writer's
@@ -919,13 +1110,26 @@ def explore_all_schedules(make_generators: Callable[[], Sequence[Iterator]],
 # ---------------------------------------------------------------------------
 
 
+def _maybe_verified(gens: Sequence[Iterator], verified: bool):
+    """Wrap each rank in the verified-transport framing when asked —
+    the harness knob that decides whether payload tampering surfaces
+    as a named IntegrityError (framed) or as silently wrong delivery
+    (bare transport, caught only by the harness's output check)."""
+    if not verified:
+        return list(gens)
+    return [verified_steps(gen, r) for r, gen in enumerate(gens)]
+
+
 def simulate_all_gather(n: int, strategy: Strategy,
-                        flow_control: bool = True, faults=None) -> None:
+                        flow_control: bool = True, faults=None,
+                        verified: bool = False) -> None:
     gens = [
         all_gather_rank(r, n, f"chunk{r}", flow_control=flow_control)
         for r in range(n)
     ]
-    outputs = RingSimulator(gens, strategy, faults=faults).run()
+    outputs = RingSimulator(
+        _maybe_verified(gens, verified), strategy, faults=faults
+    ).run()
     expected = {i: f"chunk{i}" for i in range(n)}
     for r in range(n):
         if outputs[r] != expected:
@@ -935,13 +1139,16 @@ def simulate_all_gather(n: int, strategy: Strategy,
 
 
 def simulate_all_reduce(n: int, strategy: Strategy,
-                        flow_control: bool = True, faults=None) -> None:
+                        flow_control: bool = True, faults=None,
+                        verified: bool = False) -> None:
     gens = [
         all_reduce_rank(r, n, frozenset([r]), lambda a, b: a | b,
                         flow_control=flow_control)
         for r in range(n)
     ]
-    outputs = RingSimulator(gens, strategy, faults=faults).run()
+    outputs = RingSimulator(
+        _maybe_verified(gens, verified), strategy, faults=faults
+    ).run()
     want = frozenset(range(n))
     for r in range(n):
         if outputs[r] != {0: want}:
@@ -950,7 +1157,7 @@ def simulate_all_reduce(n: int, strategy: Strategy,
 
 def simulate_reduce_scatter(n: int, strategy: Strategy,
                             flow_control: bool = True,
-                            faults=None) -> None:
+                            faults=None, verified: bool = False) -> None:
     gens = [
         reduce_scatter_rank(
             r, n, [frozenset([(r, b)]) for b in range(n)],
@@ -958,7 +1165,9 @@ def simulate_reduce_scatter(n: int, strategy: Strategy,
         )
         for r in range(n)
     ]
-    outputs = RingSimulator(gens, strategy, faults=faults).run()
+    outputs = RingSimulator(
+        _maybe_verified(gens, verified), strategy, faults=faults
+    ).run()
     for r in range(n):
         want = frozenset((src, r) for src in range(n))
         if outputs[r] != {r: want}:
@@ -970,7 +1179,8 @@ def simulate_reduce_scatter(n: int, strategy: Strategy,
 def simulate_neighbour_stream(n: int, chunks: int, strategy: Strategy,
                               direction: int = 1,
                               flow_control: bool = True,
-                              faults=None) -> None:
+                              faults=None,
+                              verified: bool = False) -> None:
     gens = [
         neighbour_stream_rank(
             r, n, [(r, c) for c in range(chunks)],
@@ -978,7 +1188,9 @@ def simulate_neighbour_stream(n: int, chunks: int, strategy: Strategy,
         )
         for r in range(n)
     ]
-    outputs = RingSimulator(gens, strategy, faults=faults).run()
+    outputs = RingSimulator(
+        _maybe_verified(gens, verified), strategy, faults=faults
+    ).run()
     for r in range(n):
         upstream = (r - direction) % n
         want = {c: (upstream, c) for c in range(chunks)}

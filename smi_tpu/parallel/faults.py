@@ -28,6 +28,16 @@ Fault classes (the matrix ``tests/test_faults.py`` sweeps):
 - **down link** — all traffic between two ranks is lost (signals and
   DMAs, both directions) → detected as a deadlock at the first
   wait that needed the dead wire.
+- **bit-flipped payload / truncated DMA** — a chunk is damaged in
+  flight with the protocol machinery none the wiser → detected by the
+  verified-transport framing (:func:`credits.verified_steps`) as
+  :class:`~credits.IntegrityError` (checksum mismatch naming rank,
+  chunk, expected vs got); on BARE transport the same injection is
+  silent corruption, which is the framing's existence proof.
+- **reordered chunks** — two consecutive frames from one source swap
+  positions on the wire → detected as
+  :class:`~credits.IntegrityError` (sequence mismatch). Reordering is
+  a framing-level concept (bare payloads carry no sequence number).
 
 The invariant the harness enforces for every cell: the run either
 completes with verified delivery (**tolerated**) or raises a *named*
@@ -55,14 +65,23 @@ from smi_tpu.parallel import credits as C
 PROTOCOLS = ("all_gather", "all_reduce", "reduce_scatter",
              "neighbour_stream")
 
-#: Fault classes the matrix is exhaustive over.
+#: Fault classes the matrix is exhaustive over. The last three damage
+#: payloads *in flight* — faults the credit protocol cannot see at all;
+#: only the verified-transport framing (``credits.verified_steps``)
+#: turns them into named IntegrityErrors instead of silent corruption.
 FAULT_CLASSES = ("dropped_grant", "duplicated_grant", "delayed_dma",
-                 "stalled_rank", "down_link")
+                 "stalled_rank", "down_link", "bit_flip_payload",
+                 "reordered_chunks", "truncated_dma")
+
+#: The wire-integrity subset of :data:`FAULT_CLASSES`.
+INTEGRITY_FAULT_CLASSES = ("bit_flip_payload", "reordered_chunks",
+                           "truncated_dma")
 
 #: Named invariant violations that count as *detection*. A bare
 #: ProtocolError (wrong delivery) is NOT in this set — that is silent
 #: corruption and fails the matrix.
-DETECTED_ERRORS = (C.ClobberError, C.DeadlockError, C.CreditLeakError)
+DETECTED_ERRORS = (C.ClobberError, C.DeadlockError, C.CreditLeakError,
+                   C.IntegrityError)
 
 
 class SilentCorruption(AssertionError):
@@ -114,6 +133,88 @@ class DownLink:
 
 
 @dataclasses.dataclass(frozen=True)
+class BitFlipPayload:
+    """Corrupt the payload of the ``nth`` DMA started by ``src`` in
+    flight (checksum stays the sender's) — the framing must catch it
+    as an ``IntegrityError(kind="checksum")``."""
+
+    src: int
+    nth: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ReorderedChunks:
+    """Swap the wire sequence numbers of the ``nth`` and ``nth+1``
+    frames sent by ``src`` (CRCs recomputed, payloads intact) — a pure
+    reordering signature the framing must catch as an
+    ``IntegrityError(kind="sequence")``. With only ``nth`` in flight
+    (last chunk) it degrades to a lone sequence skip, still detected."""
+
+    src: int
+    nth: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TruncatedDma:
+    """Truncate the payload of the ``nth`` DMA started by ``src``
+    (partial landing; checksum stays the full payload's) — caught as
+    an ``IntegrityError(kind="checksum")``."""
+
+    src: int
+    nth: int = 0
+
+
+def _corrupt_value(inner, truncate: bool):
+    """Type-preserving in-flight damage: on hardware a flipped or
+    truncated buffer still has the buffer's type — the reduction
+    combines it, the consumer consumes it, nothing crashes. The
+    simulator's symbolic payloads must behave the same way so bare
+    (unframed) transport COMPLETES with wrong data rather than
+    erroring, which is exactly the silent-corruption outcome the
+    framing exists to prevent."""
+    if truncate:
+        if isinstance(inner, str):
+            return inner[: len(inner) // 2]
+        if isinstance(inner, frozenset):
+            kept = sorted(inner, key=repr)[: len(inner) // 2]
+            return frozenset(kept)
+        if isinstance(inner, tuple):
+            return inner[: len(inner) // 2]
+        return ("truncated", repr(inner)[:4])
+    if isinstance(inner, str):
+        return inner + "\x01"
+    if isinstance(inner, frozenset):
+        return inner | {("bitflipped",)}
+    if isinstance(inner, tuple):
+        return inner + (("bitflipped",),)
+    if isinstance(inner, int):
+        return inner ^ 1
+    return ("bitflipped", inner)
+
+
+def _damage(payload, truncate: bool = False):
+    """Corrupt a payload in flight. Framed: mutate the inner payload,
+    KEEP the sender's CRC (the damage the checksum exists to catch).
+    Bare: the same mutation, undetectable by anything but the harness's
+    final output check."""
+    if isinstance(payload, C.Frame):
+        return dataclasses.replace(
+            payload, payload=_corrupt_value(payload.payload, truncate)
+        )
+    return _corrupt_value(payload, truncate)
+
+
+def _shift_seq(payload, delta: int):
+    """Move a frame's wire sequence number by ``delta``, CRC recomputed
+    — a pure reordering signature. Bare payloads carry no sequence
+    number, so reordering is inexpressible there (no-op)."""
+    if isinstance(payload, C.Frame):
+        return C.make_frame(payload.src, payload.seq + delta,
+                            payload.payload, wire=payload.wire)
+    return payload
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """Deterministic fault schedule for one simulator run.
 
@@ -128,6 +229,9 @@ class FaultPlan:
     delayed_dmas: Tuple[DelayedDma, ...] = ()
     stalled_ranks: Tuple[StalledRank, ...] = ()
     down_links: FrozenSet[Tuple[int, int]] = frozenset()
+    bit_flips: Tuple[BitFlipPayload, ...] = ()
+    reorders: Tuple[ReorderedChunks, ...] = ()
+    truncations: Tuple[TruncatedDma, ...] = ()
 
     # -- hook interface (credits.RingSimulator) ------------------------
     def grant_multiplier(self, rank: int, nth: int) -> int:
@@ -154,13 +258,60 @@ class FaultPlan:
     def link_down(self, a: int, b: int) -> bool:
         return (a, b) in self.down_links or (b, a) in self.down_links
 
+    def tamper(self, src: int, nth: int, payload):
+        """Damage the ``nth`` DMA payload of ``src`` in flight.
+
+        On a framed payload (``credits.Frame``) the damage is surgical:
+        bit flips and truncation mutate the payload while keeping the
+        sender's CRC (so only the receiver's checksum can notice);
+        reordering swaps the sequence numbers of two consecutive frames
+        with CRCs recomputed (so only the sequence check can notice).
+        On a BARE payload the same damage lands undetectably — the run
+        completes with wrong delivery, which the verdict harness
+        re-raises as :class:`SilentCorruption`: the pair of behaviours
+        is the framing layer's existence proof. Reordering is a
+        framing-level concept (there is no sequence number to swap on a
+        bare payload), so it is a no-op on unframed transport.
+        """
+        for f in self.bit_flips:
+            if f.src == src and f.nth == nth:
+                return _damage(payload)
+        for f in self.truncations:
+            if f.src == src and f.nth == nth:
+                return _damage(payload, truncate=True)
+        for f in self.reorders:
+            if f.src == src and nth == f.nth:
+                return _shift_seq(payload, +1)
+            if f.src == src and nth == f.nth + 1:
+                return _shift_seq(payload, -1)
+        return payload
+
     # -- construction ---------------------------------------------------
     @property
     def empty(self) -> bool:
         return not (
             self.dropped_grants or self.duplicated_grants
             or self.delayed_dmas or self.stalled_ranks or self.down_links
+            or self.bit_flips or self.reorders or self.truncations
         )
+
+    def faults(self) -> Tuple:
+        """Every individual fault in the plan, deterministically ordered
+        — the unit the chaos delta-debugger removes one at a time."""
+        return (
+            self.dropped_grants + self.duplicated_grants
+            + self.delayed_dmas + self.stalled_ranks
+            + tuple(DownLink(a, b) for a, b in sorted(self.down_links))
+            + self.bit_flips + self.reorders + self.truncations
+        )
+
+    def describe(self) -> List[str]:
+        """One human-readable line per fault (the chaos report's and
+        the minimal reproducer's rendering)."""
+        return [
+            f"{type(f).__name__}({', '.join(f'{k}={v}' for k, v in dataclasses.asdict(f).items())})"
+            for f in self.faults()
+        ]
 
     @classmethod
     def single(cls, fault) -> "FaultPlan":
@@ -175,7 +326,33 @@ class FaultPlan:
             return cls(stalled_ranks=(fault,))
         if isinstance(fault, DownLink):
             return cls(down_links=frozenset({(fault.a, fault.b)}))
+        if isinstance(fault, BitFlipPayload):
+            return cls(bit_flips=(fault,))
+        if isinstance(fault, ReorderedChunks):
+            return cls(reorders=(fault,))
+        if isinstance(fault, TruncatedDma):
+            return cls(truncations=(fault,))
         raise TypeError(f"unknown fault {fault!r}")
+
+    @classmethod
+    def of(cls, faults) -> "FaultPlan":
+        """A plan combining an iterable of individual faults — the
+        multi-fault schedules the chaos campaign sweeps."""
+        plan = cls()
+        for fault in faults:
+            single = cls.single(fault)
+            plan = cls(
+                dropped_grants=plan.dropped_grants + single.dropped_grants,
+                duplicated_grants=(plan.duplicated_grants
+                                   + single.duplicated_grants),
+                delayed_dmas=plan.delayed_dmas + single.delayed_dmas,
+                stalled_ranks=plan.stalled_ranks + single.stalled_ranks,
+                down_links=plan.down_links | single.down_links,
+                bit_flips=plan.bit_flips + single.bit_flips,
+                reorders=plan.reorders + single.reorders,
+                truncations=plan.truncations + single.truncations,
+            )
+        return plan
 
     @classmethod
     def random(cls, fault_class: str, n: int, seed: int) -> "FaultPlan":
@@ -196,6 +373,12 @@ class FaultPlan:
             return cls.single(StalledRank(rank, after=rng.randrange(12)))
         if fault_class == "down_link":
             return cls.single(DownLink(rank, (rank + 1) % n))
+        if fault_class == "bit_flip_payload":
+            return cls.single(BitFlipPayload(rank, nth=rng.randrange(3)))
+        if fault_class == "reordered_chunks":
+            return cls.single(ReorderedChunks(rank, nth=rng.randrange(3)))
+        if fault_class == "truncated_dma":
+            return cls.single(TruncatedDma(rank, nth=rng.randrange(3)))
         raise ValueError(
             f"unknown fault class {fault_class!r}; "
             f"known: {FAULT_CLASSES}"
@@ -228,15 +411,18 @@ class Verdict:
 
 
 def _simulate(protocol: str, n: int, strategy: C.Strategy,
-              plan: Optional[FaultPlan], chunks: int) -> None:
+              plan: Optional[FaultPlan], chunks: int,
+              verified: bool = True) -> None:
     if protocol == "all_gather":
-        C.simulate_all_gather(n, strategy, faults=plan)
+        C.simulate_all_gather(n, strategy, faults=plan, verified=verified)
     elif protocol == "all_reduce":
-        C.simulate_all_reduce(n, strategy, faults=plan)
+        C.simulate_all_reduce(n, strategy, faults=plan, verified=verified)
     elif protocol == "reduce_scatter":
-        C.simulate_reduce_scatter(n, strategy, faults=plan)
+        C.simulate_reduce_scatter(n, strategy, faults=plan,
+                                  verified=verified)
     elif protocol == "neighbour_stream":
-        C.simulate_neighbour_stream(n, chunks, strategy, faults=plan)
+        C.simulate_neighbour_stream(n, chunks, strategy, faults=plan,
+                                    verified=verified)
     else:
         raise ValueError(
             f"unknown protocol {protocol!r}; known: {PROTOCOLS}"
@@ -249,18 +435,26 @@ def run_under_faults(
     plan: Optional[FaultPlan],
     strategy: Optional[C.Strategy] = None,
     chunks: int = 5,
+    verified: bool = True,
 ) -> Verdict:
     """Execute one ring protocol under a fault plan and classify.
 
     Returns a *tolerated* verdict only when the run completed AND the
     harness verified delivery; a *detected* verdict for any named
-    invariant violation (clobber / deadlock / credit leak). A completed
-    run with wrong payloads raises :class:`SilentCorruption` — that
-    outcome must never be classified, it must fail the build.
+    invariant violation (clobber / deadlock / credit leak / integrity).
+    A completed run with wrong payloads raises
+    :class:`SilentCorruption` — that outcome must never be classified,
+    it must fail the build.
+
+    ``verified`` runs the protocols over the verified-transport framing
+    (the default, and behaviourally identical to bare transport under
+    every non-tampering fault); ``verified=False`` strips the framing,
+    which is how the matrix proves the payload-tampering classes WOULD
+    be silent corruption without it.
     """
     strategy = strategy if strategy is not None else C.Strategy(0)
     try:
-        _simulate(protocol, n, strategy, plan, chunks)
+        _simulate(protocol, n, strategy, plan, chunks, verified=verified)
     except DETECTED_ERRORS as e:
         return Verdict("detected", e)
     except C.ProtocolError as e:
@@ -334,19 +528,29 @@ def mirror_stall_dump(protocol: str, n: int, chunks: int = 4) -> Dict:
     return sim.state_dump()
 
 
-def mirror_state_provider(family: str, n: int, chunks: int = 4):
+def mirror_state_provider(family: str, n: int, chunks: int = 4,
+                          structured: bool = False):
     """A zero-arg callable producing the formatted mirror dump — the
-    ``state_provider`` shape :mod:`smi_tpu.utils.watchdog` consumes."""
+    ``state_provider`` shape :mod:`smi_tpu.utils.watchdog` consumes.
 
-    def provide() -> str:
+    With ``structured=True`` the callable returns ``(text, dump)``:
+    the watchdog attaches the raw dump dict to
+    ``WatchdogTimeout.state`` so programmatic recovery
+    (:func:`smi_tpu.parallel.recovery.failed_ranks_of`) can read the
+    per-rank states instead of re-parsing the formatted text.
+    """
+
+    def provide():
         protocol = FAMILY_PROTOCOL.get(family, family)
         try:
             dump = mirror_stall_dump(protocol, n, chunks)
         except Exception as e:  # the mirror must never mask the timeout
-            return f"(state mirror unavailable: {type(e).__name__}: {e})"
-        return (
+            text = f"(state mirror unavailable: {type(e).__name__}: {e})"
+            return (text, None) if structured else text
+        text = (
             f"protocol mirror [{protocol}, n={n}] with no remote "
             f"traffic completing:\n" + C.format_state_dump(dump)
         )
+        return (text, dump) if structured else text
 
     return provide

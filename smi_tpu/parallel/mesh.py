@@ -159,6 +159,36 @@ class Communicator:
         )
         return Communicator(mesh=mesh, axis_names=(DEFAULT_AXIS,))
 
+    def heirs(self, excluded_ranks) -> dict:
+        """excluded rank -> its surviving heir (nearest successor).
+
+        The recovery layer's inheritance rule: when a rank is shrunk
+        away, its duties — serving its progress-logged chunks, folding
+        its logged contribution into the restarted reduction — pass to
+        the first surviving rank after it on the ring. Delegates to
+        :func:`smi_tpu.parallel.recovery.heir_of` (the single
+        implementation the simulator's recovery also uses, so the two
+        can never drift). Raises ``ValueError`` when nobody survives
+        (validated by :meth:`shrink`'s own rules).
+        """
+        # deferred: recovery is pure Python but imports the fault layer
+        from smi_tpu.parallel.recovery import heir_of
+
+        excluded = set(excluded_ranks)
+        size = self.size
+        bad = sorted(r for r in excluded if not (0 <= r < size))
+        if bad:
+            raise ValueError(
+                f"excluded ranks {bad} out of range for comm size {size}"
+            )
+        if len(excluded) >= size:
+            raise ValueError(
+                f"no survivors among {size} ranks to inherit from "
+                f"{sorted(excluded)}"
+            )
+        survivors = [r for r in range(size) if r not in excluded]
+        return {r: heir_of(r, survivors, size) for r in excluded}
+
     def program_of_rank(self, rank: int):
         """The program rank ``rank`` runs under MPMD (None if no topology)."""
         if self.topology is None:

@@ -121,7 +121,16 @@ def _scan_computations(
         if mc and line.rstrip().endswith("{"):
             cur = mc.group(1)
             refs.setdefault(cur, set())
-        elif cur is not None:
+        elif line.strip() == "}":
+            # a computation's closing brace ends its scope. Without
+            # this reset, a header the regex cannot match (some print
+            # options drop the parameter list) leaves ``cur`` pointing
+            # at the PREVIOUS computation — e.g. a while body — and
+            # every later instruction inherits a false ``in_loop``.
+            # (Inline braces — constants, replica groups — never put a
+            # lone ``}`` on its own line.)
+            cur = None
+        else:
             called = _CALLED_RE.findall(line)
             mb = _BRANCHES_RE.search(line)
             if mb:
@@ -129,7 +138,11 @@ def _scan_computations(
                     c.strip().lstrip("%")
                     for c in mb.group(1).split(",") if c.strip()
                 ]
-            refs[cur].update(called)
+            if cur is not None:
+                refs[cur].update(called)
+            # while-roots are collected even in unrecognized scope —
+            # a while whose enclosing header the regex missed still
+            # makes its body's collectives per-iteration records
             if re.search(r"\swhile\(", line):
                 roots.extend(called)
         comp_of_line.append(cur)
@@ -275,10 +288,34 @@ def has_collectives(hlo_text: str) -> bool:
     is true but ``collective_traffic`` returns zero records is a
     parser miss (e.g. a print-option variant), not a collective-free
     program.
+
+    Megascale host-transfer ``send`` instructions count too: on a
+    genuine multi-slice artifact the cross-slice stage of a collective
+    lowers to host-transfer sends handled by the megascale runtime
+    (see ``_SEND_RE``), so a line carrying `` send(`` with
+    ``is_host_transfer=true`` and a megascale marker is collective
+    traffic even when no classic collective op appears — and a
+    megascale-send parser regression is then flagged exactly like a
+    collective-parser miss instead of reading as a collective-free
+    program. The marker here is the bare string ``"xla_megascale"``,
+    deliberately LOOSER than the parser's ``_xla_megascale`` attribute
+    key: it also matches the handler-name value
+    (``...handler_name="xla_megascale_runtime"``), so a renamed
+    attribute escapes the parser but still trips this check. Host
+    *callbacks* (``jax.debug.print`` / ``io_callback``) also lower to
+    host-transfer sends but carry no megascale marker — they must NOT
+    count, or every collective-free program with a debug print would
+    book a spurious parser-miss error.
     """
-    return any(
+    if any(
         f"{op}(" in hlo_text or f"{op}-start(" in hlo_text
         for op in _COLLECTIVES
+    ):
+        return True
+    return any(
+        " send(" in line and "is_host_transfer=true" in line
+        and "xla_megascale" in line
+        for line in hlo_text.splitlines()
     )
 
 
@@ -321,6 +358,16 @@ def tier_crossing_bytes(
     ``in_loop_records`` count: those records' bytes are per HLO
     occurrence (an under-count by the loop trip count), so both
     buckets are lower bounds for such programs.
+
+    Multi-slice caveat: on a GENUINE multi-slice artifact XLA compiles
+    ONE ``num_partitions=n_per_slice`` module PER SLICE, and the
+    records come from a single module's text. The ``crossing`` bucket
+    is still exact — every slice-crossing byte appears as a megascale
+    send in whichever module it leaves — but ``local`` counts only the
+    one compiled module's in-slice traffic, i.e. it is PER-MODULE, not
+    the job-wide total (slices run the same SPMD program, so the
+    job-wide figure is ``local × n_slices`` when you need it). Only
+    compare ``local`` across programs compiled for the same topology.
     """
     out = {"crossing": 0.0, "local": 0.0}
     in_loop = sum(1 for rec in records if rec.get("in_loop"))

@@ -49,18 +49,24 @@ class WatchdogTimeout(TimeoutError):
     """A deadline expired; carries the protocol-state dump if known.
 
     ``state_dump`` is the formatted per-rank dump (or None); ``elapsed``
-    and ``budget`` are seconds.
+    and ``budget`` are seconds. ``state`` is the STRUCTURED per-rank
+    dump (the :meth:`credits.RingSimulator.state_dump` dict) when the
+    provider supplied one — the machine-readable payload
+    :func:`smi_tpu.parallel.recovery.failed_ranks_of` extracts
+    crash-stopped ranks from to drive a ULFM-style shrink.
     """
 
     def __init__(self, message: str, state_dump: Optional[str] = None,
                  elapsed: Optional[float] = None,
-                 budget: Optional[float] = None):
+                 budget: Optional[float] = None,
+                 state: Optional[dict] = None):
         if state_dump:
             message = f"{message}\n{state_dump}"
         super().__init__(message)
         self.state_dump = state_dump
         self.elapsed = elapsed
         self.budget = budget
+        self.state = state
 
 
 class Deadline:
@@ -95,23 +101,33 @@ class Deadline:
     def expired(self) -> bool:
         return self.budget is not None and self.elapsed() >= self.budget
 
-    def _dump(self) -> Optional[str]:
+    def _dump(self):
+        """(text, structured) from the provider — a provider may return
+        a bare string, or a ``(str, dict)`` pair whose dict rides the
+        error's ``state`` attribute for programmatic recovery."""
         if self.state_provider is None:
-            return None
+            return None, None
         try:
-            return self.state_provider()
+            dump = self.state_provider()
         except Exception as e:  # the dump must never mask the timeout
-            return f"(state dump unavailable: {type(e).__name__}: {e})"
+            return (
+                f"(state dump unavailable: {type(e).__name__}: {e})",
+                None,
+            )
+        if isinstance(dump, tuple) and len(dump) == 2:
+            return dump
+        return dump, None
 
     def check(self, context: str = "") -> None:
         """Raise :class:`WatchdogTimeout` if the budget is spent."""
         if not self.expired():
             return
         where = f" during {context}" if context else ""
+        text, state = self._dump()
         raise WatchdogTimeout(
             f"deadline of {self.budget:.3g}s exceeded{where} "
             f"(elapsed {self.elapsed():.3g}s)",
-            state_dump=self._dump(),
+            state_dump=text, state=state,
             elapsed=self.elapsed(), budget=self.budget,
         )
 
@@ -184,17 +200,19 @@ def run_with_deadline(
     try:
         kind, value = results.get(timeout=seconds)
     except queue.Empty:
-        dump = None
+        dump, state = None, None
         if state_provider is not None:
             try:
                 dump = state_provider()
             except Exception as e:
                 dump = f"(state dump unavailable: {type(e).__name__}: {e})"
+            if isinstance(dump, tuple) and len(dump) == 2:
+                dump, state = dump
         where = f" during {context}" if context else ""
         raise WatchdogTimeout(
             f"hard watchdog of {seconds:.3g}s exceeded{where} — the "
             f"device call did not complete (worker thread abandoned)",
-            state_dump=dump,
+            state_dump=dump, state=state,
             elapsed=time.monotonic() - start, budget=seconds,
         ) from None
     if kind == "err":

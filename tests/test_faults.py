@@ -44,8 +44,12 @@ def test_fault_matrix_cell(protocol, fault_class, n, seed):
     assert (verdict.kind, verdict.error_name) == (again.kind, again.error_name)
     if verdict.detected:
         assert verdict.error_name in (
-            "ClobberError", "DeadlockError", "CreditLeakError"
+            "ClobberError", "DeadlockError", "CreditLeakError",
+            "IntegrityError",
         )
+        if fault_class in F.INTEGRITY_FAULT_CLASSES:
+            # wire damage must surface as the framing's named error
+            assert verdict.error_name == "IntegrityError"
         if isinstance(verdict.error, C.DeadlockError):
             # the detection names where every rank stood
             assert verdict.error.state is not None

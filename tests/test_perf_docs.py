@@ -1,0 +1,91 @@
+"""Perf tables in README/docs must quote the committed measurements.
+
+The satellite guard behind the PERF.json -> docs regeneration: every
+headline number the prose quotes is re-derived here from the committed
+measurement and string-matched against the documents, so a re-measure
+that edits `PERF.json` without regenerating the tables fails loudly
+instead of drifting (the r5 state quoted 124.6 TF/s against a
+committed 124.8957, and 131.6 Gcell/s against 131.7385).
+
+Pure text checks — no JAX, no devices.
+"""
+
+import json
+import os
+from decimal import ROUND_HALF_UP, Decimal
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _load():
+    with open(os.path.join(ROOT, "PERF.json")) as f:
+        perf = json.load(f)
+    return {m["metric"]: m for m in perf["metrics"]}
+
+
+def _read(name):
+    with open(os.path.join(ROOT, name)) as f:
+        return f.read()
+
+
+def _round(value, places: int) -> str:
+    """Round-half-up to the doc's quoted precision (Python's round()
+    is banker's rounding — 1.275 must quote as 1.28, not 1.27)."""
+    q = Decimal(1).scaleb(-places)
+    return str(Decimal(str(value)).quantize(q, rounding=ROUND_HALF_UP))
+
+
+#: (metric, decimals, files the quote must appear in). Decimals follow
+#: the tables' own precision: 1 for TF/s / Gcell/s rates, 2 for
+#: Mtoken/s throughputs.
+HEADLINES = [
+    ("stencil_temporal_gcells", 1, ("README.md", "docs/perf_notes.md")),
+    ("stencil_fused_gcells", 1, ("README.md",)),
+    ("stencil_temporal_vs_fused", 1, ("README.md",)),
+    ("flash_attn_fwd_s32768_bf16_causal", 1,
+     ("README.md", "docs/perf_notes.md")),
+    ("flash_attn_fwd_s8192_bf16", 1, ("README.md",)),
+    ("flash_attn_fwd_s16384_bf16", 1, ("README.md",)),
+    ("flash_attn_fwd_s32768_bf16_window4096", 1, ("README.md",)),
+    ("flash_attn_train_tflops_bf16", 1, ("README.md",)),
+    ("flash_attn_train_tokens_s32768_window4096_bf16", 2, ("README.md",)),
+    ("flash_attn_train_tokens_s65536_window4096_bf16", 2, ("README.md",)),
+    ("flash_attn_train_tokens_s131072_window4096_bf16", 2, ("README.md",)),
+    ("flash_attn_train_tokens_s262144_gqa8_window4096_bf16", 2,
+     ("README.md",)),
+    ("flash_attn_train_tokens_s524288_gqa8_window4096_bf16", 2,
+     ("README.md",)),
+    ("flash_vs_stock_default", 1, ("README.md", "docs/perf_notes.md")),
+    ("flash_vs_stock_swept", 2, ("README.md",)),
+    ("transformer_train_tokens_s32768_window4096_bf16", 2, ("README.md",)),
+    ("transformer_train_tokens_s8192_window4096_l4_bf16", 3,
+     ("README.md",)),
+    ("transformer_train_tokens_s32768_window4096_l4_bf16", 3,
+     ("README.md",)),
+]
+
+
+@pytest.mark.parametrize("metric,places,files", HEADLINES,
+                         ids=[m for m, _, _ in HEADLINES])
+def test_doc_quotes_committed_measurement(metric, places, files):
+    metrics = _load()
+    assert metric in metrics, f"{metric} missing from PERF.json"
+    want = _round(metrics[metric]["value"], places)
+    for name in files:
+        text = _read(name)
+        assert want in text, (
+            f"{name} does not quote {metric} = {want} "
+            f"(committed value {metrics[metric]['value']}); the perf "
+            f"table drifted from PERF.json — regenerate the quoted "
+            f"number"
+        )
+
+
+def test_no_known_stale_values_left():
+    """The two drifts this PR fixed must not reappear verbatim."""
+    readme = _read("README.md")
+    notes = _read("docs/perf_notes.md")
+    assert "124.6 TFLOP/s" not in readme + notes
+    assert "131.6 Gcell/s" not in readme

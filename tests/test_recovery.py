@@ -1,0 +1,386 @@
+"""Self-healing collectives: verified transport + progress-logged resume.
+
+The recovery invariant over all four ring protocols: after a
+mid-collective crash-stop, down link, or in-flight payload damage, the
+runtime shrinks/re-routes/retries and the survivors' results are
+IDENTICAL to the fault-free run — every contribution accounted for,
+because contributions are durably logged before the first packet moves.
+
+Pure Python end to end (credit-protocol simulator) except the runtime
+bridge tests, which use the 8-device emulator mesh.
+"""
+
+import pytest
+
+from smi_tpu.parallel import credits as C
+from smi_tpu.parallel import faults as F
+from smi_tpu.parallel import recovery as R
+from smi_tpu.parallel.routing import RouteCutError
+
+pytestmark = pytest.mark.faults
+
+NS = [2, 3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# Verified transport framing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+@pytest.mark.parametrize("seed", range(4))
+def test_framing_transparent_when_healthy(protocol, seed):
+    """The framed transport is behaviourally identical to bare
+    transport on healthy runs — delivery verified by the harness."""
+    F._simulate(protocol, 4, C.Strategy(seed), None, 5, verified=True)
+
+
+@pytest.mark.parametrize("fault_class", F.INTEGRITY_FAULT_CLASSES)
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+def test_tampering_detected_as_named_integrity_error(fault_class,
+                                                     protocol):
+    """Every payload-tampering injection must surface as IntegrityError
+    naming the receiving rank, source, chunk, and expected vs got —
+    never as silent corruption."""
+    plan = F.FaultPlan.random(fault_class, 4, 1)
+    verdict = F.run_under_faults(protocol, 4, plan, C.Strategy(0))
+    assert verdict.detected
+    assert verdict.error_name == "IntegrityError"
+    e = verdict.error
+    assert e.rank is not None and e.src is not None
+    assert e.expected is not None and e.got is not None
+    assert e.kind in ("checksum", "sequence")
+    if fault_class == "reordered_chunks":
+        assert e.kind == "sequence"
+    else:
+        assert e.kind == "checksum"
+
+
+@pytest.mark.parametrize("fault_class",
+                         ["bit_flip_payload", "truncated_dma"])
+def test_bare_transport_corrupts_silently(fault_class):
+    """WITHOUT framing the same injections complete with wrong data
+    (SilentCorruption from the harness's output check) — the framing
+    layer's existence proof. Tolerated is also legal: small runs may
+    never issue the targeted nth DMA."""
+    silent = 0
+    for protocol in F.PROTOCOLS:
+        for seed in range(3):
+            plan = F.FaultPlan.random(fault_class, 4, seed)
+            try:
+                v = F.run_under_faults(protocol, 4, plan, C.Strategy(0),
+                                       verified=False)
+                assert v.tolerated, (protocol, seed, v.kind)
+            except F.SilentCorruption:
+                silent += 1
+    assert silent >= len(F.PROTOCOLS)  # the damage is real, and unseen
+
+
+def test_frame_crc_keys_identity_and_payload():
+    f = C.make_frame(2, 7, "payload")
+    assert C.frame_crc(2, 7, True, "payload") == f.crc
+    assert C.frame_crc(2, 8, True, "payload") != f.crc
+    assert C.frame_crc(3, 7, True, "payload") != f.crc
+    assert C.frame_crc(2, 7, True, "payloaX") != f.crc
+
+
+# ---------------------------------------------------------------------------
+# Crash-stop recovery (shrink + heir inheritance)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+@pytest.mark.parametrize("n", [3, 4, 5])
+def test_crash_stop_resumes_identical(protocol, n):
+    """A rank crash-stopping mid-collective is shrunk out; the
+    survivors resume and end with results identical to the fault-free
+    run — the dead rank's contribution recovered from its durable
+    log."""
+    plan = F.FaultPlan.single(F.StalledRank(1, after=6))
+    out = R.run_with_recovery(protocol, n, plan, strategy_seed=0)
+    assert out.ok
+    assert out.survivors == tuple(r for r in range(n) if r != 1)
+    assert out.attempts[0].verdict == "DeadlockError"
+    assert 1 in out.attempts[0].failed_ranks
+    assert out.attempts[-1].verdict in ("completed", "resumed-from-log")
+    # identical-to-fault-free is checked per survivor, exactly
+    for g in out.survivors:
+        assert out.results[g] == out.expected[g]
+
+
+@pytest.mark.parametrize("protocol", R.ITEM_PROTOCOLS)
+def test_resume_replays_only_undelivered(protocol):
+    """A late crash leaves most chunks delivered: the delivery
+    protocols' resume pass must move strictly less than the full
+    volume (only the union of missing items)."""
+    plan = F.FaultPlan.single(F.StalledRank(2, after=20))
+    out = R.run_with_recovery(protocol, 5, plan, strategy_seed=0,
+                              chunks=6)
+    assert out.ok
+    total = sum(len(out.expected[g]) for g in out.survivors)
+    assert 0 < out.replayed_chunks < total, (
+        out.replayed_chunks, total
+    )
+
+
+@pytest.mark.parametrize("protocol", R.REDUCE_PROTOCOLS)
+def test_reduce_resume_restarts_from_logged_inputs(protocol):
+    """Reduction protocols never reuse partial state (a non-idempotent
+    combine would double-count): the resume re-folds the durably
+    logged INPUTS — at most one contribution per surviving executor
+    moves again."""
+    plan = F.FaultPlan.single(F.StalledRank(2, after=20))
+    out = R.run_with_recovery(protocol, 5, plan, strategy_seed=0,
+                              chunks=6)
+    assert out.ok
+    assert 0 < out.replayed_chunks <= len(out.survivors)
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+def test_resume_after_last_chunk_replays_nothing(protocol):
+    """Satellite edge case: when the fault strikes after every chunk
+    was delivered (a rank dies parked at its final waits), the resume
+    finds complete logs and replays NOTHING — no second network
+    pass."""
+    hit = None
+    for after in range(8, 120):
+        plan = F.FaultPlan.single(F.StalledRank(1, after=after))
+        try:
+            out = R.run_with_recovery(protocol, 3, plan,
+                                      strategy_seed=0, chunks=3)
+        except R.UnrecoverableError:
+            continue
+        if (len(out.attempts) > 1
+                and out.attempts[-1].verdict == "resumed-from-log"):
+            hit = out
+            break
+    assert hit is not None, "no stall point with complete logs found"
+    assert hit.ok
+    assert hit.replayed_chunks == 0
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+def test_double_fault_during_replay(protocol):
+    """Satellite edge case: a second crash-stop during the resume pass
+    shrinks again and still completes identically."""
+    out = R.run_with_recovery(
+        protocol, 5, F.FaultPlan.single(F.StalledRank(1, after=4)),
+        strategy_seed=3,
+        followup_plans=[F.FaultPlan.single(F.StalledRank(2, after=3))],
+    )
+    assert out.ok
+    assert len(out.attempts) == 3
+    assert len(out.survivors) == 3
+    trail = out.fault_trail
+    assert trail[0] == "DeadlockError" and trail[1] == "DeadlockError"
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+def test_shrink_to_single_survivor(protocol):
+    """Satellite edge case: n=2 with the peer dead shrinks to ONE rank,
+    which assembles the full result locally from the durable WALs."""
+    out = R.run_with_recovery(
+        protocol, 2, F.FaultPlan.single(F.StalledRank(1, after=2)),
+        strategy_seed=0, chunks=3,
+    )
+    assert out.ok
+    assert out.survivors == (0,)
+    assert out.results[0] == out.expected[0]
+
+
+def test_every_rank_dead_is_named_annihilation():
+    plan = F.FaultPlan.of(
+        [F.StalledRank(0, after=5), F.StalledRank(1, after=5)]
+    )
+    with pytest.raises(R.UnrecoverableError) as e:
+        R.run_with_recovery("all_gather", 2, plan, strategy_seed=0)
+    assert e.value.annihilated
+
+
+# ---------------------------------------------------------------------------
+# Down-link recovery (re-route via FailureSet, shrink when impossible)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+@pytest.mark.parametrize("n", [4, 5, 6])
+def test_down_link_reroutes_keeping_all_ranks(protocol, n):
+    """With n >= 4 a dead wire is re-routed: the logical ring re-forms
+    with the endpoints non-adjacent, EVERY rank survives, and the dead
+    wire stays enforced in the resumed run (a buggy re-route would
+    deadlock, not silently transit it)."""
+    plan = F.FaultPlan.single(F.DownLink(0, 1))
+    out = R.run_with_recovery(protocol, n, plan, strategy_seed=1)
+    assert out.ok
+    assert out.survivors == tuple(range(n))
+    ring = out.attempts[-1].ring
+    pos = {g: i for i, g in enumerate(ring)}
+    gap = abs(pos[0] - pos[1])
+    assert gap not in (1, len(ring) - 1), f"0 and 1 adjacent in {ring}"
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+def test_down_link_small_ring_shrinks_endpoint(protocol):
+    """A 3-ring cannot separate two ranks; the higher endpoint is
+    shrunk (deterministically) and the pair's data is still complete
+    at the survivors."""
+    plan = F.FaultPlan.single(F.DownLink(0, 1))
+    out = R.run_with_recovery(protocol, 3, plan, strategy_seed=1)
+    assert out.ok
+    assert out.survivors == (0, 2)
+
+
+def test_separating_order_properties():
+    assert R._separating_order([0, 1, 2, 3], set()) == [0, 1, 2, 3]
+    order = R._separating_order([0, 1, 2, 3], {(0, 1)})
+    pos = {g: i for i, g in enumerate(order)}
+    assert abs(pos[0] - pos[1]) not in (1, 3)
+    assert R._separating_order([0, 1], {(0, 1)}) is None
+    assert R._separating_order([0, 1, 2], {(0, 1)}) is None
+
+
+def test_cut_routability_check_uses_failure_set():
+    """The re-route step validates against the routing layer's
+    FailureSet machinery: a single ring-wire cut on a torus of n >= 3
+    leaves every surviving pair routable the long way around (no
+    raise); the same machinery raises RouteCutError when a failure
+    set genuinely isolates a destination (the routing property tests
+    cover that shape — here we pin the recovery-side call)."""
+    for n in (3, 4, 5, 6):
+        R._check_cut_routable(n, (0, 1), list(range(n)))
+        R._check_cut_routable(n, (n - 1, 0), list(range(n)))  # wrap wire
+    # non-ring-wire pairs (no physical wire to cut) are a no-op
+    R._check_cut_routable(5, (0, 2), [0, 1, 2, 3, 4])
+    # the named-isolation path: every wire of one device cut
+    from smi_tpu.parallel.routing import (
+        FailureSet, build_routing_context, check_all_pairs_routable,
+        grid_topology,
+    )
+
+    topo = grid_topology(1, 4)
+    victim = topo.devices[1]
+    cut = FailureSet(links=frozenset(
+        (dev, li) for (dev, li) in topo.connections if dev == victim
+    ))
+    ctx = build_routing_context(topo, excluded=cut)
+    with pytest.raises(RouteCutError):
+        check_all_pairs_routable(ctx, topo.devices)
+
+
+# ---------------------------------------------------------------------------
+# Transient faults: retry-with-replay, full ring preserved
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fault", [
+    F.BitFlipPayload(1, nth=0),
+    F.TruncatedDma(2, nth=1),
+    F.ReorderedChunks(0, nth=0),
+    F.DroppedGrant(0, nth=0),
+])
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+def test_transient_fault_retries_whole_ring(protocol, fault):
+    out = R.run_with_recovery(
+        protocol, 4, F.FaultPlan.single(fault), strategy_seed=2,
+    )
+    assert out.ok
+    assert out.survivors == (0, 1, 2, 3)
+
+
+# ---------------------------------------------------------------------------
+# Progress logs
+# ---------------------------------------------------------------------------
+
+
+def test_progress_log_idempotent_and_sequenced():
+    log = R.ProgressLog(0, contribution="mine")
+    assert log.record("a", 1) and log.seq == 1
+    assert not log.record("a", 2)  # replayed delivery dropped
+    assert log.entries["a"] == 1 and log.seq == 1
+    assert log.missing({"a", "b"}) == {"b"}
+
+
+def test_expected_results_match_simulator_delivery():
+    """The analytic fault-free yardstick agrees with what the real
+    protocols deliver — per protocol and rank count."""
+    for protocol in F.PROTOCOLS:
+        for n in (2, 4):
+            chunks = 3
+            inputs = R.canonical_inputs(protocol, n, chunks)
+            expected = R.expected_results(protocol, n, inputs, chunks)
+            out = R.run_with_recovery(protocol, n, None,
+                                      strategy_seed=0, chunks=chunks)
+            assert len(out.attempts) == 1
+            for g in range(n):
+                assert out.results[g] == expected[g], (protocol, n, g)
+
+
+# ---------------------------------------------------------------------------
+# Runtime bridge: shrink a live communicator from a caught error
+# ---------------------------------------------------------------------------
+
+
+def test_heirs_mapping():
+    jax = pytest.importorskip("jax")
+    import smi_tpu as smi
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device emulator mesh")
+    comm = smi.make_communicator(8, devices=devices[:8])
+    assert comm.heirs({2, 3}) == {2: 4, 3: 4}
+    assert comm.heirs({7}) == {7: 0}
+    assert comm.heirs({6, 7, 0}) == {6: 1, 7: 1, 0: 1}
+    with pytest.raises(ValueError, match="no survivors"):
+        comm.heirs(range(8))
+    with pytest.raises(ValueError, match="out of range"):
+        comm.heirs({9})
+
+
+def test_recover_communicator_from_deadlock_error():
+    jax = pytest.importorskip("jax")
+    import smi_tpu as smi
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device emulator mesh")
+    comm = smi.make_communicator(8, devices=devices[:8])
+    with pytest.raises(C.DeadlockError) as e:
+        C.simulate_all_reduce(
+            8, C.Strategy(0),
+            faults=F.FaultPlan.single(F.StalledRank(5, after=3)),
+        )
+    small, heirs = smi.recover_communicator(comm, e.value)
+    assert small.size == 7
+    assert heirs == {5: 6}
+    kept = [d for i, d in enumerate(devices[:8]) if i != 5]
+    assert list(small.mesh.devices.flat) == kept
+
+
+def test_recover_communicator_from_watchdog_timeout():
+    jax = pytest.importorskip("jax")
+    import smi_tpu as smi
+    from smi_tpu.utils.watchdog import WatchdogTimeout
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device emulator mesh")
+    comm = smi.make_communicator(8, devices=devices[:8])
+    # a runtime watchdog timeout whose structured dump names rank 3
+    state = {3: {"state": "stalled", "pending": None, "outputs": 0},
+             0: {"state": "blocked", "pending": None, "outputs": 1}}
+    err = WatchdogTimeout("hang", state=state)
+    small, heirs = smi.recover_communicator(comm, err)
+    assert small.size == 7 and heirs == {3: 4}
+    # a transient failure (no ranks named) must NOT be shrunk
+    with pytest.raises(ValueError, match="retry"):
+        smi.recover_communicator(comm, WatchdogTimeout("hang"))
+
+
+def test_failed_ranks_of_maps_ring_local_to_global():
+    state = {0: {"state": "blocked"}, 1: {"state": "stalled"},
+             2: {"state": "finished"}}
+    err = C.DeadlockError("dead", state=state)
+    assert R.failed_ranks_of(err) == {1}
+    assert R.failed_ranks_of(err, survivors=[0, 3, 4]) == {3}
+    assert R.failed_ranks_of(ValueError("no dump")) == set()

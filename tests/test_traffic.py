@@ -100,6 +100,72 @@ def test_tier_crossing_flags_in_loop_records():
         loop_free, {d: d // 4 for d in range(8)})
 
 
+def test_has_collectives_sees_host_transfer_sends():
+    """A megascale host-transfer send IS collective traffic (the DCN
+    egress of a multi-slice collective): has_collectives must flag it
+    so a megascale-send parser regression reads as a parser miss, not
+    as a collective-free program."""
+    send_line = (
+        '%send.1 = (f32[32]{0}, u32[], token[]) send(%x, %tok), '
+        'channel_id=9, is_host_transfer=true, '
+        'frontend_attributes={_xla_host_transfer_handler_name='
+        '"xla_megascale_runtime",_xla_megascale_transfer_type='
+        '"ALL_REDUCE"}'
+    )
+    assert T.has_collectives(send_line)
+    # the parser books it today — the two rule sets are in sync
+    assert T.collective_traffic(FakeCompiled(send_line))
+    # a renamed runtime attribute breaks the parser but NOT the
+    # detector: exactly the regression shape the check exists to flag
+    renamed = send_line.replace("_xla_megascale", "_xla_renamed")
+    assert T.has_collectives(renamed)
+    assert not T.collective_traffic(FakeCompiled(renamed))
+    # plain device-to-device send (no host transfer) stays invisible
+    assert not T.has_collectives(
+        "%send.2 = f32[8]{0} send(%x), channel_id=3"
+    )
+    # and the send must share a line with the attribute — a stray
+    # "is_host_transfer=true" elsewhere is not collective traffic
+    assert not T.has_collectives(
+        "%send.2 = f32[8]{0} send(%x), channel_id=3\n"
+        "%custom.1 = f32[8]{0} custom-call(), is_host_transfer=true"
+    )
+    # a host CALLBACK send (jax.debug.print / io_callback) is a
+    # host transfer but NOT collective traffic: flagging it would book
+    # a spurious parser-miss error on collective-free programs
+    assert not T.has_collectives(
+        '%send.3 = (f32[8]{0}, u32[], token[]) send(%x, %tok), '
+        'channel_id=4, is_host_transfer=true, '
+        'frontend_attributes={_xla_host_transfer_handler_name='
+        '"xla_ffi_python_cpu_callback"}'
+    )
+
+
+def test_lone_brace_resets_computation_scope():
+    """A computation's closing `}` must end its scope: with a
+    constant-heavy entry whose header the regex cannot match (some
+    print options drop the parameter list), instructions after the
+    while body's `}` previously inherited the body's scope and were
+    falsely flagged in_loop."""
+    hlo = """
+%body.6 (b: f32[8]) -> f32[8] {
+  %loop-ar.1 = f32[8]{0} all-reduce(%b), channel_id=5, replica_groups={{0,1}}, to_apply=%add.1
+}
+
+ENTRY %main.20 {
+  %big = f32[64]{0} constant({1, 2, 3, 4, 5, 6, 7, 8})
+  %entry-ar.2 = f32[64]{0} all-reduce(%big), channel_id=6, replica_groups={{0,1}}, to_apply=%add.1
+  ROOT %w = f32[8]{0} while(%p), condition=%cond.7, body=%body.6
+}
+"""
+    recs = T.collective_traffic(FakeCompiled(hlo))
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["loop-ar.1"].get("in_loop") is True
+    assert "in_loop" not in by_name["entry-ar.2"], (
+        "entry-computation collective inherited the while body's scope"
+    )
+
+
 def test_loop_computations_transitive():
     """A collective nested one call deeper than the while body is still
     loop-resident."""
