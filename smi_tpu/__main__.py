@@ -620,6 +620,71 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     return 0 if report["ok"] else 1
 
 
+def cmd_traffic(args: argparse.Namespace) -> int:
+    """Offline traffic/overlap analysis of an HLO text dump.
+
+    The artifact-reading half of the ``aoc -rtl -report`` workflow for
+    the overlap engine: feed it ``compiled.as_text()`` (saved by an AOT
+    run or ``jax.jit(...).lower(x).compile().as_text()``) and it prints
+    either the per-collective payload records or — with ``--overlap`` —
+    the comm/compute overlap report
+    (:func:`smi_tpu.parallel.traffic.overlap_report`), making overlap a
+    checkable property of a build artifact rather than a profile-time
+    hope. ``--require-overlap`` exits nonzero when no compute is
+    overlappable/scheduled during the collectives — a CI gate.
+    """
+    from smi_tpu.parallel import traffic as T
+
+    try:
+        with open(args.hlo) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.overlap:
+        report = T.overlap_report(hlo_text=text)
+        print(
+            f"collectives: {report['collectives']} "
+            f"({report['async_pairs']} async pairs)"
+        )
+        print(
+            f"overlappable compute: {report['overlappable_bytes']} B "
+            f"in {report['overlappable_ops']} ops "
+            f"({report['overlap_fraction']:.1%} of "
+            f"{report['compute_bytes']} B compute)"
+        )
+        if report["async_pairs"]:
+            print(
+                f"scheduled between start/done: "
+                f"{report['scheduled_bytes']} B"
+            )
+        payload = report
+        failed = args.require_overlap and report["overlapped_bytes"] == 0
+    else:
+        records = T.collective_traffic(None, hlo_text=text)
+        for rec in records:
+            loop = " (in loop)" if rec.get("in_loop") else ""
+            print(
+                f"{rec['op']:>20} {rec['name']:<32} "
+                f"{rec['bytes']:>12} B{loop}"
+            )
+        print(
+            f"{len(records)} collectives, "
+            f"{sum(r['bytes'] for r in records)} B total payload"
+        )
+        payload = {"collectives": records}
+        failed = args.require_overlap and not records
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        print(f"report -> {args.out}")
+    if failed:
+        print("error: no comm/compute overlap found", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     from smi_tpu.benchmarks.__main__ import main as bench_main
 
@@ -819,6 +884,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--out", default=None,
                    help="write the JSON campaign report here")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "traffic",
+        help="analyze an HLO text dump: per-collective payloads, or "
+             "--overlap for the statically-verified comm/compute "
+             "overlap report",
+    )
+    p.add_argument("hlo", help="path to an HLO text dump "
+                               "(compiled.as_text())")
+    p.add_argument("--overlap", action="store_true",
+                   help="report compute schedulable (sync modules) or "
+                        "scheduled (async pairs) during the "
+                        "collectives instead of payload records")
+    p.add_argument("--require-overlap", action="store_true",
+                   help="exit nonzero when the report finds no "
+                        "overlap (with --overlap) or no collectives — "
+                        "a CI gate on build artifacts")
+    p.add_argument("-o", "--out", default=None,
+                   help="write the full JSON report here")
+    p.set_defaults(fn=cmd_traffic)
 
     p = sub.add_parser("bench", help="run a microbenchmark")
     p.add_argument("rest", nargs=argparse.REMAINDER)

@@ -81,6 +81,11 @@ def main(argv=None):
             p["rendezvous"] = not args.eager
         elif name == "pipeline_double_rail":
             p.pop("root", None)
+        elif name == "overlap":
+            p.pop("root", None)
+            p.pop("elements", None)
+            if args.size_kb is not None:
+                p["size_kb"] = args.size_kb
         elif name.startswith("app_"):
             p.pop("root", None)
             p.pop("elements", None)

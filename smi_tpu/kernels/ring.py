@@ -169,16 +169,9 @@ def _logical_id_fn(ring_axes: Tuple[str, ...], mesh_axes: MeshAxes):
 
 
 
-def _ring_context(axis_name: RingAxes, n: int, mesh_axes: MeshAxes):
-    """(ring_axes, ring_sizes, to_logical) shared by the four wrappers.
-
-    ``ring_sizes`` carries the per-axis extents a flattened multi-axis
-    rank needs; for a single axis only ``n`` matters. ``mesh_axes``
-    (ordered (name, size) of the FULL mesh) is REQUIRED whenever the
-    ring does not span the whole mesh in mesh order — see
-    :func:`_logical_id_fn`.
-    """
-    ring_axes = _normalize_axes(axis_name)
+@functools.lru_cache(maxsize=None)
+def _ring_context_cached(ring_axes: Tuple[str, ...], n: int,
+                         mesh_axes: MeshAxes):
     if mesh_axes is not None:
         sizes = dict(mesh_axes)
         ring_sizes = {a: sizes[a] for a in ring_axes if a in sizes}
@@ -189,7 +182,30 @@ def _ring_context(axis_name: RingAxes, n: int, mesh_axes: MeshAxes):
                 "multi-axis rings need mesh_axes=((name, size), ...) to "
                 "derive per-axis extents and logical device ids"
             )
-    return ring_axes, ring_sizes, _logical_id_fn(ring_axes, tuple(mesh_axes) if mesh_axes is not None else None)
+    return ring_axes, ring_sizes, _logical_id_fn(ring_axes, mesh_axes)
+
+
+def _ring_context(axis_name: RingAxes, n: int, mesh_axes: MeshAxes):
+    """(ring_axes, ring_sizes, to_logical) shared by the four wrappers.
+
+    ``ring_sizes`` carries the per-axis extents a flattened multi-axis
+    rank needs; for a single axis only ``n`` matters. ``mesh_axes``
+    (ordered (name, size) of the FULL mesh) is REQUIRED whenever the
+    ring does not span the whole mesh in mesh order — see
+    :func:`_logical_id_fn`.
+
+    Memoized per ``(ring axes, n, mesh_axes)`` — every traced
+    collective call used to rebuild the context and its
+    :func:`_logical_id_fn` closure (a multi-hop channel retraces this
+    dozens of times per program); all inputs are hashable statics, the
+    closure is trace-pure (it reads ``lax.axis_index`` of the CALLING
+    trace), so one instance serves every retrace. Hit-counted by
+    ``tests/test_overlap.py``.
+    """
+    return _ring_context_cached(
+        _normalize_axes(axis_name), n,
+        tuple(mesh_axes) if mesh_axes is not None else None,
+    )
 
 
 def mesh_axes_of(comm: Communicator) -> Tuple[Tuple[str, int], ...]:
@@ -499,6 +515,74 @@ def _ring_all_reduce_kernel(
     o_ref[...] = comm_buf[final_slot]
 
 
+def _ring_all_reduce_chunked_kernel(
+    x_ref, o_ref, comm_buf, send_sem, recv_sem, credit_sem,
+    *, ring_axes, ring_sizes, to_logical, n: int, op: SmiOp,
+    chunks: int, flow_control: bool
+):
+    """Software-pipelined chunked ring reduce.
+
+    The payload is split into ``chunks`` leading rows, each circulating
+    the ring on its own double-buffered VMEM slot pair (flat slot layout
+    ``2*c + parity``). Every ring step runs three phases over the static
+    chunk unroll: START all chunk RDMAs, then COMBINE each arrival (so
+    chunk ``c``'s fold runs while chunks ``c+1..`` are still in flight —
+    the in-kernel rendition of SMI's asynchronicity degree), then
+    re-grant the emptied slots once their onward sends completed. The
+    per-chunk credit protocol is byte-identical to the unchunked
+    kernel's; all chunks share this stream's barrier-semaphore domain.
+    Protocol model: ``credits.all_reduce_chunked_rank`` (exhaustively
+    schedule-fuzzed; the kernel mirrors it one primitive per yield).
+    """
+    combine = _combine_fn(op)
+    me = _ring_rank(ring_axes, ring_sizes)
+    if flow_control:
+        _neighbour_barrier(me, n, to_logical)
+    for c in range(chunks):
+        comm_buf[2 * c] = x_ref[c]
+        if flow_control:
+            _grant_slot(credit_sem, 2 * c + 1, me, n, to_logical)
+
+    def step(s, _):
+        slot, nslot = s % 2, (s + 1) % 2
+        dst = lax.rem(me + 1, jnp.int32(n))
+        rdmas = []
+        for c in range(chunks):
+            if flow_control:
+                pltpu.semaphore_wait(credit_sem.at[2 * c + nslot], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[2 * c + slot],
+                dst_ref=comm_buf.at[2 * c + nslot],
+                send_sem=send_sem.at[2 * c + slot],
+                recv_sem=recv_sem.at[2 * c + nslot],
+                device_id=to_logical(dst),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdmas.append(rdma)
+        for c, rdma in enumerate(rdmas):
+            rdma.wait_recv()
+            comm_buf[2 * c + nslot] = combine(
+                comm_buf[2 * c + nslot], x_ref[c]
+            )
+        for c, rdma in enumerate(rdmas):
+            rdma.wait_send()
+            if flow_control:
+                # the slot's content is fully sent onward: its writer
+                # may reuse it — except on the last step, whose grant
+                # nobody would consume (credit balance ends at zero)
+                @pl.when(s < n - 2)
+                def _():
+                    _grant_slot(credit_sem, 2 * c + slot, me, n,
+                                to_logical)
+        return ()
+
+    lax.fori_loop(0, n - 1, step, ())
+    final_slot = (n - 1) % 2
+    for c in range(chunks):
+        o_ref[c] = comm_buf[2 * c + final_slot]
+
+
 def ring_all_reduce(
     x: jax.Array,
     axis_name: RingAxes,
@@ -508,18 +592,69 @@ def ring_all_reduce(
     flow_control: bool = True,
     stream: int = 0,
     mesh_axes: MeshAxes = None,
+    chunks: int = 1,
 ) -> jax.Array:
     """ADD/MAX/MIN all-reduce along a ring with explicit neighbour RDMA.
 
     Each rank's partial makes a full circuit: after ``n-1`` hops every
     rank has folded in all ``n`` contributions (each rank accumulates a
     rotated order, so float sums match up to reassociation).
+
+    ``chunks > 1`` splits the payload's leading axis into that many
+    pipeline rows, each on its own double-buffered VMEM slot pair, with
+    chunk ``c+1``'s RDMA in flight while chunk ``c`` combines (see
+    :func:`_ring_all_reduce_chunked_kernel`). Zero rows pad the split
+    evenly; the pad is identical on every rank and sliced off the
+    result, so it is safe for MAX/MIN as well as ADD. VMEM cost grows
+    with ``chunks`` (2 slots per chunk) — keep it small (2-8).
     """
     if n == 1:
         return x
     _check_reducible(x, interpret)
-    payload, logical = _pad_lanes(_lift_payload(x))
+    chunks = max(1, min(int(chunks), x.shape[0] if x.ndim else 1))
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
+    if chunks > 1:
+        rows = x.shape[0]
+        per = -(-rows // chunks)
+        pad = per * chunks - rows
+        xp = x
+        if pad:
+            xp = jnp.concatenate(
+                [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]
+            )
+        if x.ndim == 1:
+            xu = xp.reshape(chunks, 1, per)
+        else:
+            xu = xp.reshape((chunks, per) + x.shape[1:])
+        xu, logical = _pad_lanes(xu)
+        block = xu.shape[1:]
+        kernel = functools.partial(
+            _ring_all_reduce_chunked_kernel, ring_axes=ring_axes,
+            ring_sizes=ring_sizes, to_logical=to_logical, n=n,
+            op=SmiOp.parse(op), chunks=chunks, flow_control=flow_control,
+        )
+        reduced = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(xu.shape, x.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM((2 * chunks,) + block, x.dtype),
+                pltpu.SemaphoreType.DMA((2 * chunks,)),
+                pltpu.SemaphoreType.DMA((2 * chunks,)),
+                pltpu.SemaphoreType.REGULAR((2 * chunks,)),
+            ],
+            compiler_params=_compiler_params(
+                _CID_ALL_REDUCE, stream, flow_control,
+            ),
+            interpret=_interpret_arg(interpret),
+        )(xu)
+        if logical != xu.shape[-2:]:
+            reduced = reduced[..., : logical[0], : logical[1]]
+        return reduced.reshape((chunks * per,) + x.shape[1:])[
+            :rows
+        ].reshape(x.shape)
+    payload, logical = _pad_lanes(_lift_payload(x))
     kernel = functools.partial(
         _ring_all_reduce_kernel, ring_axes=ring_axes,
         ring_sizes=ring_sizes, to_logical=to_logical, n=n,

@@ -47,7 +47,10 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from smi_tpu.parallel.halo import halo_exchange_2d_corners
+from smi_tpu.parallel.halo import (
+    halo_exchange_2d_corners_finish,
+    halo_exchange_2d_corners_start,
+)
 from smi_tpu.parallel.mesh import Communicator
 
 #: lane padding per side of the extended array (one full register tile)
@@ -276,19 +279,24 @@ def _temporal_pass_ext(
 
     # --- corner-complete halo refresh; only halo-width slices move ---
     # (XLA fuses the block view into the ppermute operands, so no full
-    # copy of the centre columns is materialized)
-    halos = halo_exchange_2d_corners(
+    # copy of the centre columns is materialized). Split form: the
+    # column updates below consume only the phase-1 (horizontal) slabs,
+    # so they are scheduled while the phase-2 vertical ppermutes fly —
+    # the inter-pass refresh overlaps its own assembly work.
+    exchange = halo_exchange_2d_corners_start(
         xext[:, LANE_PAD : LANE_PAD + w], comm, depth=k
     )
-    xext = lax.dynamic_update_slice(xext, halos.left, (0, LANE_PAD - k))
-    xext = lax.dynamic_update_slice(xext, halos.right, (0, LANE_PAD + w))
+    xext = lax.dynamic_update_slice(xext, exchange.left,
+                                    (0, LANE_PAD - k))
+    xext = lax.dynamic_update_slice(xext, exchange.right,
+                                    (0, LANE_PAD + w))
     zrow = jnp.zeros((k, LANE_PAD - k), xext.dtype)
-    top_ext = jnp.concatenate([zrow, halos.top, zrow], axis=1)
-    bottom_ext = jnp.concatenate([zrow, halos.bottom, zrow], axis=1)
-
     rx = lax.axis_index(row_axis)
     cy = lax.axis_index(col_axis)
     offs = jnp.stack([rx * h, cy * w]).astype(jnp.int32)
+    halos = halo_exchange_2d_corners_finish(exchange)
+    top_ext = jnp.concatenate([zrow, halos.top, zrow], axis=1)
+    bottom_ext = jnp.concatenate([zrow, halos.bottom, zrow], axis=1)
 
     kernel = functools.partial(
         _temporal_kernel, tile=t, width=w, depth=k, gh=gh, gw=gw
@@ -450,11 +458,14 @@ def _temporal_pass_ext_tiled(
     n_rows = h // t
     n_cols = wp // wc
 
-    halos = halo_exchange_2d_corners(
+    exchange = halo_exchange_2d_corners_start(
         xext[:, LANE_PAD : LANE_PAD + w], comm, depth=k
     )
-    xext = lax.dynamic_update_slice(xext, halos.left, (0, LANE_PAD - k))
-    xext = lax.dynamic_update_slice(xext, halos.right, (0, LANE_PAD + w))
+    xext = lax.dynamic_update_slice(xext, exchange.left,
+                                    (0, LANE_PAD - k))
+    xext = lax.dynamic_update_slice(xext, exchange.right,
+                                    (0, LANE_PAD + w))
+    halos = halo_exchange_2d_corners_finish(exchange)
     zrow = jnp.zeros((k, LANE_PAD - k), xext.dtype)
     zpad = jnp.zeros((k, LANE_PAD), xext.dtype)
     # pad a full register tile per side so per-tile slices never clamp

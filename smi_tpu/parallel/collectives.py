@@ -33,6 +33,20 @@ the data". The *port* selects the stream assignment from the program
 model (distinct ports → independent collectives XLA is free to overlap;
 there is no false serialization because the ops share no data
 dependencies).
+
+Streaming overlap: every collective takes ``chunks=`` — the TPU analog
+of SMI's asynchronicity degree (``rewrite.py:26-33``). A chunked
+collective splits its payload along the leading axis and emits one
+independent collective per chunk plus a reassembly epilogue, so XLA's
+latency-hiding scheduler keeps chunk *i+1*'s psum/ppermute in flight
+while chunk *i*'s result combines — the element-streaming-during-compute
+shape of the reference, recovered at collective granularity. Chunking
+is pure payload splitting: each element's reduction tree is unchanged,
+so results are bit-identical to the unchunked call (property-tested in
+``tests/test_overlap.py``). Large ADD all-reduces additionally switch
+to the bandwidth-optimal reduce-scatter + all-gather decomposition
+(:data:`RS_AG_MIN_BYTES`); that path reassociates the sum and is
+therefore opt-in-by-size, never triggered below the threshold.
 """
 
 from __future__ import annotations
@@ -138,9 +152,174 @@ def _is_root(comm: Communicator, root: int) -> jax.Array:
     return comm.rank() == root
 
 
+# ---------------------------------------------------------------------------
+# Chunked software pipelining
+# ---------------------------------------------------------------------------
+
+#: Per-shard payload bytes at or above which an ADD ``allreduce`` on the
+#: XLA tier decomposes into reduce-scatter + all-gather. Below it one
+#: psum wins (latency-bound regime: one collective, no epilogue); above
+#: it each link carries ``2(n-1)/n`` of the payload instead of the
+#: naive gather-everything volume — the standard bandwidth-optimal
+#: switch (scaling-book allreduce analysis; DDP bucketing plays the
+#: same trade). The decomposition reassociates the sum, so it is gated
+#: on size (and on ``rs_ag=`` for explicit control), never silently
+#: applied to the small payloads the bit-identity property covers.
+RS_AG_MIN_BYTES = 1 << 20
+
+
+def _check_chunks(chunks: int) -> int:
+    if not isinstance(chunks, int) or isinstance(chunks, bool):
+        raise TypeError(f"chunks must be an int, got {chunks!r}")
+    if chunks < 1:
+        raise ValueError(f"chunks must be >= 1, got {chunks}")
+    return chunks
+
+
+def _chunk_bounds(total: int, chunks: int):
+    """Balanced contiguous split of ``[0, total)`` into at most
+    ``chunks`` non-empty ranges (``np.array_split``'s law: the first
+    ``total % k`` chunks get one extra element). ``chunks`` beyond
+    ``total`` clamps — a chunk is at least one element."""
+    k = max(1, min(chunks, total))
+    q, r = divmod(total, k)
+    bounds, start = [], 0
+    for i in range(k):
+        size = q + (1 if i < r else 0)
+        bounds.append((start, start + size))
+        start += size
+    return bounds
+
+
+def _pipelined(x: jax.Array, chunks: int, emit):
+    """Emit one collective per leading-axis chunk and reassemble.
+
+    The chunks share no data dependencies, so XLA is free to overlap
+    chunk *i+1*'s collective with whatever consumes chunk *i* — the
+    software pipeline is the dataflow, not explicit async handles.
+    Identity transform for ``chunks=1``, scalars, and 1-row payloads.
+    """
+    if chunks <= 1 or x.ndim == 0 or x.shape[0] <= 1:
+        return emit(x)
+    bounds = _chunk_bounds(x.shape[0], chunks)
+    if len(bounds) <= 1:
+        return emit(x)
+    return jnp.concatenate([emit(x[s:e]) for s, e in bounds], axis=0)
+
+
+def _reassemble_rank_major(pieces, bounds, size: int) -> jax.Array:
+    """Rank-major reassembly of per-chunk tiled gathers.
+
+    Each ``pieces[i]`` is a ``(size * n_i, ...)`` gather of chunk ``i``
+    (rank-interleaved chunk-major); the unchunked layout wants rank
+    ``r``'s full contribution contiguous, i.e. the concatenation of
+    its slice of every chunk's gather. Shared by the XLA and ring
+    gather tiers so the two epilogues cannot diverge.
+    """
+    rows = []
+    for r in range(size):
+        for piece, (s, e) in zip(pieces, bounds):
+            ni = e - s
+            rows.append(piece[r * ni:(r + 1) * ni])
+    return jnp.concatenate(rows, axis=0)
+
+
+def _chunked_all_gather(x: jax.Array, name, size: int, chunks: int):
+    """Tiled all-gather in leading-axis chunks.
+
+    Per-chunk gathers interleave by rank (chunk-major), so the epilogue
+    reassembles the rank-major layout of the unchunked call. Pure data
+    movement — bit-identical to one all_gather.
+    """
+    total = x.shape[0]
+    bounds = _chunk_bounds(total, chunks) if chunks > 1 else [(0, total)]
+    if len(bounds) <= 1:
+        return lax.all_gather(x, name, axis=0, tiled=True)
+    pieces = [
+        lax.all_gather(x[s:e], name, axis=0, tiled=True) for s, e in bounds
+    ]
+    return _reassemble_rank_major(pieces, bounds, size)
+
+
+def _chunked_psum_scatter(x: jax.Array, name, size: int, chunks: int):
+    """Tiled psum-scatter in chunks of the per-destination block.
+
+    ``x`` is ``(size * count, ...)``; chunking splits the ``count`` dim
+    (NOT the raw leading dim — a naive split would misalign the
+    rank-interleaved destination blocks) and scatters each column range
+    independently; results concatenate back in block order.
+    """
+    count = x.shape[0] // size
+    bounds = _chunk_bounds(count, chunks) if chunks > 1 else [(0, count)]
+    if len(bounds) <= 1:
+        return lax.psum_scatter(x, name, scatter_dimension=0, tiled=True)
+    xu = x.reshape((size, count) + x.shape[1:])
+    parts = [
+        lax.psum_scatter(
+            xu[:, s:e].reshape((size * (e - s),) + x.shape[1:]),
+            name, scatter_dimension=0, tiled=True,
+        )
+        for s, e in bounds
+    ]
+    return jnp.concatenate(parts, axis=0)
+
+
+def _rs_ag_allreduce(x: jax.Array, name, size: int, chunks: int):
+    """Bandwidth-optimal ADD all-reduce: reduce-scatter + all-gather.
+
+    Each chunk's shard crosses every link once in each phase, so the
+    per-link volume is ``2(n-1)/n`` of the payload — the reason every
+    large-payload allreduce (DDP gradient buckets, the hierarchical
+    tier's inner stage) takes this shape. Chunked form pipelines the
+    two phases per column range of the ``(size, count)`` view.
+    """
+    count = x.shape[0] // size
+    bounds = _chunk_bounds(count, chunks) if chunks > 1 else [(0, count)]
+    xu = x.reshape((size, count) + x.shape[1:])
+    gathered = []
+    for s, e in bounds:
+        piece = xu[:, s:e].reshape((size * (e - s),) + x.shape[1:])
+        shard = lax.psum_scatter(piece, name, scatter_dimension=0,
+                                 tiled=True)
+        gathered.append(
+            lax.all_gather(shard, name, axis=0, tiled=True).reshape(
+                (size, e - s) + x.shape[1:]
+            )
+        )
+    out = (gathered[0] if len(gathered) == 1
+           else jnp.concatenate(gathered, axis=1))
+    return out.reshape(x.shape)
+
+
+def _use_rs_ag(x: jax.Array, comm: Communicator, op: SmiOp,
+               rs_ag: Optional[bool]) -> bool:
+    """Size-based switch point for the reduce-scatter + all-gather form.
+
+    Eligibility (ADD, leading dim divisible by the comm size, at least
+    one row per rank) is structural; the *decision* is ``rs_ag`` when
+    given, else the payload-size heuristic (:data:`RS_AG_MIN_BYTES`).
+    """
+    if op is not SmiOp.ADD or x.ndim == 0:
+        if rs_ag:
+            raise ValueError(
+                "rs_ag=True needs an ADD allreduce over an array payload"
+            )
+        return False
+    eligible = x.shape[0] % comm.size == 0 and x.shape[0] >= comm.size
+    if rs_ag is not None:
+        if rs_ag and not eligible:
+            raise ValueError(
+                f"rs_ag=True needs leading dim divisible by comm size "
+                f"{comm.size}; got shape {x.shape}"
+            )
+        return rs_ag
+    return eligible and x.size * x.dtype.itemsize >= RS_AG_MIN_BYTES
+
+
 def bcast(x: jax.Array, comm: Communicator, root: int = 0,
           port: Optional[int] = None, backend: str = "xla",
-          program=None, deadline: Optional[Deadline] = None) -> jax.Array:
+          program=None, deadline: Optional[Deadline] = None,
+          chunks: int = 1) -> jax.Array:
     """One-to-all: every rank returns the root's ``x``.
 
     Reference: ``SMI_Bcast`` (``bcast.h:43-63``); the root's support kernel
@@ -148,9 +327,11 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
     all-reduce whose only non-zero contribution is the root's value, which
     XLA lowers to a bandwidth-optimal ICI broadcast (or, under
     ``backend="ring"``, circulates around the explicit credit-controlled
-    ring).
+    ring). ``chunks`` splits the payload into a software pipeline of
+    independent per-chunk collectives (bit-identical reassembly).
     """
     _check_backend(backend)
+    _check_chunks(chunks)
     if backend == "ring":
         _check_deadline(deadline, "broadcast", comm)
     mask = _is_root(comm, root)
@@ -160,17 +341,19 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
             contrib, _axis(comm), comm.size, op=SmiOp.ADD,
             interpret=not comm.is_tpu,
             stream=_stream_for(port, program, "broadcast"),
-            mesh_axes=_mesh_axes(comm),
+            mesh_axes=_mesh_axes(comm), chunks=chunks,
         )
     # on the XLA tier the port is metadata only: distinct ports are
     # independent by dataflow
-    return lax.psum(contrib, _axis(comm))
+    name = _axis(comm)
+    return _pipelined(contrib, chunks, lambda piece: lax.psum(piece, name))
 
 
 def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
            root: int = 0, port: Optional[int] = None,
            all_ranks: bool = False, backend: str = "xla",
-           program=None, deadline: Optional[Deadline] = None) -> jax.Array:
+           program=None, deadline: Optional[Deadline] = None,
+           chunks: int = 1) -> jax.Array:
     """All-to-one reduction with ADD/MAX/MIN.
 
     Reference: ``SMI_Reduce`` (``reduce.h:18-76``): every rank contributes,
@@ -179,9 +362,12 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
     Reduce+Bcast idiom of kmeans (``kmeans_smi.cl:132-190``) without the
     second collective. ``backend="ring"`` runs the circulating-partial
     ring kernel (``kernels/ring.py``) instead of ``lax.psum``.
+    ``chunks`` software-pipelines the payload in independent per-chunk
+    reductions (bit-identical: each element's reduction is unchanged).
     """
     _check_backend(backend)
     op = SmiOp.parse(op)
+    _check_chunks(chunks)
     if backend == "ring":
         _check_deadline(deadline, "reduce", comm)
     name = _axis(comm)
@@ -189,14 +375,14 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
         out = _ring().ring_all_reduce(
             x, name, comm.size, op=op, interpret=not comm.is_tpu,
             stream=_stream_for(port, program, "reduce"),
-            mesh_axes=_mesh_axes(comm),
+            mesh_axes=_mesh_axes(comm), chunks=chunks,
         )
     elif op is SmiOp.ADD:
-        out = lax.psum(x, name)
+        out = _pipelined(x, chunks, lambda p: lax.psum(p, name))
     elif op is SmiOp.MAX:
-        out = lax.pmax(x, name)
+        out = _pipelined(x, chunks, lambda p: lax.pmax(p, name))
     else:
-        out = lax.pmin(x, name)
+        out = _pipelined(x, chunks, lambda p: lax.pmin(p, name))
     if all_ranks:
         return out
     return jnp.where(_is_root(comm, root), out, jnp.zeros_like(out))
@@ -205,11 +391,34 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
 def allreduce(x: jax.Array, comm: Communicator,
               op: Union[str, SmiOp] = SmiOp.ADD,
               backend: str = "xla", program=None,
-              deadline: Optional[Deadline] = None) -> jax.Array:
+              deadline: Optional[Deadline] = None,
+              chunks: int = 1, rs_ag: Optional[bool] = None) -> jax.Array:
     """Reduce + Bcast in one collective (convenience; no reference analog
-    because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``)."""
+    because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``).
+
+    Two streaming-overlap knobs: ``chunks`` software-pipelines the
+    payload (bit-identical); ``rs_ag`` selects the bandwidth-optimal
+    reduce-scatter + all-gather decomposition — defaulting to the
+    :data:`RS_AG_MIN_BYTES` size heuristic, forced on/off when a bool.
+    The decomposition reassociates the sum (float results may differ in
+    the last ulp from one psum), which is why it stays size-gated.
+    """
+    _check_backend(backend)
+    op = SmiOp.parse(op)
+    _check_chunks(chunks)
+    if backend != "xla":
+        # a forced decomposition must never be silently dropped — the
+        # ring tier has no reduce-scatter+all-gather form of allreduce
+        if rs_ag:
+            raise ValueError(
+                "rs_ag=True is an XLA-tier decomposition; the ring "
+                "tier runs the circulating-partial kernel — drop "
+                "rs_ag or use backend='xla'"
+            )
+    elif _use_rs_ag(x, comm, op, rs_ag):
+        return _rs_ag_allreduce(x, _axis(comm), comm.size, chunks)
     return reduce(x, comm, op=op, all_ranks=True, backend=backend,
-                  program=program, deadline=deadline)
+                  program=program, deadline=deadline, chunks=chunks)
 
 
 def allreduce_hierarchical(x: jax.Array, comm: Communicator,
@@ -266,7 +475,8 @@ def allreduce_hierarchical(x: jax.Array, comm: Communicator,
 
 def scatter(x: jax.Array, comm: Communicator, root: int = 0,
             port: Optional[int] = None, backend: str = "xla",
-            program=None, deadline: Optional[Deadline] = None) -> jax.Array:
+            program=None, deadline: Optional[Deadline] = None,
+            chunks: int = 1) -> jax.Array:
     """Root distributes contiguous slices; rank r returns slice r.
 
     Reference: ``SMI_Scatter`` (``scatter.h:49-72``) — the root splits its
@@ -278,8 +488,11 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
 
     ``x`` must have leading dimension ``size * count`` (valid at root).
     ``backend="ring"`` uses the explicit ring reduce-scatter kernel.
+    ``chunks`` splits the per-destination block into a pipeline of
+    independent scatters (bit-identical reassembly).
     """
     _check_backend(backend)
+    _check_chunks(chunks)
     size = comm.size
     if x.shape[0] % size != 0:
         raise ValueError(
@@ -290,20 +503,39 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
         _check_deadline(deadline, "scatter", comm)
     contrib = jnp.where(_is_root(comm, root), x, jnp.zeros_like(x))
     if backend == "ring":
-        return _ring().ring_reduce_scatter(
-            contrib, _axis(comm), size, op=SmiOp.ADD,
-            interpret=not comm.is_tpu,
-            stream=_stream_for(port, program, "scatter"),
-            mesh_axes=_mesh_axes(comm),
-        )
-    return lax.psum_scatter(contrib, _axis(comm), scatter_dimension=0,
-                            tiled=True)
+        stream = _stream_for(port, program, "scatter")
+        count = x.shape[0] // size
+        bounds = (_chunk_bounds(count, chunks)
+                  if chunks > 1 else [(0, count)])
+        if len(bounds) <= 1:
+            return _ring().ring_reduce_scatter(
+                contrib, _axis(comm), size, op=SmiOp.ADD,
+                interpret=not comm.is_tpu, stream=stream,
+                mesh_axes=_mesh_axes(comm),
+            )
+        # per-chunk kernels on ONE stream: sequential in program order
+        # (they share the stream's barrier-semaphore domain), each
+        # internally double-buffered — the chunked schedule without a
+        # second semaphore domain per chunk
+        xu = contrib.reshape((size, count) + x.shape[1:])
+        parts = [
+            _ring().ring_reduce_scatter(
+                xu[:, s:e].reshape((size * (e - s),) + x.shape[1:]),
+                _axis(comm), size, op=SmiOp.ADD,
+                interpret=not comm.is_tpu, stream=stream,
+                mesh_axes=_mesh_axes(comm),
+            )
+            for s, e in bounds
+        ]
+        return jnp.concatenate(parts, axis=0)
+    return _chunked_psum_scatter(contrib, _axis(comm), size, chunks)
 
 
 def gather(x: jax.Array, comm: Communicator, root: int = 0,
            port: Optional[int] = None, all_ranks: bool = False,
            backend: str = "xla", program=None,
-           deadline: Optional[Deadline] = None) -> jax.Array:
+           deadline: Optional[Deadline] = None,
+           chunks: int = 1) -> jax.Array:
     """Root collects contiguous slices; returns ``size * count`` at root.
 
     Reference: ``SMI_Gather`` (``gather.h:47-68``) — the root pulls each
@@ -311,17 +543,33 @@ def gather(x: jax.Array, comm: Communicator, root: int = 0,
     Here one ``all_gather`` rides ICI and the result is masked off-root
     (or kept everywhere with ``all_ranks=True``). ``backend="ring"``
     forwards chunks neighbour-to-neighbour around the explicit ring.
+    ``chunks`` splits the contribution into a pipeline of independent
+    gathers whose epilogue restores rank-major order (bit-identical).
     """
     _check_backend(backend)
+    _check_chunks(chunks)
+    size = comm.size
     if backend == "ring":
         _check_deadline(deadline, "gather", comm)
-        out = _ring().ring_all_gather(
-            x, _axis(comm), comm.size, interpret=not comm.is_tpu,
-            stream=_stream_for(port, program, "gather"),
-            mesh_axes=_mesh_axes(comm),
-        )
+        stream = _stream_for(port, program, "gather")
+        bounds = (_chunk_bounds(x.shape[0], chunks)
+                  if chunks > 1 and x.ndim else [(0, x.shape[0] if x.ndim else 1)])
+        if len(bounds) <= 1:
+            out = _ring().ring_all_gather(
+                x, _axis(comm), size, interpret=not comm.is_tpu,
+                stream=stream, mesh_axes=_mesh_axes(comm),
+            )
+        else:
+            pieces = [
+                _ring().ring_all_gather(
+                    x[s:e], _axis(comm), size, interpret=not comm.is_tpu,
+                    stream=stream, mesh_axes=_mesh_axes(comm),
+                )
+                for s, e in bounds
+            ]
+            out = _reassemble_rank_major(pieces, bounds, size)
     else:
-        out = lax.all_gather(x, _axis(comm), axis=0, tiled=True)
+        out = _chunked_all_gather(x, _axis(comm), size, chunks)
     if all_ranks:
         return out
     return jnp.where(_is_root(comm, root), out, jnp.zeros_like(out))

@@ -144,39 +144,49 @@ class SmiContext:
 
         return self.backend if backend is None else check_backend(backend)
 
+    # ``chunks`` is the per-call asynchronicity degree: >1 splits the
+    # payload into a software pipeline of independent per-chunk
+    # collectives (bit-identical reassembly; see parallel/collectives).
     def bcast(self, x, root: int = 0, port: Optional[int] = None,
-              backend: Optional[str] = None):
+              backend: Optional[str] = None, chunks: int = 1):
         return _coll.bcast(x, self.comm, root=root, port=port,
                            backend=self._backend(backend),
-                           program=self.program, deadline=self.deadline)
+                           program=self.program, deadline=self.deadline,
+                           chunks=chunks)
 
     def reduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD, root: int = 0,
                port: Optional[int] = None, all_ranks: bool = False,
-               backend: Optional[str] = None):
+               backend: Optional[str] = None, chunks: int = 1):
         return _coll.reduce(x, self.comm, op=op, root=root, port=port,
                             all_ranks=all_ranks,
                             backend=self._backend(backend),
-                            program=self.program, deadline=self.deadline)
+                            program=self.program, deadline=self.deadline,
+                            chunks=chunks)
 
     def allreduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD,
-                  backend: Optional[str] = None):
+                  backend: Optional[str] = None, chunks: int = 1,
+                  rs_ag: Optional[bool] = None):
         return _coll.allreduce(x, self.comm, op=op,
                                backend=self._backend(backend),
                                program=self.program,
-                               deadline=self.deadline)
+                               deadline=self.deadline,
+                               chunks=chunks, rs_ag=rs_ag)
 
     def scatter(self, x, root: int = 0, port: Optional[int] = None,
-                backend: Optional[str] = None):
+                backend: Optional[str] = None, chunks: int = 1):
         return _coll.scatter(x, self.comm, root=root, port=port,
                              backend=self._backend(backend),
-                             program=self.program, deadline=self.deadline)
+                             program=self.program, deadline=self.deadline,
+                             chunks=chunks)
 
     def gather(self, x, root: int = 0, port: Optional[int] = None,
-               all_ranks: bool = False, backend: Optional[str] = None):
+               all_ranks: bool = False, backend: Optional[str] = None,
+               chunks: int = 1):
         return _coll.gather(x, self.comm, root=root, port=port,
                             all_ranks=all_ranks,
                             backend=self._backend(backend),
-                            program=self.program, deadline=self.deadline)
+                            program=self.program, deadline=self.deadline,
+                            chunks=chunks)
 
     # -- degraded mode -------------------------------------------------
     def shrink(self, excluded_ranks) -> "SmiContext":

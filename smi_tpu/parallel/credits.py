@@ -259,6 +259,51 @@ def all_reduce_rank(me: int, n: int, value, combine: Callable,
     yield ("output", 0, final)
 
 
+def all_reduce_chunked_rank(me: int, n: int, values: Sequence,
+                            combine: Callable, flow_control: bool = True,
+                            to_global: Callable[[int], int] = _identity):
+    """Mirrors ``_ring_all_reduce_chunked_kernel``: the payload split
+    into ``len(values)`` pipeline chunks, each circulating on its own
+    double-buffered slot pair (flat layout ``2*c + parity``). Per ring
+    step, phase A starts EVERY chunk's DMA (after its credit), phase B
+    combines each arrival — chunk ``c`` folds while chunks ``c+1..``
+    are still in flight — and phase C re-grants each slot once its
+    onward send completed. Per chunk the credit discipline is identical
+    to :func:`all_reduce_rank`; the phases interleave the chunks, which
+    is exactly what the verified-transport framing must survive (wire
+    sequence numbers advance across the chunk interleave in send order,
+    and the receiver consumes in the same order)."""
+    left, right = to_global((me - 1) % n), to_global((me + 1) % n)
+    k = len(values)
+    if flow_control:
+        yield from _barrier_steps(me, n, to_global)
+    for c in range(k):
+        yield ("write_slot", 2 * c, values[c])
+        if flow_control:
+            yield ("signal", left, SEM_CREDIT, 2 * c + 1, 1)
+    for s in range(n - 1):
+        slot, nslot = s % 2, (s + 1) % 2
+        for c in range(k):  # phase A: start all chunk RDMAs
+            if flow_control:
+                yield ("wait", SEM_CREDIT, 2 * c + nslot, 1)
+            payload = yield ("read_slot", 2 * c + slot)
+            yield ("dma", right, 2 * c + nslot, payload,
+                   2 * c + slot, 2 * c + nslot)
+        for c in range(k):  # phase B: combine arrivals in chunk order
+            yield ("wait", SEM_RECV, 2 * c + nslot, 1)
+            arrived = yield ("read_slot", 2 * c + nslot)
+            yield ("write_slot", 2 * c + nslot,
+                   combine(arrived, values[c]))
+        for c in range(k):  # phase C: sends drained -> re-grant slots
+            yield ("wait", SEM_SEND, 2 * c + slot, 1)
+            if flow_control and s < n - 2:
+                yield ("signal", left, SEM_CREDIT, 2 * c + slot, 1)
+    final_slot = (n - 1) % 2
+    for c in range(k):
+        final = yield ("read_slot", 2 * c + final_slot)
+        yield ("output", c, final)
+
+
 def reduce_scatter_rank(me: int, n: int, blocks: Sequence, combine: Callable,
                         flow_control: bool = True,
                         to_global: Callable[[int], int] = _identity):
@@ -1153,6 +1198,33 @@ def simulate_all_reduce(n: int, strategy: Strategy,
     for r in range(n):
         if outputs[r] != {0: want}:
             raise ProtocolError(f"rank {r} reduced {outputs[r]}, wanted {want}")
+
+
+def simulate_all_reduce_chunked(n: int, chunks: int, strategy: Strategy,
+                                flow_control: bool = True, faults=None,
+                                verified: bool = False) -> None:
+    """Chunked pipelined all-reduce harness: rank ``r`` contributes
+    ``frozenset({(r, c)})`` per chunk ``c``; every rank must finish
+    holding the full per-chunk union — wrong delivery in ANY pipeline
+    chunk is a :class:`ProtocolError`."""
+    gens = [
+        all_reduce_chunked_rank(
+            r, n, [frozenset([(r, c)]) for c in range(chunks)],
+            lambda a, b: a | b, flow_control=flow_control,
+        )
+        for r in range(n)
+    ]
+    outputs = RingSimulator(
+        _maybe_verified(gens, verified), strategy, faults=faults
+    ).run()
+    want = {
+        c: frozenset((src, c) for src in range(n)) for c in range(chunks)
+    }
+    for r in range(n):
+        if outputs[r] != want:
+            raise ProtocolError(
+                f"rank {r} reduced {outputs[r]}, wanted {want}"
+            )
 
 
 def simulate_reduce_scatter(n: int, strategy: Strategy,

@@ -65,6 +65,13 @@ from smi_tpu.parallel import credits as C
 PROTOCOLS = ("all_gather", "all_reduce", "reduce_scatter",
              "neighbour_stream")
 
+#: Pipelined variants runnable through :func:`run_under_faults` but NOT
+#: part of the default chaos sweep (the seed-pinned campaign counts the
+#: four base protocols): ``all_reduce_chunked`` is the chunked
+#: double-buffered schedule of ``kernels/ring.py`` — ``chunks`` pipeline
+#: rows interleaving per ring step on their own slot pairs.
+CHUNKED_PROTOCOLS = ("all_reduce_chunked",)
+
 #: Fault classes the matrix is exhaustive over. The last three damage
 #: payloads *in flight* — faults the credit protocol cannot see at all;
 #: only the verified-transport framing (``credits.verified_steps``)
@@ -423,9 +430,13 @@ def _simulate(protocol: str, n: int, strategy: C.Strategy,
     elif protocol == "neighbour_stream":
         C.simulate_neighbour_stream(n, chunks, strategy, faults=plan,
                                     verified=verified)
+    elif protocol == "all_reduce_chunked":
+        C.simulate_all_reduce_chunked(n, chunks, strategy, faults=plan,
+                                      verified=verified)
     else:
         raise ValueError(
-            f"unknown protocol {protocol!r}; known: {PROTOCOLS}"
+            f"unknown protocol {protocol!r}; known: "
+            f"{PROTOCOLS + CHUNKED_PROTOCOLS}"
         )
 
 

@@ -108,6 +108,55 @@ class Halos(NamedTuple):
     right: jax.Array
 
 
+class HaloExchange(NamedTuple):
+    """In-flight halo exchange handle (:func:`halo_exchange_start`).
+
+    At the jnp level "in flight" is dataflow, not a hardware handle:
+    the four ppermutes exist as issued ops with no consumers yet, so
+    everything the caller computes between ``start`` and ``finish``
+    is, by construction, independent of the transfers — exactly the
+    compute XLA's latency-hiding scheduler may run between the lowered
+    ``collective-permute-start``/``done`` pair. ``finish`` returns the
+    slabs and thereby places the first data dependence on them.
+    :func:`smi_tpu.parallel.traffic.overlap_report` verifies the
+    resulting schedule property on compiled HLO.
+    """
+
+    halos: Halos
+    depth: int
+
+
+def halo_exchange_start(
+    block: jax.Array,
+    comm: Communicator,
+    depth: int = 1,
+    ring: bool = False,
+    backend: str = "xla",
+) -> HaloExchange:
+    """Issue the four neighbour transfers; do NOT consume them yet.
+
+    Split form of :func:`halo_exchange_2d`: between ``start`` and
+    :func:`halo_exchange_finish` the caller computes its
+    halo-independent interior, giving XLA compute to schedule while
+    the edge ppermutes fly (the reference's bridge kernels running
+    concurrently with the compute pipeline, ``stencil_smi.cl:236-386``).
+    """
+    return HaloExchange(
+        halos=halo_exchange_2d(block, comm, depth=depth, ring=ring,
+                               backend=backend),
+        depth=depth,
+    )
+
+
+def halo_exchange_finish(exchange: HaloExchange) -> Halos:
+    """Consume an in-flight exchange: returns the four neighbour slabs.
+
+    The first use of the returned arrays is the synchronization point —
+    XLA places the ``collective-permute-done`` right before it.
+    """
+    return exchange.halos
+
+
 def halo_exchange_2d(
     block: jax.Array,
     comm: Communicator,
@@ -172,6 +221,39 @@ def halo_exchange_2d_corners(
     Returns ``top``/``bottom`` of shape ``(depth, W+2·depth)`` (halo
     columns included) and ``left``/``right`` of shape ``(H, depth)``.
     """
+    return halo_exchange_2d_corners_finish(
+        halo_exchange_2d_corners_start(block, comm, depth=depth,
+                                       ring=ring, backend=backend)
+    )
+
+
+class CornerHaloExchange(NamedTuple):
+    """In-flight corner-complete exchange: phase-1 slabs exposed, the
+    dependent phase-2 (vertical) transfers issued but unconsumed.
+
+    ``left``/``right`` arrived in phase 1 and already fed phase 2's
+    operands, so consuming them immediately costs no overlap; the
+    caller's compute between start and finish runs while the top/bottom
+    ppermutes fly (the temporal stencil updates its extended-layout
+    halo COLUMNS in that window — ``stencil_temporal.py``).
+    """
+
+    left: jax.Array
+    right: jax.Array
+    top: jax.Array
+    bottom: jax.Array
+
+
+def halo_exchange_2d_corners_start(
+    block: jax.Array,
+    comm: Communicator,
+    depth: int = 1,
+    ring: bool = False,
+    backend: str = "xla",
+) -> CornerHaloExchange:
+    """Issue both phases of the corner-complete exchange; expose the
+    phase-1 (horizontal) slabs for immediate use and leave the phase-2
+    (vertical) transfers in flight for :func:`halo_exchange_2d_corners_finish`."""
     if len(comm.axis_names) != 2:
         raise ValueError(
             f"halo_exchange_2d_corners needs a 2-axis communicator, got "
@@ -195,7 +277,17 @@ def halo_exchange_2d_corners(
                       backend=backend, comm=comm, stream=0)
     bottom = shift_along(ext_top, row_axis, nrow, -1, ring,
                          backend=backend, comm=comm, stream=1)
-    return Halos(top=top, bottom=bottom, left=left, right=right)
+    return CornerHaloExchange(left=left, right=right, top=top,
+                              bottom=bottom)
+
+
+def halo_exchange_2d_corners_finish(
+    exchange: CornerHaloExchange,
+) -> Halos:
+    """Consume the in-flight vertical transfers; returns the four slabs
+    in :class:`Halos` layout (top/bottom side-extended)."""
+    return Halos(top=exchange.top, bottom=exchange.bottom,
+                 left=exchange.left, right=exchange.right)
 
 
 def pad_with_halos(block: jax.Array, halos: Halos, depth: int = 1) -> jax.Array:

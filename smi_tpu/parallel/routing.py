@@ -162,6 +162,16 @@ class RoutingContext:
         return [Link(device, i) for i in range(self.links_per_device)]
 
 
+#: Memo for :func:`build_routing_context`, keyed by topology IDENTITY
+#: (topologies hold dicts, so they are not hashable; the cached entry
+#: pins the topology object, which keeps its ``id`` from being reused
+#: while the entry lives). Bounded: oldest entry evicted past the cap.
+_CONTEXT_CACHE: "Dict[Tuple[int, int, Optional[FailureSet]], Tuple[Topology, RoutingContext]]" = {}
+_CONTEXT_CACHE_MAX = 16
+#: build counter (cache misses), asserted on by the retrace-cache test.
+_context_builds = 0
+
+
 def build_routing_context(
     topology: Topology,
     links_per_device: int = LINKS_PER_DEVICE,
@@ -176,7 +186,32 @@ def build_routing_context(
     ``excluded`` (a :class:`FailureSet`) builds the *degraded* context:
     down wires are omitted, down devices lose all edges (no transit) but
     keep their rank slot so table shapes and rank numbering stay stable.
+
+    Memoized per ``(topology identity, links, failure set)``: the
+    all-pairs Dijkstra is the expensive step and used to rerun on
+    every call — ``egress_link_toward`` per traced program point, and
+    the :class:`RouteCutError` classifier's healthy-topology rebuild
+    per unroutable pair. Contexts are immutable in practice (callers
+    only read), so one instance serves all of them.
     """
+    global _context_builds
+    key = (id(topology), links_per_device, excluded)
+    hit = _CONTEXT_CACHE.get(key)
+    if hit is not None and hit[0] is topology:
+        return hit[1]
+    ctx = _build_routing_context(topology, links_per_device, excluded)
+    if len(_CONTEXT_CACHE) >= _CONTEXT_CACHE_MAX:
+        _CONTEXT_CACHE.pop(next(iter(_CONTEXT_CACHE)))
+    _CONTEXT_CACHE[key] = (topology, ctx)
+    _context_builds += 1
+    return ctx
+
+
+def _build_routing_context(
+    topology: Topology,
+    links_per_device: int,
+    excluded: Optional[FailureSet],
+) -> RoutingContext:
     graph = networkx.Graph()
     devices = topology.devices
     known = set(devices)

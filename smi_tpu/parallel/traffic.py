@@ -319,6 +319,238 @@ def has_collectives(hlo_text: str) -> bool:
     )
 
 
+# ---------------------------------------------------------------------------
+# Comm/compute overlap verification
+# ---------------------------------------------------------------------------
+
+#: one generic instruction definition: ``%name = <type> opcode(...``
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<type>[^=]+?)\s(?P<op>[\w\-]+)\("
+)
+#: candidate operand/attribute reference tokens after the opcode's ``(``
+_TOKEN_RE = re.compile(r"%?([\w.\-]+)")
+
+#: opcodes that move, reshape or describe data (or carry control)
+#: rather than computing — excluded from the "compute scheduled during
+#: communication" buckets so a ``pad``/``slice`` shuffle cannot
+#: masquerade as hidden arithmetic. Fusions, elementwise ops, dots,
+#: convolutions, selects, reduces all count.
+_NON_COMPUTE_OPS = frozenset({
+    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+    "copy", "copy-start", "copy-done", "after-all", "partition-id",
+    "replica-id", "while", "call", "conditional", "send", "send-done",
+    "recv", "recv-done", "infeed", "outfeed", "domain", "opt-barrier",
+    "pad", "reshape", "transpose", "slice", "dynamic-slice",
+    "dynamic-update-slice", "concatenate", "broadcast", "iota",
+    "reverse", "gather", "scatter", "rng", "rng-bit-generator",
+}) | frozenset(_COLLECTIVES) | frozenset(
+    f"{op}-{half}" for op in _COLLECTIVES for half in ("start", "done")
+) | frozenset({"async-start", "async-update", "async-done"})
+
+
+def _result_bytes_elems(type_str: str) -> Tuple[int, int]:
+    """(bytes, elements) of a result type — arrays summed over tuples."""
+    shapes = [
+        (_elems(sh) * _DTYPE_BYTES[dt], _elems(sh))
+        for dt, sh in _SHAPE_RE.findall(type_str)
+        if dt in _DTYPE_BYTES
+    ]
+    return sum(b for b, _ in shapes), sum(e for _, e in shapes)
+
+
+def _closure(start: str, edges: Dict[str, Set[str]]) -> Set[str]:
+    """Transitive closure of ``edges`` from ``start`` (start excluded)."""
+    seen: Set[str] = set()
+    stack = list(edges.get(start, ()))
+    while stack:
+        nxt = stack.pop()
+        if nxt in seen:
+            continue
+        seen.add(nxt)
+        stack.extend(edges.get(nxt, ()))
+    return seen
+
+
+def overlap_report(compiled=None, hlo_text: Optional[str] = None) -> dict:
+    """Statically verify comm/compute overlap on compiled HLO.
+
+    For every collective instruction, measure the compute the scheduler
+    can (or did) run while the transfer is in flight — turning "XLA will
+    overlap it" from a hope into a checked property of the artifact:
+
+    - an **async pair** (``collective-permute-start``/``done``,
+      async all-reduce/all-gather/reduce-scatter) reports the compute
+      instructions literally printed between start and done: compiled
+      executables are scheduled modules, so between-ness in the text IS
+      the schedule (``scheduled_ops``/``scheduled_bytes``);
+    - a **sync** collective (the CPU backend, pre-scheduling dumps)
+      has no printed flight window, so the report falls back to
+      dataflow: compute in the same computation that neither feeds the
+      collective's operands nor consumes its result — exactly the set
+      the scheduler is free to place between start and done once the
+      op is asynced (``independent_ops``/``independent_bytes``).
+
+    Per-collective independence alone can flatter a bulk-synchronous
+    program (the compute feeding collective B is "independent" of
+    collective A), so the summary's headline bucket is stricter:
+    ``overlappable_bytes`` counts compute independent of **every**
+    collective in its computation — work the scheduler could run while
+    the whole exchange is in flight. The naive stencil step reports
+    ~zero there (every cell consumes the halos; only loop bookkeeping
+    is free); the overlapped step reports its interior — the
+    deterministic CPU-HLO assertion in ``tests/test_overlap.py``.
+
+    Summary keys: ``scheduled_bytes`` (async pairs only — achieved
+    overlap in the printed schedule; an instruction sitting inside
+    several overlapping flight windows books once, though each pair's
+    own ``scheduled_bytes`` still reports its full window),
+    ``overlappable_bytes`` (the
+    independent-of-all-collectives bucket), ``overlapped_bytes``
+    (scheduled when the module has async pairs, else overlappable —
+    the strongest overlap evidence this artifact supports),
+    ``compute_bytes`` (all compute in collective-bearing
+    computations), and ``overlap_fraction`` = overlappable/compute.
+    ``flops_estimate`` is a 1-op-per-result-element lower bound
+    (dots/convolutions undercounted) — a comparator between two
+    schedules of one program, not an absolute flop count.
+    """
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    lines = hlo_text.splitlines()
+    _, comp_of_line = _scan_computations(lines)
+
+    # per-computation: defs in print (schedule) order with deps
+    comps: Dict[Optional[str], dict] = {}
+    for lineno, line in enumerate(lines):
+        m = _DEF_RE.match(line)
+        if not m:
+            continue
+        comp = comps.setdefault(
+            comp_of_line[lineno],
+            {"order": [], "op": {}, "deps": {}, "bytes": {}, "elems": {}},
+        )
+        name, op = m.group("name"), m.group("op")
+        nbytes, nelems = _result_bytes_elems(m.group("type"))
+        comp["order"].append(name)
+        comp["op"][name] = op
+        comp["bytes"][name] = nbytes
+        comp["elems"][name] = nelems
+        rest = line[m.end():]
+        comp["deps"][name] = {
+            t for t in _TOKEN_RE.findall(rest) if t != name
+        }
+    for comp in comps.values():
+        defs = set(comp["order"])
+        comp["deps"] = {
+            n: (d & defs) for n, d in comp["deps"].items()
+        }
+        users: Dict[str, Set[str]] = {n: set() for n in defs}
+        for n, d in comp["deps"].items():
+            for o in d:
+                users[o].add(n)
+        comp["users"] = users
+
+    records = []
+    scheduled_bytes = 0
+    overlappable_bytes = 0
+    overlappable_ops = 0
+    flops_estimate = 0
+    compute_bytes = 0
+    for comp_name, comp in comps.items():
+        order, ops = comp["order"], comp["op"]
+        index = {n: i for i, n in enumerate(order)}
+        coll_names = [
+            n for n in order
+            if ops[n] in _COLLECTIVES
+            or any(ops[n] == f"{c}-start" for c in _COLLECTIVES)
+        ]
+        if not coll_names:
+            continue
+        compute = [n for n in order if ops[n] not in _NON_COMPUTE_OPS]
+        compute_bytes += sum(comp["bytes"][n] for n in compute)
+        windowed_names: Set[str] = set()
+        # the strict bucket: compute linked to NO collective at all —
+        # upstream of none (not operand prep), downstream of none (not
+        # a consumer) — schedulable while the whole exchange flies
+        linked: Set[str] = set()
+        for cname in coll_names:
+            linked |= _closure(cname, comp["deps"])
+            linked |= _closure(cname, comp["users"])
+            linked.add(cname)
+        free_all = [n for n in compute if n not in linked]
+        overlappable_bytes += sum(comp["bytes"][n] for n in free_all)
+        overlappable_ops += len(free_all)
+        flops_estimate += sum(comp["elems"][n] for n in free_all)
+        for name in coll_names:
+            op = ops[name]
+            is_start = any(op == f"{c}-start" for c in _COLLECTIVES)
+            rec = {
+                "op": op[: -len("-start")] if is_start else op,
+                "name": name,
+                "computation": comp_name,
+                "async": is_start,
+            }
+            # per-collective freedom: neither upstream nor downstream
+            # of THIS collective (looser than free_all — operand prep
+            # for a sibling collective counts here)
+            ancestors = _closure(name, comp["deps"])
+            descendants = _closure(name, comp["users"])
+            free = [
+                n for n in compute
+                if n != name and n not in ancestors
+                and n not in descendants
+            ]
+            rec["independent_ops"] = len(free)
+            rec["independent_bytes"] = sum(comp["bytes"][n] for n in free)
+            if is_start:
+                done = next(
+                    (
+                        n for n in order
+                        if any(ops[n] == f"{c}-done" for c in _COLLECTIVES)
+                        and name in comp["deps"].get(n, ())
+                    ),
+                    None,
+                )
+                rec["done"] = done
+                lo = index[name]
+                hi = index[done] if done is not None else len(order)
+                between = [
+                    n for n in compute
+                    if n != name and lo < index[n] < hi
+                ]
+                rec["scheduled_ops"] = len(between)
+                rec["scheduled_bytes"] = sum(
+                    comp["bytes"][n] for n in between
+                )
+                # summary dedup: compute inside several overlapping
+                # flight windows (4 starts, interior, 4 dones) must
+                # book ONCE, or the headline would quadruple-count it
+                windowed_names.update(between)
+            records.append(rec)
+        scheduled_bytes += sum(
+            comp["bytes"][n] for n in windowed_names
+        )
+
+    async_pairs = sum(1 for r in records if r["async"])
+    return {
+        "collectives": len(records),
+        "async_pairs": async_pairs,
+        "scheduled_bytes": scheduled_bytes,
+        "overlappable_bytes": overlappable_bytes,
+        "overlappable_ops": overlappable_ops,
+        "overlapped_bytes": (
+            scheduled_bytes if async_pairs else overlappable_bytes
+        ),
+        "compute_bytes": compute_bytes,
+        "flops_estimate": flops_estimate,
+        "overlap_fraction": (
+            overlappable_bytes / compute_bytes if compute_bytes else 0.0
+        ),
+        "per_collective": records,
+    }
+
+
 def _group_crossing(group: Sequence[int], partition: Dict[int, int]) -> bool:
     """Does a replica group span more than one partition cell?"""
     return len({partition[d] for d in group}) > 1

@@ -24,6 +24,8 @@ def test_all_benchmarks_run(comm8, tmp_path):
         "pipeline": {"elements": 224, "rounds": 2, "runs": 2},
         "bandwidth_eager": {"size_kb": 8, "runs": 2},
         "pipeline_double_rail": {"elements": 224, "rounds": 2, "runs": 2},
+        "overlap": {"size_kb": 8, "sweep_kb": (8,), "chunks": 2,
+                    "repeats": 2, "runs": 2},
         "app_stencil": {"size": 64, "iterations": 4, "runs": 2},
         "app_gesummv": {"n": 64, "runs": 2},
         "app_kmeans": {"points": 256, "iterations": 2, "runs": 2},
