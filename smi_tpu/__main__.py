@@ -24,12 +24,18 @@ The TPU pipeline keeps the same stages with new emission targets:
 - ``topology`` — generate a bus-topology file for testing
   (``codegen/topology_file_generator.py``).
 
+Runtime-tuning stages (no reference analog — the ATLAS/Hockney plan
+engine, :mod:`smi_tpu.tuning`): ``tune`` sweeps candidate plans and
+writes the persistent plan cache; ``tune --explain OP`` prints the
+decision table.
+
 Usage::
 
     python -m smi_tpu manifest app.py -o build/app.json
     python -m smi_tpu route cluster.json build/smi-routes build/app.json
     python -m smi_tpu host build/smi_generated_host.py build/app.json
     python -m smi_tpu topology -n 8 -p app -f cluster.json
+    smi-tpu tune --explain all_reduce
 """
 
 from __future__ import annotations
@@ -685,6 +691,94 @@ def cmd_traffic(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_tune(args: argparse.Namespace) -> int:
+    """``smi-tpu tune``: measured sweep + plan-cache write; with
+    ``--explain OP``, print the candidate table instead.
+
+    ``--explain`` is CPU-deterministic (no sweep, no devices beyond
+    reading the local device kind): for each knob it prints the
+    candidates with modeled vs measured costs and the layer — cache /
+    model / heuristic — that decided it (``tuning.Plan.explain``).
+
+    The sweep mode times candidate plans on the available backend with
+    the microbenchmark harness and merges the winners into the cache
+    file (``--cache``, ``$SMI_TPU_PLAN_CACHE``, or the per-user
+    default); merging keeps whichever entry measured faster, so
+    repeated/fleet-wide runs only ever improve the cache.
+    """
+    from smi_tpu.tuning import PlanCache, PlanCacheError, engine
+    from smi_tpu.tuning.cache import default_cache_path
+
+    if args.explain:
+        try:
+            print(engine.get_engine().explain_text(
+                args.explain, n=args.ranks, dtype=args.dtype,
+            ))
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        return 0
+
+    from smi_tpu.parallel.mesh import make_communicator
+    from smi_tpu.tuning.sweep import sweep_allreduce, sweep_flash
+
+    path = args.cache or default_cache_path()
+    if not path:
+        print("error: no cache path (pass --cache or set "
+              "$SMI_TPU_PLAN_CACHE)", file=sys.stderr)
+        return 2
+    ops = args.ops or ["all_reduce"]
+    unknown = [o for o in ops if o not in ("all_reduce", "flash_fwd")]
+    if unknown:
+        print(f"error: unknown op(s) {unknown}; sweepable: "
+              f"all_reduce, flash_fwd", file=sys.stderr)
+        return 2
+    measured = PlanCache()
+    if "all_reduce" in ops:
+        comm = make_communicator()
+        if comm.size < 2:
+            # a 1-device "sweep" would persist meaningless ring-vs-rs+ag
+            # entries (and possibly a device-wide threshold) that every
+            # later multi-rank trace on this device kind would consult
+            print(
+                "error: the all_reduce sweep needs >= 2 devices; on a "
+                "1-chip host run the CPU fake mesh (XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8) or drop "
+                "all_reduce from --ops",
+                file=sys.stderr,
+            )
+            return 2
+        print(f"sweeping all_reduce over {comm.size} devices "
+              f"({', '.join(f'{kb} KiB' for kb in args.sizes_kb)})")
+        measured.merge(sweep_allreduce(
+            comm, sizes_kb=args.sizes_kb, runs=args.runs, verbose=True,
+        ))
+    if "flash_fwd" in ops:
+        print("sweeping flash_fwd forward tiles")
+        got = sweep_flash(runs=args.runs, verbose=True)
+        if not got.entries:
+            print("  skipped: flash sweep needs a TPU backend "
+                  "(interpreter timings are not kernel truth)")
+        measured.merge(got)
+    try:
+        disk = PlanCache.load(path) if os.path.exists(path) else PlanCache()
+    except PlanCacheError as e:
+        print(f"error: existing cache at {path} is unusable: {e}",
+              file=sys.stderr)
+        return 1
+    landed = sum(
+        1 for sig, e in measured.entries.items()
+        if e.better_than(disk.entries.get(sig))
+    )
+    disk.merge(measured)
+    disk.save(path)
+    print(f"{len(measured.entries)} plans measured, {landed} "
+          f"new/improved -> {path}")
+    # the running process should trace with what it just measured
+    engine.set_engine(None)
+    return 0
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     from smi_tpu.benchmarks.__main__ import main as bench_main
 
@@ -904,6 +998,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--out", default=None,
                    help="write the full JSON report here")
     p.set_defaults(fn=cmd_traffic)
+
+    p = sub.add_parser(
+        "tune",
+        help="sweep candidate plans and write the persistent plan "
+             "cache; --explain OP prints the candidate table with the "
+             "deciding layer (cache / model / heuristic) per knob",
+    )
+    p.add_argument("--explain", default=None, metavar="OP",
+                   help="print the plan decision table for OP "
+                        "(all_reduce, flash_fwd, stencil_temporal, "
+                        "ring_all_reduce) instead of sweeping — "
+                        "CPU-deterministic, no hardware needed")
+    p.add_argument("--ops", nargs="+", default=None, metavar="OP",
+                   help="ops to sweep (default: all_reduce; flash_fwd "
+                        "needs a TPU backend)")
+    p.add_argument("--cache", default=None,
+                   help="plan-cache JSON path (default: "
+                        "$SMI_TPU_PLAN_CACHE or "
+                        "~/.cache/smi_tpu/plans.json)")
+    p.add_argument("--sizes-kb", nargs="+", type=int,
+                   default=[64, 256, 1024, 4096], metavar="KB",
+                   help="allreduce payload sweep grid")
+    p.add_argument("--runs", type=int, default=5,
+                   help="timed repetitions per candidate")
+    p.add_argument("--ranks", type=int, default=8,
+                   help="with --explain: rank count the collective "
+                        "table models")
+    p.add_argument("--dtype", default="float32",
+                   help="with --explain: payload dtype of the table")
+    p.set_defaults(fn=cmd_tune)
 
     p = sub.add_parser("bench", help="run a microbenchmark")
     p.add_argument("rest", nargs=argparse.REMAINDER)

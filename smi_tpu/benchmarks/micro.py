@@ -45,6 +45,14 @@ def _force(fn):
     return run
 
 
+#: Public name of the readback-forcing wrapper: the tuning sweep driver
+#: (``smi_tpu.tuning.sweep``) times its candidate plans with THIS
+#: harness — same completion forcing, same ``timed_samples`` warmup and
+#: repeat discipline — so a sweep-measured cost is comparable with the
+#: microbenchmark suite's numbers.
+force_readback = _force
+
+
 def bench_bandwidth(
     comm: Communicator, size_kb: int = 512, runs: int = 10, repeats: int = 4,
     rendezvous: bool = False, buffer_size: int = 2048,

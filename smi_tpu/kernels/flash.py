@@ -109,27 +109,58 @@ def _pick_block(extent: int, target: int, multiple: int = 8) -> Optional[int]:
     return None
 
 
+def _is_bf16(dtype) -> bool:
+    """dtype may arrive as a jnp dtype or the plan engine's name
+    string — both normalize through ``jnp.dtype``."""
+    return jnp.dtype(dtype) == jnp.bfloat16
+
+
 def _sublane(dtype) -> int:
-    return 16 if dtype == jnp.bfloat16 else 8
+    return 16 if _is_bf16(dtype) else 8
 
 
 def _block_k(dtype) -> int:
-    return BLOCK_K_BF16 if dtype == jnp.bfloat16 else BLOCK_K
+    return BLOCK_K_BF16 if _is_bf16(dtype) else BLOCK_K
 
 
 def _block_q_fwd(dtype) -> int:
-    """Forward query-tile target (the backward uses :data:`BLOCK_Q`
-    directly — its VMEM frame does not fit the wide tile)."""
-    return BLOCK_Q_BF16_FWD if dtype == jnp.bfloat16 else BLOCK_Q
+    """HEURISTIC-layer forward query-tile target (the backward uses
+    :data:`BLOCK_Q` directly — its VMEM frame does not fit the wide
+    tile). The resolved target is :func:`_fwd_block_targets`."""
+    return BLOCK_Q_BF16_FWD if _is_bf16(dtype) else BLOCK_Q
 
 
 def _block_k_fwd(dtype, window) -> int:
-    """Forward key-tile target; the bf16 windowed schedule narrows to
-    :data:`BLOCK_K_BF16_WINDOW` (backward kernels keep :func:`_block_k`
-    — their inner sub-tile was not part of the windowed A/B)."""
-    if dtype == jnp.bfloat16 and window is not None:
+    """HEURISTIC-layer forward key-tile target; the bf16 windowed
+    schedule narrows to :data:`BLOCK_K_BF16_WINDOW` (backward kernels
+    keep :func:`_block_k` — their inner sub-tile was not part of the
+    windowed A/B)."""
+    if _is_bf16(dtype) and window is not None:
         return BLOCK_K_BF16_WINDOW
     return _block_k(dtype)
+
+
+def _fwd_block_targets(dtype, window) -> tuple:
+    """Resolved forward ``(block_q, block_k)`` tile targets.
+
+    Plan-engine consult (:mod:`smi_tpu.tuning`): a plan-cache entry for
+    this device kind wins — the shipped cache seeds v5e with exactly
+    the measured constants below, so hardware behavior is unchanged
+    until a ``smi-tpu tune`` sweep records something better; any other
+    host (cpu interpret tier, unknown accelerators) falls through to
+    the dtype heuristics byte-for-byte. Never errors: a broken cache
+    costs tuning, not a trace."""
+    try:
+        from smi_tpu.tuning.engine import planned_flash_blocks
+
+        got = planned_flash_blocks(
+            jnp.dtype(dtype).name, window is not None
+        )
+        if got is not None:
+            return got
+    except Exception:
+        pass
+    return _block_q_fwd(dtype), _block_k_fwd(dtype, window)
 
 
 def _chunk_for(extent: int, block: int, d: int, itemsize: int) -> int:
@@ -553,8 +584,9 @@ def flash_attend_fused(
     s_k = k.shape[1]
     group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
-    bq = _pick_block(s_q, _block_q_fwd(q.dtype), mult)
-    bk = _pick_block(s_k, _block_k_fwd(q.dtype, window), mult)
+    bq_t, bk_t = _fwd_block_targets(q.dtype, window)
+    bq = _pick_block(s_q, bq_t, mult)
+    bk = _pick_block(s_k, bk_t, mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
     # one block-sized K/V tile per grid step (streamed double-buffered;
@@ -638,8 +670,9 @@ def flash_block_attend(
     s_k = k.shape[1]
     group = _gqa_group(h, k.shape[0])
     mult = _sublane(q.dtype)
-    bq = _pick_block(s_q, _block_q_fwd(q.dtype), mult)
-    bk = _pick_block(s_k, _block_k_fwd(q.dtype, window), mult)
+    bq_t, bk_t = _fwd_block_targets(q.dtype, window)
+    bq = _pick_block(s_q, bq_t, mult)
+    bk = _pick_block(s_k, bk_t, mult)
     if bq is None or bk is None:
         raise ValueError(f"untileable extents Sq={s_q}, Sk={s_k}")
     kc = bk

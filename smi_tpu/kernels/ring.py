@@ -169,7 +169,17 @@ def _logical_id_fn(ring_axes: Tuple[str, ...], mesh_axes: MeshAxes):
 
 
 
-@functools.lru_cache(maxsize=None)
+#: Bound on the memoized ring contexts. The working set of a real
+#: program is a handful of (axes, n, mesh) triples; the bound exists so
+#: a long-lived process sweeping many mesh shapes (the tuning sweep
+#: driver, a notebook building meshes in a loop) cannot grow the memo
+#: without limit — the r3 unbounded ``maxsize=None`` was a slow leak.
+#: Eviction is LRU: a rebuilt context is correct (all inputs are in the
+#: key), merely re-paid. Eviction/rehit-tested in tests/test_overlap.py.
+RING_CONTEXT_CACHE_MAX = 64
+
+
+@functools.lru_cache(maxsize=RING_CONTEXT_CACHE_MAX)
 def _ring_context_cached(ring_axes: Tuple[str, ...], n: int,
                          mesh_axes: MeshAxes):
     if mesh_axes is not None:
@@ -206,6 +216,20 @@ def _ring_context(axis_name: RingAxes, n: int, mesh_axes: MeshAxes):
         _normalize_axes(axis_name), n,
         tuple(mesh_axes) if mesh_axes is not None else None,
     )
+
+
+def _planned_ring_chunks(x: jax.Array, n: int) -> int:
+    """Plan-engine default for the chunked ring all-reduce's pipeline
+    depth: a measured cache entry for this device kind, else 1 (the
+    unchunked kernel — today's behavior). Never errors."""
+    try:
+        from smi_tpu.tuning.engine import planned_chunks
+
+        payload = int(x.size) * x.dtype.itemsize if x.ndim else 0
+        return planned_chunks("ring_all_reduce", payload, n,
+                              str(x.dtype))
+    except Exception:
+        return 1
 
 
 def mesh_axes_of(comm: Communicator) -> Tuple[Tuple[str, int], ...]:
@@ -592,7 +616,7 @@ def ring_all_reduce(
     flow_control: bool = True,
     stream: int = 0,
     mesh_axes: MeshAxes = None,
-    chunks: int = 1,
+    chunks: Optional[int] = None,
 ) -> jax.Array:
     """ADD/MAX/MIN all-reduce along a ring with explicit neighbour RDMA.
 
@@ -607,10 +631,15 @@ def ring_all_reduce(
     evenly; the pad is identical on every rank and sliced off the
     result, so it is safe for MAX/MIN as well as ADD. VMEM cost grows
     with ``chunks`` (2 slots per chunk) — keep it small (2-8).
+    ``chunks=None`` (the default) consults the plan engine's cache for
+    this device kind (:mod:`smi_tpu.tuning`), falling back to the
+    unchunked kernel — explicit ints are used as-is.
     """
     if n == 1:
         return x
     _check_reducible(x, interpret)
+    if chunks is None:
+        chunks = _planned_ring_chunks(x, n)
     chunks = max(1, min(int(chunks), x.shape[0] if x.ndim else 1))
     ring_axes, ring_sizes, to_logical = _ring_context(axis_name, n, mesh_axes)
     if chunks > 1:

@@ -336,6 +336,38 @@ def executable_report(compiled) -> dict:
     return report
 
 
+def cost_facts(compiled) -> dict:
+    """Kernel-side inputs of the tuning cost model, from one compiled
+    executable: flops, HBM bytes-accessed, and the per-chip HBM peak.
+
+    The bridge between this tier and :mod:`smi_tpu.tuning` — the plan
+    engine's roofline ranking
+    (``tuning.cost_model.kernel_roofline_us``) consumes exactly these
+    facts, so a knob candidate can be priced from an AOT compile alone,
+    on a host that owns no TPU. Missing facts are ``None`` (backend-
+    dependent availability, same caveat as :func:`executable_report`).
+    """
+    rep = executable_report(compiled)
+    cost = rep.get("cost", {})
+    bytes_accessed = None
+    for k, v in cost.items():
+        # the aggregate "bytes accessed" entry, not the per-operand
+        # "bytes accessed N{...}" breakdowns
+        if k == "bytes accessed" or (
+            k.startswith("bytes accessed") and bytes_accessed is None
+        ):
+            bytes_accessed = v
+            if k == "bytes accessed":
+                break
+    return {
+        "flops": cost.get("flops"),
+        "bytes_accessed": bytes_accessed,
+        "per_chip_hbm_bytes": rep.get("memory", {}).get(
+            "per_chip_hbm_bytes"
+        ),
+    }
+
+
 # ---------------------------------------------------------------------------
 # The multi-chip surface
 # ---------------------------------------------------------------------------

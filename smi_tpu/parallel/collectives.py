@@ -47,10 +47,20 @@ so results are bit-identical to the unchunked call (property-tested in
 to the bandwidth-optimal reduce-scatter + all-gather decomposition
 (:data:`RS_AG_MIN_BYTES`); that path reassociates the sum and is
 therefore opt-in-by-size, never triggered below the threshold.
+
+Tuning: the switch tier and the default chunk count are *plan-engine
+decisions* (:mod:`smi_tpu.tuning`), consulted at trace time and never
+erroring — a measured plan-cache entry wins, then the alpha-beta model
+where it is confidently away from its crossover, then today's
+heuristics byte-for-byte. The threshold itself is an overridable
+tuning default: ``$SMI_TPU_RS_AG_MIN_BYTES`` (explicit, beats every
+engine layer) -> plan-cache entry -> :data:`RS_AG_MIN_BYTES` — see
+:func:`rs_ag_min_bytes`.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Union
 
 import jax
@@ -165,7 +175,50 @@ def _is_root(comm: Communicator, root: int) -> jax.Array:
 #: same trade). The decomposition reassociates the sum, so it is gated
 #: on size (and on ``rs_ag=`` for explicit control), never silently
 #: applied to the small payloads the bit-identity property covers.
+#: This constant is the *heuristic-layer* default; the resolved tier is
+#: :func:`rs_ag_min_bytes` (env + plan cache override).
 RS_AG_MIN_BYTES = 1 << 20
+
+#: Explicit byte-count override of the rs+ag switch tier. An explicit
+#: env setting outranks every plan-engine layer (including measured
+#: cache entries) — it is the operator's word.
+RS_AG_ENV = "SMI_TPU_RS_AG_MIN_BYTES"
+
+
+def _rs_ag_env_bytes() -> Optional[int]:
+    """$SMI_TPU_RS_AG_MIN_BYTES as an int, ``None`` when unset. A
+    malformed value is a LOUD error — a typo silently falling back to
+    the default would undo the operator's intent without a trace."""
+    raw = os.environ.get(RS_AG_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"${RS_AG_ENV} must be an integer byte count, got {raw!r}"
+        ) from None
+    if value < 0:
+        raise ValueError(
+            f"${RS_AG_ENV} must be >= 0, got {value}"
+        )
+    return value
+
+
+def rs_ag_min_bytes() -> int:
+    """The resolved rs+ag switch tier: ``$SMI_TPU_RS_AG_MIN_BYTES``
+    when set, else the plan cache's measured/seeded threshold entry,
+    else :data:`RS_AG_MIN_BYTES`. The engine consult never errors —
+    a broken cache costs tuning, not a trace."""
+    env = _rs_ag_env_bytes()
+    if env is not None:
+        return env
+    try:
+        from smi_tpu.tuning.engine import get_engine
+
+        return int(get_engine().rs_ag_threshold()[0])
+    except Exception:
+        return RS_AG_MIN_BYTES
 
 
 def _check_chunks(chunks: int) -> int:
@@ -174,6 +227,27 @@ def _check_chunks(chunks: int) -> int:
     if chunks < 1:
         raise ValueError(f"chunks must be >= 1, got {chunks}")
     return chunks
+
+
+def _resolve_chunks(chunks, x: jax.Array, comm: Communicator,
+                    family: str) -> int:
+    """Default chunk count of a collective whose caller left
+    ``chunks=None``: a plan-cache entry for this (op, payload bucket,
+    dtype, device kind, rank count), else today's unchunked heuristic.
+    An explicit int is validated and used as-is — ``chunks=1`` still
+    means "exactly one collective", not "ask the engine". Never
+    errors (:func:`smi_tpu.tuning.engine.planned_chunks`)."""
+    if chunks is not None:
+        return _check_chunks(chunks)
+    try:
+        from smi_tpu.tuning.engine import planned_chunks
+
+        payload = int(x.size) * x.dtype.itemsize if x.ndim else 0
+        return _check_chunks(
+            planned_chunks(family, payload, comm.size, str(x.dtype))
+        )
+    except Exception:
+        return 1
 
 
 def _chunk_bounds(total: int, chunks: int):
@@ -293,11 +367,15 @@ def _rs_ag_allreduce(x: jax.Array, name, size: int, chunks: int):
 
 def _use_rs_ag(x: jax.Array, comm: Communicator, op: SmiOp,
                rs_ag: Optional[bool]) -> bool:
-    """Size-based switch point for the reduce-scatter + all-gather form.
+    """Algorithm switch point for the reduce-scatter + all-gather form.
 
     Eligibility (ADD, leading dim divisible by the comm size, at least
     one row per rank) is structural; the *decision* is ``rs_ag`` when
-    given, else the payload-size heuristic (:data:`RS_AG_MIN_BYTES`).
+    given, else the plan engine's gate (measured cache entry ->
+    confident alpha-beta model -> the resolved size threshold,
+    :func:`rs_ag_min_bytes`) — with the engine unreachable, the plain
+    :data:`RS_AG_MIN_BYTES` comparison, i.e. exactly the pre-engine
+    behavior.
     """
     if op is not SmiOp.ADD or x.ndim == 0:
         if rs_ag:
@@ -313,13 +391,23 @@ def _use_rs_ag(x: jax.Array, comm: Communicator, op: SmiOp,
                 f"{comm.size}; got shape {x.shape}"
             )
         return rs_ag
-    return eligible and x.size * x.dtype.itemsize >= RS_AG_MIN_BYTES
+    if not eligible:
+        return False
+    payload = int(x.size) * x.dtype.itemsize
+    env = _rs_ag_env_bytes()   # loud on malformed — before the engine
+    try:
+        from smi_tpu.tuning.engine import planned_rs_ag
+
+        return planned_rs_ag(payload, comm.size, str(x.dtype),
+                             threshold=env)
+    except Exception:
+        return payload >= (RS_AG_MIN_BYTES if env is None else env)
 
 
 def bcast(x: jax.Array, comm: Communicator, root: int = 0,
           port: Optional[int] = None, backend: str = "xla",
           program=None, deadline: Optional[Deadline] = None,
-          chunks: int = 1) -> jax.Array:
+          chunks: Optional[int] = None) -> jax.Array:
     """One-to-all: every rank returns the root's ``x``.
 
     Reference: ``SMI_Bcast`` (``bcast.h:43-63``); the root's support kernel
@@ -328,10 +416,12 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
     XLA lowers to a bandwidth-optimal ICI broadcast (or, under
     ``backend="ring"``, circulates around the explicit credit-controlled
     ring). ``chunks`` splits the payload into a software pipeline of
-    independent per-chunk collectives (bit-identical reassembly).
+    independent per-chunk collectives (bit-identical reassembly);
+    ``None`` (the default) consults the plan engine's cache, falling
+    back to one collective.
     """
     _check_backend(backend)
-    _check_chunks(chunks)
+    chunks = _resolve_chunks(chunks, x, comm, "broadcast")
     if backend == "ring":
         _check_deadline(deadline, "broadcast", comm)
     mask = _is_root(comm, root)
@@ -353,7 +443,7 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
            root: int = 0, port: Optional[int] = None,
            all_ranks: bool = False, backend: str = "xla",
            program=None, deadline: Optional[Deadline] = None,
-           chunks: int = 1) -> jax.Array:
+           chunks: Optional[int] = None) -> jax.Array:
     """All-to-one reduction with ADD/MAX/MIN.
 
     Reference: ``SMI_Reduce`` (``reduce.h:18-76``): every rank contributes,
@@ -367,7 +457,7 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
     """
     _check_backend(backend)
     op = SmiOp.parse(op)
-    _check_chunks(chunks)
+    chunks = _resolve_chunks(chunks, x, comm, "reduce")
     if backend == "ring":
         _check_deadline(deadline, "reduce", comm)
     name = _axis(comm)
@@ -392,7 +482,8 @@ def allreduce(x: jax.Array, comm: Communicator,
               op: Union[str, SmiOp] = SmiOp.ADD,
               backend: str = "xla", program=None,
               deadline: Optional[Deadline] = None,
-              chunks: int = 1, rs_ag: Optional[bool] = None) -> jax.Array:
+              chunks: Optional[int] = None,
+              rs_ag: Optional[bool] = None) -> jax.Array:
     """Reduce + Bcast in one collective (convenience; no reference analog
     because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``).
 
@@ -405,7 +496,7 @@ def allreduce(x: jax.Array, comm: Communicator,
     """
     _check_backend(backend)
     op = SmiOp.parse(op)
-    _check_chunks(chunks)
+    chunks = _resolve_chunks(chunks, x, comm, "all_reduce")
     if backend != "xla":
         # a forced decomposition must never be silently dropped — the
         # ring tier has no reduce-scatter+all-gather form of allreduce
@@ -476,7 +567,7 @@ def allreduce_hierarchical(x: jax.Array, comm: Communicator,
 def scatter(x: jax.Array, comm: Communicator, root: int = 0,
             port: Optional[int] = None, backend: str = "xla",
             program=None, deadline: Optional[Deadline] = None,
-            chunks: int = 1) -> jax.Array:
+            chunks: Optional[int] = None) -> jax.Array:
     """Root distributes contiguous slices; rank r returns slice r.
 
     Reference: ``SMI_Scatter`` (``scatter.h:49-72``) — the root splits its
@@ -492,7 +583,7 @@ def scatter(x: jax.Array, comm: Communicator, root: int = 0,
     independent scatters (bit-identical reassembly).
     """
     _check_backend(backend)
-    _check_chunks(chunks)
+    chunks = _resolve_chunks(chunks, x, comm, "scatter")
     size = comm.size
     if x.shape[0] % size != 0:
         raise ValueError(
@@ -535,7 +626,7 @@ def gather(x: jax.Array, comm: Communicator, root: int = 0,
            port: Optional[int] = None, all_ranks: bool = False,
            backend: str = "xla", program=None,
            deadline: Optional[Deadline] = None,
-           chunks: int = 1) -> jax.Array:
+           chunks: Optional[int] = None) -> jax.Array:
     """Root collects contiguous slices; returns ``size * count`` at root.
 
     Reference: ``SMI_Gather`` (``gather.h:47-68``) — the root pulls each
@@ -547,7 +638,7 @@ def gather(x: jax.Array, comm: Communicator, root: int = 0,
     gathers whose epilogue restores rank-major order (bit-identical).
     """
     _check_backend(backend)
-    _check_chunks(chunks)
+    chunks = _resolve_chunks(chunks, x, comm, "gather")
     size = comm.size
     if backend == "ring":
         _check_deadline(deadline, "gather", comm)

@@ -147,8 +147,10 @@ class SmiContext:
     # ``chunks`` is the per-call asynchronicity degree: >1 splits the
     # payload into a software pipeline of independent per-chunk
     # collectives (bit-identical reassembly; see parallel/collectives).
+    # The default ``None`` consults the plan engine (smi_tpu.tuning):
+    # measured cache entry, else one collective — today's behavior.
     def bcast(self, x, root: int = 0, port: Optional[int] = None,
-              backend: Optional[str] = None, chunks: int = 1):
+              backend: Optional[str] = None, chunks: Optional[int] = None):
         return _coll.bcast(x, self.comm, root=root, port=port,
                            backend=self._backend(backend),
                            program=self.program, deadline=self.deadline,
@@ -156,7 +158,7 @@ class SmiContext:
 
     def reduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD, root: int = 0,
                port: Optional[int] = None, all_ranks: bool = False,
-               backend: Optional[str] = None, chunks: int = 1):
+               backend: Optional[str] = None, chunks: Optional[int] = None):
         return _coll.reduce(x, self.comm, op=op, root=root, port=port,
                             all_ranks=all_ranks,
                             backend=self._backend(backend),
@@ -164,7 +166,8 @@ class SmiContext:
                             chunks=chunks)
 
     def allreduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD,
-                  backend: Optional[str] = None, chunks: int = 1,
+                  backend: Optional[str] = None,
+                  chunks: Optional[int] = None,
                   rs_ag: Optional[bool] = None):
         return _coll.allreduce(x, self.comm, op=op,
                                backend=self._backend(backend),
@@ -173,7 +176,7 @@ class SmiContext:
                                chunks=chunks, rs_ag=rs_ag)
 
     def scatter(self, x, root: int = 0, port: Optional[int] = None,
-                backend: Optional[str] = None, chunks: int = 1):
+                backend: Optional[str] = None, chunks: Optional[int] = None):
         return _coll.scatter(x, self.comm, root=root, port=port,
                              backend=self._backend(backend),
                              program=self.program, deadline=self.deadline,
@@ -181,12 +184,25 @@ class SmiContext:
 
     def gather(self, x, root: int = 0, port: Optional[int] = None,
                all_ranks: bool = False, backend: Optional[str] = None,
-               chunks: int = 1):
+               chunks: Optional[int] = None):
         return _coll.gather(x, self.comm, root=root, port=port,
                             all_ranks=all_ranks,
                             backend=self._backend(backend),
                             program=self.program, deadline=self.deadline,
                             chunks=chunks)
+
+    # -- tuning --------------------------------------------------------
+    def explain_plan(self, op: str = "all_reduce",
+                     dtype: str = "float32") -> str:
+        """The plan engine's candidate table for this communicator:
+        which knob values a collective dispatched through this context
+        would run with, which layer (cache / model / heuristic) decided
+        each, and the modeled vs measured costs behind the choice —
+        the API twin of ``smi-tpu tune --explain`` (ISSUE 4: every
+        silent default is an inspectable decision)."""
+        from smi_tpu.tuning.engine import get_engine
+
+        return get_engine().explain_text(op, n=self.size, dtype=dtype)
 
     # -- degraded mode -------------------------------------------------
     def shrink(self, excluded_ranks) -> "SmiContext":

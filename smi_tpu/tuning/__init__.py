@@ -1,0 +1,51 @@
+"""Plan engine: cost-model-driven autotuning with a persistent cache.
+
+Three layers turn every frozen performance knob in the framework into
+an inspectable, overridable decision (ISSUE 4; PAPERS.md: ATLAS
+empirical autotuning + the Hockney alpha-beta model):
+
+1. :mod:`~smi_tpu.tuning.cost_model` — deterministic analytic ranking
+   (alpha-beta link model for collectives, rooflines + VMEM gates for
+   kernels), runnable on any CPU.
+2. :mod:`~smi_tpu.tuning.sweep` — the measured refinement, reusing the
+   ``benchmarks/micro.py`` timing harness on real hardware.
+3. :mod:`~smi_tpu.tuning.cache` — the persistent, versioned, mergeable
+   JSON plan cache, shipped pre-seeded with PERF.json's measured-best
+   configs (:mod:`~smi_tpu.tuning.seeded`).
+
+:mod:`~smi_tpu.tuning.engine` resolves cache -> model -> heuristic at
+trace time for ``collectives.py``, ``kernels/flash.py``,
+``kernels/ring.py`` and :class:`SmiContext` — never erroring, and
+byte-identical to the pre-engine behavior until a cache entry or a
+confident model call says otherwise. ``smi-tpu tune`` sweeps and writes
+the cache; ``smi-tpu tune --explain OP`` prints the candidate table
+with the deciding layer per knob; :meth:`Plan.explain` is the same
+trail as an API.
+"""
+
+from smi_tpu.tuning.cache import (
+    CacheEntry,
+    PlanCache,
+    PlanCacheError,
+    default_cache_path,
+)
+from smi_tpu.tuning.cost_model import LinkModel, TopologySpec
+from smi_tpu.tuning.engine import PlanEngine, get_engine, set_engine
+from smi_tpu.tuning.plan import Candidate, Plan, PlanKey
+from smi_tpu.tuning.seeded import seeded_cache
+
+__all__ = [
+    "CacheEntry",
+    "Candidate",
+    "LinkModel",
+    "Plan",
+    "PlanCache",
+    "PlanCacheError",
+    "PlanEngine",
+    "PlanKey",
+    "TopologySpec",
+    "default_cache_path",
+    "get_engine",
+    "seeded_cache",
+    "set_engine",
+]

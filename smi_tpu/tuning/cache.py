@@ -1,0 +1,180 @@
+"""Persistent plan cache: versioned JSON, schema-validated, mergeable.
+
+The ATLAS half of the plan engine (PAPERS.md): measured-best configs
+survive the process that measured them. One cache file holds entries
+for any number of device kinds/topologies (the key carries both), so a
+fleet can merge per-host sweeps into one artifact:
+
+- **versioned** — ``schema_version`` is checked on load; a mismatch is
+  a loud :class:`PlanCacheError`, never a silent reinterpretation of
+  old knobs under new semantics.
+- **schema-validated** — every entry must carry a knob dict and a
+  well-formed cost; junk entries name themselves on load.
+- **mergeable** — :meth:`PlanCache.merge` keeps, per key, the entry
+  with the *better measured cost* (lower ``cost_us``); a measured
+  entry always beats an unmeasured one, and between two unmeasured
+  entries the incoming one wins (newer sweep metadata).
+
+Cost unit is microseconds-per-op (lower is better) — the one scalar
+every sweep and the analytic model both speak, so merge order is total.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, Optional
+
+from smi_tpu.tuning.plan import PlanKey
+
+SCHEMA_VERSION = 1
+
+#: Environment variable naming the user's persistent cache file; the
+#: engine merges it over the shipped seeded cache at load.
+CACHE_ENV = "SMI_TPU_PLAN_CACHE"
+
+
+class PlanCacheError(ValueError):
+    """Malformed or version-mismatched plan-cache payload."""
+
+
+@dataclasses.dataclass
+class CacheEntry:
+    """Measured-best knobs for one :class:`PlanKey`."""
+
+    knobs: Dict[str, object]
+    cost_us: Optional[float] = None     # lower is better; None = seeded
+    provenance: str = ""                # e.g. "sweep:2026-08-03" or
+    #                                     "seeded:PERF.json:<metric>"
+
+    def better_than(self, other: Optional["CacheEntry"]) -> bool:
+        if other is None:
+            return True
+        if self.cost_us is None:
+            # unmeasured never displaces measured; vs unmeasured the
+            # incoming entry wins (merge order: other.merge(self))
+            return other.cost_us is None
+        if other.cost_us is None:
+            return True
+        return self.cost_us < other.cost_us
+
+    def to_json(self) -> dict:
+        out: dict = {"knobs": dict(self.knobs)}
+        if self.cost_us is not None:
+            out["cost_us"] = self.cost_us
+        if self.provenance:
+            out["provenance"] = self.provenance
+        return out
+
+    @staticmethod
+    def from_json(sig: str, payload: object) -> "CacheEntry":
+        if not isinstance(payload, dict) or not isinstance(
+            payload.get("knobs"), dict
+        ):
+            raise PlanCacheError(
+                f"plan-cache entry {sig!r} is not "
+                f"{{'knobs': {{...}}, ...}}: {payload!r}"
+            )
+        cost = payload.get("cost_us")
+        if cost is not None and not isinstance(cost, (int, float)):
+            raise PlanCacheError(
+                f"plan-cache entry {sig!r} has non-numeric cost_us "
+                f"{cost!r}"
+            )
+        return CacheEntry(
+            knobs=dict(payload["knobs"]),
+            cost_us=None if cost is None else float(cost),
+            provenance=str(payload.get("provenance", "")),
+        )
+
+
+@dataclasses.dataclass
+class PlanCache:
+    entries: Dict[str, CacheEntry] = dataclasses.field(default_factory=dict)
+
+    def lookup(self, key: PlanKey) -> Optional[CacheEntry]:
+        return self.entries.get(key.signature())
+
+    def put(self, key: PlanKey, entry: CacheEntry,
+            keep_best: bool = True) -> bool:
+        """Insert; with ``keep_best`` an existing better-measured entry
+        survives. Returns whether ``entry`` landed."""
+        sig = key.signature()
+        if keep_best and not entry.better_than(self.entries.get(sig)):
+            return False
+        self.entries[sig] = entry
+        return True
+
+    def merge(self, other: "PlanCache") -> "PlanCache":
+        """Per-key best-measured union of two caches (see module doc
+        for the tie rules). Returns ``self`` for chaining."""
+        for sig, entry in other.entries.items():
+            if entry.better_than(self.entries.get(sig)):
+                self.entries[sig] = entry
+        return self
+
+    # -- serialization --------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "entries": {
+                sig: e.to_json() for sig, e in sorted(self.entries.items())
+            },
+        }
+
+    @staticmethod
+    def from_json(payload: object) -> "PlanCache":
+        if not isinstance(payload, dict):
+            raise PlanCacheError(
+                f"plan cache must be a JSON object, got "
+                f"{type(payload).__name__}"
+            )
+        version = payload.get("schema_version")
+        if version != SCHEMA_VERSION:
+            raise PlanCacheError(
+                f"plan-cache schema_version {version!r} does not match "
+                f"this build's {SCHEMA_VERSION}; refusing to "
+                f"reinterpret tuned knobs across schema changes — "
+                f"re-run `smi-tpu tune` to regenerate the cache"
+            )
+        raw = payload.get("entries", {})
+        if not isinstance(raw, dict):
+            raise PlanCacheError("plan-cache 'entries' must be an object")
+        entries = {}
+        for sig, e in raw.items():
+            PlanKey.from_signature(sig)   # validates key shape loudly
+            entries[sig] = CacheEntry.from_json(sig, e)
+        return PlanCache(entries=entries)
+
+    def save(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        return path
+
+    @staticmethod
+    def load(path: str) -> "PlanCache":
+        with open(path) as f:
+            try:
+                payload = json.load(f)
+            except json.JSONDecodeError as e:
+                raise PlanCacheError(
+                    f"plan cache {path!r} is not valid JSON: {e}"
+                ) from e
+        return PlanCache.from_json(payload)
+
+
+def default_cache_path() -> Optional[str]:
+    """The user cache file: $SMI_TPU_PLAN_CACHE when set, else the
+    conventional per-user location."""
+    env = os.environ.get(CACHE_ENV, "").strip()
+    if env:
+        return env
+    home = os.path.expanduser("~")
+    if home and home != "/":
+        return os.path.join(home, ".cache", "smi_tpu", "plans.json")
+    return None

@@ -1,0 +1,288 @@
+"""Analytic layer: alpha-beta (Hockney) link costs + kernel rooflines.
+
+The Hockney model prices one message as ``T(m) = alpha + m / beta`` —
+a fixed per-step overhead plus bytes over link bandwidth (PAPERS.md).
+Collective algorithms differ in how many alpha steps they take and how
+many payload bytes cross each link, so the model ranks whole
+decompositions deterministically on CPU, with no hardware in the loop:
+
+- ``ring`` (one fused collective, the small-payload regime): the
+  payload makes ``n - 1`` neighbour hops — few launches, but each link
+  carries the *full* payload (the "gather-everything" volume the
+  collectives module documents).
+- ``rs_ag`` (reduce-scatter + all-gather): twice the steps, but each
+  link carries only ``2 (n-1) / n`` of the payload — the
+  bandwidth-optimal decomposition every large-payload allreduce takes.
+- ``hierarchical`` (two-tier meshes): the slow DCN tier is crossed once
+  with already-combined shards (``1/n_inner`` of the payload), at the
+  cost of three phases.
+
+The ranking flips from ``ring`` to ``rs_ag`` at
+:func:`rs_ag_crossover_bytes` — :data:`DEFAULT_ALPHA_S` is calibrated
+so the 8-rank crossover lands on the *measured* switch point the repo
+ships (``collectives.RS_AG_MIN_BYTES``, the HLO-verified 1 MiB tier);
+alpha here is per-collective-phase launch+dispatch overhead (tens of
+microseconds on a real XLA program), not raw wire latency.
+
+Kernel-side costs are rooflines over the facts the AOT tier already
+extracts (``parallel/aot.py::cost_facts``): bytes-accessed over HBM
+bandwidth vs flops over peak, whichever binds. Flash block candidates
+additionally carry the VMEM-footprint feasibility gate — a candidate
+that cannot fit the 16 MB scoped-VMEM frame is excluded, not ranked
+(the measured bq=1024 backward rejection, ``kernels/flash.py``).
+
+Link/roofline constants mirror ``parallel/traffic.py`` and PERF.json's
+roofline blocks; ``tests/test_tuning.py`` pins them against each other
+so the two evidence columns cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from smi_tpu.tuning.plan import Candidate
+
+#: v5e one-way ICI link bandwidth — MUST equal
+#: ``traffic.V5E_ICI_LINK_BYTES_PER_S`` (drift-guarded); re-declared so
+#: the model stays importable without the traffic module's JAX surface.
+V5E_ICI_BETA_BYTES_PER_S = 4.5e10
+
+#: DCN (inter-slice) bandwidth per host NIC — roughly 25 GbE effective;
+#: only the *ratio* to ICI matters for ranking (the reference routes
+#: intra-node at cost 1 vs QSFP at cost 100, ``codegen/program.py:7-8``).
+DCN_BETA_BYTES_PER_S = 3.0e9
+
+#: Per-collective-phase overhead (launch + dispatch + first-byte
+#: latency). Calibrated so :func:`rs_ag_crossover_bytes` at n=8 equals
+#: the measured 1 MiB switch tier (``RS_AG_MIN_BYTES``):
+#: ``alpha = RS_AG_MIN_BYTES * (n-2) / (n * beta)`` = 1.7476e-5 s.
+DEFAULT_ALPHA_S = 1.75e-5
+
+#: v5e HBM bandwidth / compute peaks (PERF.json ``rooflines``,
+#: ``benchmarks/surface.py``): 819 GB/s, 197 bf16 TFLOP/s, 65.67
+#: effective f32 TFLOP/s.
+V5E_HBM_BYTES_PER_S = 8.19e11
+V5E_PEAK_FLOPS = {"bfloat16": 1.97e14, "float32": 6.56667e13}
+#: Mosaic scoped-VMEM frame the flash kernels compile against.
+VMEM_LIMIT_BYTES = 16 * 1024 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkModel:
+    """Alpha-beta parameters of one interconnect tier."""
+
+    alpha_s: float = DEFAULT_ALPHA_S
+    beta_bytes_per_s: float = V5E_ICI_BETA_BYTES_PER_S
+
+    def step_us(self, payload_bytes: float, steps: float = 1.0) -> float:
+        return (steps * self.alpha_s
+                + payload_bytes / self.beta_bytes_per_s) * 1e6
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologySpec:
+    """What the model needs to know about where the collective runs:
+    rank count, and (for two-tier meshes) the inner/outer split."""
+
+    n: int
+    inner: Optional[int] = None      # ICI ranks per slice (hybrid mesh)
+    outer: Optional[int] = None      # slice count across DCN
+
+    @property
+    def hierarchical_eligible(self) -> bool:
+        return bool(self.inner and self.outer and self.outer > 1)
+
+
+def topology_from_comm(comm) -> TopologySpec:
+    """TopologySpec of a live :class:`Communicator` (lazy — no JAX work
+    beyond reading mesh axis sizes). A ``(dcn, ici)``-style 2-axis
+    hybrid mesh exposes the two-tier split."""
+    sizes = tuple(int(comm.mesh.shape[a]) for a in comm.axis_names)
+    n = 1
+    for s in sizes:
+        n *= s
+    if len(sizes) == 2 and "dcn" in comm.axis_names:
+        outer = int(comm.mesh.shape["dcn"])
+        return TopologySpec(n=n, inner=n // outer, outer=outer)
+    return TopologySpec(n=n)
+
+
+def topology_from_routing(topology) -> TopologySpec:
+    """TopologySpec from a build-time routing topology
+    (:func:`smi_tpu.parallel.routing.grid_topology` et al.) — the
+    route-table world's device count feeding the same model the live
+    communicator path uses."""
+    return TopologySpec(n=len(topology.devices))
+
+
+# ---------------------------------------------------------------------------
+# Collective algorithm costs
+# ---------------------------------------------------------------------------
+
+
+def ring_allreduce_us(payload_bytes: float, n: int,
+                      link: LinkModel) -> float:
+    """One fused collective: the payload circulates ``n - 1`` hops with
+    the running partial — minimal steps, full payload per link."""
+    if n <= 1:
+        return 0.0
+    return link.step_us((n - 1) * payload_bytes, steps=n - 1)
+
+
+def rs_ag_allreduce_us(payload_bytes: float, n: int,
+                       link: LinkModel) -> float:
+    """Reduce-scatter + all-gather: ``2 (n-1)`` steps, each link carries
+    ``2 (n-1) / n`` of the payload — bandwidth-optimal."""
+    if n <= 1:
+        return 0.0
+    return link.step_us(2 * (n - 1) / n * payload_bytes,
+                        steps=2 * (n - 1))
+
+
+def hierarchical_allreduce_us(
+    payload_bytes: float, topo: TopologySpec,
+    ici: LinkModel, dcn: LinkModel,
+) -> float:
+    """rs(ICI) + allreduce(DCN, 1/inner of the payload) + ag(ICI)."""
+    ni, no = topo.inner or topo.n, topo.outer or 1
+    t = 0.0
+    if ni > 1:
+        t += ici.step_us(2 * (ni - 1) / ni * payload_bytes,
+                         steps=2 * (ni - 1))
+    if no > 1:
+        t += dcn.step_us((no - 1) * (payload_bytes / max(1, ni)),
+                         steps=no - 1)
+    return t
+
+
+def rs_ag_crossover_bytes(n: int, link: LinkModel = LinkModel()) -> float:
+    """Payload size where ``rs_ag`` overtakes ``ring``:
+    ``alpha * beta * n / (n - 2)`` (from equating the two formulas).
+    ``inf`` for n <= 2 — the decomposition can never win a 2-ring
+    (identical volume, twice the steps)."""
+    if n <= 2:
+        return math.inf
+    return link.alpha_s * link.beta_bytes_per_s * n / (n - 2)
+
+
+def allreduce_candidates(
+    payload_bytes: int,
+    topo: TopologySpec,
+    link: LinkModel = LinkModel(),
+    dcn: LinkModel = LinkModel(beta_bytes_per_s=DCN_BETA_BYTES_PER_S),
+) -> List[Candidate]:
+    """Modeled candidate table for an ADD allreduce, best first.
+
+    Ties keep declaration order (``ring`` first): at a tie the fused
+    single collective wins — fewer launches, no epilogue.
+    """
+    n = topo.n
+    cands = [
+        Candidate(
+            "ring", {"algorithm": "ring"},
+            modeled_us=ring_allreduce_us(payload_bytes, n, link),
+            note=f"1 collective, {n - 1} hops x full payload/link",
+        ),
+        Candidate(
+            "rs_ag", {"algorithm": "rs_ag"},
+            modeled_us=rs_ag_allreduce_us(payload_bytes, n, link),
+            note=f"2 phases, 2(n-1)/n = {2 * (n - 1) / n:.2f}x "
+                 f"payload/link",
+        ),
+    ]
+    if topo.hierarchical_eligible:
+        cands.append(Candidate(
+            "hierarchical", {"algorithm": "hierarchical"},
+            modeled_us=hierarchical_allreduce_us(
+                payload_bytes, topo, link, dcn
+            ),
+            note=f"DCN crossed once at 1/{topo.inner} volume",
+        ))
+    order = sorted(enumerate(cands),
+                   key=lambda ic: (ic[1].modeled_us, ic[0]))
+    return [c for _, c in order]
+
+
+def chunk_pipeline_us(
+    payload_bytes: float, n: int, chunks: int, link: LinkModel,
+    overlappable_us: float = 0.0,
+) -> float:
+    """Advisory pipeline model for ``chunks=``: splitting into ``c``
+    independent collectives lets up to ``(c-1)/c`` of adjacent compute
+    hide behind the wire time, at ``(c-1)`` extra launches."""
+    base = ring_allreduce_us(payload_bytes, n, link)
+    c = max(1, chunks)
+    hidden = overlappable_us * (c - 1) / c
+    return base + (c - 1) * link.alpha_s * 1e6 - min(hidden, base)
+
+
+# ---------------------------------------------------------------------------
+# Kernel-side rooflines (fed by the AOT cost analysis)
+# ---------------------------------------------------------------------------
+
+
+def kernel_roofline_us(
+    flops: Optional[float],
+    bytes_accessed: Optional[float],
+    dtype: str = "bfloat16",
+    hbm_bytes_per_s: float = V5E_HBM_BYTES_PER_S,
+) -> Optional[float]:
+    """max(HBM time, compute time) of one kernel launch, from the facts
+    ``parallel/aot.py::cost_facts`` extracts out of a compiled
+    executable. ``None`` when neither fact is available (the tier the
+    heuristics then cover)."""
+    times = []
+    if bytes_accessed:
+        times.append(bytes_accessed / hbm_bytes_per_s)
+    if flops:
+        peak = V5E_PEAK_FLOPS.get(dtype, V5E_PEAK_FLOPS["float32"])
+        times.append(flops / peak)
+    if not times:
+        return None
+    return max(times) * 1e6
+
+
+def flash_fwd_vmem_bytes(bq: int, bk: int, d: int, itemsize: int) -> int:
+    """VMEM frame of one forward grid step: double-buffered q/k/v tiles
+    plus the f32 online-softmax scratch (``kernels/flash.py`` layout)."""
+    tiles = (bq * d + 2 * bk * d) * itemsize * 2   # double-buffered
+    scratch = bq * d * 4 + 2 * bq * 128 * 4        # acc + lane-wide m/l
+    return tiles + scratch
+
+
+def flash_block_candidates(
+    s: int, d: int, dtype: str, windowed: bool,
+    targets: Sequence[Tuple[int, int]] = (
+        (512, 512), (512, 1024), (1024, 512), (1024, 1024),
+    ),
+) -> List[Candidate]:
+    """Feasible forward-tile candidates, ranked by modeled grid-step
+    overhead (fewer, larger tiles amortize per-tile masking); the
+    VMEM-infeasible ones are *excluded*. This ranking is deliberately
+    coarse — it seeds the sweep order; measurement (the cache layer)
+    has the last word, which is exactly why f32 keeps bk=512 despite
+    the model preferring 1024 (PERF.json: f32 measured slower at 1024).
+    """
+    itemsize = 2 if dtype == "bfloat16" else 4
+    out = []
+    for bq, bk in targets:
+        vmem = flash_fwd_vmem_bytes(bq, bk, d, itemsize)
+        if vmem > VMEM_LIMIT_BYTES:
+            continue
+        steps = max(1, s // bq) * max(1, s // bk)
+        # per-step overhead ~2us (grid bookkeeping + edge masking);
+        # windowed grids touch few tiles, so finer bk wastes less dead
+        # span at the window edges — modeled as a mild fine-tile credit
+        overhead = steps * 2.0
+        if windowed and bk <= 512:
+            overhead *= 0.9
+        out.append(Candidate(
+            f"bq{bq}/bk{bk}", {"block_q": bq, "block_k": bk},
+            modeled_us=overhead,
+            note=f"vmem {vmem // 1024} KiB, {steps} grid steps",
+        ))
+    return sorted(
+        out, key=lambda c: (c.modeled_us, -c.knobs["block_q"])
+    )

@@ -1,0 +1,498 @@
+"""The plan engine: cache -> analytic model -> heuristic, never erroring.
+
+One object answers every "which knob value here?" question the trace
+paths used to answer with frozen constants. Resolution order per knob:
+
+1. **cache** — a measured entry in the persistent plan cache (the
+   shipped seeded cache merged with the user's ``$SMI_TPU_PLAN_CACHE``
+   file). Measurement always has the last word.
+2. **model** — the deterministic alpha-beta / roofline ranking
+   (:mod:`smi_tpu.tuning.cost_model`). At trace time the model layer
+   only decides where it is *confident* (payload at least
+   :data:`RS_AG_MODEL_MARGIN` x away from its own crossover) and only
+   when no explicit threshold override (env or cache) is in force — an
+   unmeasured model ranking near its crossover must never silently flip
+   a compiled program away from the measured default. ``smi-tpu tune
+   --explain`` always shows the full model ranking.
+3. **heuristic** — today's frozen defaults (``RS_AG_MIN_BYTES``, the
+   dtype-keyed flash block constants, ``chunks=1``), byte-for-byte the
+   pre-engine behavior, so a host with no cache and no model confidence
+   compiles exactly what it compiled before this subsystem existed.
+
+Trace-time consultation goes through the ``planned_*`` module functions,
+which swallow *every* exception into the heuristic answer — a corrupt
+cache file or an exotic backend can cost tuning, never a trace.
+
+The engine is process-global (:func:`get_engine`); tests swap it with
+:func:`set_engine` and restore with ``set_engine(None)``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Dict, Optional, Tuple
+
+from smi_tpu.tuning import cost_model as cm
+from smi_tpu.tuning.cache import (
+    CACHE_ENV,
+    CacheEntry,
+    PlanCache,
+    default_cache_path,
+)
+from smi_tpu.tuning.plan import (
+    Candidate,
+    Plan,
+    PlanKey,
+    normalize_device_kind,
+    payload_bucket,
+)
+from smi_tpu.tuning.seeded import seeded_cache
+
+#: Model-confidence margin for trace-time algorithm decisions: the
+#: model may decide only when the payload is at least this factor away
+#: from its own ring/rs+ag crossover. Inside the band the measured
+#: threshold default decides. With the calibrated DEFAULT_ALPHA_S the
+#: confident decisions provably agree with the 1 MiB heuristic, so
+#: enabling the model layer cannot change an untuned program.
+RS_AG_MODEL_MARGIN = 4.0
+
+
+def _valid_flash_block(v) -> bool:
+    """A flash tile target the kernels can actually use: a positive
+    multiple of the widest sublane tile (16 rows bf16), bounded well
+    above any real extent. Anything else is value-junk that would make
+    ``_pick_block`` find no divisor and fail the trace."""
+    return (
+        isinstance(v, int) and not isinstance(v, bool)
+        and 16 <= v <= (1 << 16) and v % 16 == 0
+    )
+
+
+def _collective_topology(topo: cm.TopologySpec) -> str:
+    if topo.hierarchical_eligible:
+        return f"n{topo.n}:dcn{topo.outer}"
+    return f"n{topo.n}"
+
+
+class PlanEngine:
+    def __init__(
+        self,
+        cache: Optional[PlanCache] = None,
+        link: Optional[cm.LinkModel] = None,
+        device_kind: Optional[str] = None,
+    ):
+        self.cache = cache if cache is not None else _load_default_cache()
+        self.link = link or cm.LinkModel()
+        self._device_kind = (
+            normalize_device_kind(device_kind) if device_kind else None
+        )
+        self._memo: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    # -- device identity -------------------------------------------------
+    def device_kind(self) -> str:
+        """Normalized local device kind (lazy; ``"unknown"`` when no
+        backend is reachable — such hosts simply never hit seeded
+        device-keyed entries)."""
+        if self._device_kind is None:
+            self._device_kind = _detect_device_kind()
+        return self._device_kind
+
+    def _memoized(self, key: tuple, compute):
+        with self._lock:
+            if key in self._memo:
+                return self._memo[key]
+        value = compute()
+        with self._lock:
+            if len(self._memo) >= 4096:   # trace-cache bound
+                self._memo.clear()
+            self._memo[key] = value
+        return value
+
+    # -- collectives -----------------------------------------------------
+    def allreduce_plan(
+        self,
+        payload_bytes: int,
+        topo: cm.TopologySpec,
+        dtype: str = "float32",
+        device_kind: Optional[str] = None,
+    ) -> Plan:
+        """Full (algorithm, chunks) plan for an ADD allreduce — the
+        ``tune``/``--explain`` entry: the model ranking is applied
+        outright when no cache entry exists (the deterministic-CPU
+        acceptance surface; the *trace-time* gate is
+        :meth:`use_rs_ag`)."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+        key = PlanKey("all_reduce", payload_bucket(payload_bytes), dtype,
+                      dk, _collective_topology(topo))
+        cands = cm.allreduce_candidates(payload_bytes, topo,
+                                        link=self.link)
+        knobs: Dict[str, object] = {}
+        decided: Dict[str, str] = {}
+        rationale = []
+        hit = self.cache.lookup(key)
+        if hit is not None and "algorithm" in hit.knobs:
+            knobs["algorithm"] = hit.knobs["algorithm"]
+            decided["algorithm"] = "cache"
+            rationale.append(
+                f"cache entry ({hit.provenance or 'measured sweep'}"
+                + (f", {hit.cost_us:.1f} us" if hit.cost_us is not None
+                   else "") + ")"
+            )
+            cands = [
+                Candidate(c.name, c.knobs, c.modeled_us,
+                          hit.cost_us if c.knobs.get("algorithm")
+                          == hit.knobs["algorithm"] else None, c.note)
+                for c in cands
+            ]
+        else:
+            knobs["algorithm"] = cands[0].knobs["algorithm"]
+            decided["algorithm"] = "model"
+            xover = cm.rs_ag_crossover_bytes(topo.n, self.link)
+            rationale.append(
+                f"alpha-beta ranking (ring/rs+ag crossover at "
+                f"{xover:.0f} B for n={topo.n})"
+            )
+        chunks, chunks_layer = self.collective_chunks(
+            "all_reduce", payload_bytes, topo.n, dtype, device_kind=dk
+        )
+        knobs["chunks"] = chunks
+        decided["chunks"] = chunks_layer
+        threshold, thr_layer = self.rs_ag_threshold(device_kind=dk)
+        knobs["rs_ag_min_bytes"] = threshold
+        decided["rs_ag_min_bytes"] = thr_layer
+        return Plan(key=key, knobs=knobs, decided_by=decided,
+                    candidates=cands, rationale=rationale)
+
+    def rs_ag_threshold(
+        self, device_kind: Optional[str] = None
+    ) -> Tuple[int, str]:
+        """(bytes, layer) of the rs+ag switch tier: plan-cache entry
+        when one exists, else the built-in heuristic constant. The env
+        override (``SMI_TPU_RS_AG_MIN_BYTES``) is applied by the
+        caller (``collectives.rs_ag_min_bytes``) — an explicit user
+        setting outranks every engine layer."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+
+        def compute():
+            for kind in (dk, "unknown"):
+                hit = self.cache.lookup(
+                    PlanKey("all_reduce", "threshold", "", kind, "any")
+                )
+                if hit is not None and "rs_ag_min_bytes" in hit.knobs:
+                    return int(hit.knobs["rs_ag_min_bytes"]), "cache"
+            from smi_tpu.parallel.collectives import RS_AG_MIN_BYTES
+
+            return int(RS_AG_MIN_BYTES), "heuristic"
+
+        return self._memoized(("rs_ag_threshold", dk), compute)
+
+    def use_rs_ag(
+        self,
+        payload_bytes: int,
+        topo: cm.TopologySpec,
+        dtype: str = "float32",
+        threshold: Optional[int] = None,
+        threshold_layer: str = "env",
+    ) -> Tuple[bool, str]:
+        """Trace-time algorithm gate for an *eligible* ADD allreduce.
+
+        ``threshold`` given = an explicit override (env var) — it
+        decides ALONE: not even a measured cache entry may outrank the
+        operator's word (the env path exists precisely to pin the
+        bit-exact single-psum form regardless of what a sweep found).
+        Otherwise: per-bucket cache entry, then the model where
+        confident, then the resolved threshold tier.
+        """
+        dk = self.device_kind()
+
+        def compute():
+            if threshold is not None:
+                return payload_bytes >= threshold, threshold_layer
+            key = PlanKey("all_reduce", payload_bucket(payload_bytes),
+                          dtype, dk, _collective_topology(topo))
+            hit = self.cache.lookup(key)
+            if hit is not None and "algorithm" in hit.knobs:
+                return hit.knobs["algorithm"] == "rs_ag", "cache"
+            thr, thr_layer = self.rs_ag_threshold()
+            if thr_layer == "heuristic":
+                # no explicit tier in force: the model decides where
+                # it is confidently away from its own crossover
+                xover = cm.rs_ag_crossover_bytes(topo.n, self.link)
+                if payload_bytes >= RS_AG_MODEL_MARGIN * xover:
+                    return True, "model"
+                if payload_bytes <= xover / RS_AG_MODEL_MARGIN:
+                    return False, "model"
+            return payload_bytes >= thr, thr_layer
+
+        return self._memoized(
+            ("use_rs_ag", payload_bucket(payload_bytes), topo, dtype,
+             threshold, threshold_layer, dk),
+            compute,
+        )
+
+    def collective_chunks(
+        self,
+        family: str,
+        payload_bytes: int,
+        n: int,
+        dtype: str = "float32",
+        device_kind: Optional[str] = None,
+    ) -> Tuple[int, str]:
+        """(chunks, layer) for a collective whose caller left
+        ``chunks=None``: cache entry, else today's unchunked default.
+        (The pipeline model's chunk suggestion is advisory — shown by
+        ``--explain``, applied only once a sweep has measured it.)"""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+
+        def compute():
+            key = PlanKey(family, payload_bucket(payload_bytes), dtype,
+                          dk, f"n{n}")
+            hit = self.cache.lookup(key)
+            if hit is not None and "chunks" in hit.knobs:
+                return max(1, int(hit.knobs["chunks"])), "cache"
+            return 1, "heuristic"
+
+        return self._memoized(
+            ("chunks", family, payload_bucket(payload_bytes), n, dtype,
+             dk),
+            compute,
+        )
+
+    # -- kernels ---------------------------------------------------------
+    def flash_blocks(
+        self,
+        dtype: str,
+        windowed: bool,
+        device_kind: Optional[str] = None,
+    ) -> Optional[Tuple[int, int, str]]:
+        """(block_q, block_k, layer) for the flash forward tiles, or
+        ``None`` when no cache entry exists — the kernel then keeps its
+        measured-constant heuristics (which the seeded v5e entries
+        reproduce exactly, so hardware behavior is unchanged until a
+        sweep says otherwise)."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+
+        def compute():
+            key = PlanKey("flash_fwd", "window" if windowed else "causal",
+                          dtype, dk, "chip")
+            hit = self.cache.lookup(key)
+            if hit is not None and {"block_q", "block_k"} <= set(hit.knobs):
+                bq, bk = hit.knobs["block_q"], hit.knobs["block_k"]
+                if _valid_flash_block(bq) and _valid_flash_block(bk):
+                    return int(bq), int(bk), "cache"
+                # value-junk in a schema-valid entry: the kernel's
+                # _pick_block would find no divisor and raise at trace
+                # time — the heuristics apply instead (broken cache
+                # costs tuning, never a trace)
+            return None
+
+        return self._memoized(("flash", dtype, windowed, dk), compute)
+
+    def flash_plan(
+        self,
+        dtype: str = "bfloat16",
+        windowed: bool = False,
+        s: int = 8192,
+        d: int = 128,
+        device_kind: Optional[str] = None,
+    ) -> Plan:
+        """Explain-surface flash plan: cache choice next to the model's
+        VMEM-gated candidate ranking and the dtype heuristic."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+        key = PlanKey("flash_fwd", "window" if windowed else "causal",
+                      dtype, dk, "chip")
+        cands = cm.flash_block_candidates(s, d, dtype, windowed)
+        picked = self.flash_blocks(dtype, windowed, device_kind=dk)
+        from smi_tpu.kernels import flash as _flash
+
+        heur = (_flash._block_q_fwd(dtype),
+                _flash._block_k_fwd(dtype, 4096 if windowed else None))
+        if picked is not None:
+            bq, bk, layer = picked
+            rationale = ["measured cache entry; heuristic tier would "
+                         f"pick bq{heur[0]}/bk{heur[1]}"]
+        else:
+            bq, bk = heur
+            layer = "heuristic"
+            rationale = [
+                "no cache entry for this device kind; dtype-keyed "
+                "measured constants apply (model ranking shown is "
+                "advisory until swept)"
+            ]
+        return Plan(
+            key=key,
+            knobs={"block_q": bq, "block_k": bk},
+            decided_by={"block_q": layer, "block_k": layer},
+            candidates=cands,
+            rationale=rationale,
+        )
+
+    def stencil_depth(
+        self,
+        extent: int = 8192,
+        dtype: str = "float32",
+        device_kind: Optional[str] = None,
+    ) -> Tuple[Optional[int], str]:
+        """(depth, layer) for the temporal stencil: seeded/swept cache
+        entry, else ``None`` + heuristic (``pick_temporal_depth``)."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+        hit = self.cache.lookup(
+            PlanKey("stencil_temporal", str(extent), dtype, dk, "chip")
+        )
+        if hit is not None and "depth" in hit.knobs:
+            return int(hit.knobs["depth"]), "cache"
+        return None, "heuristic"
+
+    # -- explain ---------------------------------------------------------
+    def explain_text(
+        self,
+        op: str,
+        n: int = 8,
+        dtype: str = "float32",
+        sizes_kb: Tuple[int, ...] = (4, 64, 1024, 16384),
+    ) -> str:
+        """The ``smi-tpu tune --explain OP`` payload: candidate tables
+        with modeled vs measured costs and the deciding layer per knob.
+        Deterministic on CPU — no devices are touched beyond reading
+        the local device kind."""
+        op = op.replace("-", "_")
+        if op in ("all_reduce", "allreduce"):
+            topo = cm.TopologySpec(n=n)
+            parts = [
+                f"all_reduce over n={n} ranks, dtype={dtype}, device "
+                f"kind '{self.device_kind()}'"
+            ]
+            for kb in sizes_kb:
+                parts.append(
+                    self.allreduce_plan(kb * 1024, topo, dtype).explain()
+                )
+            return "\n\n".join(parts)
+        if op == "flash_fwd":
+            return "\n\n".join(
+                self.flash_plan(dtype=dt, windowed=w).explain()
+                for dt in ("bfloat16", "float32")
+                for w in (False, True)
+            )
+        if op == "stencil_temporal":
+            depth, layer = self.stencil_depth()
+            via = ("plan cache" if layer == "cache"
+                   else "pick_temporal_depth heuristic")
+            return (
+                f"plan stencil_temporal|8192|float32|"
+                f"{self.device_kind()}|chip\n"
+                f"  depth = {depth!r}  [{layer}] ({via})"
+            )
+        if op in ("ring_all_reduce", "ring"):
+            chunks, layer = self.collective_chunks(
+                "ring_all_reduce", 1 << 20, n, dtype
+            )
+            return (
+                f"plan ring_all_reduce|{payload_bucket(1 << 20)}|{dtype}"
+                f"|{self.device_kind()}|n{n}\n"
+                f"  chunks = {chunks}  [{layer}]"
+            )
+        raise ValueError(
+            f"unknown op {op!r}; explainable ops: all_reduce, "
+            f"flash_fwd, stencil_temporal, ring_all_reduce"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Process-global engine + never-erroring trace-time entry points
+# ---------------------------------------------------------------------------
+
+_ENGINE: Optional[PlanEngine] = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def _detect_device_kind() -> str:
+    try:
+        import jax
+
+        return normalize_device_kind(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
+def _load_default_cache() -> PlanCache:
+    """Shipped seeded cache, with the user's cache file (when present)
+    merged over it. A malformed user file costs tuning, not a trace:
+    it is reported once as a warning and skipped."""
+    cache = seeded_cache()
+    path = default_cache_path()
+    try:
+        if path and os.path.exists(path):
+            cache.merge(PlanCache.load(path))
+    except Exception as e:
+        warnings.warn(
+            f"ignoring unreadable plan cache at {path!r} "
+            f"({type(e).__name__}: {e}); run `smi-tpu tune` to "
+            f"regenerate it, or unset ${CACHE_ENV}",
+            stacklevel=2,
+        )
+    return cache
+
+
+def get_engine() -> PlanEngine:
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is None:
+            _ENGINE = PlanEngine()
+        return _ENGINE
+
+
+def set_engine(engine: Optional[PlanEngine]) -> None:
+    """Install (or with ``None`` reset) the process-global engine —
+    the test seam, and how ``smi-tpu tune`` activates a fresh cache."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        _ENGINE = engine
+
+
+def planned_flash_blocks(
+    dtype: str, windowed: bool
+) -> Optional[Tuple[int, int]]:
+    """Trace-time flash consult: (bq, bk) from the cache, or ``None``
+    (keep the kernel's heuristics). Never raises."""
+    try:
+        got = get_engine().flash_blocks(dtype, windowed)
+        return None if got is None else (got[0], got[1])
+    except Exception:
+        return None
+
+
+def planned_chunks(
+    family: str, payload_bytes: int, n: int, dtype: str
+) -> int:
+    """Trace-time chunks consult for a ``chunks=None`` caller. Never
+    raises; the heuristic answer is 1 (unchunked)."""
+    try:
+        return get_engine().collective_chunks(
+            family, payload_bytes, n, dtype
+        )[0]
+    except Exception:
+        return 1
+
+
+def planned_rs_ag(
+    payload_bytes: int,
+    n: int,
+    dtype: str,
+    threshold: Optional[int] = None,
+) -> bool:
+    """Trace-time rs+ag gate for an eligible ADD allreduce. ``threshold``
+    carries an explicit env override. Never raises; the fallback is the
+    built-in constant comparison."""
+    try:
+        return get_engine().use_rs_ag(
+            payload_bytes, cm.TopologySpec(n=n), dtype,
+            threshold=threshold,
+        )[0]
+    except Exception:
+        from smi_tpu.parallel.collectives import RS_AG_MIN_BYTES
+
+        thr = RS_AG_MIN_BYTES if threshold is None else threshold
+        return payload_bytes >= thr

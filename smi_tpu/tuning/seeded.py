@@ -1,0 +1,107 @@
+"""The shipped default plan cache: PERF.json's measured-best configs.
+
+Satellite of the plan engine: v5e defaults start at the *measured*
+optimum instead of the dtype heuristic. Every entry cites the PERF.json
+metric whose sweep produced it, and ``tests/test_perf_docs.py`` pins the
+knob values against the committed measurement configs (the same drift
+discipline as the README perf tables):
+
+- bf16 causal forward: ``bq=1024 / bk=1024`` — the r5 interleaved A/B's
+  bq=1024 forward tile (+1.5% at S=8192, +11% windowed) and the
+  hand-swept ``block_q_kmajor_k = [1024, 1024, 1024]`` tier of
+  ``flash_vs_stock_swept`` (0.98x vs 6.4x at defaults: the row that
+  proves measured sweeps dominate heuristics).
+- bf16 *windowed* forward: ``bk`` narrows to 512 — measured +3% at
+  S=32k/window=4096 (107.6 vs 104.5 TF/s): finer tiles waste less dead
+  span at the window edges.
+- f32 forward keeps ``512/512`` — f32 measured fractionally *slower*
+  at bk=1024 (the case the analytic model ranks wrong, which is why
+  measurement outranks it).
+- temporal stencil: ``depth=16`` — the measured knee (131.7 Gcell/s);
+  beyond it halo-ring recompute cancels the HBM savings.
+- the rs+ag switch tier ships the HLO-verified 1 MiB threshold as a
+  *cache entry*, so ``smi-tpu tune`` sweeps can move it per fleet
+  without a code change (env ``SMI_TPU_RS_AG_MIN_BYTES`` still wins).
+
+Seeded costs are microseconds per timed rep, derived from each metric's
+committed differential timing ``[r, 4r, t_r, t_4r]`` as
+``(t_4r - t_r) / (4r - r) * 1e6`` — comparable with sweep results, so
+a merge prefers whichever config actually measured faster.
+"""
+
+from __future__ import annotations
+
+from smi_tpu.tuning.cache import CacheEntry, PlanCache
+from smi_tpu.tuning.plan import PlanKey
+
+#: the device kind every seeded entry is keyed to (normalized form of
+#: PERF.json's "TPU v5 lite0" / jax's device_kind "TPU v5 lite")
+SEEDED_DEVICE_KIND = "tpu v5 lite"
+
+#: knob values drift-guarded against PERF.json configs
+SEEDED_FLASH_BF16_BLOCKS = (1024, 1024)       # flash_vs_stock_swept
+SEEDED_FLASH_BF16_WINDOW_BLOCKS = (1024, 512)
+SEEDED_FLASH_F32_BLOCKS = (512, 512)
+SEEDED_STENCIL_DEPTH = 16                     # stencil_temporal_gcells
+SEEDED_RS_AG_MIN_BYTES = 1 << 20              # the HLO-verified switch
+
+
+def _us(timing) -> float:
+    """Per-rep microseconds of a PERF.json differential timing row."""
+    r, r4, t_r, t_r4 = timing
+    return (t_r4 - t_r) / (r4 - r) * 1e6
+
+
+def seeded_cache() -> PlanCache:
+    """A fresh copy of the shipped default cache (callers may merge
+    user sweeps over it without aliasing)."""
+    dk = SEEDED_DEVICE_KIND
+    cache = PlanCache()
+
+    bq, bk = SEEDED_FLASH_BF16_BLOCKS
+    cache.put(
+        PlanKey("flash_fwd", "causal", "bfloat16", dk, "chip"),
+        CacheEntry(
+            {"block_q": bq, "block_k": bk},
+            cost_us=_us([256, 512, 0.3992, 0.6978]),
+            provenance="seeded:PERF.json:flash_attn_fwd_s8192_bf16"
+                       "+flash_vs_stock_swept",
+        ),
+    )
+    bq, bk = SEEDED_FLASH_BF16_WINDOW_BLOCKS
+    cache.put(
+        PlanKey("flash_fwd", "window", "bfloat16", dk, "chip"),
+        CacheEntry(
+            {"block_q": bq, "block_k": bk},
+            cost_us=_us([256, 512, 1.4007, 2.7085]),
+            provenance="seeded:PERF.json:"
+                       "flash_attn_fwd_s32768_bf16_window4096",
+        ),
+    )
+    bq, bk = SEEDED_FLASH_F32_BLOCKS
+    cache.put(
+        PlanKey("flash_fwd", "causal", "float32", dk, "chip"),
+        CacheEntry(
+            {"block_q": bq, "block_k": bk},
+            cost_us=_us([64, 256, 0.4386, 1.4499]),
+            provenance="seeded:PERF.json:flash_attn_fwd_s8192_f32",
+        ),
+    )
+    cache.put(
+        PlanKey("stencil_temporal", "8192", "float32", dk, "chip"),
+        CacheEntry(
+            {"depth": SEEDED_STENCIL_DEPTH},
+            cost_us=_us([16, 64, 1.1119, 4.2417]),
+            provenance="seeded:PERF.json:stencil_temporal_gcells",
+        ),
+    )
+    cache.put(
+        PlanKey("all_reduce", "threshold", "", dk, "any"),
+        CacheEntry(
+            {"rs_ag_min_bytes": SEEDED_RS_AG_MIN_BYTES},
+            cost_us=None,
+            provenance="seeded:collectives.RS_AG_MIN_BYTES "
+                       "(HLO-verified switch test)",
+        ),
+    )
+    return cache

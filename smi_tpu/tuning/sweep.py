@@ -1,0 +1,199 @@
+"""Measured-sweep layer: refine the analytic winner on real hardware.
+
+The ATLAS move (PAPERS.md): enumerate the candidate configurations the
+cost model ranked, *time them* with the same harness the microbenchmark
+suite trusts (``benchmarks/micro.py``: jitted shard_map programs, a
+scalar readback forcing completion per run, ``timed_samples``' warmup +
+repeat discipline), and persist the winners as plan-cache entries. The
+sweep driver is what ``smi-tpu tune`` runs; on a CPU fake mesh it is
+functional (the cache mechanics and CLI are fully exercised) but the
+numbers describe the emulator, so entries are keyed by the *measured*
+device kind — a CPU sweep can never shadow a v5e entry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from smi_tpu.tuning import cost_model as cm
+from smi_tpu.tuning.cache import CacheEntry, PlanCache
+from smi_tpu.tuning.engine import PlanEngine, _collective_topology
+from smi_tpu.tuning.plan import PlanKey, normalize_device_kind, payload_bucket
+
+
+def _measure(make_fn, x, runs: int) -> float:
+    """Mean seconds of one candidate via the micro.py harness."""
+    from smi_tpu.benchmarks.micro import force_readback
+    from smi_tpu.benchmarks.stats import timed_samples
+
+    samples = timed_samples(force_readback(lambda: make_fn(x)), runs)
+    return sum(samples) / len(samples)
+
+
+def sweep_allreduce(
+    comm,
+    sizes_kb: Sequence[int] = (64, 256, 1024, 4096),
+    chunk_candidates: Sequence[int] = (1, 2, 4),
+    runs: int = 5,
+    device_kind: Optional[str] = None,
+    verbose: bool = False,
+) -> PlanCache:
+    """Time ring vs rs+ag (x chunk counts) per payload size; return the
+    winners as a mergeable :class:`PlanCache`.
+
+    Also distills the measured ring/rs+ag crossover into the
+    ``rs_ag_min_bytes`` threshold entry — the tuned replacement for the
+    frozen constant, consumed by ``collectives.rs_ag_min_bytes``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from smi_tpu.parallel import collectives as coll
+
+    axis = comm.axis_names[0]
+    n = comm.size
+    dk = normalize_device_kind(
+        device_kind or jax.devices()[0].device_kind
+    )
+    topo = cm.TopologySpec(n=n)
+    cache = PlanCache()
+    rs_ag_wins = []   # payload bytes where the decomposition measured best
+
+    for kb in sizes_kb:
+        elems = max(n, (kb * 1024 // 4) // n * n)  # rs+ag-eligible
+        payload_bytes = elems * 4
+
+        def make(rs_ag: bool, chunks: int):
+            def shard_fn(x):
+                y = coll.allreduce(x, comm, rs_ag=rs_ag, chunks=chunks)
+                return jnp.sum(y)[None]
+
+            fn = jax.jit(jax.shard_map(
+                shard_fn, mesh=comm.mesh, in_specs=P(),
+                out_specs=P(axis), check_vma=False,
+            ))
+            return lambda x: np.asarray(fn(x))
+
+        x = jnp.ones(elems, jnp.float32)
+        results = []
+        for algo, rs_ag in (("ring", False), ("rs_ag", True)):
+            for chunks in chunk_candidates:
+                secs = _measure(make(rs_ag, chunks), x, runs)
+                results.append((secs, algo, chunks))
+                if verbose:
+                    print(
+                        f"  {kb:>7} KiB {algo:>6} chunks={chunks}: "
+                        f"{secs * 1e6:.1f} us"
+                    )
+        secs, algo, chunks = min(results)
+        if algo == "rs_ag":
+            rs_ag_wins.append(payload_bytes)
+        key = PlanKey("all_reduce", payload_bucket(payload_bytes),
+                      "float32", dk, _collective_topology(topo))
+        cache.put(key, CacheEntry(
+            {"algorithm": algo, "chunks": chunks},
+            cost_us=secs * 1e6,
+            provenance=f"sweep:allreduce:{kb}KiB:n{n}",
+        ))
+
+    if rs_ag_wins and n > 2:
+        # the SMALLEST payload the decomposition won at, regardless of
+        # --sizes-kb iteration order; skipped on n <= 2 rings, where
+        # rs+ag is structurally unable to win (same volume, twice the
+        # steps) and any "win" is timing noise that would lower the
+        # device-wide tier for every later multi-rank trace
+        cache.put(
+            PlanKey("all_reduce", "threshold", "", dk, "any"),
+            CacheEntry(
+                {"rs_ag_min_bytes": int(min(rs_ag_wins))},
+                cost_us=None,
+                provenance=f"sweep:allreduce-crossover:n{n}",
+            ),
+        )
+    return cache
+
+
+def sweep_flash(
+    s: int = 8192,
+    d: int = 128,
+    h: int = 8,
+    dtype_name: str = "bfloat16",
+    windowed: bool = False,
+    runs: int = 3,
+    device_kind: Optional[str] = None,
+    targets: Sequence[Tuple[int, int]] = (
+        (512, 512), (512, 1024), (1024, 512), (1024, 1024),
+    ),
+    verbose: bool = False,
+) -> PlanCache:
+    """Time the flash forward at each feasible (block_q, block_k) and
+    cache the winner. Hardware-tier only (the compiled Mosaic path);
+    on a non-TPU backend this returns an empty cache rather than
+    recording interpreter timings as kernel truth."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from smi_tpu.kernels import flash as F
+    from smi_tpu.tuning import engine as eng
+
+    if jax.devices()[0].platform != "tpu":
+        return PlanCache()
+    dk = normalize_device_kind(
+        device_kind or jax.devices()[0].device_kind
+    )
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    window = 4096 if windowed else None
+    key = jax.random.PRNGKey(0)
+    q, k, v = (
+        jax.random.normal(jax.random.fold_in(key, i), (h, s, d), dtype)
+        for i in range(3)
+    )
+    feasible = [
+        (c.knobs["block_q"], c.knobs["block_k"])
+        for c in cm.flash_block_candidates(s, d, dtype_name, windowed,
+                                           targets=targets)
+    ]
+    plan_key = PlanKey("flash_fwd", "window" if windowed else "causal",
+                       dtype_name, dk, "chip")
+    results = []
+    saved = eng.get_engine()
+    try:
+        for bq, bk in feasible:
+            # candidate blocks are forced by a throwaway engine whose
+            # cache carries exactly this candidate — the same consult
+            # path production traces use, so the sweep times what
+            # deployment would run
+            trial = PlanCache()
+            trial.put(plan_key,
+                      CacheEntry({"block_q": bq, "block_k": bk}))
+            eng.set_engine(PlanEngine(cache=trial, device_kind=dk))
+            fn = jax.jit(lambda q, k, v: F.flash_attend_fused(
+                q, k, v, 0, 0, causal=True, scale=1.0, window=window,
+            )[0])
+            try:
+                secs = _measure(
+                    lambda args: np.asarray(jnp.sum(fn(*args))),
+                    (q, k, v), runs,
+                )
+            except Exception as e:
+                if verbose:
+                    print(f"  bq{bq}/bk{bk}: rejected ({e})")
+                continue
+            results.append((secs, bq, bk))
+            if verbose:
+                print(f"  bq{bq}/bk{bk}: {secs * 1e6:.1f} us")
+    finally:
+        eng.set_engine(saved)
+    cache = PlanCache()
+    if results:
+        secs, bq, bk = min(results)
+        cache.put(plan_key, CacheEntry(
+            {"block_q": bq, "block_k": bk},
+            cost_us=secs * 1e6,
+            provenance=f"sweep:flash_fwd:S{s}:{dtype_name}"
+                       + (":window" if windowed else ""),
+        ))
+    return cache

@@ -491,6 +491,37 @@ def test_ring_context_cache_hit_on_retrace():
     assert after.misses >= before.misses + 2
 
 
+def test_ring_context_cache_bounded_eviction_and_rehit():
+    """The ring-context memo is BOUNDED (tuning-PR satellite: the r3
+    ``maxsize=None`` was a slow leak under mesh-shape sweeps): filling
+    past the bound evicts LRU entries, and an evicted key re-misses
+    then re-hits — correctness is unaffected, only the rebuild cost."""
+    import pytest
+    from smi_tpu.kernels import ring as kring
+
+    maxsize = kring.RING_CONTEXT_CACHE_MAX
+    assert kring._ring_context_cached.cache_info().maxsize == maxsize
+    kring._ring_context_cached.cache_clear()
+    for i in range(maxsize + 8):
+        kring._ring_context(f"evx{i}", 2, ((f"evx{i}", 2),))
+    info = kring._ring_context_cached.cache_info()
+    assert info.currsize <= maxsize
+    assert info.misses == maxsize + 8
+    # the earliest key was evicted: re-request misses (rebuild) ...
+    before = kring._ring_context_cached.cache_info()
+    a = kring._ring_context("evx0", 2, (("evx0", 2),))
+    mid = kring._ring_context_cached.cache_info()
+    assert mid.misses == before.misses + 1
+    # ... and the rebuild re-enters the memo: the next call hits
+    b = kring._ring_context("evx0", 2, (("evx0", 2),))
+    after = kring._ring_context_cached.cache_info()
+    assert after.hits == mid.hits + 1
+    assert a is b
+    assert a[1] == {"evx0": 2}, "rebuilt context must be equivalent"
+    if maxsize < 8:  # pragma: no cover - config sanity
+        pytest.fail("bound too small for real programs")
+
+
 def test_routing_context_cache_hit_on_rebuild():
     from smi_tpu.parallel import routing as R
 

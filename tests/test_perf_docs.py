@@ -41,14 +41,16 @@ def _round(value, places: int) -> str:
 #: the tables' own precision: 1 for TF/s / Gcell/s rates, 2 for
 #: Mtoken/s throughputs.
 HEADLINES = [
-    ("stencil_temporal_gcells", 1, ("README.md", "docs/perf_notes.md")),
+    ("stencil_temporal_gcells", 1,
+     ("README.md", "docs/perf_notes.md", "docs/tuning.md")),
     ("stencil_fused_gcells", 1, ("README.md",)),
     ("stencil_temporal_vs_fused", 1, ("README.md",)),
     ("flash_attn_fwd_s32768_bf16_causal", 1,
      ("README.md", "docs/perf_notes.md")),
-    ("flash_attn_fwd_s8192_bf16", 1, ("README.md",)),
+    ("flash_attn_fwd_s8192_bf16", 1, ("README.md", "docs/tuning.md")),
     ("flash_attn_fwd_s16384_bf16", 1, ("README.md",)),
-    ("flash_attn_fwd_s32768_bf16_window4096", 1, ("README.md",)),
+    ("flash_attn_fwd_s32768_bf16_window4096", 1,
+     ("README.md", "docs/tuning.md")),
     ("flash_attn_train_tflops_bf16", 1, ("README.md",)),
     ("flash_attn_train_tokens_s32768_window4096_bf16", 2, ("README.md",)),
     ("flash_attn_train_tokens_s65536_window4096_bf16", 2, ("README.md",)),
@@ -57,8 +59,9 @@ HEADLINES = [
      ("README.md",)),
     ("flash_attn_train_tokens_s524288_gqa8_window4096_bf16", 2,
      ("README.md",)),
-    ("flash_vs_stock_default", 1, ("README.md", "docs/perf_notes.md")),
-    ("flash_vs_stock_swept", 2, ("README.md",)),
+    ("flash_vs_stock_default", 1,
+     ("README.md", "docs/perf_notes.md", "docs/tuning.md")),
+    ("flash_vs_stock_swept", 2, ("README.md", "docs/tuning.md")),
     ("transformer_train_tokens_s32768_window4096_bf16", 2, ("README.md",)),
     ("transformer_train_tokens_s8192_window4096_l4_bf16", 3,
      ("README.md",)),
@@ -89,3 +92,49 @@ def test_no_known_stale_values_left():
     notes = _read("docs/perf_notes.md")
     assert "124.6 TFLOP/s" not in readme + notes
     assert "131.6 Gcell/s" not in readme
+
+
+def test_seeded_plan_cache_matches_perf_json_measured_best():
+    """The shipped plan-cache seeds (tuning-PR satellite) quote the
+    committed measurements: the bf16 forward tiles must equal the
+    hand-swept blocks recorded in ``flash_vs_stock_swept`` and the r5
+    bq=1024 forward tile, and the temporal depth must equal the
+    measured knee of ``stencil_temporal_gcells``. A re-measure that
+    edits PERF.json without re-seeding fails here, the same discipline
+    as the doc tables. (Imports the tuning package — pure Python paths,
+    no devices.)"""
+    from smi_tpu.tuning import seeded
+
+    metrics = _load()
+    swept = metrics["flash_vs_stock_swept"]["config"]["block_q_kmajor_k"]
+    assert seeded.SEEDED_FLASH_BF16_BLOCKS == (swept[0], swept[2]), (
+        "seeded bf16 flash blocks drifted from the measured sweep in "
+        "PERF.json (flash_vs_stock_swept block_q_kmajor_k)"
+    )
+    assert (metrics["stencil_temporal_gcells"]["config"]["depth"]
+            == seeded.SEEDED_STENCIL_DEPTH), (
+        "seeded temporal depth drifted from the measured knee in "
+        "PERF.json (stencil_temporal_gcells)"
+    )
+    # the windowed seed narrows bk: the PERF row it cites must still be
+    # the window=4096 config it was measured at
+    cfg = metrics["flash_attn_fwd_s32768_bf16_window4096"]["config"]
+    assert cfg["window"] == 4096
+    assert seeded.SEEDED_FLASH_BF16_WINDOW_BLOCKS[1] < (
+        seeded.SEEDED_FLASH_BF16_BLOCKS[1]
+    )
+
+
+def test_tuning_doc_quotes_the_seeded_knobs():
+    """docs/tuning.md's decision table must state the seeded values the
+    code ships (block tiles, depth, threshold) — the table is the
+    human-readable mirror of ``smi_tpu/tuning/seeded.py``."""
+    from smi_tpu.tuning import seeded
+
+    text = _read("docs/tuning.md")
+    bq, bk = seeded.SEEDED_FLASH_BF16_BLOCKS
+    assert f"{bq} / {bk}" in text
+    wq, wk = seeded.SEEDED_FLASH_BF16_WINDOW_BLOCKS
+    assert f"{wq} / {wk}" in text
+    assert f"| {seeded.SEEDED_STENCIL_DEPTH} |" in text
+    assert str(seeded.SEEDED_RS_AG_MIN_BYTES) in text

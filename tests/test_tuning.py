@@ -1,0 +1,597 @@
+"""Plan-engine tests: the CPU-deterministic tier of the tuning marker.
+
+Covers the ISSUE 4 acceptance surface with no hardware in the loop:
+
+- every seeded cache entry resolves through the engine to the
+  measured-best plan (layer ``cache``);
+- with the cache removed, the analytic ranking matches the alpha-beta
+  prediction — ring wins small payloads, rs+ag wins large — across a
+  size sweep x 3 dtypes, flipping exactly once at the crossover;
+- the trace-time gate is *conservative*: on an untuned host it agrees
+  with the pre-engine heuristic at every payload size (enabling the
+  engine cannot move a compiled program);
+- plan-cache JSON round-trips, rejects mismatched schema versions
+  loudly, and merging prefers the better measured cost;
+- ``smi-tpu tune --explain all_reduce`` runs on CPU and prints the
+  candidate table naming the deciding layer per knob;
+- ``$SMI_TPU_RS_AG_MIN_BYTES`` overrides the switch tier (malformed
+  values are loud); trace paths consult injected caches; bench.py's
+  additive ``plan`` field keeps the one-line contract.
+
+The measured-sweep smoke runs the real driver at a tiny size on the
+CPU fake mesh (the mechanics, not the numbers); wide sweeps belong to
+the hardware tier and are marked ``slow``.
+"""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.tuning
+
+import jax.numpy as jnp  # noqa: E402  (conftest pins the CPU backend)
+
+from smi_tpu.parallel import collectives as C  # noqa: E402
+from smi_tpu.parallel.mesh import make_communicator  # noqa: E402
+from smi_tpu.tuning import (  # noqa: E402
+    CacheEntry,
+    PlanCache,
+    PlanCacheError,
+    PlanEngine,
+    PlanKey,
+    seeded_cache,
+)
+from smi_tpu.tuning import cost_model as cm  # noqa: E402
+from smi_tpu.tuning import engine as eng  # noqa: E402
+from smi_tpu.tuning.plan import (  # noqa: E402
+    normalize_device_kind,
+    payload_bucket,
+)
+from smi_tpu.tuning.seeded import SEEDED_DEVICE_KIND  # noqa: E402
+
+
+@pytest.fixture
+def fresh_engine():
+    """Restore the process-global engine after a test installs one."""
+    saved = eng.get_engine()
+    yield
+    eng.set_engine(saved)
+
+
+# ---------------------------------------------------------------------------
+# Seeded cache -> engine returns the measured-best plan
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_entries_resolve_to_measured_best():
+    e = PlanEngine(cache=seeded_cache(), device_kind=SEEDED_DEVICE_KIND)
+    assert e.flash_blocks("bfloat16", windowed=False) == (
+        1024, 1024, "cache"
+    )
+    assert e.flash_blocks("bfloat16", windowed=True) == (
+        1024, 512, "cache"
+    )
+    assert e.flash_blocks("float32", windowed=False) == (
+        512, 512, "cache"
+    )
+    assert e.stencil_depth(8192) == (16, "cache")
+    assert e.rs_ag_threshold() == (C.RS_AG_MIN_BYTES, "cache")
+
+
+def test_every_seeded_entry_is_reachable_through_the_engine():
+    """No orphan seeds: each shipped entry must be the value some
+    engine query actually returns (else a future key-schema change
+    could silently strand the measured optima)."""
+    cache = seeded_cache()
+    e = PlanEngine(cache=cache, device_kind=SEEDED_DEVICE_KIND)
+    for sig, entry in cache.entries.items():
+        key = PlanKey.from_signature(sig)
+        if key.op == "flash_fwd":
+            got = e.flash_blocks(key.dtype, key.detail == "window")
+            assert got is not None, sig
+            assert (got[0], got[1]) == (
+                entry.knobs["block_q"], entry.knobs["block_k"]
+            ), sig
+            assert got[2] == "cache"
+        elif key.op == "stencil_temporal":
+            assert e.stencil_depth(int(key.detail), key.dtype) == (
+                entry.knobs["depth"], "cache"
+            ), sig
+        elif key.op == "all_reduce" and key.detail == "threshold":
+            assert e.rs_ag_threshold() == (
+                entry.knobs["rs_ag_min_bytes"], "cache"
+            ), sig
+        else:  # pragma: no cover - fails on unknown seed shapes
+            pytest.fail(f"seeded entry {sig} has no engine query")
+
+
+def test_normalized_device_kinds_agree():
+    # PERF.json's device string and jax's device_kind key identically
+    assert normalize_device_kind("TPU v5 lite0") == SEEDED_DEVICE_KIND
+    assert normalize_device_kind("TPU v5 lite") == SEEDED_DEVICE_KIND
+    assert normalize_device_kind(None) == "unknown"
+
+
+def test_seeded_entries_never_hit_on_other_device_kinds():
+    e = PlanEngine(cache=seeded_cache(), device_kind="cpu")
+    assert e.flash_blocks("bfloat16", windowed=False) is None
+    assert e.stencil_depth(8192) == (None, "heuristic")
+    assert e.rs_ag_threshold() == (C.RS_AG_MIN_BYTES, "heuristic")
+
+
+# ---------------------------------------------------------------------------
+# Analytic model: the alpha-beta ranking (cache removed)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype,itemsize", [
+    ("float32", 4), ("bfloat16", 2), ("int32", 4),
+])
+def test_model_ranking_matches_alpha_beta_prediction(dtype, itemsize):
+    """Ring wins small payloads, rs+ag wins large, across a size sweep
+    — and the preference flips exactly once, at the model's crossover."""
+    e = PlanEngine(cache=PlanCache(), device_kind="cpu")
+    topo = cm.TopologySpec(n=8)
+    xover = cm.rs_ag_crossover_bytes(8)
+    choices = []
+    for k in range(8, 27):
+        elems = 2 ** k
+        payload = elems * itemsize
+        plan = e.allreduce_plan(payload, topo, dtype)
+        assert plan.decided_by["algorithm"] == "model"
+        want = "rs_ag" if payload > xover else "ring"
+        assert plan.knobs["algorithm"] == want, f"payload {payload}"
+        # the winning candidate leads the ranked table with the
+        # smaller modeled cost
+        assert plan.candidates[0].knobs["algorithm"] == want
+        assert (plan.candidates[0].modeled_us
+                <= plan.candidates[1].modeled_us)
+        choices.append(want)
+    assert "ring" in choices and "rs_ag" in choices
+    flip = choices.index("rs_ag")
+    assert all(c == "ring" for c in choices[:flip])
+    assert all(c == "rs_ag" for c in choices[flip:])
+
+
+def test_crossover_is_calibrated_to_the_measured_switch():
+    """DEFAULT_ALPHA_S is not arbitrary: the 8-rank crossover must sit
+    on the HLO-verified 1 MiB tier (within 10%), and a 2-ring must
+    never prefer the decomposition."""
+    xover = cm.rs_ag_crossover_bytes(8)
+    assert abs(xover - C.RS_AG_MIN_BYTES) / C.RS_AG_MIN_BYTES < 0.1
+    assert cm.rs_ag_crossover_bytes(2) == float("inf")
+
+
+def test_link_constants_match_the_traffic_model():
+    from smi_tpu.parallel import traffic
+
+    assert cm.V5E_ICI_BETA_BYTES_PER_S == traffic.V5E_ICI_LINK_BYTES_PER_S
+
+
+def test_hierarchical_candidate_on_two_tier_meshes():
+    topo = cm.TopologySpec(n=16, inner=8, outer=2)
+    cands = cm.allreduce_candidates(256 << 20, topo)
+    names = [c.name for c in cands]
+    assert "hierarchical" in names
+    # at a quarter-GiB payload the DCN-crossing-once shape must beat
+    # the flat ring (the reference's route-inside-the-node economics)
+    assert names.index("hierarchical") < names.index("ring")
+
+
+def test_kernel_roofline_from_cost_facts():
+    # pure HBM-bound: one second of traffic at the v5e rate
+    assert cm.kernel_roofline_us(0, cm.V5E_HBM_BYTES_PER_S) == (
+        pytest.approx(1e6)
+    )
+    # pure compute-bound at bf16 peak
+    assert cm.kernel_roofline_us(
+        cm.V5E_PEAK_FLOPS["bfloat16"], 0, "bfloat16"
+    ) == pytest.approx(1e6)
+    assert cm.kernel_roofline_us(None, None) is None
+
+
+def test_flash_candidates_are_vmem_gated():
+    cands = cm.flash_block_candidates(8192, 128, "bfloat16", False)
+    assert all(
+        cm.flash_fwd_vmem_bytes(
+            c.knobs["block_q"], c.knobs["block_k"], 128, 2
+        ) <= cm.VMEM_LIMIT_BYTES
+        for c in cands
+    )
+    # an absurd head_dim excludes every wide tile rather than ranking it
+    assert cm.flash_block_candidates(
+        8192, 8192, "float32", False
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# Trace-time conservatism: the engine cannot move an untuned program
+# ---------------------------------------------------------------------------
+
+
+def test_untuned_gate_agrees_with_the_heuristic_everywhere():
+    e = PlanEngine(cache=PlanCache(), device_kind="cpu")
+    topo = cm.TopologySpec(n=8)
+    for k in range(6, 28):
+        payload = 2 ** k
+        got, layer = e.use_rs_ag(payload, topo, "float32")
+        assert got == (payload >= C.RS_AG_MIN_BYTES), f"payload {payload}"
+        assert layer in ("model", "heuristic")
+
+
+def test_env_threshold_outranks_even_a_measured_cache_entry():
+    """The explicit override decides ALONE: an operator pinning the
+    bit-exact single-psum form must win over a swept rs_ag entry."""
+    cache = PlanCache()
+    cache.put(
+        PlanKey("all_reduce", payload_bucket(1 << 30), "float32",
+                "cpu", "n8"),
+        CacheEntry({"algorithm": "rs_ag", "chunks": 1}, cost_us=1.0,
+                   provenance="sweep:test"),
+    )
+    e = PlanEngine(cache=cache, device_kind="cpu")
+    got, layer = e.use_rs_ag(1 << 30, cm.TopologySpec(n=8), "float32",
+                             threshold=1 << 31)
+    assert got is False and layer == "env"
+    # without the override, the measured entry decides
+    assert e.use_rs_ag(1 << 30, cm.TopologySpec(n=8), "float32") == (
+        True, "cache"
+    )
+
+
+def test_value_junk_flash_entry_falls_back_to_heuristics(fresh_engine):
+    """A schema-valid entry with untileable knob values must cost
+    tuning, not the trace: flash_blocks rejects it and the dtype
+    constants apply."""
+    from smi_tpu.kernels import flash as F
+
+    for junk in ({"block_q": 7, "block_k": 512},
+                 {"block_q": 512, "block_k": 0},
+                 {"block_q": "big", "block_k": 512},
+                 {"block_q": True, "block_k": 512}):
+        cache = PlanCache()
+        cache.put(PlanKey("flash_fwd", "causal", "float32", "cpu",
+                          "chip"),
+                  CacheEntry(dict(junk), cost_us=1.0))
+        e = PlanEngine(cache=cache, device_kind="cpu")
+        assert e.flash_blocks("float32", False) is None, junk
+        eng.set_engine(e)
+        assert F._fwd_block_targets(jnp.float32, None) == (512, 512)
+
+
+def test_sweep_threshold_is_the_smallest_winning_payload(monkeypatch):
+    """An unsorted --sizes-kb grid must still distill min(payload where
+    rs+ag won), not the first iteration's payload."""
+    from smi_tpu.tuning import sweep as S
+
+    calls = {"i": 0}
+
+    def fake_measure(make_fn, x, runs):
+        # per size the driver times ring first, rs_ag second — make
+        # the second (rs_ag) always measure faster
+        calls["i"] += 1
+        return 2.0 if calls["i"] % 2 else 1.0
+    monkeypatch.setattr(S, "_measure", fake_measure)
+    comm = make_communicator()
+    cache = S.sweep_allreduce(comm, sizes_kb=[64, 4],
+                              chunk_candidates=[1], runs=1)
+    thr = cache.lookup(
+        PlanKey("all_reduce", "threshold", "", "cpu", "any")
+    )
+    assert thr is not None
+    # 4 KiB, not the first-iterated 64 KiB
+    assert thr.knobs["rs_ag_min_bytes"] == 4 * 1024
+    for sig, entry in cache.entries.items():
+        if sig.startswith("all_reduce|pow2:"):
+            assert entry.knobs["algorithm"] == "rs_ag"
+
+
+def test_cache_entry_decides_the_gate():
+    cache = PlanCache()
+    key = PlanKey("all_reduce", payload_bucket(5 << 20), "float32",
+                  "cpu", "n8")
+    cache.put(key, CacheEntry({"algorithm": "ring", "chunks": 1},
+                              cost_us=10.0, provenance="sweep:test"))
+    e = PlanEngine(cache=cache, device_kind="cpu")
+    # 5 MiB would switch by size; the measured entry overrides
+    assert e.use_rs_ag(5 << 20, cm.TopologySpec(n=8), "float32") == (
+        False, "cache"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Persistent cache: round-trip, loud schema rejection, best-cost merge
+# ---------------------------------------------------------------------------
+
+
+def test_cache_json_round_trips_to_identical_plans(tmp_path):
+    cache = seeded_cache()
+    cache.put(
+        PlanKey("all_reduce", "pow2:22", "float32", "cpu", "n8"),
+        CacheEntry({"algorithm": "rs_ag", "chunks": 2}, cost_us=123.4,
+                   provenance="sweep:test"),
+    )
+    path = str(tmp_path / "plans.json")
+    cache.save(path)
+    loaded = PlanCache.load(path)
+    assert loaded.to_json() == cache.to_json()
+    # identical plans through the engine, not just identical JSON
+    e1 = PlanEngine(cache=cache, device_kind=SEEDED_DEVICE_KIND)
+    e2 = PlanEngine(cache=loaded, device_kind=SEEDED_DEVICE_KIND)
+    assert (e1.flash_blocks("bfloat16", False)
+            == e2.flash_blocks("bfloat16", False))
+    assert (e1.use_rs_ag(5 << 20, cm.TopologySpec(n=8), "float32")
+            == e2.use_rs_ag(5 << 20, cm.TopologySpec(n=8), "float32"))
+
+
+def test_schema_version_mismatch_is_loud(tmp_path):
+    payload = seeded_cache().to_json()
+    payload["schema_version"] = 99
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(payload))
+    with pytest.raises(PlanCacheError, match="schema_version"):
+        PlanCache.load(str(path))
+    with pytest.raises(PlanCacheError, match="schema_version"):
+        PlanCache.from_json({"entries": {}})
+
+
+def test_malformed_entries_and_keys_are_loud(tmp_path):
+    with pytest.raises(PlanCacheError, match="knobs"):
+        PlanCache.from_json({
+            "schema_version": 1,
+            "entries": {"a|b|c|d|e": {"cost_us": 1.0}},
+        })
+    with pytest.raises((PlanCacheError, ValueError), match="signature"):
+        PlanCache.from_json({
+            "schema_version": 1,
+            "entries": {"not-a-key": {"knobs": {}}},
+        })
+    bad = tmp_path / "junk.json"
+    bad.write_text("{nope")
+    with pytest.raises(PlanCacheError, match="JSON"):
+        PlanCache.load(str(bad))
+
+
+def test_merge_prefers_the_better_measured_cost():
+    key = PlanKey("all_reduce", "pow2:20", "float32", "cpu", "n8")
+    slow = CacheEntry({"algorithm": "ring"}, cost_us=100.0)
+    fast = CacheEntry({"algorithm": "rs_ag"}, cost_us=50.0)
+    unmeasured = CacheEntry({"algorithm": "ring"})
+
+    a = PlanCache()
+    a.put(key, slow)
+    a.merge(_single(key, fast))
+    assert a.lookup(key).knobs["algorithm"] == "rs_ag"
+
+    b = PlanCache()
+    b.put(key, fast)
+    b.merge(_single(key, slow))   # worse incoming entry loses
+    assert b.lookup(key).cost_us == 50.0
+
+    c = PlanCache()
+    c.put(key, unmeasured)
+    c.merge(_single(key, slow))   # measured beats unmeasured
+    assert c.lookup(key).cost_us == 100.0
+    c.merge(_single(key, unmeasured))  # and survives a later unmeasured
+    assert c.lookup(key).cost_us == 100.0
+
+
+def _single(key, entry):
+    cache = PlanCache()
+    cache.put(key, entry)
+    return cache
+
+
+# ---------------------------------------------------------------------------
+# Env override of the rs+ag switch tier (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_rs_ag_env_override(monkeypatch):
+    monkeypatch.delenv(C.RS_AG_ENV, raising=False)
+    assert C.rs_ag_min_bytes() == C.RS_AG_MIN_BYTES
+    monkeypatch.setenv(C.RS_AG_ENV, "4096")
+    assert C.rs_ag_min_bytes() == 4096
+    monkeypatch.setenv(C.RS_AG_ENV, "  1048576 ")
+    assert C.rs_ag_min_bytes() == 1 << 20
+
+
+@pytest.mark.parametrize("bad", ["garbage", "-5", "1.5"])
+def test_rs_ag_env_malformed_is_loud(monkeypatch, bad):
+    monkeypatch.setenv(C.RS_AG_ENV, bad)
+    with pytest.raises(ValueError, match=C.RS_AG_ENV):
+        C.rs_ag_min_bytes()
+
+
+def test_env_threshold_moves_the_trace_time_switch(monkeypatch):
+    from smi_tpu.ops.types import SmiOp
+
+    comm = make_communicator()
+    x = jnp.ones((64, 16), jnp.float32)  # 4 KiB, rs+ag-eligible
+    monkeypatch.delenv(C.RS_AG_ENV, raising=False)
+    assert C._use_rs_ag(x, comm, SmiOp.ADD, None) is False
+    monkeypatch.setenv(C.RS_AG_ENV, "1024")
+    assert C._use_rs_ag(x, comm, SmiOp.ADD, None) is True
+    # the loud rejection on ineligible payloads is untouched
+    with pytest.raises(ValueError, match="rs_ag=True"):
+        C._use_rs_ag(jnp.float32(1.0), comm, SmiOp.ADD, True)
+
+
+# ---------------------------------------------------------------------------
+# Trace-path consultation (flash tiles, collective/ring chunks)
+# ---------------------------------------------------------------------------
+
+
+def test_flash_targets_follow_an_injected_cache(fresh_engine):
+    from smi_tpu.kernels import flash as F
+
+    cache = PlanCache()
+    cache.put(
+        PlanKey("flash_fwd", "causal", "float32", "cpu", "chip"),
+        CacheEntry({"block_q": 256, "block_k": 256}, cost_us=1.0,
+                   provenance="sweep:test"),
+    )
+    eng.set_engine(PlanEngine(cache=cache, device_kind="cpu"))
+    assert F._fwd_block_targets(jnp.float32, None) == (256, 256)
+    eng.set_engine(PlanEngine(cache=PlanCache(), device_kind="cpu"))
+    # no entry: the dtype heuristics, byte-for-byte
+    assert F._fwd_block_targets(jnp.float32, None) == (512, 512)
+    assert F._fwd_block_targets(jnp.bfloat16, None) == (1024, 1024)
+    assert F._fwd_block_targets(jnp.bfloat16, 4096) == (1024, 512)
+
+
+def test_collective_chunks_follow_the_cache(fresh_engine):
+    comm = make_communicator()
+    x = jnp.ones((64, 16), jnp.float32)        # 4 KiB -> pow2:12
+    assert C._resolve_chunks(None, x, comm, "all_reduce") == 1
+    assert C._resolve_chunks(4, x, comm, "all_reduce") == 4
+    with pytest.raises(ValueError):
+        C._resolve_chunks(0, x, comm, "all_reduce")
+    with pytest.raises(TypeError):
+        C._resolve_chunks(True, x, comm, "all_reduce")
+    cache = PlanCache()
+    cache.put(
+        PlanKey("all_reduce", payload_bucket(64 * 16 * 4), "float32",
+                "cpu", "n8"),
+        CacheEntry({"algorithm": "ring", "chunks": 3}, cost_us=5.0,
+                   provenance="sweep:test"),
+    )
+    eng.set_engine(PlanEngine(cache=cache, device_kind="cpu"))
+    assert C._resolve_chunks(None, x, comm, "all_reduce") == 3
+    # an explicit chunks=1 still means ONE collective, not "ask"
+    assert C._resolve_chunks(1, x, comm, "all_reduce") == 1
+
+
+def test_ring_chunks_follow_the_cache(fresh_engine):
+    from smi_tpu.kernels.ring import _planned_ring_chunks
+
+    x = jnp.ones((16, 128), jnp.float32)
+    assert _planned_ring_chunks(x, 4) == 1
+    cache = PlanCache()
+    cache.put(
+        PlanKey("ring_all_reduce", payload_bucket(16 * 128 * 4),
+                "float32", "cpu", "n4"),
+        CacheEntry({"chunks": 2}, cost_us=5.0, provenance="sweep:test"),
+    )
+    eng.set_engine(PlanEngine(cache=cache, device_kind="cpu"))
+    assert _planned_ring_chunks(x, 4) == 2
+
+
+# ---------------------------------------------------------------------------
+# CLI + explain surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_cli_tune_explain_all_reduce_runs_on_cpu(capsys):
+    from smi_tpu.__main__ import main
+
+    assert main(["tune", "--explain", "all_reduce"]) == 0
+    out = capsys.readouterr().out
+    assert "ring" in out and "rs_ag" in out
+    assert "modeled_us" in out and "measured_us" in out
+    # the deciding layer is named per knob
+    assert "[model]" in out or "[cache]" in out
+    assert "[heuristic]" in out
+    assert "rs_ag_min_bytes" in out and "chunks" in out
+
+
+def test_cli_tune_explain_unknown_op_fails_loudly(capsys):
+    from smi_tpu.__main__ import main
+
+    assert main(["tune", "--explain", "bogus"]) == 2
+    assert "unknown op" in capsys.readouterr().err
+
+
+def test_plan_explain_api_names_layers():
+    e = PlanEngine(cache=seeded_cache(), device_kind=SEEDED_DEVICE_KIND)
+    plan = e.flash_plan(dtype="bfloat16", windowed=False)
+    text = plan.explain()
+    assert "block_q = 1024" in text and "[cache]" in text
+    assert plan.source == "cache"
+    # an untuned decision reads as model/heuristic, never cache
+    plan2 = PlanEngine(
+        cache=PlanCache(), device_kind="cpu"
+    ).allreduce_plan(4096, cm.TopologySpec(n=8))
+    assert plan2.source == "model"
+    assert "[model]" in plan2.explain()
+
+
+def test_context_explain_plan_scopes_to_the_communicator():
+    from smi_tpu.parallel.context import SmiContext
+
+    comm = make_communicator()
+    text = SmiContext(comm=comm).explain_plan("all_reduce")
+    assert f"n={comm.size}" in text
+    assert "ring" in text and "rs_ag" in text
+
+
+# ---------------------------------------------------------------------------
+# Measured sweep (smoke: the mechanics on the CPU fake mesh)
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_allreduce_smoke_writes_a_mergeable_cache(tmp_path):
+    from smi_tpu.tuning.sweep import sweep_allreduce
+
+    comm = make_communicator()
+    cache = sweep_allreduce(comm, sizes_kb=[4], chunk_candidates=[1],
+                            runs=1)
+    sigs = [s for s in cache.entries if s.startswith("all_reduce|pow2:")]
+    assert sigs, cache.entries
+    entry = cache.entries[sigs[0]]
+    assert entry.knobs["algorithm"] in ("ring", "rs_ag")
+    assert entry.cost_us is not None and entry.cost_us > 0
+    assert entry.provenance.startswith("sweep:allreduce")
+    # the measured entry is keyed by the MEASURED device kind: a CPU
+    # sweep must never shadow a v5e seed
+    key = PlanKey.from_signature(sigs[0])
+    assert key.device_kind == normalize_device_kind("cpu")
+    path = str(tmp_path / "plans.json")
+    cache.save(path)
+    assert PlanCache.load(path).to_json() == cache.to_json()
+
+
+@pytest.mark.slow
+def test_sweep_allreduce_full_grid(tmp_path):
+    """The hardware-shaped sweep (multiple sizes x chunk candidates) —
+    slow tier: minutes of compile+measure even on the fake mesh."""
+    from smi_tpu.tuning.sweep import sweep_allreduce
+
+    comm = make_communicator()
+    cache = sweep_allreduce(comm, sizes_kb=[4, 64],
+                            chunk_candidates=[1, 2], runs=2)
+    assert len([s for s in cache.entries
+                if s.startswith("all_reduce|pow2:")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# bench.py additive plan field (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_line_with_plan_field_stays_single_line():
+    import bench
+
+    payload = {
+        "metric": "m", "value": 1, "unit": "u", "vs_baseline": 1,
+        "plan": {"stencil_depth": {"value": 16, "source": "cache"},
+                 "device_kind": "tpu v5 lite"},
+    }
+    line = bench.render_line(payload)
+    assert "\n" not in line
+    assert json.loads(line)["plan"]["stencil_depth"]["source"] == "cache"
+    # legacy keys stay mandatory with the new field present
+    with pytest.raises(ValueError, match="legacy key"):
+        bench.render_line({"metric": "m", "value": 1, "unit": "u",
+                           "plan": {}})
+
+
+def test_bench_plan_fields_never_claim_false_cache_provenance():
+    import bench
+
+    fields = bench.plan_fields(16)
+    assert fields["stencil_depth"]["value"] == 16
+    # this host is not the seeded device kind: the knob matches the
+    # seeded VALUE but must not claim cache provenance
+    assert fields["stencil_depth"]["source"] == "heuristic"
+    assert "device_kind" in fields
