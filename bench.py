@@ -73,6 +73,28 @@ def overlap_fields(compiled) -> dict:
     }
 
 
+def elastic_fields() -> dict:
+    """Additive elastic-runtime provenance: whether the run was
+    checkpointed (``$SMI_TPU_CHECKPOINT_DIR``), at what cadence, and
+    the failure-detector configuration that would police it
+    (:mod:`smi_tpu.parallel.checkpoint`/``membership``) — so a
+    multichip number states the durability regime it was measured
+    under. ``{"enabled": False}`` when the env does not opt in; the
+    legacy metric/value/unit/vs_baseline contract is untouched either
+    way (schema-guarded by ``tests/test_elastic.py``)."""
+    from smi_tpu.parallel.checkpoint import elastic_env_config
+
+    cfg = elastic_env_config()
+    if cfg is None:
+        return {"enabled": False}
+    return {
+        "enabled": True,
+        "cadence": cfg["cadence"],
+        "dir": cfg["dir"],
+        "detector": cfg["detector"],
+    }
+
+
 def plan_fields(depth) -> dict:
     """Additive plan-provenance evidence: which tuning layer (cache /
     model / heuristic) produced the knobs behind the headline metric
@@ -195,6 +217,11 @@ def main():
             )
         except Exception as e:
             payload["overlap"] = {"error": f"{type(e).__name__}: {e}"}
+        # additive elastic-provenance field (same best-effort contract)
+        try:
+            payload["elastic"] = elastic_fields()
+        except Exception as e:
+            payload["elastic"] = {"error": f"{type(e).__name__}: {e}"}
     # additive plan-provenance field (same best-effort contract)
     try:
         payload["plan"] = plan_fields(depth)

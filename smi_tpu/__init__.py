@@ -83,9 +83,23 @@ from smi_tpu.parallel.channels import FrameCheck, P2PChannel, stream_concurrent
 from smi_tpu.parallel.context import SmiContext, smi_kernel
 from smi_tpu.parallel.credits import IntegrityError
 from smi_tpu.parallel.faults import FaultPlan
+from smi_tpu.parallel.checkpoint import (
+    CheckpointIntegrityError,
+    CheckpointStore,
+    run_iterative,
+)
+from smi_tpu.parallel.membership import (
+    ConfirmedDead,
+    MembershipView,
+    PhiAccrualDetector,
+    StaleEpochError,
+    SuspectRank,
+    elastic_campaign,
+)
 from smi_tpu.parallel.recovery import (
     ProgressLog,
     RecoveryOutcome,
+    WalCorruptionError,
     chaos_campaign,
     recover_communicator,
     run_with_recovery,
@@ -133,9 +147,19 @@ __all__ = [
     "RouteCutError",
     "ProgressLog",
     "RecoveryOutcome",
+    "WalCorruptionError",
     "chaos_campaign",
     "recover_communicator",
     "run_with_recovery",
+    "CheckpointIntegrityError",
+    "CheckpointStore",
+    "run_iterative",
+    "ConfirmedDead",
+    "MembershipView",
+    "PhiAccrualDetector",
+    "StaleEpochError",
+    "SuspectRank",
+    "elastic_campaign",
     "Deadline",
     "WatchdogTimeout",
 ]

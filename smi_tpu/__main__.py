@@ -169,6 +169,9 @@ def _route_check(args: argparse.Namespace, topology, ctx) -> int:
     except NoRouteFound as e:
         print(f"routes: FAIL — {e}")
         rc = 1
+    if excluded is not None and excluded.devices:
+        rc = max(rc, _check_heirs(topology, ctx, excluded, healthy,
+                                  routes_ok=rc == 0))
     if args.hostfile:
         try:
             with open(args.hostfile) as f:
@@ -189,6 +192,81 @@ def _route_check(args: argparse.Namespace, topology, ctx) -> int:
         except (OSError, HostfileError) as e:
             print(f"hostfile: FAIL — {e}")
             rc = 1
+    return rc
+
+
+def _check_heirs(topology, ctx, excluded, healthy,
+                 routes_ok: bool = True) -> int:
+    """``route --check --down``: every down rank must have a reachable
+    heir under the regrow plan.
+
+    The elastic runtime's launch-time counterpart: when a device is
+    declared down, its duties (progress log, logged contribution,
+    checkpoint shard) pass to its heir — the nearest surviving
+    successor on the original ring
+    (:func:`smi_tpu.parallel.recovery.heir_of`) — and the survivors
+    later regrow around the same rank slots. A down rank with no
+    survivor to inherit to is named HERE (the one shape the all-pairs
+    check passes trivially: nobody healthy means no pairs), before a
+    launcher grabs a pod that could never heal. When the all-pairs
+    check already FAILED (``routes_ok=False``), the per-down-rank scan
+    additionally names which heirs the cut strands — redundant for the
+    exit code, but it turns "some pair is unroutable" into "rank 3's
+    state cannot be reassembled". One line per verdict; returns the
+    exit contribution.
+    """
+    from smi_tpu.parallel.recovery import UnrecoverableError, heir_of
+    from smi_tpu.parallel.routing import NoRouteFound, _paths_to_device
+
+    devices = topology.devices
+    n = len(devices)
+    survivors = [r for r, d in enumerate(devices) if d in set(healthy)]
+    rc = 0
+    inherited = []
+    for rank, device in enumerate(devices):
+        if device not in excluded.devices:
+            continue
+        try:
+            heir = heir_of(rank, survivors, n)
+        except UnrecoverableError:
+            print(
+                f"heirs: FAIL — rank {rank} ({device}) has no "
+                f"surviving heir under the regrow plan: every rank is "
+                f"down"
+            )
+            rc = 1
+            continue
+        heir_dev = devices[heir]
+        stranded = None
+        if not routes_ok:
+            # all-pairs among the healthy devices already holds when
+            # routes_ok: the heir is healthy, so it is reachable — no
+            # need to re-derive a subset of that check
+            for peer in healthy:
+                if peer == heir_dev:
+                    continue
+                try:
+                    for link in ctx.links(peer):
+                        _paths_to_device(ctx, link, heir_dev)
+                except NoRouteFound:
+                    stranded = peer
+                    break
+        if stranded is not None:
+            print(
+                f"heirs: FAIL — rank {rank} ({device}) inherits to "
+                f"rank {heir} ({heir_dev}), but the failure set "
+                f"[{excluded}] cuts {stranded} off from the heir — "
+                f"the regrow plan cannot reassemble its state"
+            )
+            rc = 1
+            continue
+        inherited.append((rank, heir))
+    if not rc and inherited:
+        print(
+            "heirs: ok ("
+            + ", ".join(f"rank {r} -> rank {h}" for r, h in inherited)
+            + " all reachable under the regrow plan)"
+        )
     return rc
 
 
@@ -590,6 +668,8 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     from smi_tpu.parallel.faults import PROTOCOLS
     from smi_tpu.parallel.recovery import chaos_campaign
 
+    if args.elastic:
+        return _cmd_chaos_elastic(args)
     protocols = args.protocols or list(PROTOCOLS)
     unknown = [p for p in protocols if p not in PROTOCOLS]
     if unknown:
@@ -623,6 +703,62 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         print(f"report -> {args.out}")
     if report["ok"]:
         print("campaign ok: every cell healed or ended in a named state")
+    return 0 if report["ok"] else 1
+
+
+def _cmd_chaos_elastic(args: argparse.Namespace) -> int:
+    """``chaos --elastic``: the seeded kill→detect→shrink→
+    checkpoint-restore→regrow soak (:mod:`smi_tpu.parallel.membership`).
+
+    Every cell runs a sharded iterative Jacobi job under a seeded
+    elastic fault plan (FlappingRank / StalledHeartbeat): the
+    phi-accrual detector must confirm a crash before the watchdog
+    budget, survivors must shrink and restore from the last complete
+    checkpoint manifest, the flapped rank must regrow under a new
+    epoch, and the final grid must be bit-identical to the fault-free
+    run. Exit gate: zero silent corruptions AND zero stale-epoch
+    leaks (every packet from a dead incarnation rejected loudly).
+    """
+    from smi_tpu.parallel.membership import elastic_campaign
+
+    if args.protocols:
+        print("error: --protocols does not apply to --elastic (the "
+              "soak drives the sharded Jacobi job)", file=sys.stderr)
+        return 2
+    if args.max_faults != 2:
+        print("error: --max-faults does not apply to --elastic "
+              "(elastic plans draw exactly one job-level fault; "
+              "sweep more cells with --trials/--ranks instead)",
+              file=sys.stderr)
+        return 2
+    report = elastic_campaign(
+        seed=args.seed, ns=args.ranks, trials=args.trials,
+    )
+    for key in sorted(report["outcomes"]):
+        print(f"{key:>18}: {report['outcomes'][key]}")
+    print(
+        f"{report['cells']} cells (seed {args.seed}), "
+        f"max detect latency "
+        f"{report['max_detect_ticks']} ticks "
+        f"(watchdog budget {report['watchdog_budget_ticks']}), "
+        f"{report['stale_epoch_rejections']} stale-epoch packets "
+        f"rejected, {report['silent_corruptions']} silent corruptions, "
+        f"{report['stale_epoch_leaks']} stale-epoch leaks"
+    )
+    for failure in report["failures"]:
+        print(
+            f"FAILURE n={failure['n']} (cell seed "
+            f"{failure['cell_seed']}): {failure['verdict']}"
+        )
+        print(f"  plan: {failure['plan']}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"report -> {args.out}")
+    if report["ok"]:
+        print("elastic campaign ok: every cell detected, restored, "
+              "regrew, and matched the fault-free grid bit-for-bit")
     return 0 if report["ok"] else 1
 
 
@@ -975,6 +1111,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="random plans per (protocol, n) cell")
     p.add_argument("--max-faults", type=int, default=2,
                    help="faults per random plan (1..N drawn)")
+    p.add_argument("--elastic", action="store_true",
+                   help="run the elastic runtime soak instead: seeded "
+                        "kill→detect→shrink→checkpoint-restore→regrow "
+                        "cells over a sharded Jacobi job, gated on "
+                        "zero silent corruption and zero stale-epoch "
+                        "leaks (--ranks/--trials apply; --protocols "
+                        "does not)")
     p.add_argument("-o", "--out", default=None,
                    help="write the JSON campaign report here")
     p.set_defaults(fn=cmd_chaos)

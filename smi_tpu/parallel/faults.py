@@ -84,6 +84,16 @@ FAULT_CLASSES = ("dropped_grant", "duplicated_grant", "delayed_dma",
 INTEGRITY_FAULT_CLASSES = ("bit_flip_payload", "reordered_chunks",
                            "truncated_dma")
 
+#: Elastic (job-level) fault classes, deliberately NOT in
+#: :data:`FAULT_CLASSES`: the seed-pinned base chaos campaign draws
+#: from that tuple, so extending it would silently re-roll every
+#: pinned cell (the same discipline that keeps CHUNKED_PROTOCOLS out
+#: of the base sweep). These classes drive the membership layer
+#: (:mod:`smi_tpu.parallel.membership`) across *iterations of a job*,
+#: not actions of one collective — ``smi-tpu chaos --elastic`` sweeps
+#: them.
+ELASTIC_FAULT_CLASSES = ("flapping_rank", "stalled_heartbeat")
+
 #: Named invariant violations that count as *detection*. A bare
 #: ProtocolError (wrong delivery) is NOT in this set — that is silent
 #: corruption and fails the matrix.
@@ -171,6 +181,51 @@ class TruncatedDma:
     nth: int = 0
 
 
+@dataclasses.dataclass(frozen=True)
+class FlappingRank:
+    """``rank`` crash-stops at job iteration ``dies_at``, recovers,
+    and asks to rejoin at iteration ``rejoins_at``.
+
+    A *job-level* fault (units are iterations of an iterative job, not
+    actions of one collective): the phi-accrual detector must confirm
+    the death before any watchdog fires, survivors shrink and restore
+    from the last checkpoint manifest, and the recovered rank regrows
+    under a new epoch — with the dead incarnation's traffic rejected
+    as :class:`~smi_tpu.parallel.membership.StaleEpochError`. Inside a
+    single simulator run the rank simply runs or is absent (membership
+    decides), so the plan's simulator hooks ignore this class.
+    """
+
+    rank: int
+    dies_at: int = 2
+    rejoins_at: int = 8
+
+    def __post_init__(self):
+        if self.rejoins_at <= self.dies_at:
+            raise ValueError(
+                f"FlappingRank must die before it rejoins "
+                f"(dies_at={self.dies_at}, rejoins_at={self.rejoins_at})"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class StalledHeartbeat:
+    """``rank`` stays alive and computing but its heartbeats go silent
+    for ``silent_for`` step-clock ticks starting at ``from_tick``.
+
+    The fault the two-threshold detector exists for: the rank must be
+    *suspected* (phi crosses the suspect threshold) and then cleared
+    when heartbeats resume — never confirmed dead, never shrunk. A
+    detector that kills it is a false positive the elastic campaign
+    counts as a failure. No simulator-hook effect (the data plane is
+    healthy).
+    """
+
+    rank: int
+    from_tick: int = 50
+    silent_for: int = 20
+
+
 def _corrupt_value(inner, truncate: bool):
     """Type-preserving in-flight damage: on hardware a flipped or
     truncated buffer still has the buffer's type — the reduction
@@ -239,6 +294,10 @@ class FaultPlan:
     bit_flips: Tuple[BitFlipPayload, ...] = ()
     reorders: Tuple[ReorderedChunks, ...] = ()
     truncations: Tuple[TruncatedDma, ...] = ()
+    #: Job-level elastic faults (no simulator-hook effect; consumed by
+    #: the membership layer's elastic soak).
+    flapping_ranks: Tuple[FlappingRank, ...] = ()
+    stalled_heartbeats: Tuple[StalledHeartbeat, ...] = ()
 
     # -- hook interface (credits.RingSimulator) ------------------------
     def grant_multiplier(self, rank: int, nth: int) -> int:
@@ -300,6 +359,7 @@ class FaultPlan:
             self.dropped_grants or self.duplicated_grants
             or self.delayed_dmas or self.stalled_ranks or self.down_links
             or self.bit_flips or self.reorders or self.truncations
+            or self.flapping_ranks or self.stalled_heartbeats
         )
 
     def faults(self) -> Tuple:
@@ -310,6 +370,7 @@ class FaultPlan:
             + self.delayed_dmas + self.stalled_ranks
             + tuple(DownLink(a, b) for a, b in sorted(self.down_links))
             + self.bit_flips + self.reorders + self.truncations
+            + self.flapping_ranks + self.stalled_heartbeats
         )
 
     def describe(self) -> List[str]:
@@ -339,6 +400,10 @@ class FaultPlan:
             return cls(reorders=(fault,))
         if isinstance(fault, TruncatedDma):
             return cls(truncations=(fault,))
+        if isinstance(fault, FlappingRank):
+            return cls(flapping_ranks=(fault,))
+        if isinstance(fault, StalledHeartbeat):
+            return cls(stalled_heartbeats=(fault,))
         raise TypeError(f"unknown fault {fault!r}")
 
     @classmethod
@@ -358,6 +423,10 @@ class FaultPlan:
                 bit_flips=plan.bit_flips + single.bit_flips,
                 reorders=plan.reorders + single.reorders,
                 truncations=plan.truncations + single.truncations,
+                flapping_ranks=(plan.flapping_ranks
+                                + single.flapping_ranks),
+                stalled_heartbeats=(plan.stalled_heartbeats
+                                    + single.stalled_heartbeats),
             )
         return plan
 
@@ -386,9 +455,31 @@ class FaultPlan:
             return cls.single(ReorderedChunks(rank, nth=rng.randrange(3)))
         if fault_class == "truncated_dma":
             return cls.single(TruncatedDma(rank, nth=rng.randrange(3)))
+        if fault_class == "flapping_rank":
+            # dies after the detector bootstrap, rejoins mid-job so the
+            # regrow path always exercises (elastic cells run >= 14
+            # iterations)
+            dies = 2 + rng.randrange(4)
+            return cls.single(FlappingRank(
+                rank, dies_at=dies, rejoins_at=dies + 4 + rng.randrange(4),
+            ))
+        if fault_class == "stalled_heartbeat":
+            # silence starts after the soak's ~40-tick bootstrap and is
+            # calibrated to the two-threshold band: long enough that
+            # suspicion is guaranteed (>= suspect latency ~16 ticks for
+            # any window phase), short enough that the resuming beat
+            # lands inside the confirmation grace even in the worst
+            # phase (window + ~2 periods of schedule phase must stay
+            # under suspect latency + CONFIRM_GRACE_TICKS) — suspected,
+            # cleared, never killed, for EVERY (from_tick, silent_for)
+            # this generator can draw (swept in tests/test_membership)
+            return cls.single(StalledHeartbeat(
+                rank, from_tick=50 + rng.randrange(40),
+                silent_for=16 + rng.randrange(9),
+            ))
         raise ValueError(
             f"unknown fault class {fault_class!r}; "
-            f"known: {FAULT_CLASSES}"
+            f"known: {FAULT_CLASSES + ELASTIC_FAULT_CLASSES}"
         )
 
 

@@ -1,0 +1,822 @@
+"""Elastic membership: phi-accrual failure detection, epochs, regrow.
+
+PR 1/2 recover *reactively*: a rank is declared dead only when some
+operation deadlocks on it (simulator :class:`~credits.DeadlockError`)
+or a watchdog budget expires — by which point every survivor has
+already burned a full timeout, and the only way forward is to shrink.
+This module adds the two standard production pieces on top
+(PAPERS.md):
+
+- a **phi-accrual failure detector** (Hayashibara et al., SRDS'04):
+  every rank heartbeats on a deterministic step clock; the detector
+  keeps a sliding window of inter-arrival times per rank and computes
+  ``phi = -log10(P(a heartbeat this late is still coming))`` under a
+  normal model of the window. ``phi`` is a *suspicion level*, not a
+  binary verdict: crossing :data:`SUSPECT_PHI` emits
+  :class:`SuspectRank` (drain new work away from the rank, keep it in
+  the ring), crossing :data:`DEAD_PHI` emits :class:`ConfirmedDead`
+  (feed :class:`~smi_tpu.parallel.routing.FailureSet`/recovery and
+  shrink) — *before* any watchdog fires, because the detector's
+  evidence accrues continuously instead of waiting out one fixed
+  budget. A heartbeat from a suspected rank clears the suspicion
+  (:class:`SuspicionCleared`): a rank that is alive-but-silent
+  (:class:`~smi_tpu.parallel.faults.StalledHeartbeat`) is suspected,
+  never killed.
+- **epoch-numbered membership** with *regrow* — the inverse of
+  :meth:`Communicator.shrink`: a recovered rank re-admits under a new
+  epoch and a new incarnation number, the ring re-plans via the
+  existing :func:`~smi_tpu.parallel.recovery.plan_ring` /
+  :func:`~smi_tpu.parallel.routing.grid_topology` machinery, and any
+  traffic still tagged with an old epoch raises
+  :class:`StaleEpochError` naming the sender, its stale epoch, and the
+  current one — the dead incarnation's packets can never be silently
+  folded into the regrown job.
+
+Everything here is pure Python and clock-deterministic (the step clock
+is the credits simulator's event count, never wall time), so the
+elastic kill→detect→shrink→restore→regrow soak
+(:func:`run_elastic_cell` / :func:`elastic_campaign`, the
+``smi-tpu chaos --elastic`` surface) replays bit-identically per seed.
+The runtime bridge — :meth:`Communicator.regrow` — lives in
+:mod:`smi_tpu.parallel.mesh` and delegates its ring validation here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from smi_tpu.parallel import faults as F
+
+#: Detector thresholds (phi is -log10 of the probability the heartbeat
+#: is merely late): suspect at phi >= 4 — a 1-in-10^4 late arrival —
+#: and confirm dead at phi >= 8. docs/robustness.md quotes both
+#: (drift-guarded by tests/test_perf_docs.py).
+SUSPECT_PHI = 4.0
+DEAD_PHI = 8.0
+
+#: Nominal heartbeat period in step-clock ticks; the elastic soak
+#: advances the clock by one period per job iteration.
+HEARTBEAT_INTERVAL = 10
+
+#: Confirmation grace: a suspect is only confirmed dead once it has
+#: stayed suspected (phi never dipping below the suspect threshold)
+#: for four full heartbeat periods. Suspicion is cheap and reversible
+#: (drain new work); death is not (shrink + restore) — the grace is
+#: what lets an alive-but-silent rank (``StalledHeartbeat``) be
+#: suspected and cleared without ever being killed, while a genuine
+#: crash still confirms within ~5-6 periods, far inside any watchdog
+#: budget. Four periods, not two: the observable silence of a silent-
+#: but-alive rank is its *window* plus up to one period of phase on
+#: each side (the last heartbeat before the window and the first
+#: scheduled one after it), so the grace must absorb ~2 periods of
+#: phase beyond the calibrated window or a healthy rank's clearing
+#: beat can lose the race to the confirm poll.
+CONFIRM_GRACE_TICKS = 4 * HEARTBEAT_INTERVAL
+
+#: Sliding window of inter-arrival samples per rank.
+WINDOW = 32
+
+#: Variance floor (ticks). A perfectly regular simulated heartbeat has
+#: zero sample variance; the floor keeps phi finite and calibrated:
+#: with mean ~10 and sigma 1, phi crosses the suspect threshold about
+#: 4 ticks after a heartbeat was due.
+MIN_STD = 1.0
+
+
+class StaleEpochError(RuntimeError):
+    """Traffic tagged with a mismatched membership epoch.
+
+    Raised loudly at the first validation point — never silently
+    dropped, never folded into the current epoch's state. Carries the
+    sending ``rank``, the ``stale`` epoch it claimed, and the
+    ``current`` epoch of the validating view. The wording names the
+    party at fault: an OLDER tag means the sender is a superseded
+    incarnation (re-join via regrow); a NEWER tag means the
+    *validator* missed a membership change (split view) — sending the
+    operator to regrow the healthy side would be exactly backwards.
+    """
+
+    def __init__(self, rank: int, stale: int, current: int,
+                 what: str = "message"):
+        if stale > current:
+            msg = (
+                f"future-epoch {what} from rank {rank}: tagged epoch "
+                f"{stale} but this view is at epoch {current} — split "
+                f"view: the RECEIVER missed a membership change and "
+                f"must resynchronize before trusting its own epoch"
+            )
+        else:
+            msg = (
+                f"stale-epoch {what} from rank {rank}: tagged epoch "
+                f"{stale} but membership is at epoch {current} — the "
+                f"sender is a superseded incarnation and must re-join "
+                f"via regrow()"
+            )
+        super().__init__(msg)
+        self.rank = rank
+        self.stale = stale
+        self.current = current
+
+
+@dataclasses.dataclass(frozen=True)
+class SuspectRank:
+    """phi crossed :data:`SUSPECT_PHI`: stop routing new work to the
+    rank, keep it in the ring — it may just be slow or silent."""
+
+    rank: int
+    phi: float
+    step: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SuspicionCleared:
+    """A suspected rank heartbeated again: it was alive-but-silent."""
+
+    rank: int
+    step: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfirmedDead:
+    """phi crossed :data:`DEAD_PHI`: treat as crash-stopped — feed the
+    FailureSet, shrink, restore. A later heartbeat from this
+    incarnation is stale-epoch traffic, not a resurrection."""
+
+    rank: int
+    phi: float
+    step: int
+
+
+class StepClock:
+    """Deterministic integer clock — the credits simulator's event
+    count, never wall time. Everything downstream of it (phi, the
+    elastic soak, the campaign reports) replays bit-identically."""
+
+    def __init__(self, start: int = 0):
+        self._now = int(start)
+
+    def now(self) -> int:
+        return self._now
+
+    def advance(self, ticks: int = 1) -> int:
+        if ticks < 0:
+            raise ValueError(f"clock cannot run backwards ({ticks})")
+        self._now += int(ticks)
+        return self._now
+
+
+def _phi_from(elapsed: float, mean: float, std: float) -> float:
+    """phi = -log10(P(interval > elapsed)) under Normal(mean, std)."""
+    std = max(std, MIN_STD)
+    p_later = 0.5 * math.erfc((elapsed - mean) / (std * math.sqrt(2.0)))
+    if p_later <= 0.0:
+        return float("inf")
+    return -math.log10(p_later)
+
+
+class PhiAccrualDetector:
+    """The phi-accrual failure detector over a :class:`StepClock`.
+
+    Call :meth:`heartbeat` as arrivals land and :meth:`poll` once per
+    scheduling decision; ``poll`` returns the *transitions* since the
+    last call (:class:`SuspectRank` / :class:`SuspicionCleared` /
+    :class:`ConfirmedDead`), each at most once per episode. Ranks with
+    fewer than two arrivals are in bootstrap and never suspected —
+    there is no interval distribution to accrue against yet.
+    """
+
+    def __init__(self, clock: StepClock, ranks: Sequence[int],
+                 suspect_phi: float = SUSPECT_PHI,
+                 dead_phi: float = DEAD_PHI,
+                 window: int = WINDOW,
+                 confirm_grace: int = CONFIRM_GRACE_TICKS):
+        if dead_phi <= suspect_phi:
+            raise ValueError(
+                f"dead_phi {dead_phi} must exceed suspect_phi "
+                f"{suspect_phi}: suspicion is the milder verdict"
+            )
+        self.clock = clock
+        self.suspect_phi = suspect_phi
+        self.dead_phi = dead_phi
+        self.window = window
+        self.confirm_grace = confirm_grace
+        self._last: Dict[int, int] = {}
+        self._intervals: Dict[int, List[int]] = {r: [] for r in ranks}
+        self.suspected: Set[int] = set()
+        self._suspected_at: Dict[int, int] = {}
+        self.dead: Set[int] = set()
+
+    def heartbeat(self, rank: int) -> None:
+        if rank not in self._intervals:
+            raise ValueError(f"unknown rank {rank}")
+        if rank in self.dead:
+            # the detector's verdict is monotone; resurrection is the
+            # membership layer's regrow decision, not a heartbeat's
+            return
+        now = self.clock.now()
+        prev = self._last.get(rank)
+        if prev is not None:
+            samples = self._intervals[rank]
+            samples.append(now - prev)
+            if len(samples) > self.window:
+                del samples[: len(samples) - self.window]
+        self._last[rank] = now
+
+    def phi(self, rank: int) -> float:
+        samples = self._intervals.get(rank)
+        if not samples or rank not in self._last:
+            return 0.0  # bootstrap: no distribution to accrue against
+        elapsed = self.clock.now() - self._last[rank]
+        mean = sum(samples) / len(samples)
+        var = sum((s - mean) ** 2 for s in samples) / len(samples)
+        return _phi_from(elapsed, mean, math.sqrt(var))
+
+    def poll(self) -> List:
+        """Transitions since the last poll, in rank order.
+
+        Death is two-phase: a rank must first cross the suspect
+        threshold, then stay suspected for :attr:`confirm_grace` ticks
+        with phi at or above the dead threshold — so a brief silence
+        is suspected and cleared, never killed, and no rank can jump
+        from healthy to dead in one poll.
+        """
+        out: List = []
+        now = self.clock.now()
+        for rank in sorted(self._intervals):
+            if rank in self.dead:
+                continue
+            phi = self.phi(rank)
+            if rank in self.suspected:
+                if phi < self.suspect_phi:
+                    self.suspected.discard(rank)
+                    self._suspected_at.pop(rank, None)
+                    out.append(SuspicionCleared(rank, now))
+                elif (phi >= self.dead_phi
+                      and now - self._suspected_at[rank]
+                      >= self.confirm_grace):
+                    self.suspected.discard(rank)
+                    self._suspected_at.pop(rank, None)
+                    self.dead.add(rank)
+                    out.append(ConfirmedDead(rank, phi, now))
+            elif phi >= self.suspect_phi:
+                self.suspected.add(rank)
+                self._suspected_at[rank] = now
+                out.append(SuspectRank(rank, phi, now))
+        return out
+
+    def forget(self, rank: int) -> None:
+        """Drop a rank's history — called on regrow so the re-admitted
+        incarnation bootstraps fresh instead of inheriting the dead
+        incarnation's silence."""
+        self.dead.discard(rank)
+        self.suspected.discard(rank)
+        self._suspected_at.pop(rank, None)
+        self._last.pop(rank, None)
+        self._intervals[rank] = []
+
+
+@dataclasses.dataclass
+class MembershipView:
+    """Epoch-numbered view of who is in the job.
+
+    Every change of composition — a confirmed death, a regrow — bumps
+    ``epoch``; traffic carries the epoch it was sent under and
+    :meth:`validate` rejects anything stale with
+    :class:`StaleEpochError`. ``incarnation[r]`` counts how many times
+    rank ``r`` has been admitted, so a regrown rank is distinguishable
+    from its dead predecessor even within one process.
+    """
+
+    n: int
+    epoch: int = 0
+    members: Set[int] = dataclasses.field(default_factory=set)
+    incarnation: Dict[int, int] = dataclasses.field(default_factory=dict)
+    #: (epoch, kind, rank) history — the campaign report's audit trail.
+    transitions: List[Tuple[int, str, int]] = dataclasses.field(
+        default_factory=list
+    )
+
+    def __post_init__(self):
+        if not self.members:
+            self.members = set(range(self.n))
+        if not self.incarnation:
+            self.incarnation = {r: 0 for r in range(self.n)}
+
+    @property
+    def dead(self) -> Set[int]:
+        return set(range(self.n)) - self.members
+
+    def confirm_dead(self, rank: int) -> int:
+        """Remove a rank under a new epoch; returns the new epoch."""
+        if rank not in self.members:
+            raise ValueError(f"rank {rank} is not a member")
+        if len(self.members) == 1:
+            raise ValueError(
+                f"cannot remove rank {rank}: it is the last member"
+            )
+        self.members.discard(rank)
+        self.epoch += 1
+        self.transitions.append((self.epoch, "dead", rank))
+        return self.epoch
+
+    def regrow(self, rank: int) -> int:
+        """Re-admit a recovered rank under a new epoch + incarnation.
+
+        The inverse of shrink. The caller is responsible for restoring
+        the rank's application state (checkpoint manifest) and
+        re-planning the ring (:func:`plan_regrow_ring`) before routing
+        traffic to it. Returns the new epoch.
+        """
+        if rank in self.members:
+            raise ValueError(f"rank {rank} is already a member")
+        if not 0 <= rank < self.n:
+            raise ValueError(f"rank {rank} out of range for n={self.n}")
+        self.members.add(rank)
+        self.incarnation[rank] += 1
+        self.epoch += 1
+        self.transitions.append((self.epoch, "regrow", rank))
+        return self.epoch
+
+    def validate(self, rank: int, epoch: int, what: str = "message") -> None:
+        """Reject traffic from a mismatched epoch or a non-member (the
+        error's wording distinguishes stale sender from split view)."""
+        if epoch != self.epoch:
+            raise StaleEpochError(rank, epoch, self.epoch, what=what)
+        if rank not in self.members:
+            raise StaleEpochError(rank, epoch, self.epoch,
+                                  what=f"{what} from a non-member")
+
+    def failure_set(self, topology=None):
+        """The routing :class:`~smi_tpu.parallel.routing.FailureSet`
+        for the current dead set — dead ranks' devices go down but
+        keep their rank slots, exactly the shape degraded routing
+        expects. ``topology`` defaults to the 1-D ring of ``n``."""
+        from smi_tpu.parallel.routing import FailureSet, grid_topology
+
+        topo = topology if topology is not None else grid_topology(1, self.n)
+        return FailureSet(
+            devices=frozenset(topo.devices[r] for r in sorted(self.dead))
+        )
+
+
+def plan_regrow_ring(view: MembershipView,
+                     down_pairs: Sequence[Tuple[int, int]] = ()
+                     ) -> List[int]:
+    """The ring order after a membership change, re-derived through the
+    existing machinery: :func:`~smi_tpu.parallel.recovery.plan_ring`
+    orders the members around any down wires, and the 1-D
+    :func:`~smi_tpu.parallel.routing.grid_topology` with the dead
+    ranks' devices excluded must still route every member pair (a
+    regrow that would strand a member raises
+    :class:`~smi_tpu.parallel.routing.RouteCutError` naming the cut).
+    """
+    from smi_tpu.parallel.recovery import plan_ring
+    from smi_tpu.parallel.routing import (
+        build_routing_context,
+        check_all_pairs_routable,
+        grid_topology,
+    )
+
+    members = sorted(view.members)
+    order, extra = plan_ring(members, down_pairs, view.n)
+    if extra:
+        raise ValueError(
+            f"regrow ring cannot separate down pairs {sorted(down_pairs)} "
+            f"without shrinking {sorted(extra)}; shrink first"
+        )
+    cut = view.failure_set()
+    topo = grid_topology(1, view.n)
+    ctx = build_routing_context(topo, excluded=cut)
+    check_all_pairs_routable(ctx, [topo.devices[r] for r in order])
+    return order
+
+
+# ---------------------------------------------------------------------------
+# The elastic soak: kill -> detect -> shrink -> restore -> regrow
+# ---------------------------------------------------------------------------
+
+#: Watchdog budget in clock ticks the detector must beat: the PR-1
+#: deadline layer would declare the job hung only after this long with
+#: no progress. phi-accrual confirms within ~one heartbeat period.
+WATCHDOG_TICKS = 12 * HEARTBEAT_INTERVAL
+
+
+@dataclasses.dataclass
+class _EpochMessage:
+    """A halo slab on the elastic job's wire, epoch-tagged."""
+
+    src: int
+    epoch: int
+    payload: object
+
+
+def _jacobi_sweep(blocks: Dict[int, "object"], owners: Dict[int, int],
+                  view: MembershipView, n: int):
+    """One global Jacobi sweep over per-rank row blocks.
+
+    Every block's top/bottom halo rows travel as epoch-tagged messages
+    validated by the membership view — the soak's data plane. Math is
+    the models' reference update (``models.stencil.reference_stencil``)
+    split by row block: Dirichlet boundary rows held, interior cells
+    averaging their four neighbours. Owners compute dead ranks' blocks
+    (heir inheritance), so the global grid is identical to the
+    fault-free run's no matter the membership.
+    """
+    import numpy as np
+
+    def rows_of(r):
+        return blocks[r]
+
+    new: Dict[int, object] = {}
+    for r in range(n):
+        owner = owners[r]
+        if owner is None:
+            raise RuntimeError(f"block {r} has no live owner")
+        block = rows_of(r)
+        up = None if r == 0 else _EpochMessage(
+            owners[r - 1], view.epoch, rows_of(r - 1)[-1]
+        )
+        down = None if r == n - 1 else _EpochMessage(
+            owners[r + 1], view.epoch, rows_of(r + 1)[0]
+        )
+        for msg in (up, down):
+            if msg is not None:
+                view.validate(msg.src, msg.epoch, what="halo slab")
+        h, w = block.shape
+        padded = np.zeros((h + 2, w), dtype=block.dtype)
+        padded[1:-1] = block
+        padded[0] = up.payload if up is not None else block[0]
+        padded[-1] = down.payload if down is not None else block[-1]
+        avg = 0.25 * (
+            padded[:-2, 1:-1] + padded[2:, 1:-1]
+            + padded[1:-1, :-2] + padded[1:-1, 2:]
+        )
+        out = block.copy()
+        interior_top = 1 if r == 0 else 0
+        interior_bot = h - 1 if r == n - 1 else h
+        out[interior_top:interior_bot, 1:-1] = (
+            avg[interior_top:interior_bot]
+        )
+        new[r] = out
+    return new
+
+
+def _initial_grid(x: int, y: int):
+    """Hot-top-edge Jacobi start (``models.stencil.initial_grid`` in
+    float64, inlined so the soak never imports the JAX model stack)."""
+    import numpy as np
+
+    g = np.zeros((x, y), dtype=np.float64)
+    g[0, :] = 1.0
+    return g
+
+
+def _fault_free_grid(grid0, iterations: int):
+    """Serial Jacobi yardstick — the exact update of
+    ``models.stencil.reference_stencil``, term order included, so the
+    healed run's bit-identity claim is against the models' math."""
+    g = grid0.copy()
+    for _ in range(iterations):
+        avg = 0.25 * (
+            g[:-2, 1:-1] + g[2:, 1:-1] + g[1:-1, :-2] + g[1:-1, 2:]
+        )
+        g[1:-1, 1:-1] = avg
+    return g
+
+
+def run_elastic_cell(
+    n: int,
+    plan: F.FaultPlan,
+    seed: int,
+    iterations: int = 24,
+    cadence: int = 4,
+    rows_per_rank: int = 3,
+    width: int = 8,
+    checkpoint_dir: Optional[str] = None,
+) -> Dict:
+    """One elastic soak cell: a sharded Jacobi job under an elastic
+    fault plan, healed end to end.
+
+    The job runs ``iterations`` sweeps of the reference Jacobi update
+    over ``n`` per-rank row blocks, checkpointing every ``cadence``
+    iterations (sharded CRC-framed shards + atomic manifest,
+    :mod:`smi_tpu.parallel.checkpoint`). Heartbeats tick on the step
+    clock with seeded jitter; the phi-accrual detector drives:
+
+    - :class:`~faults.FlappingRank` — the rank stops heartbeating and
+      computing at ``dies_at``; the job *stalls* (a real collective
+      would block) while phi accrues; ``ConfirmedDead`` must land
+      before :data:`WATCHDOG_TICKS` of stall, then the survivors
+      shrink (epoch bump), heirs inherit the dead block, ALL state
+      restores from the last complete manifest, and the tail replays.
+      At ``rejoins_at`` the rank's new incarnation first presents its
+      old epoch — rejected loudly (:class:`StaleEpochError`, counted)
+      — then regrows under a fresh epoch (ring re-planned via
+      :func:`plan_regrow_ring`), restores from the manifest the
+      survivors cut at the regrow barrier, and finishes in place.
+    - :class:`~faults.StalledHeartbeat` — the rank computes but its
+      heartbeats go silent for ``silent_for`` ticks: it must be
+      *suspected* and then cleared, never confirmed dead, and the job
+      must neither shrink nor restore.
+
+    Exit gate per cell: the final global grid is bit-identical to the
+    fault-free run's, and every stale-epoch injection was rejected
+    loudly. Deterministic per ``(n, plan, seed)``.
+    """
+    import numpy as np
+
+    from smi_tpu.parallel.checkpoint import CheckpointStore
+
+    if rows_per_rank < 1 or width < 3:
+        raise ValueError("grid too small for a Jacobi block per rank")
+    rng = random.Random(f"elastic:{n}:{seed}")
+    clock = StepClock()
+    detector = PhiAccrualDetector(clock, range(n))
+    view = MembershipView(n)
+    grid0 = _initial_grid(n * rows_per_rank, width)
+    blocks = {
+        r: grid0[r * rows_per_rank:(r + 1) * rows_per_rank].copy()
+        for r in range(n)
+    }
+    store = CheckpointStore(checkpoint_dir) if checkpoint_dir else None
+
+    flaps = {f.rank: f for f in plan.flapping_ranks}
+    silences = {f.rank: f for f in plan.stalled_heartbeats}
+
+    def owners_now() -> Dict[int, Optional[int]]:
+        from smi_tpu.parallel.recovery import heir_of
+
+        members = view.members
+        out: Dict[int, Optional[int]] = {}
+        for r in range(n):
+            if r in members:
+                out[r] = r
+            elif members:
+                out[r] = heir_of(r, members, n)
+            else:
+                out[r] = None
+        return out
+
+    report: Dict = {
+        "n": n, "seed": seed, "plan": plan.describe(),
+        "iterations": iterations, "cadence": cadence,
+        "suspected": [], "confirmed": [], "cleared": [],
+        "shrinks": 0, "regrows": 0, "restores": 0,
+        "stale_epoch_rejections": 0, "stale_epoch_leaks": 0,
+        "checkpoints": 0, "replayed_iterations": 0,
+        "watchdog_fired": False, "detect_ticks": None,
+        "verdict": "ok",
+    }
+
+    it = 0
+    next_beat = clock.now()
+
+    def all_beat() -> None:
+        """Every live, non-silenced member heartbeats on schedule."""
+        nonlocal next_beat
+        if clock.now() < next_beat:
+            return
+        for r in sorted(view.members):
+            flap = flaps.get(r)
+            if flap is not None and flap.dies_at <= it:
+                continue  # dead: no heartbeat, no compute
+            sil = silences.get(r)
+            if sil is not None and (
+                sil.from_tick <= clock.now()
+                < sil.from_tick + sil.silent_for
+            ):
+                continue  # alive but silent: the suspect-only fault
+            detector.heartbeat(r)
+        next_beat = clock.now() + HEARTBEAT_INTERVAL + rng.randrange(-1, 2)
+
+    def tick(ticks: int) -> List:
+        """Advance the clock in 2-tick poll steps (heartbeats land on
+        their own schedule) and collect detector transitions."""
+        out: List = []
+        left = ticks
+        while left > 0:
+            step = min(2, left)
+            clock.advance(step)
+            left -= step
+            all_beat()
+            out.extend(detector.poll())
+        return out
+
+    def checkpoint() -> None:
+        if store is None:
+            return
+        store.save(it, blocks, epoch=view.epoch)
+        report["checkpoints"] += 1
+
+    # bootstrap the inter-arrival window before any fault can land
+    for _ in range(4):
+        for tr in tick(HEARTBEAT_INTERVAL):
+            raise RuntimeError(f"transition during bootstrap: {tr}")
+    checkpoint()
+
+    stall_started: Optional[int] = None
+    pending_dead: Optional[int] = None
+    while it < iterations:
+        # a dead member blocks the sweep: the job stalls while phi
+        # accrues — this is the window the detector must close before
+        # the watchdog would
+        dead_member = next(
+            (r for r in sorted(view.members)
+             if r in flaps and flaps[r].dies_at <= it), None,
+        )
+        if dead_member is not None:
+            if stall_started is None:
+                stall_started = clock.now()
+            for tr in tick(2):
+                if isinstance(tr, SuspectRank):
+                    report["suspected"].append(tr.rank)
+                elif isinstance(tr, ConfirmedDead):
+                    report["confirmed"].append(tr.rank)
+                    pending_dead = tr.rank
+            stalled_for = clock.now() - stall_started
+            if pending_dead is None and stalled_for > WATCHDOG_TICKS:
+                report["watchdog_fired"] = True
+                report["verdict"] = (
+                    f"watchdog beat the detector for rank {dead_member}"
+                )
+                return report
+            if pending_dead is None:
+                continue
+            # detect -> shrink -> restore -> replay the tail
+            report["detect_ticks"] = stalled_for
+            view.confirm_dead(pending_dead)
+            report["shrinks"] += 1
+            plan_regrow_ring(view)  # survivors must still ring up
+            if store is not None:
+                restored = store.restore()
+                if restored is None:
+                    report["verdict"] = "no complete manifest to restore"
+                    return report
+                step, shards, _epoch = restored
+                for r, payload in shards.items():
+                    blocks[r] = payload
+                report["restores"] += 1
+                report["replayed_iterations"] += it - step
+                it = step
+            pending_dead = None
+            stall_started = None
+            continue
+
+        # regrow: a flapped rank whose rejoin time arrived. (A rank
+        # that died but is not yet CONFIRMED is still a member — the
+        # first check skips it and the stall branch above keeps
+        # running until the detector rules.)
+        for r, flap in sorted(flaps.items()):
+            if r in view.members or flap.rejoins_at > it:
+                continue
+            # the old incarnation announces itself under its old epoch
+            try:
+                view.validate(r, 0, what="rejoin request")
+                report["stale_epoch_leaks"] += 1
+            except StaleEpochError:
+                report["stale_epoch_rejections"] += 1
+            # survivors cut a barrier checkpoint so the newcomer
+            # restores the *current* state, then admit it
+            checkpoint()
+            view.regrow(r)
+            # fresh incarnation, fresh bootstrap: no off-schedule beat
+            # here — an immediate beat would seed a tiny first interval
+            # and make the next normal gap look like silence
+            detector.forget(r)
+            report["regrows"] += 1
+            plan_regrow_ring(view)
+            if store is not None:
+                restored = store.restore()
+                step, shards, _epoch = restored
+                blocks[r] = shards[r]
+            del flaps[r]
+            # one straggler packet from the dead incarnation arrives
+            # AFTER the regrow: it must be rejected, never folded in
+            try:
+                view.validate(r, view.epoch - 1, what="straggler halo")
+                report["stale_epoch_leaks"] += 1
+            except StaleEpochError:
+                report["stale_epoch_rejections"] += 1
+
+        owners = owners_now()
+        blocks = _jacobi_sweep(blocks, owners, view, n)
+        it += 1
+        for tr in tick(HEARTBEAT_INTERVAL):
+            if isinstance(tr, SuspectRank):
+                report["suspected"].append(tr.rank)
+            elif isinstance(tr, SuspicionCleared):
+                report["cleared"].append(tr.rank)
+            elif isinstance(tr, ConfirmedDead):
+                report["verdict"] = (
+                    f"rank {tr.rank} confirmed dead while computing"
+                )
+                return report
+        if it % cadence == 0:
+            checkpoint()
+
+    final = np.concatenate([blocks[r] for r in range(n)])
+    want = _fault_free_grid(grid0, iterations)
+    problems = []
+    if not np.array_equal(final, want):
+        problems.append("silent corruption: final grid differs")
+    if report["stale_epoch_leaks"]:
+        problems.append("stale-epoch traffic accepted")
+    if problems:
+        # both gate violations must survive into the verdict — the
+        # campaign counts each by substring, and one masking the other
+        # would understate the headline silent-corruption figure
+        report["verdict"] = "; ".join(problems)
+    report["epoch"] = view.epoch
+    report["members"] = sorted(view.members)
+    return report
+
+
+def random_elastic_plan(n: int, seed: int) -> F.FaultPlan:
+    """A deterministic single-fault elastic plan: one FlappingRank or
+    one StalledHeartbeat, seeded."""
+    rng = random.Random(f"elastic-plan:{n}:{seed}")
+    cls = F.ELASTIC_FAULT_CLASSES[
+        rng.randrange(len(F.ELASTIC_FAULT_CLASSES))
+    ]
+    return F.FaultPlan.random(cls, n, rng.randrange(1 << 30))
+
+
+def elastic_campaign(
+    seed: int,
+    ns: Sequence[int] = (2, 3, 4),
+    trials: int = 2,
+    iterations: int = 18,
+    cadence: int = 3,
+    checkpoint_root: Optional[str] = None,
+) -> Dict:
+    """Seeded elastic soak: kill/detect/shrink/restore/regrow cells
+    over several ring sizes, with the same zero-silent-corruption,
+    zero-stale-epoch exit gate the base chaos campaign enforces.
+
+    Each cell runs :func:`run_elastic_cell` with a seeded
+    :func:`random_elastic_plan`; checkpoints land under
+    ``checkpoint_root`` (a fresh tempdir per cell when None).
+    Deterministic per ``seed`` — the report reproduces from its JSON
+    alone via ``smi-tpu chaos --elastic --seed N``.
+    """
+    import os
+    import tempfile
+
+    outcomes: Dict[str, int] = {}
+    failures: List[Dict] = []
+    cells = 0
+    detect_ticks: List[int] = []
+    stale_rejections = 0
+    for n in ns:
+        for trial in range(trials):
+            cells += 1
+            cell_seed = random.Random(
+                f"elastic:{seed}:{n}:{trial}"
+            ).randrange(1 << 31)
+            plan = random_elastic_plan(n, cell_seed)
+            with tempfile.TemporaryDirectory(
+                dir=checkpoint_root
+            ) as ckpt:
+                report = run_elastic_cell(
+                    n, plan, cell_seed, iterations=iterations,
+                    cadence=cadence,
+                    checkpoint_dir=os.path.join(ckpt, "shards"),
+                )
+            stale_rejections += report["stale_epoch_rejections"]
+            if report["verdict"] != "ok":
+                outcomes["failed"] = outcomes.get("failed", 0) + 1
+                failures.append({
+                    "n": n, "trial": trial, "cell_seed": cell_seed,
+                    "plan": plan.describe(),
+                    "verdict": report["verdict"],
+                })
+                continue
+            if report["detect_ticks"] is not None:
+                detect_ticks.append(report["detect_ticks"])
+            key = ("regrown" if report["regrows"]
+                   else "suspected-cleared" if report["cleared"]
+                   else "healed")
+            outcomes[key] = outcomes.get(key, 0) + 1
+    silent = sum(
+        1 for f in failures if "silent corruption" in f["verdict"]
+    )
+    stale_leaks = sum(
+        1 for f in failures if "stale-epoch" in f["verdict"]
+    )
+    return {
+        "seed": seed,
+        "ns": list(ns),
+        "trials": trials,
+        "cells": cells,
+        "outcomes": outcomes,
+        "failures": failures,
+        "silent_corruptions": silent,
+        "stale_epoch_leaks": stale_leaks,
+        "stale_epoch_rejections": stale_rejections,
+        "max_detect_ticks": max(detect_ticks) if detect_ticks else None,
+        "watchdog_budget_ticks": WATCHDOG_TICKS,
+        "ok": not failures,
+    }

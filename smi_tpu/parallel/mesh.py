@@ -48,6 +48,13 @@ class Communicator:
     topology: Optional[Topology] = dataclasses.field(
         default=None, compare=False
     )
+    #: Membership epoch (elastic runtime): bumped by every composition
+    #: change — :meth:`shrink` and :meth:`regrow` — so traffic tagged
+    #: with a superseded epoch is rejectable (:meth:`validate_epoch`).
+    #: ``compare=False``: two communicators over the same devices are
+    #: interchangeable for dispatch regardless of how many membership
+    #: changes produced them.
+    epoch: int = dataclasses.field(default=0, compare=False)
 
     def __post_init__(self):
         for name in self.axis_names:
@@ -136,9 +143,23 @@ class Communicator:
             )
         if not excluded:
             return self
-        # flatten devices in this communicator's rank order: transpose
-        # the mesh array to (comm axes..., other axes...) and read the
-        # comm-axes block row-major
+        survivors = [
+            d for r, d in enumerate(self._flat_rank_devices("shrink"))
+            if r not in excluded
+        ]
+        mesh = Mesh(
+            np.array(survivors).reshape(len(survivors)), (DEFAULT_AXIS,)
+        )
+        return Communicator(
+            mesh=mesh, axis_names=(DEFAULT_AXIS,), epoch=self.epoch + 1
+        )
+
+    def _flat_rank_devices(self, what: str):
+        """Devices in this communicator's flattened rank order:
+        transpose the mesh array to (comm axes..., other axes...) and
+        read the comm-axes block row-major. Requires the communicator
+        to span all mesh axes — membership surgery on a sub-axis view
+        would silently desynchronize the other axes' rank numbering."""
         mesh_names = list(self.mesh.axis_names)
         order = [mesh_names.index(a) for a in self.axis_names] + [
             i for i, n in enumerate(mesh_names) if n not in self.axis_names
@@ -146,18 +167,102 @@ class Communicator:
         flat = np.transpose(self.mesh.devices, order).reshape(self.size, -1)
         if flat.shape[1] != 1:
             raise ValueError(
-                "shrink() needs a communicator spanning all mesh axes "
+                f"{what}() needs a communicator spanning all mesh axes "
                 f"(mesh axes {tuple(mesh_names)}, comm axes "
-                f"{self.axis_names}); shrink the full communicator and "
+                f"{self.axis_names}); {what} the full communicator and "
                 "rebuild sub-axes from the survivors"
             )
-        survivors = [
-            flat[r, 0] for r in range(size) if r not in excluded
-        ]
+        return [flat[r, 0] for r in range(self.size)]
+
+    def regrow(self, excluded_ranks, readmit_ranks,
+               epoch: Optional[int] = None) -> "Communicator":
+        """The inverse of :meth:`shrink`: re-admit recovered ranks.
+
+        Called on the ORIGINAL (pre-shrink) communicator — the only
+        holder of the full rank order — with the currently-excluded
+        set and the subset of it to re-admit. Returns a fresh 1-D
+        communicator over the surviving + re-admitted devices in
+        original rank order, under a **new epoch**. Pass ``epoch``
+        (``shrunk.epoch + 1`` of the LIVE chain) when more than one
+        shrink produced the excluded set; the default assumes the
+        natural single-shrink cycle and bumps the original's epoch
+        TWICE — once for that shrink, once for this regrow — so the
+        shrunk incarnation's epoch can never collide with the regrown
+        one's (a collision would let exactly the stale pre-regrow
+        traffic the gate exists to reject pass
+        :meth:`validate_epoch`). Pair with
+        :class:`~smi_tpu.parallel.membership.MembershipView` for the
+        full audit trail. When this communicator carries a real
+        ``topology``, the still-dead devices are declared as a
+        :class:`~smi_tpu.parallel.routing.FailureSet` and every member
+        pair must still route around them — a regrow that would strand
+        anyone raises
+        :class:`~smi_tpu.parallel.routing.RouteCutError` naming the
+        cut instead of handing back a broken communicator. Without a
+        topology (the common bare-mesh case) no physical check runs:
+        XLA owns routing over ICI and a plain JAX mesh has no wire
+        list to validate against — mirroring :meth:`shrink`, which has
+        never needed one. (A degraded *ring order* around down wires
+        is :func:`~smi_tpu.parallel.recovery.plan_ring`'s job at
+        resume time; membership here has no down pairs, only dead
+        devices, so original rank order is the plan.) Traffic from the
+        pre-regrow incarnation is rejected by :meth:`validate_epoch`.
+        """
+        excluded = set(excluded_ranks)
+        readmit = set(readmit_ranks)
+        size = self.size
+        stray = sorted(readmit - excluded)
+        if stray:
+            raise ValueError(
+                f"cannot regrow ranks {stray}: they are not in the "
+                f"excluded set {sorted(excluded)}"
+            )
+        if not readmit:
+            raise ValueError("regrow() needs at least one rank to re-admit")
+        bad = sorted(r for r in excluded if not (0 <= r < size))
+        if bad:
+            raise ValueError(
+                f"excluded ranks {bad} out of range for comm size {size}"
+            )
+        still_dead = excluded - readmit
+        alive = [r for r in range(size) if r not in still_dead]
+        if self.topology is not None:
+            from smi_tpu.parallel.routing import (
+                FailureSet,
+                build_routing_context,
+                check_all_pairs_routable,
+            )
+
+            topo_devices = self.topology.devices
+            cut = FailureSet(devices=frozenset(
+                topo_devices[r] for r in sorted(still_dead)
+            ))
+            ctx = build_routing_context(self.topology, excluded=cut)
+            check_all_pairs_routable(
+                ctx, [topo_devices[r] for r in alive]
+            )
+        devices = self._flat_rank_devices("regrow")
+        members = [devices[r] for r in alive]
         mesh = Mesh(
-            np.array(survivors).reshape(len(survivors)), (DEFAULT_AXIS,)
+            np.array(members).reshape(len(members)), (DEFAULT_AXIS,)
         )
-        return Communicator(mesh=mesh, axis_names=(DEFAULT_AXIS,))
+        return Communicator(
+            mesh=mesh, axis_names=(DEFAULT_AXIS,),
+            epoch=self.epoch + 2 if epoch is None else epoch,
+        )
+
+    def validate_epoch(self, rank: int, epoch: int,
+                       what: str = "message") -> None:
+        """Reject traffic tagged with another epoch — the loud
+        stale-epoch gate (:class:`~membership.StaleEpochError`):
+        packets from a shrunk-out incarnation can never be folded into
+        the regrown job silently. A *newer* epoch than ours is the
+        mirror failure — WE missed a membership change (split view) —
+        and is named as such so the operator debugs the right side."""
+        if epoch != self.epoch:
+            from smi_tpu.parallel.membership import StaleEpochError
+
+            raise StaleEpochError(rank, epoch, self.epoch, what=what)
 
     def heirs(self, excluded_ranks) -> dict:
         """excluded rank -> its surviving heir (nearest successor).

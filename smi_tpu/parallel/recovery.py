@@ -97,6 +97,16 @@ class UnrecoverableError(RuntimeError):
 # ---------------------------------------------------------------------------
 
 
+class WalCorruptionError(RuntimeError):
+    """A progress-log file is damaged beyond its final record.
+
+    A torn *tail* (the last record cut mid-write by a crash) is the
+    expected crash shape and is skipped loudly (a ``RuntimeWarning``
+    names the file and the dropped record); damage anywhere *before*
+    the tail — or a corrupt header — is not a crash artifact and must
+    never be silently truncated into a shorter-but-plausible log."""
+
+
 @dataclasses.dataclass
 class ProgressLog:
     """One rank's durable recovery state.
@@ -108,11 +118,22 @@ class ProgressLog:
     (``seq`` numbers them). Records are idempotent: replayed deliveries
     of a known key are dropped, so recovery passes may over-deliver
     without corrupting the log.
+
+    :meth:`save`/:meth:`load` persist the log with the repo's shared
+    durability discipline (temp file + fsync + atomic rename; one
+    CRC-framed record per line), so the WAL survives not just a rank
+    crash but a crash *of the writer mid-save*: a reader either sees
+    the previous complete file or the new one, and a torn final record
+    inside a file (crash between write and rename on filesystems that
+    reorder) is skipped loudly, never parsed as garbage.
     """
 
     rank: int
     contribution: object = None
     entries: Dict = dataclasses.field(default_factory=dict)
+    #: Records dropped by :meth:`load` as a torn tail (0 on a clean
+    #: load) — the loud part of "skipped loudly".
+    torn_records: int = dataclasses.field(default=0, compare=False)
 
     @property
     def seq(self) -> int:
@@ -129,6 +150,111 @@ class ProgressLog:
 
     def missing(self, expected_keys) -> Set:
         return {k for k in expected_keys if k not in self.entries}
+
+    # -- durability -----------------------------------------------------
+
+    @staticmethod
+    def _frame(seq: int, obj) -> str:
+        import base64
+        import pickle
+        import zlib
+
+        blob = base64.b64encode(pickle.dumps(obj)).decode("ascii")
+        crc = zlib.crc32(f"{seq}:{blob}".encode()) & 0xFFFFFFFF
+        return f"{seq} {crc:08x} {blob}"
+
+    @staticmethod
+    def _unframe(line: str):
+        """Decode one framed record; raises ``ValueError`` on any
+        damage (truncation, bit rot, wrong sequence text)."""
+        import base64
+        import pickle
+        import zlib
+
+        seq_s, crc_s, blob = line.split(" ", 2)
+        seq = int(seq_s)
+        want = int(crc_s, 16)
+        got = zlib.crc32(f"{seq}:{blob}".encode()) & 0xFFFFFFFF
+        if want != got:
+            raise ValueError(
+                f"record {seq}: crc {got:#010x} != framed {want:#010x}"
+            )
+        return seq, pickle.loads(base64.b64decode(blob))
+
+    def save(self, path: str) -> str:
+        """Persist the WAL atomically (temp + fsync + rename)."""
+        from smi_tpu.parallel.checkpoint import write_atomic
+
+        lines = [f"smi-tpu-wal v1 rank {self.rank}"]
+        lines.append(self._frame(0, ("contribution", self.contribution)))
+        for i, (key, payload) in enumerate(self.entries.items()):
+            lines.append(self._frame(i + 1, ("entry", key, payload)))
+        write_atomic(path, ("\n".join(lines) + "\n").encode())
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "ProgressLog":
+        """Load a WAL, skipping a torn final record loudly.
+
+        A record that fails its CRC (or will not parse at all) ends the
+        log: if it is the *last* record in the file it is the torn tail
+        of an interrupted append — dropped with a ``RuntimeWarning``
+        naming the file and counted in ``torn_records``; anything
+        damaged before the tail raises :class:`WalCorruptionError`.
+        """
+        import warnings
+
+        with open(path) as f:
+            raw = f.read().split("\n")
+        lines = [l for l in raw if l]
+        if not lines or not lines[0].startswith("smi-tpu-wal v1 rank "):
+            raise WalCorruptionError(
+                f"{path!r} is not a smi-tpu WAL (bad header)"
+            )
+        try:
+            rank = int(lines[0].rsplit(" ", 1)[1])
+        except ValueError as e:
+            raise WalCorruptionError(
+                f"{path!r} header names no rank "
+                f"({lines[0]!r}): damaged header"
+            ) from e
+        records = []
+        torn = 0
+        for i, line in enumerate(lines[1:]):
+            try:
+                seq, obj = cls._unframe(line)
+                if seq != len(records):
+                    raise ValueError(
+                        f"sequence skip: expected {len(records)}, "
+                        f"got {seq}"
+                    )
+            except (ValueError, KeyError, EOFError) as e:
+                if i == len(lines) - 2:
+                    torn = 1
+                    warnings.warn(
+                        f"progress log {path!r}: final record is torn "
+                        f"({e}); dropping it — the WAL prefix of "
+                        f"{len(records)} record(s) is intact",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    break
+                raise WalCorruptionError(
+                    f"{path!r} record {i} is damaged before the tail "
+                    f"({e}); refusing to truncate a WAL mid-file"
+                ) from e
+            records.append(obj)
+        if not records or records[0][0] != "contribution":
+            raise WalCorruptionError(
+                f"{path!r} is missing its contribution record "
+                f"(sequence 0) — the one entry a WAL must never lose"
+            )
+        log = cls(rank, contribution=records[0][1])
+        for obj in records[1:]:
+            _tag, key, payload = obj
+            log.record(key, payload)
+        log.torn_records = torn
+        return log
 
 
 def logged_steps(gen, log: ProgressLog, item_of: Callable):
@@ -409,6 +535,7 @@ def run_with_recovery(
     chunks: int = 5,
     max_attempts: int = 5,
     followup_plans: Sequence[Optional[F.FaultPlan]] = (),
+    membership=None,
 ) -> RecoveryOutcome:
     """Run one ring collective under a fault plan and heal it to
     completion.
@@ -422,6 +549,16 @@ def run_with_recovery(
     torture tests. A resumed run that completes with results different
     from the fault-free run raises :class:`faults.SilentCorruption`;
     exhausting ``max_attempts`` raises :class:`UnrecoverableError`.
+
+    ``membership`` (a
+    :class:`~smi_tpu.parallel.membership.MembershipView`) makes
+    failure knowledge *proactive* instead of purely error-parsed:
+    ranks the phi-accrual detector has already confirmed dead are
+    shrunk out **before the first attempt** — the collective never
+    even tries the ring that would deadlock — and each later attempt
+    re-consults the view, unioning its dead set with whatever the
+    raised error's state dump names. The error-parsing path is
+    unchanged when no view is given.
     """
     inputs = canonical_inputs(protocol, n, chunks)
     expected = expected_results(protocol, n, inputs, chunks)
@@ -432,8 +569,38 @@ def run_with_recovery(
     current_plan: Optional[F.FaultPlan] = plan
     followups = list(followup_plans)
 
+    pre_shrunk = False
     for attempt in range(max_attempts):
         first = attempt == 0
+        if membership is not None:
+            known_dead = {
+                r for r in survivors if r in membership.dead
+            }
+            if known_dead:
+                pre_shrunk = pre_shrunk or first
+                survivors = [
+                    r for r in survivors if r not in known_dead
+                ]
+                if not survivors:
+                    raise UnrecoverableError(
+                        f"{protocol}: membership confirmed every rank "
+                        f"dead", attempts, annihilated=True,
+                    )
+                attempts.append(AttemptRecord(
+                    ring=tuple(survivors),
+                    verdict="membership-shrink",
+                    detail=(
+                        f"detector confirmed {sorted(known_dead)} dead "
+                        f"before any attempt"
+                        if first else
+                        f"detector confirmed {sorted(known_dead)} dead"
+                    ),
+                    failed_ranks=tuple(sorted(known_dead)),
+                ))
+        # a membership pre-shrink makes even attempt 1 a RESUME pass:
+        # the dead ranks' logged contributions must be served by their
+        # heirs, which the fresh-run builder does not do
+        fresh = first and not pre_shrunk
         ring, extra = plan_ring(survivors, down_pairs, n)
         if extra:
             survivors = [r for r in survivors if r not in extra]
@@ -442,7 +609,7 @@ def run_with_recovery(
         done = total - sum(
             len(logs[g].missing(expected[g])) for g in survivors
         )
-        if not first and done == total:
+        if not fresh and done == total:
             # resume after the last chunk: every survivor's log is
             # already complete — nothing to replay, no network pass
             attempts.append(AttemptRecord(
@@ -462,14 +629,14 @@ def run_with_recovery(
             break
         gens, moved = _build_attempt(
             protocol, ring, survivors, logs, inputs, expected,
-            n, chunks, first,
+            n, chunks, fresh,
         )
         entries_before = sum(len(logs[g].entries) for g in survivors)
         # keep known-dead wires enforced in resumed attempts (mapped
         # to the ring's local indices): a buggy re-route then fails
         # loudly as a deadlock instead of silently using a dead link
         effective_plan = current_plan
-        if down_pairs and not first:
+        if down_pairs and not fresh:
             local = frozenset(
                 (ring.index(a), ring.index(b))
                 for a, b in down_pairs if a in ring and b in ring
@@ -487,7 +654,11 @@ def run_with_recovery(
             ).run()
         except F.DETECTED_ERRORS as e:
             failed = failed_ranks_of(e, ring)
-            newly_down = _down_pairs_of(current_plan, ring, first)
+            # `fresh`, not `first`: the simulator applies plan indices
+            # to ring-local slots, and after a membership pre-shrink
+            # attempt 1's ring is already a subset — booking the
+            # local pair as global would blame the wrong wire
+            newly_down = _down_pairs_of(current_plan, ring, fresh)
             # a failed attempt books only what it actually DELIVERED
             # before the fault (the log delta), never its planned
             # replay size — the retry re-moves the rest and would
@@ -499,7 +670,7 @@ def run_with_recovery(
                 ring=tuple(ring), verdict=type(e).__name__,
                 detail=str(e).splitlines()[0],
                 failed_ranks=tuple(sorted(failed)),
-                replayed_chunks=0 if first else delivered,
+                replayed_chunks=0 if fresh else delivered,
             ))
             if failed:
                 survivors = [r for r in survivors if r not in failed]
@@ -518,9 +689,9 @@ def run_with_recovery(
             continue
         attempts.append(AttemptRecord(
             ring=tuple(ring), verdict="completed",
-            detail="" if first else "resume pass",
-            replayed_chunks=0 if first else moved,
-            skipped_chunks=0 if first else done,
+            detail="" if fresh else "resume pass",
+            replayed_chunks=0 if fresh else moved,
+            skipped_chunks=0 if fresh else done,
         ))
         break
     else:

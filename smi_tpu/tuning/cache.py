@@ -148,12 +148,15 @@ class PlanCache:
         return PlanCache(entries=entries)
 
     def save(self, path: str) -> str:
-        d = os.path.dirname(os.path.abspath(path))
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(self.to_json(), f, indent=2, sort_keys=True)
-            f.write("\n")
+        """Write the cache crash-safely: temp file + fsync + atomic
+        rename (the checkpoint layer's shared durability idiom), so a
+        crash mid-save leaves the previous cache intact — a fleet host
+        can never load a half-written entries table as its tuning
+        truth."""
+        from smi_tpu.parallel.checkpoint import write_atomic
+
+        payload = json.dumps(self.to_json(), indent=2, sort_keys=True)
+        write_atomic(path, (payload + "\n").encode())
         return path
 
     @staticmethod

@@ -1,0 +1,415 @@
+"""Sharded checkpoint/restore + the crash-safety satellites.
+
+Covers the at-rest durability discipline end to end: CRC-framed
+shards, atomic write-then-rename manifests as the commit point,
+fallback past an incomplete newest checkpoint, loud integrity errors
+for damage — plus the satellite crash-safety of the durable
+``ProgressLog`` WAL (torn tail skipped loudly) and the tuning plan
+cache (atomic save). The model drivers prove crash-at-iteration-*i*
+restore + tail replay is bit-identical for Jacobi and K-means.
+"""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from smi_tpu.parallel import checkpoint as C
+from smi_tpu.parallel import recovery as R
+
+pytestmark = pytest.mark.elastic
+
+
+# ---------------------------------------------------------------------------
+# Shard framing
+# ---------------------------------------------------------------------------
+
+
+def test_shard_roundtrip_is_type_exact(tmp_path):
+    d = str(tmp_path)
+    arr = np.arange(12, dtype=np.float64).reshape(3, 4) / 7
+    name, crc = C.write_shard(d, 2, 5, arr)
+    rank, step, got, rcrc = C.read_shard(os.path.join(d, name))
+    assert (rank, step) == (2, 5) and rcrc == crc
+    assert got.dtype == arr.dtype and np.array_equal(got, arr)
+    # non-ndarray state must round-trip TYPE-exactly: int dict keys
+    # stay ints, tuples stay tuples — a resumed run whose state
+    # changed container type diverges from the fault-free run
+    state = {0: (1, 2), "k": [1.5]}
+    C.write_shard(d, 0, 1, state)
+    _, _, payload, _ = C.read_shard(os.path.join(d, C.shard_name(0, 1)))
+    assert payload == state
+    assert isinstance(payload[0], tuple) and 0 in payload
+
+
+def test_shard_corruption_is_named_not_parsed(tmp_path):
+    d = str(tmp_path)
+    C.write_shard(d, 1, 3, np.ones(4))
+    path = os.path.join(d, C.shard_name(1, 3))
+    blob = bytearray(open(path, "rb").read())
+    blob[-2] ^= 0xFF  # bit rot in the payload
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(C.CheckpointIntegrityError) as e:
+        C.read_shard(path)
+    assert e.value.rank == 1 and e.value.step == 3
+    assert e.value.expected is not None and e.value.got is not None
+
+
+def test_shard_truncation_is_a_torn_write(tmp_path):
+    d = str(tmp_path)
+    C.write_shard(d, 0, 0, np.arange(8))
+    path = os.path.join(d, C.shard_name(0, 0))
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-5])
+    with pytest.raises(C.CheckpointIntegrityError, match="torn write"):
+        C.read_shard(path)
+
+
+def test_write_atomic_leaves_no_temp_files(tmp_path):
+    path = str(tmp_path / "x" / "file.bin")
+    C.write_atomic(path, b"payload")
+    assert open(path, "rb").read() == b"payload"
+    assert sorted(os.listdir(tmp_path / "x")) == ["file.bin"]
+
+
+# ---------------------------------------------------------------------------
+# Store: manifests, fallback, pruning
+# ---------------------------------------------------------------------------
+
+
+def _shards(step):
+    return {r: np.full(3, step * 10 + r, dtype=np.int64)
+            for r in range(3)}
+
+
+def test_store_restores_latest_complete(tmp_path):
+    store = C.CheckpointStore(str(tmp_path))
+    store.save(0, _shards(0), epoch=0)
+    store.save(4, _shards(4), epoch=1)
+    step, shards, epoch = store.restore()
+    assert (step, epoch) == (4, 1)
+    assert np.array_equal(shards[2], np.full(3, 42))
+
+
+def test_store_falls_back_past_incomplete_newest(tmp_path):
+    store = C.CheckpointStore(str(tmp_path))
+    store.save(2, _shards(2))
+    store.save(6, _shards(6))
+    # a crash shape: the newest manifest survives but a shard is gone
+    os.unlink(str(tmp_path / C.shard_name(1, 6)))
+    step, shards, _ = store.restore()
+    assert step == 2 and np.array_equal(shards[1], np.full(3, 21))
+
+
+def test_store_raises_on_corrupt_existing_shard(tmp_path):
+    """A shard that exists but fails its CRC is bit rot, not a crash
+    artifact: restore must raise, not silently fall back past it."""
+    store = C.CheckpointStore(str(tmp_path))
+    store.save(1, _shards(1))
+    path = str(tmp_path / C.shard_name(0, 1))
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 1
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(C.CheckpointIntegrityError):
+        store.restore()
+
+
+def test_store_falls_back_past_mixed_generation_shards(tmp_path):
+    """An interrupted RE-save of the same step overwrites shards the
+    committed manifest points at: each shard self-verifies, but its
+    framed CRC no longer matches the manifest's record. Restore must
+    treat that manifest as incomplete and fall back — never silently
+    return mixed-generation state."""
+    store = C.CheckpointStore(str(tmp_path))
+    store.save(2, _shards(2))
+    store.save(8, _shards(8))
+    # generation B of step 8 crashed after one shard, pre-manifest
+    C.write_shard(str(tmp_path), 1, 8,
+                  np.full(3, 999, dtype=np.int64))
+    step, shards, _ = store.restore()
+    assert step == 2
+    assert np.array_equal(shards[1], np.full(3, 21))
+
+
+def test_run_iterative_resume_keeps_the_restored_epoch(tmp_path):
+    store = C.CheckpointStore(str(tmp_path))
+    C.run_iterative(np.zeros(2), lambda s: s + 1, 4, store=store,
+                    cadence=2, epoch=3)
+    assert store.restore()[2] == 3
+    # resume without restating the epoch: the audit field must not
+    # regress to 0
+    C.run_iterative(np.zeros(2), lambda s: s + 1, 8, store=store,
+                    cadence=2)
+    step, shards, epoch = store.restore()
+    assert step == 8 and epoch == 3
+    assert np.array_equal(shards[0], np.full(2, 8.0))
+
+
+def test_store_ignores_torn_manifest(tmp_path):
+    store = C.CheckpointStore(str(tmp_path))
+    store.save(3, _shards(3))
+    # a torn manifest write that never renamed in cannot exist by
+    # construction; a truncated one (copied in by hand, bad backup)
+    # must not mask the complete predecessor
+    (tmp_path / "manifest-00000009.json").write_text('{"step": 9')
+    step, _, _ = store.restore()
+    assert step == 3
+
+
+def test_store_prunes_beyond_keep(tmp_path):
+    store = C.CheckpointStore(str(tmp_path), keep=2)
+    for step in (0, 2, 4, 6):
+        store.save(step, _shards(step))
+    assert len(store.manifests()) == 2
+    step, _, _ = store.restore()
+    assert step == 6
+    # pruned shards are gone too
+    assert not os.path.exists(str(tmp_path / C.shard_name(0, 0)))
+
+
+def test_manifest_schema_version_is_loud(tmp_path):
+    store = C.CheckpointStore(str(tmp_path))
+    store.save(1, _shards(1))
+    path = store.manifests()[0]
+    payload = json.load(open(path))
+    payload["schema_version"] = 99
+    open(path, "w").write(json.dumps(payload))
+    with pytest.raises(C.CheckpointError, match="schema_version"):
+        C.Manifest.from_json(payload, path)
+
+
+def test_empty_store_restores_none(tmp_path):
+    assert C.CheckpointStore(str(tmp_path / "nope")).restore() is None
+    with pytest.raises(C.CheckpointError, match="zero shards"):
+        C.CheckpointStore(str(tmp_path)).save(0, {})
+
+
+# ---------------------------------------------------------------------------
+# run_iterative: crash at iteration i -> restore + tail replay
+# ---------------------------------------------------------------------------
+
+
+class _Crash(RuntimeError):
+    pass
+
+
+def _crashing(step_fn, at):
+    calls = {"n": 0}
+
+    def fn(state):
+        if calls["n"] == at:
+            raise _Crash(f"crash at iteration {at}")
+        calls["n"] += 1
+        return step_fn(state)
+
+    return fn
+
+
+def test_run_iterative_restores_and_replays_only_the_tail(tmp_path):
+    step = lambda s: s * 1.0000001 + 1.0  # noqa: E731 - fp-sensitive
+    state0 = np.linspace(0.0, 1.0, 8)
+    want, _ = C.run_iterative(state0.copy(), step, 10, store=None)
+
+    store = C.CheckpointStore(str(tmp_path))
+    with pytest.raises(_Crash):
+        C.run_iterative(state0.copy(), _crashing(step, 7), 10,
+                        store=store, cadence=3)
+    # the crash left manifests at 0, 3, 6; resume replays 6..10 only
+    assert store.latest_step() == 6
+    got, start = C.run_iterative(state0.copy(), step, 10, store=store,
+                                 cadence=3)
+    assert start == 6
+    assert np.array_equal(got, want)  # bit-identical, not just close
+
+
+def test_run_iterative_checkpoint_beyond_request_is_loud(tmp_path):
+    store = C.CheckpointStore(str(tmp_path))
+    C.run_iterative(np.zeros(2), lambda s: s + 1, 6, store=store,
+                    cadence=2)
+    with pytest.raises(C.CheckpointError, match="only asks for"):
+        C.run_iterative(np.zeros(2), lambda s: s + 1, 3, store=store)
+
+
+def test_run_iterative_guards_cadence():
+    with pytest.raises(ValueError, match="cadence"):
+        C.run_iterative(0, lambda s: s, 1, cadence=0)
+
+
+def test_elastic_env_config(monkeypatch):
+    monkeypatch.delenv(C.DIR_ENV, raising=False)
+    assert C.elastic_env_config() is None
+    monkeypatch.setenv(C.DIR_ENV, "/tmp/ckpt")
+    cfg = C.elastic_env_config()
+    assert cfg["dir"] == "/tmp/ckpt"
+    assert cfg["cadence"] == C.DEFAULT_CADENCE
+    assert cfg["detector"]["suspect_phi"] < cfg["detector"]["dead_phi"]
+    monkeypatch.setenv(C.CADENCE_ENV, "12")
+    assert C.elastic_env_config()["cadence"] == 12
+    monkeypatch.setenv(C.CADENCE_ENV, "banana")
+    with pytest.raises(C.CheckpointError, match="not an integer"):
+        C.elastic_env_config()
+    monkeypatch.setenv(C.CADENCE_ENV, "0")
+    with pytest.raises(C.CheckpointError, match=">= 1"):
+        C.elastic_env_config()
+
+
+# ---------------------------------------------------------------------------
+# The model drivers (JAX, CPU emulator tier)
+# ---------------------------------------------------------------------------
+
+
+def test_run_jacobi_crash_restore_bit_identical(tmp_path, comm8):
+    import jax.numpy as jnp
+
+    from smi_tpu.models.stencil import initial_grid, make_stencil_fn
+    from smi_tpu.parallel.mesh import make_communicator
+
+    comm = make_communicator(shape=(2, 4), axis_names=("jx", "jy"),
+                             devices=comm8.mesh.devices.flat[:8])
+    grid = initial_grid(16, 16)
+    want = np.asarray(C.run_jacobi(grid, 7, comm=comm, store=None))
+
+    store = C.CheckpointStore(str(tmp_path))
+    step = make_stencil_fn(comm, iterations=1)
+
+    def band_shards(s):  # run_jacobi's layout: one band per grid row
+        host = np.asarray(s)
+        return {0: host[:8], 1: host[8:]}
+
+    with pytest.raises(_Crash):
+        C.run_iterative(
+            jnp.asarray(grid), _crashing(step, 5), 7, store=store,
+            cadence=2,
+            shard_fn=band_shards,
+            unshard_fn=lambda sh: jnp.asarray(
+                np.concatenate([sh[0], sh[1]])
+            ),
+        )
+    assert store.latest_step() == 4
+    got = np.asarray(C.run_jacobi(grid, 7, comm=comm, store=store,
+                                  cadence=2))
+    assert np.array_equal(got, want)
+
+
+def test_run_jacobi_shards_per_process_row(tmp_path, comm8):
+    from smi_tpu.models.stencil import initial_grid
+    from smi_tpu.parallel.mesh import make_communicator
+
+    comm = make_communicator(shape=(2, 4), axis_names=("jx", "jy"),
+                             devices=comm8.mesh.devices.flat[:8])
+    store = C.CheckpointStore(str(tmp_path))
+    C.run_jacobi(initial_grid(16, 16), 2, comm=comm, store=store,
+                 cadence=2)
+    _, shards, _ = store.restore()
+    assert sorted(shards) == [0, 1]  # one band per process-grid row
+    assert shards[0].shape == (8, 16)
+
+
+def test_run_kmeans_crash_restore_bit_identical(tmp_path, comm8):
+    rng = np.random.RandomState(0)
+    points = rng.randn(64, 4).astype(np.float32)
+    means0 = points[:3].copy()
+    want = np.asarray(C.run_kmeans(points, means0, 6, comm=comm8,
+                                   store=None))
+
+    import jax.numpy as jnp
+
+    from smi_tpu.models.kmeans import make_kmeans_fn
+
+    store = C.CheckpointStore(str(tmp_path))
+    fn = make_kmeans_fn(comm8, 1)
+    pts = jnp.asarray(points)
+    with pytest.raises(_Crash):
+        C.run_iterative(
+            jnp.asarray(means0), _crashing(lambda m: fn(pts, m), 4), 6,
+            store=store, cadence=2,
+            shard_fn=lambda m: {0: np.asarray(m)},
+            unshard_fn=lambda sh: jnp.asarray(sh[0]),
+        )
+    got = np.asarray(C.run_kmeans(points, means0, 6, comm=comm8,
+                                  store=store, cadence=2))
+    assert np.array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the durable ProgressLog WAL + the plan cache
+# ---------------------------------------------------------------------------
+
+
+def _wal(tmp_path):
+    log = R.ProgressLog(2, contribution=frozenset({2}))
+    log.record((0, 0), frozenset({(0, 0)}))
+    log.record((1, 0), ("payload", 1))
+    path = str(tmp_path / "rank2.wal")
+    log.save(path)
+    return log, path
+
+
+def test_progress_log_save_load_roundtrip(tmp_path):
+    log, path = _wal(tmp_path)
+    got = R.ProgressLog.load(path)
+    assert got.rank == log.rank
+    assert got.contribution == log.contribution
+    assert got.entries == log.entries
+    assert list(got.entries) == list(log.entries)  # delivery order too
+    assert got.torn_records == 0
+    assert not [f for f in os.listdir(tmp_path) if f.startswith(".tmp")]
+
+
+def test_progress_log_torn_tail_skipped_loudly(tmp_path):
+    """The satellite's torn-write test: truncate mid-final-record and
+    prove the partial tail is skipped with a warning — the intact WAL
+    prefix survives, garbage is never parsed."""
+    log, path = _wal(tmp_path)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-9])  # cut into the last record
+    with pytest.warns(RuntimeWarning, match="torn"):
+        got = R.ProgressLog.load(path)
+    assert got.torn_records == 1
+    assert got.contribution == log.contribution
+    assert list(got.entries) == [(0, 0)]  # the prefix, nothing else
+
+
+def test_progress_log_mid_file_damage_refuses(tmp_path):
+    _log, path = _wal(tmp_path)
+    lines = open(path).read().split("\n")
+    lines[1] = lines[1][:-4] + "beef"  # damage BEFORE the tail
+    open(path, "w").write("\n".join(lines))
+    with pytest.raises(R.WalCorruptionError, match="before the tail"):
+        R.ProgressLog.load(path)
+
+
+def test_progress_log_rejects_foreign_files(tmp_path):
+    path = str(tmp_path / "junk")
+    open(path, "w").write("not a wal\n")
+    with pytest.raises(R.WalCorruptionError, match="bad header"):
+        R.ProgressLog.load(path)
+
+
+def test_progress_log_damaged_header_rank_is_classified(tmp_path):
+    """A header whose rank field is bit-rotted must raise the
+    documented WalCorruptionError, not a bare ValueError."""
+    _log, path = _wal(tmp_path)
+    lines = open(path).read().split("\n")
+    lines[0] = lines[0] + "\xe9"
+    open(path, "w").write("\n".join(lines))
+    with pytest.raises(R.WalCorruptionError, match="damaged header"):
+        R.ProgressLog.load(path)
+
+
+def test_plan_cache_save_is_atomic(tmp_path):
+    from smi_tpu.tuning.cache import CacheEntry, PlanCache
+    from smi_tpu.tuning.plan import PlanKey
+
+    cache = PlanCache()
+    key = PlanKey(op="all_reduce", detail="test", dtype="float32",
+                  device_kind="cpu", topology="1d:8")
+    cache.put(key, CacheEntry(knobs={"chunks": 4}, cost_us=1.0))
+    path = str(tmp_path / "sub" / "plans.json")
+    cache.save(path)
+    got = PlanCache.load(path)
+    assert got.entries[key.signature()].knobs == {"chunks": 4}
+    assert not [f for f in os.listdir(tmp_path / "sub")
+                if f.startswith(".tmp")]

@@ -1,0 +1,345 @@
+"""Elastic runtime integration: CLI, bench provenance, runtime bridge.
+
+The seams between the new membership/checkpoint layers and everything
+that already existed: ``run_with_recovery`` consulting the membership
+view, ``Communicator.regrow`` as the inverse of ``shrink``, the
+``chaos --elastic`` and ``route --check`` heir surfaces, the elastic
+fault classes' registration (and their deliberate absence from the
+seed-pinned base campaign), and the bench line's additive ``elastic``
+field under the unchanged legacy schema.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from smi_tpu.parallel import faults as F
+from smi_tpu.parallel import membership as M
+from smi_tpu.parallel import recovery as R
+
+pytestmark = pytest.mark.elastic
+
+
+# ---------------------------------------------------------------------------
+# Fault-class registration
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_classes_not_in_base_fault_classes():
+    """The seed-pinned base chaos campaign draws from FAULT_CLASSES;
+    the elastic classes must live in their own tuple or every pinned
+    cell silently re-rolls."""
+    assert set(F.ELASTIC_FAULT_CLASSES) == {
+        "flapping_rank", "stalled_heartbeat"
+    }
+    assert not set(F.ELASTIC_FAULT_CLASSES) & set(F.FAULT_CLASSES)
+
+
+def test_elastic_faults_register_with_fault_plan():
+    flap = F.FlappingRank(1, dies_at=2, rejoins_at=6)
+    sil = F.StalledHeartbeat(0, from_tick=50, silent_for=20)
+    plan = F.FaultPlan.of([flap, sil])
+    assert plan.faults() == (flap, sil)
+    assert not plan.empty
+    assert F.FaultPlan.single(flap).flapping_ranks == (flap,)
+    described = plan.describe()
+    assert any("FlappingRank" in s for s in described)
+    assert any("StalledHeartbeat" in s for s in described)
+    # job-level faults have no simulator-hook effect
+    assert plan.stall_after(1) is None
+    assert plan.grant_multiplier(0, 0) == 1
+
+
+def test_elastic_random_plans_seeded():
+    for cls in F.ELASTIC_FAULT_CLASSES:
+        a = F.FaultPlan.random(cls, 4, 17)
+        assert a == F.FaultPlan.random(cls, 4, 17)
+        assert len(a.faults()) == 1
+
+
+def test_flapping_rank_must_die_before_rejoining():
+    with pytest.raises(ValueError, match="die before it rejoins"):
+        F.FlappingRank(0, dies_at=5, rejoins_at=5)
+
+
+def test_base_campaign_seed_pinned_cells_unchanged():
+    """Adding the elastic fields must not perturb a single base-chaos
+    draw: the pinned plan for a known cell seed is byte-stable."""
+    plan = R.random_chaos_plan(4, 12345, max_faults=2)
+    assert plan == R.random_chaos_plan(4, 12345, max_faults=2)
+    assert not plan.flapping_ranks and not plan.stalled_heartbeats
+
+
+# ---------------------------------------------------------------------------
+# run_with_recovery consults membership
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("protocol", F.PROTOCOLS)
+def test_recovery_membership_preshrink_skips_doomed_ring(protocol):
+    """A rank the detector already confirmed dead is shrunk out BEFORE
+    attempt 1 — no deadlock is ever provoked, and the heirs serve the
+    dead rank's logged contribution so results still match the
+    fault-free run exactly."""
+    view = M.MembershipView(4)
+    view.confirm_dead(2)
+    out = R.run_with_recovery(protocol, 4, None, membership=view)
+    assert out.ok
+    assert out.survivors == (0, 1, 3)
+    assert out.attempts[0].verdict == "membership-shrink"
+    assert out.attempts[0].failed_ranks == (2,)
+    # no attempt ever deadlocked: the detector beat the error path
+    assert not any("Deadlock" in a.verdict for a in out.attempts)
+
+
+def test_recovery_membership_composes_with_error_parsing():
+    """Detector knowledge and error-dump knowledge union: one rank
+    pre-confirmed dead, another crashes mid-run."""
+    view = M.MembershipView(5)
+    view.confirm_dead(4)
+    plan = F.FaultPlan.single(F.StalledRank(1, after=3))
+    out = R.run_with_recovery("all_gather", 5, plan, membership=view)
+    assert out.ok
+    assert 4 not in out.survivors and 1 not in out.survivors
+    assert out.fault_trail[0] == "membership-shrink"
+
+
+def test_recovery_membership_annihilation_is_named():
+    view = M.MembershipView(2)
+    view.confirm_dead(0)
+    # the view's own guard forbids removing the last member; a view
+    # that nonetheless reports everyone dead (operator override, a
+    # merged remote view) must surface as named annihilation
+    view.members = set()
+    with pytest.raises(R.UnrecoverableError) as e:
+        R.run_with_recovery("all_reduce", 2, None, membership=view)
+    assert e.value.annihilated
+
+
+def test_recovery_without_membership_is_unchanged():
+    plan = F.FaultPlan.single(F.StalledRank(2, after=5))
+    a = R.run_with_recovery("all_reduce", 4, plan, strategy_seed=3)
+    b = R.run_with_recovery("all_reduce", 4, plan, strategy_seed=3,
+                            membership=None)
+    assert a.ok and b.ok and a.fault_trail == b.fault_trail
+
+
+# ---------------------------------------------------------------------------
+# Communicator.regrow (runtime bridge; CPU fake mesh)
+# ---------------------------------------------------------------------------
+
+
+def test_regrow_is_the_inverse_of_shrink(comm8):
+    small = comm8.shrink({3, 5})
+    assert small.size == 6 and small.epoch == comm8.epoch + 1
+    back = comm8.regrow({3, 5}, {3}, epoch=small.epoch + 1)
+    assert back.size == 7 and back.epoch == 2
+    orig = list(comm8.mesh.devices.flat)
+    assert list(back.mesh.devices.flat) == [
+        d for i, d in enumerate(orig) if i != 5
+    ]
+    full = comm8.regrow({3, 5}, {3, 5})
+    assert full.size == 8
+    assert list(full.mesh.devices.flat) == orig
+
+
+def test_regrow_bare_mesh_skips_physical_check(comm8):
+    """A plain JAX mesh has no wire list: two non-adjacent still-dead
+    ranks must NOT spuriously strand a readmitted survivor (shrink to
+    the identical membership has never required a topology either)."""
+    back = comm8.regrow({1, 2, 3}, {2})
+    assert back.size == 6
+    orig = list(comm8.mesh.devices.flat)
+    assert list(back.mesh.devices.flat) == [
+        d for i, d in enumerate(orig) if i not in (1, 3)
+    ]
+
+
+def test_regrow_with_topology_validates_the_real_wires(eight_devices):
+    """With a real topology the still-dead devices become a
+    FailureSet: a regrow that strands a member on the actual wire
+    graph raises RouteCutError naming the cut; one the graph can route
+    around succeeds."""
+    from smi_tpu.parallel.mesh import mesh_from_topology
+    from smi_tpu.parallel.routing import RouteCutError, grid_topology
+
+    ring = mesh_from_topology(grid_topology(1, 8),
+                              devices=eight_devices)
+    # on the 8-ring, dead {1, 3} isolate rank 2 from the others
+    with pytest.raises(RouteCutError):
+        ring.regrow({1, 2, 3}, {2})
+    # dead {1} alone routes around via the wrap wire
+    assert ring.regrow({1, 2}, {2}).size == 7
+    # the 2x4 torus routes around the same dead pair fine
+    torus = mesh_from_topology(grid_topology(2, 4),
+                               devices=eight_devices)
+    assert torus.regrow({1, 2, 3}, {2}).size == 6
+
+
+def test_regrow_validates_its_arguments(comm8):
+    with pytest.raises(ValueError, match="not in the excluded set"):
+        comm8.regrow({3}, {4})
+    with pytest.raises(ValueError, match="at least one rank"):
+        comm8.regrow({3}, set())
+    with pytest.raises(ValueError, match="out of range"):
+        comm8.regrow({99}, {99})
+
+
+def test_validate_epoch_rejects_stale_traffic(comm8):
+    regrown = comm8.regrow({2}, {2})
+    regrown.validate_epoch(2, regrown.epoch)  # current: fine
+    with pytest.raises(M.StaleEpochError) as e:
+        regrown.validate_epoch(2, 0, what="halo slab")
+    assert e.value.rank == 2 and e.value.current == regrown.epoch
+    assert "halo slab" in str(e.value)
+
+
+def test_regrow_default_epoch_outranks_the_shrunk_incarnation(comm8):
+    """The natural shrink -> regrow cycle with NO explicit epoch: the
+    regrown epoch must supersede the shrunk communicator's, or a
+    straggler tagged with the shrunk epoch would pass the gate — the
+    exact stale traffic the epoch exists to reject."""
+    shrunk = comm8.shrink({2})
+    regrown = comm8.regrow({2}, {2})
+    assert regrown.epoch > shrunk.epoch
+    with pytest.raises(M.StaleEpochError):
+        regrown.validate_epoch(2, shrunk.epoch, what="straggler")
+
+
+def test_validate_epoch_names_the_split_view_side(comm8):
+    """A NEWER tag than ours means WE are stale: the error must say
+    split view, not tell the healthy sender to regrow."""
+    with pytest.raises(M.StaleEpochError, match="split view") as e:
+        comm8.validate_epoch(3, comm8.epoch + 5)
+    assert "regrow()" not in str(e.value)
+
+
+def test_shrink_bumps_epoch_but_not_equality(comm8):
+    small = comm8.shrink({7})
+    twin = dataclasses.replace(small, epoch=small.epoch + 5)
+    assert twin == small  # epoch is compare=False: dispatch unaffected
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_elastic_cli_gate_and_report(tmp_path, capsys):
+    from smi_tpu.__main__ import main
+
+    out = tmp_path / "elastic.json"
+    rc = main(["chaos", "--elastic", "--seed", "1729",
+               "--ranks", "2", "3", "--trials", "1", "-o", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["ok"]
+    assert report["silent_corruptions"] == 0
+    assert report["stale_epoch_leaks"] == 0
+    assert report["max_detect_ticks"] <= report["watchdog_budget_ticks"]
+    printed = capsys.readouterr().out
+    assert "elastic campaign ok" in printed
+    assert "stale-epoch packets" in printed
+
+
+def test_chaos_elastic_cli_rejects_protocols(capsys):
+    from smi_tpu.__main__ import main
+
+    rc = main(["chaos", "--elastic", "--protocols", "all_gather"])
+    assert rc == 2
+
+
+def test_chaos_elastic_cli_rejects_max_faults(capsys):
+    """Elastic plans draw exactly one job-level fault: a --max-faults
+    that silently did nothing would misrepresent the sweep."""
+    from smi_tpu.__main__ import main
+
+    rc = main(["chaos", "--elastic", "--max-faults", "3"])
+    assert rc == 2
+    assert "--max-faults does not apply" in capsys.readouterr().err
+
+
+def _write_ring_topology(tmp_path, n=4):
+    from smi_tpu.__main__ import main
+
+    topo = tmp_path / "topo.json"
+    assert main(["topology", "-n", str(n), "-p", "app",
+                 "-f", str(topo), "--ring"]) == 0
+    return topo
+
+
+def test_route_check_names_reachable_heirs(tmp_path, capsys):
+    from smi_tpu.__main__ import main
+
+    topo = _write_ring_topology(tmp_path)
+    rc = main(["route", str(topo), "--check", "--down", "device-1:0"])
+    printed = capsys.readouterr().out
+    assert rc == 0
+    assert "heirs: ok (rank 1 -> rank 2" in printed
+
+
+def test_route_check_heirless_rank_is_named(tmp_path, capsys):
+    """All devices down: the all-pairs check passes trivially (no
+    healthy pairs), so the heir check is the one that catches it —
+    naming every stranded rank."""
+    from smi_tpu.__main__ import main
+
+    topo = _write_ring_topology(tmp_path)
+    rc = main(["route", str(topo), "--check"]
+              + [x for i in range(4)
+                 for x in ("--down", f"device-{i}:0")])
+    printed = capsys.readouterr().out
+    assert rc == 1
+    assert "heirs: FAIL — rank 0 (device-0:0) has no surviving heir" \
+        in printed
+
+
+def test_route_check_without_down_devices_prints_no_heirs(tmp_path,
+                                                          capsys):
+    from smi_tpu.__main__ import main
+
+    topo = _write_ring_topology(tmp_path)
+    rc = main(["route", str(topo), "--check"])
+    printed = capsys.readouterr().out
+    assert rc == 0
+    assert "heirs:" not in printed
+
+
+# ---------------------------------------------------------------------------
+# bench.py: the additive elastic field under the legacy schema
+# ---------------------------------------------------------------------------
+
+
+def _legacy_payload():
+    return {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 0.5}
+
+
+def test_bench_elastic_field_is_additive_and_schema_safe(monkeypatch):
+    import bench
+
+    monkeypatch.delenv("SMI_TPU_CHECKPOINT_DIR", raising=False)
+    assert bench.elastic_fields() == {"enabled": False}
+    monkeypatch.setenv("SMI_TPU_CHECKPOINT_DIR", "/tmp/ck")
+    monkeypatch.setenv("SMI_TPU_CHECKPOINT_CADENCE", "16")
+    fields = bench.elastic_fields()
+    assert fields["enabled"] and fields["cadence"] == 16
+    assert fields["detector"]["suspect_phi"] == M.SUSPECT_PHI
+    assert fields["detector"]["dead_phi"] == M.DEAD_PHI
+    # the ONE output line: legacy keys intact with the field attached
+    payload = dict(_legacy_payload(), elastic=fields)
+    line = bench.render_line(payload)
+    parsed = json.loads(line)
+    assert parsed["metric"] == "m" and parsed["vs_baseline"] == 0.5
+    assert parsed["elastic"]["cadence"] == 16
+    assert "\n" not in line
+
+
+def test_bench_render_line_still_rejects_dropped_legacy_keys():
+    import bench
+
+    payload = _legacy_payload()
+    payload.pop("unit")
+    payload["elastic"] = {"enabled": False}
+    with pytest.raises(ValueError, match="legacy key"):
+        bench.render_line(payload)

@@ -1,0 +1,328 @@
+"""Elastic membership: phi-accrual detection, epochs, the soak.
+
+Everything here is pure Python on the deterministic step clock — no
+JAX, no devices, no wall time. The acceptance cell (seeded
+FlappingRank mid-Jacobi) pins the whole elastic story end to end:
+suspected by phi-accrual before any watchdog budget, shrink + restore
+from the last complete manifest, regrow under a new epoch, final grid
+bit-identical to the fault-free run, stale-epoch traffic rejected
+loudly.
+"""
+
+import json
+import os
+
+import pytest
+
+from smi_tpu.parallel import faults as F
+from smi_tpu.parallel import membership as M
+
+pytestmark = pytest.mark.elastic
+
+#: Seed-pinned: the tier-1 elastic campaign must reproduce exactly
+#: with ``python -m smi_tpu chaos --elastic --seed 1729``.
+TIER1_SEED = 1729
+
+
+def _beat_all(det, ranks):
+    for r in ranks:
+        det.heartbeat(r)
+
+
+def _bootstrap(det, clock, ranks, rounds=5, interval=M.HEARTBEAT_INTERVAL):
+    for _ in range(rounds):
+        _beat_all(det, ranks)
+        clock.advance(interval)
+        assert det.poll() == []
+
+
+# ---------------------------------------------------------------------------
+# The detector
+# ---------------------------------------------------------------------------
+
+
+def test_detector_bootstrap_never_suspects():
+    clock = M.StepClock()
+    det = M.PhiAccrualDetector(clock, range(3))
+    # no samples at all: silence is not evidence yet
+    clock.advance(500)
+    assert det.poll() == []
+    assert det.phi(0) == 0.0
+
+
+def test_detector_silence_suspects_then_confirms_with_grace():
+    clock = M.StepClock()
+    det = M.PhiAccrualDetector(clock, range(3))
+    _bootstrap(det, clock, range(3))
+    transitions = []
+    for _ in range(40):
+        _beat_all(det, (0, 1))  # rank 2 goes silent
+        clock.advance(2)
+        transitions.extend(det.poll())
+    kinds = [type(t).__name__ for t in transitions]
+    assert kinds == ["SuspectRank", "ConfirmedDead"]
+    assert all(t.rank == 2 for t in transitions)
+    suspect, dead = transitions
+    # the grace separates the two verdicts: no healthy->dead jump
+    assert dead.step - suspect.step >= M.CONFIRM_GRACE_TICKS
+    assert det.dead == {2} and det.suspected == set()
+    # a very-late heartbeat from the dead incarnation changes nothing
+    det.heartbeat(2)
+    assert det.poll() == [] and det.dead == {2}
+
+
+def test_detector_heartbeat_clears_suspicion():
+    clock = M.StepClock()
+    det = M.PhiAccrualDetector(clock, range(2))
+    _bootstrap(det, clock, range(2))
+    transitions = []
+    # rank 1 silent just long enough to be suspected...
+    while not det.suspected:
+        det.heartbeat(0)
+        clock.advance(2)
+        transitions.extend(det.poll())
+    assert [type(t).__name__ for t in transitions] == ["SuspectRank"]
+    # ...then it beats again: cleared, never dead
+    det.heartbeat(1)
+    cleared = det.poll()
+    assert [type(t).__name__ for t in cleared] == ["SuspicionCleared"]
+    assert cleared[0].rank == 1
+    assert det.dead == set()
+
+
+def test_detector_phi_grows_with_silence():
+    clock = M.StepClock()
+    det = M.PhiAccrualDetector(clock, [0])
+    _bootstrap(det, clock, [0])
+    values = []
+    for _ in range(10):
+        clock.advance(4)
+        values.append(det.phi(0))
+    assert values == sorted(values)
+    assert values[-1] > M.DEAD_PHI
+
+
+def test_detector_forget_resets_history():
+    clock = M.StepClock()
+    det = M.PhiAccrualDetector(clock, range(2))
+    _bootstrap(det, clock, range(2))
+    clock.advance(200)
+    det.poll(), det.poll()
+    while 1 not in det.dead:
+        clock.advance(2)
+        det.poll()
+    det.forget(1)
+    assert 1 not in det.dead
+    assert det.phi(1) == 0.0  # fresh bootstrap, no inherited silence
+
+
+def test_detector_threshold_order_enforced():
+    with pytest.raises(ValueError, match="must exceed"):
+        M.PhiAccrualDetector(M.StepClock(), range(2),
+                             suspect_phi=8.0, dead_phi=4.0)
+
+
+def test_clock_never_runs_backwards():
+    with pytest.raises(ValueError):
+        M.StepClock().advance(-1)
+
+
+# ---------------------------------------------------------------------------
+# Membership view: epochs, incarnations, stale traffic
+# ---------------------------------------------------------------------------
+
+
+def test_view_epoch_bumps_per_composition_change():
+    view = M.MembershipView(4)
+    assert view.epoch == 0 and view.members == {0, 1, 2, 3}
+    assert view.confirm_dead(2) == 1
+    assert view.dead == {2}
+    assert view.regrow(2) == 2
+    assert view.members == {0, 1, 2, 3}
+    assert view.incarnation[2] == 1 and view.incarnation[0] == 0
+    assert view.transitions == [(1, "dead", 2), (2, "regrow", 2)]
+
+
+def test_view_rejects_stale_future_and_nonmember_traffic():
+    view = M.MembershipView(3)
+    view.confirm_dead(1)
+    view.validate(0, 1)  # current epoch from a member: fine
+    with pytest.raises(M.StaleEpochError) as e:
+        view.validate(1, 0)
+    assert e.value.rank == 1 and e.value.stale == 0 and e.value.current == 1
+    with pytest.raises(M.StaleEpochError, match="split view"):
+        view.validate(0, 5)
+    with pytest.raises(M.StaleEpochError, match="non-member"):
+        view.validate(1, 1)
+
+
+def test_view_guards():
+    view = M.MembershipView(2)
+    with pytest.raises(ValueError, match="not a member"):
+        view.confirm_dead(5)
+    view.confirm_dead(1)
+    with pytest.raises(ValueError, match="last member"):
+        view.confirm_dead(0)
+    with pytest.raises(ValueError, match="already a member"):
+        view.regrow(0)
+    with pytest.raises(ValueError, match="out of range"):
+        view.regrow(9)
+
+
+def test_failure_set_names_dead_devices():
+    from smi_tpu.parallel.routing import grid_topology
+
+    view = M.MembershipView(4)
+    view.confirm_dead(1)
+    topo = grid_topology(1, 4)
+    fs = view.failure_set(topo)
+    assert fs.devices == frozenset({topo.devices[1]})
+
+
+def test_plan_regrow_ring_orders_members_and_validates_routing():
+    view = M.MembershipView(5)
+    view.confirm_dead(2)
+    assert M.plan_regrow_ring(view) == [0, 1, 3, 4]
+    view.regrow(2)
+    assert M.plan_regrow_ring(view) == [0, 1, 2, 3, 4]
+    # an unseparable down pair on a tiny ring is the caller's shrink
+    tiny = M.MembershipView(2)
+    with pytest.raises(ValueError, match="shrink first"):
+        M.plan_regrow_ring(tiny, down_pairs=[(0, 1)])
+
+
+# ---------------------------------------------------------------------------
+# The elastic cells (THE acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_flapping_rank_cell_full_story(tmp_path):
+    """Seeded FlappingRank mid-Jacobi: suspected by phi-accrual before
+    any watchdog budget, shrink + restore from the last complete
+    manifest with tail replay, regrow under a new epoch, final grid
+    bit-identical to the fault-free run, and the dead incarnation's
+    traffic rejected loudly — never silently folded in."""
+    # dies_at=4 with cadence=3: the latest manifest is at iteration 3,
+    # so the restore must genuinely replay a tail
+    plan = F.FaultPlan.single(F.FlappingRank(1, dies_at=4, rejoins_at=9))
+    report = M.run_elastic_cell(
+        3, plan, seed=11, iterations=15, cadence=3,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    assert report["verdict"] == "ok"  # bit-identical final grid
+    assert report["suspected"] == [1] and report["confirmed"] == [1]
+    assert report["detect_ticks"] is not None
+    assert report["detect_ticks"] <= M.WATCHDOG_TICKS
+    assert not report["watchdog_fired"]
+    assert report["shrinks"] == 1 and report["restores"] == 1
+    assert report["replayed_iterations"] >= 1  # the tail, not a restart
+    assert report["regrows"] == 1
+    assert report["members"] == [0, 1, 2]  # rejoined
+    assert report["epoch"] == 2  # dead bump + regrow bump
+    assert report["stale_epoch_rejections"] >= 2  # rejoin + straggler
+    assert report["stale_epoch_leaks"] == 0
+
+
+def test_stalled_heartbeat_cell_suspected_never_killed(tmp_path):
+    plan = F.FaultPlan.single(
+        F.StalledHeartbeat(0, from_tick=60, silent_for=20)
+    )
+    report = M.run_elastic_cell(
+        3, plan, seed=5, iterations=15, cadence=3,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    assert report["verdict"] == "ok"
+    assert report["suspected"] == [0] and report["cleared"] == [0]
+    assert report["confirmed"] == []
+    assert report["shrinks"] == 0 and report["restores"] == 0
+    assert report["regrows"] == 0 and report["epoch"] == 0
+
+
+def test_stalled_heartbeat_never_killed_across_phase_space():
+    """Sweep the generator's whole calibration range — every window
+    phase x length it can draw. The observable silence of a silent-
+    but-alive rank is its window plus up to one heartbeat period of
+    schedule phase on EACH side (last beat before the window, first
+    scheduled beat after it), so with too small a confirmation grace
+    the clearing beat loses the race to the confirm poll and a healthy
+    rank dies. Every cell here must end ok with zero confirmations."""
+    for from_tick in range(50, 90, 4):
+        for silent_for in (16, 20, 24):
+            plan = F.FaultPlan.single(F.StalledHeartbeat(
+                1, from_tick=from_tick, silent_for=silent_for,
+            ))
+            report = M.run_elastic_cell(
+                3, plan, seed=from_tick * 31 + silent_for,
+                iterations=15, cadence=3,
+            )
+            assert report["verdict"] == "ok", (
+                from_tick, silent_for, report["verdict"]
+            )
+            assert report["confirmed"] == []
+            assert report["shrinks"] == 0 and report["regrows"] == 0
+
+
+def test_elastic_cell_deterministic(tmp_path):
+    plan = F.FaultPlan.single(F.FlappingRank(0, dies_at=3, rejoins_at=8))
+    a = M.run_elastic_cell(2, plan, seed=3, iterations=12, cadence=3,
+                           checkpoint_dir=str(tmp_path / "a"))
+    b = M.run_elastic_cell(2, plan, seed=3, iterations=12, cadence=3,
+                           checkpoint_dir=str(tmp_path / "b"))
+    assert a == b
+
+
+def test_elastic_cell_without_store_still_bit_identical():
+    """Heir inheritance alone keeps the global grid exact — the store
+    adds durability, not correctness of the surviving math."""
+    plan = F.FaultPlan.single(F.FlappingRank(1, dies_at=3, rejoins_at=7))
+    report = M.run_elastic_cell(3, plan, seed=2, iterations=12, cadence=4)
+    assert report["verdict"] == "ok" and report["restores"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The campaign
+# ---------------------------------------------------------------------------
+
+
+def _assert_clean(report):
+    assert report["ok"], report["failures"]
+    assert report["silent_corruptions"] == 0
+    assert report["stale_epoch_leaks"] == 0
+    assert report["stale_epoch_rejections"] > 0  # regrows were exercised
+    assert report["outcomes"].get("regrown", 0) > 0
+    assert report["max_detect_ticks"] is not None
+    assert report["max_detect_ticks"] <= report["watchdog_budget_ticks"]
+
+
+def test_tier1_seed_pinned_elastic_campaign():
+    report = M.elastic_campaign(seed=TIER1_SEED, ns=(2, 3, 4), trials=2)
+    _assert_clean(report)
+    assert report["cells"] == 6
+
+
+def test_elastic_campaign_deterministic_and_json_roundtrippable():
+    a = M.elastic_campaign(seed=7, ns=(2, 3), trials=1)
+    b = M.elastic_campaign(seed=7, ns=(2, 3), trials=1)
+    assert a == b
+    assert json.loads(json.dumps(a)) == a
+    c = M.elastic_campaign(seed=8, ns=(2, 3), trials=1)
+    assert c != a
+
+
+def test_random_elastic_plan_seeded_and_single_fault():
+    assert M.random_elastic_plan(3, 42) == M.random_elastic_plan(3, 42)
+    seen = set()
+    for seed in range(30):
+        plan = M.random_elastic_plan(4, seed)
+        faults = plan.faults()
+        assert len(faults) == 1
+        seen.add(type(faults[0]).__name__)
+    assert seen == {"FlappingRank", "StalledHeartbeat"}
+
+
+@pytest.mark.slow
+def test_long_elastic_soak():
+    for seed in range(3):
+        report = M.elastic_campaign(seed=seed, ns=(2, 3, 4, 5, 6),
+                                    trials=4, iterations=24, cadence=4)
+        _assert_clean(report)

@@ -125,6 +125,26 @@ def test_seeded_plan_cache_matches_perf_json_measured_best():
     )
 
 
+def test_robustness_doc_quotes_elastic_config():
+    """docs/robustness.md's "Elastic runtime" section must state the
+    detector thresholds, confirmation grace, watchdog budget, and
+    checkpoint cadence the code ships — the same discipline as the
+    tuning decision table: the doc is the human-readable mirror of
+    ``membership.py``/``checkpoint.py`` and must not drift. (Pure
+    Python imports, no devices.)"""
+    from smi_tpu.parallel import checkpoint, membership
+
+    text = _read("docs/robustness.md")
+    assert f"suspect at phi >= {membership.SUSPECT_PHI:g}" in text
+    assert f"confirm dead at phi >= {membership.DEAD_PHI:g}" in text
+    assert (f"{membership.CONFIRM_GRACE_TICKS}-tick confirmation grace"
+            in text)
+    assert f"{membership.WATCHDOG_TICKS}-tick watchdog budget" in text
+    assert f"default cadence {checkpoint.DEFAULT_CADENCE}" in text
+    assert f"${checkpoint.CADENCE_ENV}" in text
+    assert f"${checkpoint.DIR_ENV}" in text
+
+
 def test_tuning_doc_quotes_the_seeded_knobs():
     """docs/tuning.md's decision table must state the seeded values the
     code ships (block tiles, depth, threshold) — the table is the
