@@ -95,6 +95,45 @@ def elastic_fields() -> dict:
     }
 
 
+def hierarchy_fields() -> dict:
+    """Additive two-tier provenance on multichip measurements: how
+    many slices the backend reports, the alpha-beta rates both wire
+    tiers are priced at (env-resolved DCN beta, so a fleet override is
+    recorded next to the number it shaped), and which plan-engine
+    layer would gate the hierarchical allreduce here. Single-slice
+    hosts record ``slices: 1`` with the flat plan — the field states
+    the tier regime either way; the legacy metric/value/unit/
+    vs_baseline contract is untouched (schema-guarded)."""
+    import jax
+
+    from smi_tpu.tuning import cost_model as cm
+
+    devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", None) or 0 for d in devices}
+    slices = max(1, len(slice_ids))
+    fields = {
+        "slices": slices,
+        "tier_betas": {
+            "ici_bytes_per_s": cm.V5E_ICI_BETA_BYTES_PER_S,
+            "dcn_bytes_per_s": cm.dcn_beta_bytes_per_s(),
+        },
+    }
+    if slices > 1 and len(devices) % slices == 0:
+        from smi_tpu.parallel.collectives import _hier_env_min_slices
+        from smi_tpu.tuning.engine import get_engine
+
+        topo = cm.TopologySpec(
+            n=len(devices), inner=len(devices) // slices, outer=slices
+        )
+        engaged, layer = get_engine().use_hierarchical(
+            1 << 20, topo, min_slices=_hier_env_min_slices()
+        )
+        fields["plan"] = {"hierarchical": engaged, "source": layer}
+    else:
+        fields["plan"] = {"hierarchical": False, "source": "heuristic"}
+    return fields
+
+
 def plan_fields(depth) -> dict:
     """Additive plan-provenance evidence: which tuning layer (cache /
     model / heuristic) produced the knobs behind the headline metric
@@ -222,6 +261,11 @@ def main():
             payload["elastic"] = elastic_fields()
         except Exception as e:
             payload["elastic"] = {"error": f"{type(e).__name__}: {e}"}
+        # additive two-tier provenance field (same best-effort contract)
+        try:
+            payload["hierarchy"] = hierarchy_fields()
+        except Exception as e:
+            payload["hierarchy"] = {"error": f"{type(e).__name__}: {e}"}
     # additive plan-provenance field (same best-effort contract)
     try:
         payload["plan"] = plan_fields(depth)

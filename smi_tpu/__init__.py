@@ -92,9 +92,12 @@ from smi_tpu.parallel.membership import (
     ConfirmedDead,
     MembershipView,
     PhiAccrualDetector,
+    PodRingPlan,
     StaleEpochError,
     SuspectRank,
     elastic_campaign,
+    plan_pod_rings,
+    pod_campaign,
 )
 from smi_tpu.parallel.recovery import (
     ProgressLog,
@@ -156,10 +159,13 @@ __all__ = [
     "run_iterative",
     "ConfirmedDead",
     "MembershipView",
+    "PodRingPlan",
     "PhiAccrualDetector",
     "StaleEpochError",
     "SuspectRank",
     "elastic_campaign",
+    "plan_pod_rings",
+    "pod_campaign",
     "Deadline",
     "WatchdogTimeout",
 ]

@@ -172,6 +172,10 @@ def _route_check(args: argparse.Namespace, topology, ctx) -> int:
     if excluded is not None and excluded.devices:
         rc = max(rc, _check_heirs(topology, ctx, excluded, healthy,
                                   routes_ok=rc == 0))
+    if getattr(args, "slices", None) is not None:
+        rc = max(rc, _check_slices(args.slices, topology, ctx,
+                                   excluded, healthy,
+                                   routes_ok=rc == 0))
     if args.hostfile:
         try:
             with open(args.hostfile) as f:
@@ -270,6 +274,113 @@ def _check_heirs(topology, ctx, excluded, healthy,
     return rc
 
 
+def _check_slices(n_slices: int, topology, ctx, excluded, healthy,
+                  routes_ok: bool = True) -> int:
+    """``route --check --slices N``: pod-of-slices launch validation.
+
+    Two pod-specific properties on top of the all-pairs check:
+
+    - **cross-slice leaders reach each other** — the two-tier
+      collectives' phase B runs over slice leaders, so every live
+      leader pair must route (around any ``--down`` failures);
+    - **every slice has a flat-ring fallback** — for EACH slice, the
+      what-if of that whole slice down must leave the remaining
+      healthy devices all-pairs routable (the ``plan_pod_rings``
+      flat-fallback shape), and a slice whose loss would strand the
+      survivors is NAMED before a launcher grabs the pod.
+
+    One line per verdict; returns the exit contribution.
+    """
+    from smi_tpu.parallel.routing import (
+        FailureSet,
+        NoRouteFound,
+        _paths_to_device,
+        build_routing_context,
+        check_all_pairs_routable,
+        pod_slice_partition,
+    )
+
+    try:
+        groups = pod_slice_partition(topology, n_slices)
+    except ValueError as e:
+        print(f"slices: FAIL — {e}")
+        return 1
+    rc = 0
+    healthy_set = set(healthy)
+    leaders = []
+    for group in groups:
+        alive = [d for d in group if d in healthy_set]
+        leaders.append(alive[0] if alive else None)
+    live_leaders = [l for l in leaders if l is not None]
+    leader_fail = False
+    if not routes_ok:
+        # all-pairs among the healthy devices already holds when
+        # routes_ok: every live leader is healthy, so the pair scan is
+        # a proven subset — only re-derive it after a routes failure
+        for a in live_leaders:
+            for b in live_leaders:
+                if a == b:
+                    continue
+                try:
+                    for link in ctx.links(a):
+                        _paths_to_device(ctx, link, b)
+                except NoRouteFound as e:
+                    print(
+                        f"slices: FAIL — leader {a} cannot reach "
+                        f"leader {b}: {e}"
+                    )
+                    rc = 1
+                    leader_fail = True
+                    break
+            if leader_fail:
+                break
+    if not leader_fail:
+        down_slices = sum(1 for l in leaders if l is None)
+        print(
+            f"slices: ok ({len(live_leaders)} slice leaders all-pairs "
+            f"reachable"
+            + (f"; {down_slices} slice(s) fully down" if down_slices
+               else "")
+            + ")"
+        )
+    base_links = excluded.links if excluded is not None else frozenset()
+    base_devices = (excluded.devices if excluded is not None
+                    else frozenset())
+    for s, group in enumerate(groups):
+        group_set = frozenset(group)
+        what_if = FailureSet(
+            links=base_links,
+            devices=base_devices | group_set,
+        )
+        survivors = [d for d in healthy if d not in group_set]
+        if not survivors:
+            # every healthy device lives in this slice: it is the last
+            # live slice, and "fall back without it" is vacuous — the
+            # heirs/all-pairs checks own the everything-down story
+            print(
+                f"slices: slice {s} is the last live slice — no "
+                f"fallback scenario to validate"
+            )
+            continue
+        ctx_s = build_routing_context(
+            topology, ctx.links_per_device, excluded=what_if
+        )
+        try:
+            check_all_pairs_routable(ctx_s, survivors)
+        except NoRouteFound as e:
+            print(
+                f"slices: FAIL — slice {s} has no flat-ring fallback: "
+                f"losing it strands the survivors ({e})"
+            )
+            rc = 1
+    if rc == 0:
+        print(
+            f"slices: every slice down-scenario keeps a flat-ring "
+            f"fallback over the survivors ({n_slices} checked)"
+        )
+    return rc
+
+
 def cmd_route(args: argparse.Namespace) -> int:
     from smi_tpu.parallel.routing import (
         NoRouteFound,
@@ -281,11 +392,12 @@ def cmd_route(args: argparse.Namespace) -> int:
         print("error: dest_dir is required unless --check is given",
               file=sys.stderr)
         return 2
-    if not args.check and (args.down or args.hostfile):
+    if not args.check and (args.down or args.hostfile
+                           or getattr(args, "slices", None) is not None):
         # writing healthy tables while silently ignoring a declared
         # failure set would hand the launcher routes over dead wires
-        print("error: --down/--hostfile only apply with --check",
-              file=sys.stderr)
+        print("error: --down/--hostfile/--slices only apply with "
+              "--check", file=sys.stderr)
         return 2
     if args.check and args.dest_dir is not None:
         # in check mode there is no output directory: the second
@@ -849,14 +961,22 @@ def cmd_tune(args: argparse.Namespace) -> int:
         try:
             print(engine.get_engine().explain_text(
                 args.explain, n=args.ranks, dtype=args.dtype,
+                slices=args.slices,
             ))
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
         return 0
 
-    from smi_tpu.parallel.mesh import make_communicator
-    from smi_tpu.tuning.sweep import sweep_allreduce, sweep_flash
+    from smi_tpu.parallel.mesh import (
+        make_communicator,
+        make_hybrid_communicator,
+    )
+    from smi_tpu.tuning.sweep import (
+        sweep_allreduce,
+        sweep_allreduce_hierarchical,
+        sweep_flash,
+    )
 
     path = args.cache or default_cache_path()
     if not path:
@@ -864,12 +984,36 @@ def cmd_tune(args: argparse.Namespace) -> int:
               "$SMI_TPU_PLAN_CACHE)", file=sys.stderr)
         return 2
     ops = args.ops or ["all_reduce"]
-    unknown = [o for o in ops if o not in ("all_reduce", "flash_fwd")]
+    unknown = [o for o in ops
+               if o not in ("all_reduce", "flash_fwd", "hierarchical")]
     if unknown:
         print(f"error: unknown op(s) {unknown}; sweepable: "
-              f"all_reduce, flash_fwd", file=sys.stderr)
+              f"all_reduce, flash_fwd, hierarchical", file=sys.stderr)
+        return 2
+    if "hierarchical" in ops and not args.slices:
+        print("error: the hierarchical sweep needs --slices N (the "
+              "pod shape to tier over)", file=sys.stderr)
         return 2
     measured = PlanCache()
+    if "hierarchical" in ops:
+        try:
+            hcomm = make_hybrid_communicator(n_slices=args.slices)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(f"sweeping flat-vs-hierarchical allreduce over "
+              f"{args.slices} slices x {hcomm.size // args.slices} "
+              f"ranks "
+              f"({', '.join(f'{kb} KiB' for kb in args.sizes_kb)})")
+        try:
+            measured.merge(sweep_allreduce_hierarchical(
+                hcomm, sizes_kb=args.sizes_kb, runs=args.runs,
+                verbose=True,
+            ))
+        except ValueError as e:
+            # e.g. --slices 1: the comm builds but has no DCN tier
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     if "all_reduce" in ops:
         comm = make_communicator()
         if comm.size < 2:
@@ -1022,6 +1166,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hostfile", default=None,
                    help="with --check: hostfile to validate against the "
                         "topology's rank order")
+    p.add_argument("--slices", type=int, default=None, metavar="N",
+                   help="with --check: validate the topology as an "
+                        "N-slice pod — every cross-slice leader pair "
+                        "must be reachable (around --down failures) "
+                        "and every slice's loss must leave a flat-ring "
+                        "fallback over the survivors, naming the slice "
+                        "that doesn't")
     p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser(
@@ -1155,7 +1306,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "CPU-deterministic, no hardware needed")
     p.add_argument("--ops", nargs="+", default=None, metavar="OP",
                    help="ops to sweep (default: all_reduce; flash_fwd "
-                        "needs a TPU backend)")
+                        "needs a TPU backend; hierarchical sweeps "
+                        "flat-vs-two-tier over --slices N virtual "
+                        "slices and persists the measured crossover)")
+    p.add_argument("--slices", type=int, default=None, metavar="N",
+                   help="pod slice count: with --explain, price the "
+                        "all_reduce table for an N-slice pod (all "
+                        "three candidates); with --ops hierarchical, "
+                        "the shape the sweep tiers over")
     p.add_argument("--cache", default=None,
                    help="plan-cache JSON path (default: "
                         "$SMI_TPU_PLAN_CACHE or "

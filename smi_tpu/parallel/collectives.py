@@ -61,7 +61,7 @@ engine layer) -> plan-cache entry -> :data:`RS_AG_MIN_BYTES` — see
 from __future__ import annotations
 
 import os
-from typing import Optional, Union
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -183,6 +183,40 @@ RS_AG_MIN_BYTES = 1 << 20
 #: env setting outranks every plan-engine layer (including measured
 #: cache entries) — it is the operator's word.
 RS_AG_ENV = "SMI_TPU_RS_AG_MIN_BYTES"
+
+#: Explicit slice-count override of the two-tier (hierarchical)
+#: allreduce gate: an eligible allreduce on a hybrid communicator
+#: with at least this many slices takes the rs(ICI) -> reduce(DCN) ->
+#: ag(ICI) composition; below it (or unset) the plan engine decides.
+#: Mirrors :data:`RS_AG_ENV` semantics — outranks cache and model,
+#: malformed values are a LOUD error. Set it huge to pin the flat
+#: form on any pod; set it to 2 to force the two-tier form wherever
+#: it is structurally possible.
+HIER_MIN_SLICES_ENV = "SMI_TPU_HIER_MIN_SLICES"
+
+
+def _hier_env_min_slices() -> Optional[int]:
+    """$SMI_TPU_HIER_MIN_SLICES as an int, ``None`` when unset. A
+    malformed value is a LOUD error, same discipline as
+    :func:`_rs_ag_env_bytes`: a typo must not silently hand the
+    decision back to the engine."""
+    raw = os.environ.get(HIER_MIN_SLICES_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"${HIER_MIN_SLICES_ENV} must be an integer slice count, "
+            f"got {raw!r}"
+        ) from None
+    if value < 2:
+        raise ValueError(
+            f"${HIER_MIN_SLICES_ENV} must be >= 2 (a pod tiers over "
+            f"at least two slices; set a large value to pin the flat "
+            f"form), got {value}"
+        )
+    return value
 
 
 def _rs_ag_env_bytes() -> Optional[int]:
@@ -404,10 +438,98 @@ def _use_rs_ag(x: jax.Array, comm: Communicator, op: SmiOp,
         return payload >= (RS_AG_MIN_BYTES if env is None else env)
 
 
+def _use_hierarchical(x: jax.Array, comm: Communicator, op: SmiOp,
+                      hierarchical: Optional[bool],
+                      rs_ag: Optional[bool],
+                      chunks: Optional[int] = None) -> bool:
+    """Algorithm switch point for the two-tier (ICI x DCN) form.
+
+    Structural eligibility: an ADD allreduce on a 2-axis hybrid
+    multi-slice communicator whose leading dim the inner (ICI) axis
+    divides. The *decision* is ``hierarchical`` when given (True
+    validates loudly), else flat when the caller pinned ``rs_ag=``
+    either way or an explicit ``chunks=`` pipeline (a forced
+    decomposition must never be silently replaced — nor turned into
+    a trace-time conflict by a config flip), else the explicit env
+    slice tier
+    (:data:`HIER_MIN_SLICES_ENV` — the operator's word, outranking
+    every engine layer), else the plan engine's gate (measured
+    cache entry -> measured crossover -> confident model -> flat).
+    Single-slice communicators are never eligible, so an untuned
+    single-slice program is byte-identical by construction.
+    """
+    from smi_tpu.tuning import cost_model as cm
+
+    if hierarchical and rs_ag is not None:
+        if rs_ag:
+            raise ValueError(
+                "hierarchical=True and rs_ag=True are competing "
+                "decompositions of one allreduce — pick one (the "
+                "hierarchical form already reduce-scatters within the "
+                "slice)"
+            )
+        raise ValueError(
+            "hierarchical=True conflicts with rs_ag=False: rs_ag="
+            "False pins the single bit-exact psum, which the "
+            "two-tier decomposition would reassociate — drop one pin"
+        )
+    topo = cm.topology_from_comm(comm)
+    if hierarchical:
+        if not topo.hierarchical_eligible:
+            raise ValueError(
+                f"hierarchical=True needs a multi-slice hybrid "
+                f"communicator (a 2-axis mesh with a 'dcn' outer "
+                f"axis of >= 2 slices); got axes {comm.axis_names} "
+                f"with sizes {comm.axis_sizes}"
+            )
+        if op is SmiOp.ADD:
+            inner = topo.inner or 1
+            if x.ndim == 0 or x.shape[0] % inner:
+                raise ValueError(
+                    f"hierarchical=True needs a leading dim divisible "
+                    f"by the inner (ICI) axis size {inner}; got shape "
+                    f"{jnp.shape(x)}"
+                )
+        return True
+    if hierarchical is not None:  # explicit False
+        return False
+    if rs_ag is not None:
+        # the caller pinned the flat decomposition — rs_ag=True forces
+        # reduce-scatter+all-gather, rs_ag=False pins the single
+        # bit-exact psum; either way the auto gate stands down
+        return False
+    if chunks is not None and chunks != 1:
+        # an explicit chunk pipeline is equally a forced shape: the
+        # auto gate must not turn it into a trace-time error when an
+        # env var or cache entry flips (hierarchical=True still
+        # raises on the conflict)
+        return False
+    if (op is not SmiOp.ADD or not topo.hierarchical_eligible
+            or x.ndim == 0):
+        return False
+    inner = topo.inner or 1
+    if x.shape[0] % inner or x.shape[0] < inner:
+        return False
+    min_slices = _hier_env_min_slices()  # loud on malformed — first
+    payload = int(x.size) * x.dtype.itemsize
+    if min_slices is not None:
+        return (topo.outer or 0) >= min_slices
+    try:
+        from smi_tpu.tuning.engine import planned_hierarchical
+
+        return planned_hierarchical(
+            payload, topo.n, topo.inner or 1, topo.outer or 0,
+            str(x.dtype),
+        )
+    except Exception:
+        return False
+
+
 def bcast(x: jax.Array, comm: Communicator, root: int = 0,
           port: Optional[int] = None, backend: str = "xla",
           program=None, deadline: Optional[Deadline] = None,
-          chunks: Optional[int] = None) -> jax.Array:
+          chunks: Optional[int] = None,
+          hierarchical: Optional[bool] = None) -> jax.Array:
     """One-to-all: every rank returns the root's ``x``.
 
     Reference: ``SMI_Bcast`` (``bcast.h:43-63``); the root's support kernel
@@ -418,9 +540,25 @@ def bcast(x: jax.Array, comm: Communicator, root: int = 0,
     ring). ``chunks`` splits the payload into a software pipeline of
     independent per-chunk collectives (bit-identical reassembly);
     ``None`` (the default) consults the plan engine's cache, falling
-    back to one collective.
+    back to one collective. ``hierarchical=True`` takes the two-tier
+    slice-leader tree on a hybrid communicator
+    (:func:`bcast_hierarchical` — bit-identical, pure routing);
+    rooted collectives keep the flat form by default (the gate is
+    explicit, not engine-driven — no sweep covers them yet).
     """
     _check_backend(backend)
+    if hierarchical:
+        if backend != "xla":
+            raise ValueError(
+                "hierarchical=True is an XLA-tier composition; drop "
+                "it or use backend='xla'"
+            )
+        if chunks is not None and chunks != 1:
+            raise ValueError(
+                "chunks= does not compose with the hierarchical "
+                "bcast; drop chunks or hierarchical"
+            )
+        return bcast_hierarchical(x, comm, root=root)
     chunks = _resolve_chunks(chunks, x, comm, "broadcast")
     if backend == "ring":
         _check_deadline(deadline, "broadcast", comm)
@@ -443,7 +581,8 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
            root: int = 0, port: Optional[int] = None,
            all_ranks: bool = False, backend: str = "xla",
            program=None, deadline: Optional[Deadline] = None,
-           chunks: Optional[int] = None) -> jax.Array:
+           chunks: Optional[int] = None,
+           hierarchical: Optional[bool] = None) -> jax.Array:
     """All-to-one reduction with ADD/MAX/MIN.
 
     Reference: ``SMI_Reduce`` (``reduce.h:18-76``): every rank contributes,
@@ -454,9 +593,26 @@ def reduce(x: jax.Array, comm: Communicator, op: Union[str, SmiOp] = SmiOp.ADD,
     ring kernel (``kernels/ring.py``) instead of ``lax.psum``.
     ``chunks`` software-pipelines the payload in independent per-chunk
     reductions (bit-identical: each element's reduction is unchanged).
+    ``hierarchical=True`` takes the two-tier slice-leader composition
+    on a hybrid communicator (:func:`reduce_hierarchical`: combine
+    over ICI first, cross DCN once with slice partials); explicit
+    only — rooted collectives keep the flat form by default.
     """
     _check_backend(backend)
     op = SmiOp.parse(op)
+    if hierarchical:
+        if backend != "xla":
+            raise ValueError(
+                "hierarchical=True is an XLA-tier composition; drop "
+                "it or use backend='xla'"
+            )
+        if chunks is not None and chunks != 1:
+            raise ValueError(
+                "chunks= does not compose with the hierarchical "
+                "reduce; drop chunks or hierarchical"
+            )
+        return reduce_hierarchical(x, comm, op=op, root=root,
+                                   all_ranks=all_ranks)
     chunks = _resolve_chunks(chunks, x, comm, "reduce")
     if backend == "ring":
         _check_deadline(deadline, "reduce", comm)
@@ -483,20 +639,27 @@ def allreduce(x: jax.Array, comm: Communicator,
               backend: str = "xla", program=None,
               deadline: Optional[Deadline] = None,
               chunks: Optional[int] = None,
-              rs_ag: Optional[bool] = None) -> jax.Array:
+              rs_ag: Optional[bool] = None,
+              hierarchical: Optional[bool] = None) -> jax.Array:
     """Reduce + Bcast in one collective (convenience; no reference analog
     because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``).
 
-    Two streaming-overlap knobs: ``chunks`` software-pipelines the
-    payload (bit-identical); ``rs_ag`` selects the bandwidth-optimal
+    Three algorithm knobs: ``chunks`` software-pipelines the payload
+    (bit-identical); ``rs_ag`` selects the bandwidth-optimal
     reduce-scatter + all-gather decomposition — defaulting to the
-    :data:`RS_AG_MIN_BYTES` size heuristic, forced on/off when a bool.
-    The decomposition reassociates the sum (float results may differ in
-    the last ulp from one psum), which is why it stays size-gated.
+    :data:`RS_AG_MIN_BYTES` size heuristic, forced on/off when a bool;
+    ``hierarchical`` selects the two-tier rs(ICI) -> reduce(DCN) ->
+    ag(ICI) composition on a hybrid multi-slice communicator
+    (:func:`allreduce_hierarchical`), defaulting to the plan engine's
+    gate behind the explicit :data:`HIER_MIN_SLICES_ENV` override.
+    Both decompositions reassociate the sum (float results may differ
+    in the last ulp from one psum), which is why they stay gated —
+    size-gated for rs+ag, slice/measurement-gated for hierarchical —
+    and why a single-slice or untuned program never takes them
+    silently.
     """
     _check_backend(backend)
     op = SmiOp.parse(op)
-    chunks = _resolve_chunks(chunks, x, comm, "all_reduce")
     if backend != "xla":
         # a forced decomposition must never be silently dropped — the
         # ring tier has no reduce-scatter+all-gather form of allreduce
@@ -506,7 +669,22 @@ def allreduce(x: jax.Array, comm: Communicator,
                 "tier runs the circulating-partial kernel — drop "
                 "rs_ag or use backend='xla'"
             )
-    elif _use_rs_ag(x, comm, op, rs_ag):
+        if hierarchical:
+            raise ValueError(
+                "hierarchical=True is an XLA-tier composition; the "
+                "ring tier runs the circulating-partial kernel — "
+                "drop hierarchical or use backend='xla'"
+            )
+    elif _use_hierarchical(x, comm, op, hierarchical, rs_ag, chunks):
+        if chunks is not None and chunks != 1:
+            raise ValueError(
+                "chunks= does not compose with the hierarchical "
+                "allreduce (its three phases are already a pipeline); "
+                "drop chunks or pin hierarchical=False"
+            )
+        return allreduce_hierarchical(x, comm, op=op)
+    chunks = _resolve_chunks(chunks, x, comm, "all_reduce")
+    if backend == "xla" and _use_rs_ag(x, comm, op, rs_ag):
         return _rs_ag_allreduce(x, _axis(comm), comm.size, chunks)
     return reduce(x, comm, op=op, all_ranks=True, backend=backend,
                   program=program, deadline=deadline, chunks=chunks)
@@ -532,9 +710,29 @@ def allreduce_hierarchical(x: jax.Array, comm: Communicator,
     for the ADD path. Defaults take the communicator's axes as
     ``(outer, inner)``.
     """
+    outer, inner = _hier_axes(comm, inner, outer)
+    op = SmiOp(op)
+    if op is not SmiOp.ADD:
+        fn = lax.pmax if op is SmiOp.MAX else lax.pmin
+        return fn(fn(x, inner), outer)
+    inner_size = comm.mesh.shape[inner]
+    if x.shape[0] % inner_size != 0:
+        raise ValueError(
+            f"leading dim {x.shape[0]} not divisible by inner axis "
+            f"size {inner_size}"
+        )
+    shard = lax.psum_scatter(x, inner, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, outer)
+    return lax.all_gather(shard, inner, axis=0, tiled=True)
+
+
+def _hier_axes(comm: Communicator, inner: Optional[str],
+               outer: Optional[str]) -> Tuple[str, str]:
+    """Resolve and validate the (outer, inner) tier axes of a hybrid
+    communicator — shared by every two-tier composition."""
     if len(comm.axis_names) != 2 and (inner is None or outer is None):
         raise ValueError(
-            "hierarchical allreduce needs a 2-axis communicator or "
+            "a hierarchical collective needs a 2-axis communicator or "
             "explicit inner=/outer= axis names"
         )
     outer = outer if outer is not None else comm.axis_names[0]
@@ -549,19 +747,44 @@ def allreduce_hierarchical(x: jax.Array, comm: Communicator,
             raise ValueError(
                 f"axis {name!r} not in mesh axes {comm.mesh.axis_names}"
             )
-    op = SmiOp(op)
-    if op is not SmiOp.ADD:
-        fn = lax.pmax if op is SmiOp.MAX else lax.pmin
-        return fn(fn(x, inner), outer)
-    inner_size = comm.mesh.shape[inner]
-    if x.shape[0] % inner_size != 0:
-        raise ValueError(
-            f"leading dim {x.shape[0]} not divisible by inner axis "
-            f"size {inner_size}"
-        )
-    shard = lax.psum_scatter(x, inner, scatter_dimension=0, tiled=True)
-    shard = lax.psum(shard, outer)
-    return lax.all_gather(shard, inner, axis=0, tiled=True)
+    return outer, inner
+
+
+def bcast_hierarchical(x: jax.Array, comm: Communicator, root: int = 0,
+                       inner: Optional[str] = None,
+                       outer: Optional[str] = None) -> jax.Array:
+    """Two-tier one-to-all: the slice-leader tree of the reference's
+    router economics. The root's value is shared within its slice
+    over ICI (one masked psum on the inner axis), then crosses DCN
+    exactly once per leader position (one psum on the outer axis) —
+    already positioned, never echoed back across the slow tier. Pure
+    routing, so the result is bit-identical to the flat bcast for
+    every dtype."""
+    outer, inner = _hier_axes(comm, inner, outer)
+    mask = _is_root(comm, root)
+    contrib = jnp.where(mask, x, jnp.zeros_like(x))
+    return lax.psum(lax.psum(contrib, inner), outer)
+
+
+def reduce_hierarchical(x: jax.Array, comm: Communicator,
+                        op: Union[str, SmiOp] = SmiOp.ADD,
+                        root: int = 0, all_ranks: bool = False,
+                        inner: Optional[str] = None,
+                        outer: Optional[str] = None) -> jax.Array:
+    """Two-tier all-to-one: each slice combines over ICI first (inner
+    stage), then the already-combined slice partials cross DCN once
+    via the leader positions (outer stage); the result is masked to
+    the root unless ``all_ranks``. ADD reassociates the sum across
+    the two stages (ints exact; floats to the last ulp), MAX/MIN are
+    exact."""
+    outer, inner = _hier_axes(comm, inner, outer)
+    op = SmiOp.parse(op)
+    fn = (lax.psum if op is SmiOp.ADD
+          else lax.pmax if op is SmiOp.MAX else lax.pmin)
+    out = fn(fn(x, inner), outer)
+    if all_ranks:
+        return out
+    return jnp.where(_is_root(comm, root), out, jnp.zeros_like(out))
 
 
 def scatter(x: jax.Array, comm: Communicator, root: int = 0,

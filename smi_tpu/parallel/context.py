@@ -150,30 +150,34 @@ class SmiContext:
     # The default ``None`` consults the plan engine (smi_tpu.tuning):
     # measured cache entry, else one collective — today's behavior.
     def bcast(self, x, root: int = 0, port: Optional[int] = None,
-              backend: Optional[str] = None, chunks: Optional[int] = None):
+              backend: Optional[str] = None, chunks: Optional[int] = None,
+              hierarchical: Optional[bool] = None):
         return _coll.bcast(x, self.comm, root=root, port=port,
                            backend=self._backend(backend),
                            program=self.program, deadline=self.deadline,
-                           chunks=chunks)
+                           chunks=chunks, hierarchical=hierarchical)
 
     def reduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD, root: int = 0,
                port: Optional[int] = None, all_ranks: bool = False,
-               backend: Optional[str] = None, chunks: Optional[int] = None):
+               backend: Optional[str] = None, chunks: Optional[int] = None,
+               hierarchical: Optional[bool] = None):
         return _coll.reduce(x, self.comm, op=op, root=root, port=port,
                             all_ranks=all_ranks,
                             backend=self._backend(backend),
                             program=self.program, deadline=self.deadline,
-                            chunks=chunks)
+                            chunks=chunks, hierarchical=hierarchical)
 
     def allreduce(self, x, op: Union[str, SmiOp] = SmiOp.ADD,
                   backend: Optional[str] = None,
                   chunks: Optional[int] = None,
-                  rs_ag: Optional[bool] = None):
+                  rs_ag: Optional[bool] = None,
+                  hierarchical: Optional[bool] = None):
         return _coll.allreduce(x, self.comm, op=op,
                                backend=self._backend(backend),
                                program=self.program,
                                deadline=self.deadline,
-                               chunks=chunks, rs_ag=rs_ag)
+                               chunks=chunks, rs_ag=rs_ag,
+                               hierarchical=hierarchical)
 
     def scatter(self, x, root: int = 0, port: Optional[int] = None,
                 backend: Optional[str] = None, chunks: Optional[int] = None):
@@ -199,10 +203,18 @@ class SmiContext:
         would run with, which layer (cache / model / heuristic) decided
         each, and the modeled vs measured costs behind the choice —
         the API twin of ``smi-tpu tune --explain`` (ISSUE 4: every
-        silent default is an inspectable decision)."""
+        silent default is an inspectable decision). On a hybrid
+        multi-slice communicator the allreduce table prices all three
+        candidates — flat ring, rs+ag, and the two-tier hierarchical
+        form — and names the two-tier gate's deciding layer."""
+        from smi_tpu.tuning import cost_model as cm
         from smi_tpu.tuning.engine import get_engine
 
-        return get_engine().explain_text(op, n=self.size, dtype=dtype)
+        topo = cm.topology_from_comm(self.comm)
+        return get_engine().explain_text(
+            op, n=self.size, dtype=dtype,
+            slices=topo.outer if topo.hierarchical_eligible else None,
+        )
 
     # -- degraded mode -------------------------------------------------
     def shrink(self, excluded_ranks) -> "SmiContext":

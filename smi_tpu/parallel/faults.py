@@ -94,6 +94,25 @@ INTEGRITY_FAULT_CLASSES = ("bit_flip_payload", "reordered_chunks",
 #: them.
 ELASTIC_FAULT_CLASSES = ("flapping_rank", "stalled_heartbeat")
 
+#: The two-tier pod protocol runnable through :func:`run_under_faults`
+#: but NOT in the seed-pinned base sweep (same discipline as
+#: :data:`CHUNKED_PROTOCOLS`): ``allreduce_pod`` is the hierarchical
+#: rs(ICI) -> ring(DCN) -> ag(ICI) composition of
+#: :func:`credits.allreduce_pod_rank`.
+POD_PROTOCOLS = ("allreduce_pod",)
+
+#: DCN-tier fault classes, deliberately NOT in :data:`FAULT_CLASSES`
+#: (the seed-pinned base chaos campaign would re-roll; same rule as
+#: :data:`ELASTIC_FAULT_CLASSES`). They target the pod's slow wire
+#: tier specifically: a down DCN link severs two *slices* (every
+#: cross-slice wire between them, both directions), a DCN delay is
+#: the slow-but-never-lost hold the inter-slice fabric actually
+#: exhibits. ``tests/test_multislice.py`` sweeps them against the pod
+#: protocol; verified-transport framing composes unchanged (a
+#: payload tampered on a DCN wire is the same named IntegrityError
+#: an ICI tamper is).
+DCN_FAULT_CLASSES = ("dcn_link_down", "dcn_delay")
+
 #: Named invariant violations that count as *detection*. A bare
 #: ProtocolError (wrong delivery) is NOT in this set — that is silent
 #: corruption and fails the matrix.
@@ -179,6 +198,57 @@ class TruncatedDma:
 
     src: int
     nth: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DcnLinkDown:
+    """The DCN path between ``slice_a`` and ``slice_b`` of a
+    ``per_slice``-wide pod is severed: every cross-slice signal and
+    DMA between ranks of the two slices is lost, both directions —
+    the inter-slice analog of :class:`DownLink`, at slice granularity
+    because DCN connectivity is per slice pair (one host fabric
+    route), not per rank pair. In-slice ICI traffic is untouched.
+    Detected as a :class:`~credits.DeadlockError` at the first pod
+    phase-B wait that needed the dead route.
+    """
+
+    slice_a: int
+    slice_b: int
+    per_slice: int = 2
+
+    def __post_init__(self):
+        if self.slice_a == self.slice_b:
+            raise ValueError(
+                f"a DCN link connects two DISTINCT slices, got "
+                f"{self.slice_a} twice (in-slice wires are ICI — use "
+                f"DownLink)"
+            )
+        if self.per_slice < 1:
+            raise ValueError(f"per_slice must be >= 1, got {self.per_slice}")
+
+    def severs(self, a: int, b: int) -> bool:
+        slice_of = C.pod_slice_of(self.per_slice)
+        return {slice_of(a), slice_of(b)} == {self.slice_a, self.slice_b}
+
+
+@dataclasses.dataclass(frozen=True)
+class DcnDelay:
+    """Hold the ``nth`` DMA started by ``src`` for ``hold`` scheduler
+    events — but only when that DMA actually crosses a slice boundary
+    of the ``per_slice``-wide pod (an in-slice copy is ICI business
+    and this fault never touches it). The DCN tier's characteristic
+    fault: slow, never lost — **tolerated** by the credit protocol
+    like :class:`DelayedDma`, which is exactly what the pod protocol
+    must prove about its cross-slice phase."""
+
+    src: int
+    nth: int = 0
+    hold: int = 64
+    per_slice: int = 2
+
+    def __post_init__(self):
+        if self.per_slice < 1:
+            raise ValueError(f"per_slice must be >= 1, got {self.per_slice}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -298,6 +368,10 @@ class FaultPlan:
     #: the membership layer's elastic soak).
     flapping_ranks: Tuple[FlappingRank, ...] = ()
     stalled_heartbeats: Tuple[StalledHeartbeat, ...] = ()
+    #: DCN-tier faults (slice-pair link cuts, cross-slice-only DMA
+    #: holds) — consulted through the same hooks, slice-resolved.
+    dcn_link_downs: Tuple[DcnLinkDown, ...] = ()
+    dcn_delays: Tuple[DcnDelay, ...] = ()
 
     # -- hook interface (credits.RingSimulator) ------------------------
     def grant_multiplier(self, rank: int, nth: int) -> int:
@@ -315,6 +389,20 @@ class FaultPlan:
                 return f.hold
         return 0
 
+    def dma_hold_to(self, src: int, dst: int, nth: int) -> int:
+        """Destination-aware hold (the simulator prefers this hook
+        when present): the base per-source holds plus the DCN delays,
+        which apply only to a copy that actually crosses slices."""
+        held = self.dma_hold(src, nth)
+        if held:
+            return held
+        for f in self.dcn_delays:
+            if f.src == src and f.nth == nth:
+                slice_of = C.pod_slice_of(f.per_slice)
+                if slice_of(src) != slice_of(dst):
+                    return f.hold
+        return 0
+
     def stall_after(self, rank: int) -> Optional[int]:
         for f in self.stalled_ranks:
             if f.rank == rank:
@@ -322,7 +410,9 @@ class FaultPlan:
         return None
 
     def link_down(self, a: int, b: int) -> bool:
-        return (a, b) in self.down_links or (b, a) in self.down_links
+        if (a, b) in self.down_links or (b, a) in self.down_links:
+            return True
+        return any(f.severs(a, b) for f in self.dcn_link_downs)
 
     def tamper(self, src: int, nth: int, payload):
         """Damage the ``nth`` DMA payload of ``src`` in flight.
@@ -360,6 +450,7 @@ class FaultPlan:
             or self.delayed_dmas or self.stalled_ranks or self.down_links
             or self.bit_flips or self.reorders or self.truncations
             or self.flapping_ranks or self.stalled_heartbeats
+            or self.dcn_link_downs or self.dcn_delays
         )
 
     def faults(self) -> Tuple:
@@ -371,6 +462,7 @@ class FaultPlan:
             + tuple(DownLink(a, b) for a, b in sorted(self.down_links))
             + self.bit_flips + self.reorders + self.truncations
             + self.flapping_ranks + self.stalled_heartbeats
+            + self.dcn_link_downs + self.dcn_delays
         )
 
     def describe(self) -> List[str]:
@@ -404,6 +496,10 @@ class FaultPlan:
             return cls(flapping_ranks=(fault,))
         if isinstance(fault, StalledHeartbeat):
             return cls(stalled_heartbeats=(fault,))
+        if isinstance(fault, DcnLinkDown):
+            return cls(dcn_link_downs=(fault,))
+        if isinstance(fault, DcnDelay):
+            return cls(dcn_delays=(fault,))
         raise TypeError(f"unknown fault {fault!r}")
 
     @classmethod
@@ -427,6 +523,9 @@ class FaultPlan:
                                 + single.flapping_ranks),
                 stalled_heartbeats=(plan.stalled_heartbeats
                                     + single.stalled_heartbeats),
+                dcn_link_downs=(plan.dcn_link_downs
+                                + single.dcn_link_downs),
+                dcn_delays=plan.dcn_delays + single.dcn_delays,
             )
         return plan
 
@@ -477,9 +576,24 @@ class FaultPlan:
                 rank, from_tick=50 + rng.randrange(40),
                 silent_for=16 + rng.randrange(9),
             ))
+        if fault_class in DCN_FAULT_CLASSES:
+            # pod shape convention for random draws: 2 slices of n//2
+            # (the n-rank ring split in half) — n must be even
+            if n < 2 or n % 2:
+                raise ValueError(
+                    f"DCN fault draws need an even n >= 2 (two slices "
+                    f"of n//2), got n={n}"
+                )
+            per_slice = n // 2
+            if fault_class == "dcn_link_down":
+                return cls.single(DcnLinkDown(0, 1, per_slice=per_slice))
+            return cls.single(DcnDelay(
+                rank, nth=rng.randrange(3), hold=rng.randrange(8, 120),
+                per_slice=per_slice,
+            ))
         raise ValueError(
             f"unknown fault class {fault_class!r}; "
-            f"known: {FAULT_CLASSES + ELASTIC_FAULT_CLASSES}"
+            f"known: {FAULT_CLASSES + ELASTIC_FAULT_CLASSES + DCN_FAULT_CLASSES}"
         )
 
 
@@ -510,7 +624,7 @@ class Verdict:
 
 def _simulate(protocol: str, n: int, strategy: C.Strategy,
               plan: Optional[FaultPlan], chunks: int,
-              verified: bool = True) -> None:
+              verified: bool = True, slices: int = 2) -> None:
     if protocol == "all_gather":
         C.simulate_all_gather(n, strategy, faults=plan, verified=verified)
     elif protocol == "all_reduce":
@@ -524,10 +638,18 @@ def _simulate(protocol: str, n: int, strategy: C.Strategy,
     elif protocol == "all_reduce_chunked":
         C.simulate_all_reduce_chunked(n, chunks, strategy, faults=plan,
                                       verified=verified)
+    elif protocol == "allreduce_pod":
+        if n % slices:
+            raise ValueError(
+                f"allreduce_pod needs n divisible by slices, got "
+                f"n={n} slices={slices}"
+            )
+        C.simulate_allreduce_pod(slices, n // slices, strategy,
+                                 faults=plan, verified=verified)
     else:
         raise ValueError(
             f"unknown protocol {protocol!r}; known: "
-            f"{PROTOCOLS + CHUNKED_PROTOCOLS}"
+            f"{PROTOCOLS + CHUNKED_PROTOCOLS + POD_PROTOCOLS}"
         )
 
 
@@ -538,6 +660,7 @@ def run_under_faults(
     strategy: Optional[C.Strategy] = None,
     chunks: int = 5,
     verified: bool = True,
+    slices: int = 2,
 ) -> Verdict:
     """Execute one ring protocol under a fault plan and classify.
 
@@ -556,7 +679,8 @@ def run_under_faults(
     """
     strategy = strategy if strategy is not None else C.Strategy(0)
     try:
-        _simulate(protocol, n, strategy, plan, chunks, verified=verified)
+        _simulate(protocol, n, strategy, plan, chunks, verified=verified,
+                  slices=slices)
     except DETECTED_ERRORS as e:
         return Verdict("detected", e)
     except C.ProtocolError as e:

@@ -395,6 +395,330 @@ def plan_regrow_ring(view: MembershipView,
 
 
 # ---------------------------------------------------------------------------
+# Pod-of-slices membership: two-tier rings, heirs, elastic soak
+# ---------------------------------------------------------------------------
+
+
+def pod_heir_of(rank: int, survivors, slices: int, per_slice: int) -> int:
+    """The pod inheritance rule: a dead rank's duties pass to its
+    nearest surviving successor ON ITS SLICE RING first (the heir can
+    read the shard over ICI and the slice ring re-closes locally);
+    only when the whole slice is dead does inheritance cross to the
+    global successor — the flat-fallback shape where DCN is already
+    being paid. Degenerates to :func:`~recovery.heir_of` at one
+    slice."""
+    from smi_tpu.parallel.recovery import heir_of
+
+    n = slices * per_slice
+    surv = set(survivors)
+    s, i = divmod(rank, per_slice)
+    for step in range(1, per_slice):
+        cand = s * per_slice + (i + step) % per_slice
+        if cand in surv:
+            return cand
+    return heir_of(rank, surv, n)
+
+
+@dataclasses.dataclass(frozen=True)
+class PodRingPlan:
+    """The executable ring layout after a pod membership change.
+
+    ``hierarchical`` layouts carry one (possibly shrunk) ring per
+    surviving slice plus the cross-slice leader ring; the
+    ``flat_ring`` fallback (any slice annihilated, or a single
+    surviving slice) is the one-ring-over-survivors shape every
+    collective can always run."""
+
+    slice_rings: Tuple[Tuple[int, ...], ...] = ()
+    cross_ring: Tuple[int, ...] = ()
+    flat_ring: Optional[Tuple[int, ...]] = None
+
+    @property
+    def hierarchical(self) -> bool:
+        return self.flat_ring is None
+
+
+def plan_pod_rings(view: MembershipView, slices: int,
+                   per_slice: int) -> PodRingPlan:
+    """Ring layout for the current members of a (slices, per_slice)
+    pod, validated against the pod topology with the dead devices
+    excluded (the same :func:`~routing.check_all_pairs_routable`
+    discipline as :func:`plan_regrow_ring` — a plan that would strand
+    a member raises :class:`~routing.RouteCutError` naming the cut).
+
+    - a dead RANK shrinks its slice ring: the slice keeps ringing
+      over its survivors, the cross ring connects each surviving
+      slice's leader (lowest surviving rank), and the hierarchical
+      protocol stays on;
+    - a dead SLICE (no survivors in some slice) — or a pod reduced to
+      one surviving slice — falls back to the flat ring over all
+      survivors: with a tier gone there is nothing to tier over.
+    """
+    from smi_tpu.parallel.routing import (
+        FailureSet,
+        build_routing_context,
+        check_all_pairs_routable,
+        pod_topology,
+    )
+
+    n = slices * per_slice
+    if view.n != n:
+        raise ValueError(
+            f"view over {view.n} ranks does not match the "
+            f"{slices}x{per_slice} pod"
+        )
+    members = sorted(view.members)
+    topo = pod_topology(slices, per_slice)
+    cut = FailureSet(
+        devices=frozenset(topo.devices[r] for r in sorted(view.dead))
+    )
+    ctx = build_routing_context(topo, excluded=cut)
+    check_all_pairs_routable(ctx, [topo.devices[r] for r in members])
+    per = [
+        tuple(r for r in members if r // per_slice == s)
+        for s in range(slices)
+    ]
+    live = [ring for ring in per if ring]
+    if len(live) < len(per) or len(live) < 2:
+        return PodRingPlan(flat_ring=tuple(members))
+    return PodRingPlan(
+        slice_rings=tuple(live),
+        cross_ring=tuple(ring[0] for ring in live),
+    )
+
+
+def run_pod_cell(
+    slices: int,
+    per_slice: int,
+    kill: str,
+    seed: int,
+    iterations: int = 18,
+    cadence: int = 3,
+    rows_per_rank: int = 3,
+    width: int = 8,
+    checkpoint_dir: Optional[str] = None,
+) -> Dict:
+    """One pod elastic soak cell: the sharded Jacobi job on a
+    (slices, per_slice) pod healed through a seeded kill.
+
+    ``kill="rank"`` crash-stops one seeded rank (its slice ring
+    shrinks, the plan stays hierarchical); ``kill="slice"`` crash-
+    stops a whole seeded slice (the plan must fall back to the flat
+    ring over the survivors). Either way: shrink under new epochs,
+    restore ALL state from the last complete manifest and replay the
+    tail, reject the dead incarnation's stale-epoch traffic loudly,
+    regrow under a fresh epoch with the hierarchical plan restored,
+    and finish bit-identical to the fault-free run. Deterministic per
+    ``(shape, kill, seed)``.
+    """
+    import numpy as np
+
+    from smi_tpu.parallel.checkpoint import CheckpointStore
+
+    if kill not in ("rank", "slice"):
+        raise ValueError(f"kill must be 'rank' or 'slice', got {kill!r}")
+    if slices < 2 or per_slice < 1:
+        raise ValueError(
+            f"pod soak needs >= 2 slices (got {slices}x{per_slice})"
+        )
+    n = slices * per_slice
+    rng = random.Random(f"pod:{slices}x{per_slice}:{kill}:{seed}")
+    view = MembershipView(n)
+    grid0 = _initial_grid(n * rows_per_rank, width)
+    blocks = {
+        r: grid0[r * rows_per_rank:(r + 1) * rows_per_rank].copy()
+        for r in range(n)
+    }
+    store = CheckpointStore(checkpoint_dir) if checkpoint_dir else None
+
+    if kill == "rank":
+        victims = [rng.randrange(n)]
+    else:
+        s = rng.randrange(slices)
+        victims = list(range(s * per_slice, (s + 1) * per_slice))
+    dies_at = 2 + rng.randrange(3)
+    rejoins_at = dies_at + 4 + rng.randrange(3)
+
+    report: Dict = {
+        "slices": slices, "per_slice": per_slice, "kill": kill,
+        "seed": seed, "victims": victims, "dies_at": dies_at,
+        "rejoins_at": rejoins_at, "iterations": iterations,
+        "shrinks": 0, "regrows": 0, "restores": 0, "checkpoints": 0,
+        "replayed_iterations": 0, "stale_epoch_rejections": 0,
+        "stale_epoch_leaks": 0, "plan_modes": [], "verdict": "ok",
+    }
+
+    def owners_now() -> Dict[int, Optional[int]]:
+        members = view.members
+        return {
+            r: (r if r in members
+                else pod_heir_of(r, members, slices, per_slice)
+                if members else None)
+            for r in range(n)
+        }
+
+    def checkpoint() -> None:
+        if store is not None:
+            store.save(it, blocks, epoch=view.epoch)
+            report["checkpoints"] += 1
+
+    it = 0
+    checkpoint()
+    killed = False
+    death_epoch = view.epoch
+    while it < iterations:
+        if not killed and it == dies_at:
+            death_epoch = view.epoch
+            for r in victims:
+                view.confirm_dead(r)
+                report["shrinks"] += 1
+            plan = plan_pod_rings(view, slices, per_slice)
+            report["plan_modes"].append(
+                "hierarchical" if plan.hierarchical else "flat"
+            )
+            want_hier = kill == "rank" and per_slice > 1
+            if plan.hierarchical != want_hier:
+                report["verdict"] = (
+                    f"{kill} kill planned "
+                    f"{'hierarchical' if plan.hierarchical else 'flat'}"
+                    f", wanted "
+                    f"{'hierarchical' if want_hier else 'flat'}"
+                )
+                return report
+            if store is not None:
+                restored = store.restore()
+                if restored is None:
+                    report["verdict"] = "no complete manifest to restore"
+                    return report
+                step, shards, _epoch = restored
+                for r, payload in shards.items():
+                    blocks[r] = payload
+                report["restores"] += 1
+                report["replayed_iterations"] += it - step
+                it = step
+            killed = True
+            continue
+        if killed and victims and it == rejoins_at:
+            # the dead incarnation presents its pre-shrink epoch: the
+            # gate must reject it loudly, never fold it in
+            for r in victims:
+                try:
+                    view.validate(r, death_epoch, what="rejoin request")
+                    report["stale_epoch_leaks"] += 1
+                except StaleEpochError:
+                    report["stale_epoch_rejections"] += 1
+            checkpoint()  # regrow barrier: newcomers restore this state
+            for r in victims:
+                view.regrow(r)
+                report["regrows"] += 1
+            plan = plan_pod_rings(view, slices, per_slice)
+            report["plan_modes"].append(
+                "hierarchical" if plan.hierarchical else "flat"
+            )
+            if not plan.hierarchical and per_slice > 1:
+                report["verdict"] = "regrown pod did not restore tiering"
+                return report
+            if store is not None:
+                restored = store.restore()
+                step, shards, _epoch = restored
+                for r in victims:
+                    blocks[r] = shards[r]
+            # one straggler packet from the dead incarnation arrives
+            # AFTER the regrow: reject, never fold in
+            for r in victims:
+                try:
+                    view.validate(r, view.epoch - 1,
+                                  what="straggler halo")
+                    report["stale_epoch_leaks"] += 1
+                except StaleEpochError:
+                    report["stale_epoch_rejections"] += 1
+            victims = []
+        owners = owners_now()
+        blocks = _jacobi_sweep(blocks, owners, view, n)
+        it += 1
+        if it % cadence == 0:
+            checkpoint()
+
+    final = np.concatenate([blocks[r] for r in range(n)])
+    want = _fault_free_grid(grid0, iterations)
+    problems = []
+    if not np.array_equal(final, want):
+        problems.append("silent corruption: final grid differs")
+    if report["stale_epoch_leaks"]:
+        problems.append("stale-epoch traffic accepted")
+    if problems:
+        report["verdict"] = "; ".join(problems)
+    report["epoch"] = view.epoch
+    report["members"] = sorted(view.members)
+    return report
+
+
+def pod_campaign(
+    seed: int,
+    shapes: Sequence[Tuple[int, int]] = ((2, 2), (2, 3), (3, 2)),
+    trials: int = 2,
+    iterations: int = 18,
+    cadence: int = 3,
+    checkpoint_root: Optional[str] = None,
+) -> Dict:
+    """Seeded pod soak: kill-one-rank AND kill-one-slice cells over
+    several (slices, per_slice) shapes, gated like the elastic
+    campaign on zero silent corruption and zero stale-epoch leaks."""
+    import os
+    import tempfile
+
+    outcomes: Dict[str, int] = {}
+    failures: List[Dict] = []
+    cells = 0
+    stale_rejections = 0
+    for slices, per_slice in shapes:
+        for kill in ("rank", "slice"):
+            for trial in range(trials):
+                cells += 1
+                cell_seed = random.Random(
+                    f"pod:{seed}:{slices}x{per_slice}:{kill}:{trial}"
+                ).randrange(1 << 31)
+                with tempfile.TemporaryDirectory(
+                    dir=checkpoint_root
+                ) as ckpt:
+                    report = run_pod_cell(
+                        slices, per_slice, kill, cell_seed,
+                        iterations=iterations, cadence=cadence,
+                        checkpoint_dir=os.path.join(ckpt, "shards"),
+                    )
+                stale_rejections += report["stale_epoch_rejections"]
+                if report["verdict"] != "ok":
+                    outcomes["failed"] = outcomes.get("failed", 0) + 1
+                    failures.append({
+                        "slices": slices, "per_slice": per_slice,
+                        "kill": kill, "trial": trial,
+                        "cell_seed": cell_seed,
+                        "verdict": report["verdict"],
+                    })
+                    continue
+                key = f"regrown-{kill}"
+                outcomes[key] = outcomes.get(key, 0) + 1
+    silent = sum(
+        1 for f in failures if "silent corruption" in f["verdict"]
+    )
+    stale_leaks = sum(
+        1 for f in failures if "stale-epoch" in f["verdict"]
+    )
+    return {
+        "seed": seed,
+        "shapes": [list(s) for s in shapes],
+        "trials": trials,
+        "cells": cells,
+        "outcomes": outcomes,
+        "failures": failures,
+        "silent_corruptions": silent,
+        "stale_epoch_leaks": stale_leaks,
+        "stale_epoch_rejections": stale_rejections,
+        "ok": not failures,
+    }
+
+
+# ---------------------------------------------------------------------------
 # The elastic soak: kill -> detect -> shrink -> restore -> regrow
 # ---------------------------------------------------------------------------
 

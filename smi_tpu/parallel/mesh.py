@@ -129,13 +129,9 @@ class Communicator:
         matches the shrunk mesh; degraded *routing* keeps the full rank
         space instead (:class:`smi_tpu.parallel.routing.FailureSet`).
         """
-        excluded = set(excluded_ranks)
+        excluded, _ = self._validate_membership_args(
+            excluded_ranks, None, "shrink")
         size = self.size
-        bad = sorted(r for r in excluded if not (0 <= r < size))
-        if bad:
-            raise ValueError(
-                f"excluded ranks {bad} out of range for comm size {size}"
-            )
         if len(excluded) >= size:
             raise ValueError(
                 f"cannot shrink a {size}-rank communicator by "
@@ -208,39 +204,13 @@ class Communicator:
         devices, so original rank order is the plan.) Traffic from the
         pre-regrow incarnation is rejected by :meth:`validate_epoch`.
         """
-        excluded = set(excluded_ranks)
-        readmit = set(readmit_ranks)
+        excluded, readmit = self._validate_membership_args(
+            excluded_ranks, readmit_ranks, "regrow"
+        )
         size = self.size
-        stray = sorted(readmit - excluded)
-        if stray:
-            raise ValueError(
-                f"cannot regrow ranks {stray}: they are not in the "
-                f"excluded set {sorted(excluded)}"
-            )
-        if not readmit:
-            raise ValueError("regrow() needs at least one rank to re-admit")
-        bad = sorted(r for r in excluded if not (0 <= r < size))
-        if bad:
-            raise ValueError(
-                f"excluded ranks {bad} out of range for comm size {size}"
-            )
         still_dead = excluded - readmit
+        self._check_regrow_routes(still_dead)
         alive = [r for r in range(size) if r not in still_dead]
-        if self.topology is not None:
-            from smi_tpu.parallel.routing import (
-                FailureSet,
-                build_routing_context,
-                check_all_pairs_routable,
-            )
-
-            topo_devices = self.topology.devices
-            cut = FailureSet(devices=frozenset(
-                topo_devices[r] for r in sorted(still_dead)
-            ))
-            ctx = build_routing_context(self.topology, excluded=cut)
-            check_all_pairs_routable(
-                ctx, [topo_devices[r] for r in alive]
-            )
         devices = self._flat_rank_devices("regrow")
         members = [devices[r] for r in alive]
         mesh = Mesh(
@@ -250,6 +220,151 @@ class Communicator:
             mesh=mesh, axis_names=(DEFAULT_AXIS,),
             epoch=self.epoch + 2 if epoch is None else epoch,
         )
+
+    def _validate_membership_args(self, excluded_ranks, readmit_ranks,
+                                  what: str):
+        """Shared argument validation for the shrink/regrow pairs
+        (flat and pod): range-checks the excluded set and, when
+        ``readmit_ranks`` is given (the regrow pair), the
+        readmit ⊆ excluded relation and non-emptiness. Returns
+        ``(excluded, readmit)`` as sets (``readmit`` is None for the
+        shrink pair). One copy, so the flat and pod paths can never
+        drift on what counts as a legal membership change."""
+        excluded = set(excluded_ranks)
+        readmit = None
+        if readmit_ranks is not None:
+            readmit = set(readmit_ranks)
+            stray = sorted(readmit - excluded)
+            if stray:
+                raise ValueError(
+                    f"cannot regrow ranks {stray}: they are not in the "
+                    f"excluded set {sorted(excluded)}"
+                )
+            if not readmit:
+                raise ValueError(
+                    f"{what}() needs at least one rank to re-admit"
+                )
+        size = self.size
+        bad = sorted(r for r in excluded if not (0 <= r < size))
+        if bad:
+            raise ValueError(
+                f"excluded ranks {bad} out of range for comm size {size}"
+            )
+        return excluded, readmit
+
+    def _check_regrow_routes(self, still_dead) -> None:
+        """Physical leg of the regrow contract: with a real topology
+        the still-dead devices become a FailureSet and every surviving
+        member pair must route around them, or RouteCutError names the
+        cut instead of handing back a broken communicator. Bare JAX
+        meshes (no topology) skip — XLA owns ICI routing there."""
+        if self.topology is None:
+            return
+        from smi_tpu.parallel.routing import (
+            FailureSet,
+            build_routing_context,
+            check_all_pairs_routable,
+        )
+
+        topo_devices = self.topology.devices
+        cut = FailureSet(devices=frozenset(
+            topo_devices[r] for r in sorted(still_dead)
+        ))
+        ctx = build_routing_context(self.topology, excluded=cut)
+        alive = [r for r in range(self.size) if r not in still_dead]
+        check_all_pairs_routable(
+            ctx, [topo_devices[r] for r in alive]
+        )
+
+    def _pod_axes(self, what: str) -> Tuple[int, int]:
+        """(slices, per_slice) of a two-axis hybrid communicator;
+        loud otherwise — pod membership surgery on a flat mesh has no
+        slice structure to preserve."""
+        if len(self.axis_names) != 2:
+            raise ValueError(
+                f"{what}() needs a 2-axis (slices, per_slice) hybrid "
+                f"communicator; got axes {self.axis_names} — use "
+                f"{what.replace('_pod', '')}() on flat meshes"
+            )
+        outer, inner = self.axis_names
+        return self.mesh.shape[outer], self.mesh.shape[inner]
+
+    def _pod_mesh_without(self, dead_slices, what: str,
+                          epoch: int) -> "Communicator":
+        """Rebuild the hybrid mesh with whole dead slices dropped from
+        the outer axis — the one copy of the row layout shared by
+        :meth:`shrink_pod` and :meth:`regrow_pod`, so the two can
+        never diverge on slice-row ordering or device flattening."""
+        slices, per_slice = self._pod_axes(what)
+        devices = self._flat_rank_devices(what)
+        rows = [
+            [devices[s * per_slice + i] for i in range(per_slice)]
+            for s in range(slices) if s not in dead_slices
+        ]
+        mesh = Mesh(np.array(rows), self.axis_names)
+        return Communicator(
+            mesh=mesh, axis_names=self.axis_names, epoch=epoch
+        )
+
+    def shrink_pod(self, excluded_ranks) -> "Communicator":
+        """Pod-aware :meth:`shrink` for a hybrid (slices, per_slice)
+        communicator.
+
+        Whole dead slices drop out of the OUTER axis with the hybrid
+        shape preserved — the survivors keep their two-tier mesh, so
+        hierarchical collectives continue over the remaining slices.
+        A partial slice cannot keep the shape (JAX meshes are
+        rectangular; unequal slices do not tile), so dead *ranks*
+        fall back to the flat 1-D ring over all survivors — exactly
+        the ``plan_pod_rings`` flat-fallback rule, at mesh level.
+        Epoch bumps once either way (no-op exclusion returns ``self``
+        unbumped, mirroring :meth:`shrink`).
+        """
+        slices, per_slice = self._pod_axes("shrink_pod")
+        excluded, _ = self._validate_membership_args(
+            excluded_ranks, None, "shrink_pod"
+        )
+        size = self.size
+        if not excluded:
+            return self
+        if len(excluded) >= size:
+            raise ValueError(
+                f"cannot shrink a {size}-rank pod by {len(excluded)} "
+                f"ranks: no survivors"
+            )
+        by_slice: dict = {}
+        for r in excluded:
+            by_slice.setdefault(r // per_slice, set()).add(r)
+        if any(len(dead) < per_slice for dead in by_slice.values()):
+            return self.shrink(excluded)  # partial slice: flat ring
+        return self._pod_mesh_without(by_slice, "shrink_pod",
+                                      epoch=self.epoch + 1)
+
+    def regrow_pod(self, excluded_ranks, readmit_ranks,
+                   epoch: Optional[int] = None) -> "Communicator":
+        """The inverse of :meth:`shrink_pod`, called on the ORIGINAL
+        pod communicator (the holder of the full slice structure).
+        When the still-dead set after re-admission consists of whole
+        slices (usually empty — everyone came back), the result keeps
+        the hybrid (slices', per_slice) shape; a still-dead partial
+        slice falls back to the flat :meth:`regrow`. Epoch semantics
+        mirror :meth:`regrow` (default assumes the single
+        shrink→regrow cycle and bumps twice; pass ``epoch`` for
+        longer chains)."""
+        slices, per_slice = self._pod_axes("regrow_pod")
+        excluded, readmit = self._validate_membership_args(
+            excluded_ranks, readmit_ranks, "regrow_pod"
+        )
+        still_dead = excluded - readmit
+        by_slice: dict = {}
+        for r in still_dead:
+            by_slice.setdefault(r // per_slice, set()).add(r)
+        new_epoch = self.epoch + 2 if epoch is None else epoch
+        if any(len(dead) < per_slice for dead in by_slice.values()):
+            return self.regrow(excluded, readmit, epoch=new_epoch)
+        self._check_regrow_routes(still_dead)
+        return self._pod_mesh_without(by_slice, "regrow_pod",
+                                      epoch=new_epoch)
 
     def validate_epoch(self, rank: int, epoch: int,
                        what: str = "message") -> None:

@@ -653,6 +653,59 @@ def grid_topology(
     return Topology(connections=connections, mapping=mapping)
 
 
+#: The link indices that carry CROSS-SLICE (DCN) wires in a pod
+#: topology: :func:`pod_topology` routes slice rings over east/west
+#: (0/1) and the inter-slice columns over south/north (2/3), so a
+#: failure set naming a (device, 2|3) endpoint cuts DCN capacity while
+#: (device, 0|1) cuts ICI — the two tiers are physically distinct
+#: wire populations, exactly as on a real pod.
+POD_DCN_LINK_INDICES = (2, 3)
+
+
+def pod_topology(
+    n_slices: int,
+    per_slice: int,
+    program: Optional[Program] = None,
+) -> Topology:
+    """A ``(slices, ranks_per_slice)`` pod-of-slices topology.
+
+    Row ``s`` is slice ``s``: a ring of ``per_slice`` devices over the
+    east/west wires (the ICI tier). Same-index ranks across slices
+    ring up over the south/north wires (the DCN tier) — one cross
+    ring per in-slice position, which is exactly the wire population
+    the two-tier allreduce's phase B uses (``credits.
+    allreduce_pod_rank``). Structurally this IS the wrap grid of
+    :func:`grid_topology` with rows = slices — the pod is the torus
+    read tier-wise — so every existing degraded-routing property
+    (FailureSet cuts, RouteCutError naming, all-pairs checks) applies
+    to pods unchanged. Rank order is row-major: slice ``s`` owns
+    ranks ``[s*per_slice, (s+1)*per_slice)``, matching
+    ``mesh.make_hybrid_communicator`` and ``credits.pod_slice_of``.
+    """
+    if n_slices < 1 or per_slice < 1:
+        raise ValueError(
+            f"pod must be >= 1x1, got {n_slices}x{per_slice}"
+        )
+    return grid_topology(n_slices, per_slice, wrap=True, program=program)
+
+
+def pod_slice_partition(topology: Topology, n_slices: int):
+    """Contiguous rank groups of a pod topology: slice ``s`` = the
+    ``s``-th equal block of the topology's rank order. Loud on a
+    device count the slice count does not divide — a launcher asking
+    for 3 slices of an 8-device pod is a config error, not a guess."""
+    devices = topology.devices
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} "
+            f"equal slices"
+        )
+    k = len(devices) // n_slices
+    return [devices[s * k:(s + 1) * k] for s in range(n_slices)]
+
+
 def egress_link_toward(
     src: Device,
     dst: Device,

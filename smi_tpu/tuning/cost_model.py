@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from smi_tpu.tuning.plan import Candidate
@@ -53,6 +54,52 @@ V5E_ICI_BETA_BYTES_PER_S = 4.5e10
 #: only the *ratio* to ICI matters for ranking (the reference routes
 #: intra-node at cost 1 vs QSFP at cost 100, ``codegen/program.py:7-8``).
 DCN_BETA_BYTES_PER_S = 3.0e9
+
+#: DCN per-message latency (host NIC + datacenter fabric round, ~100 us
+#: — order-of-magnitude above the ICI alpha the same way the beta sits
+#: ~15x under ICI's). The credits simulator's DCN wire tier and the
+#: hierarchical cost both price cross-slice steps with it; the flat
+#: ring pays it on every slice-crossing hop, which is exactly the term
+#: the two-tier protocol amortizes to once-per-shard.
+DCN_ALPHA_S = 1.0e-4
+
+#: Explicit override of the DCN bandwidth model
+#: (bytes/s). Mirrors ``$SMI_TPU_RS_AG_MIN_BYTES`` semantics: unset =
+#: the published :data:`DCN_BETA_BYTES_PER_S`; a malformed or
+#: non-positive value is a LOUD error (a typo silently falling back
+#: would reprice every hierarchical decision without a trace). The
+#: override reaches every consumer of the DCN rate — the model's
+#: hierarchical pricing, the credits simulator's wire tier, and the
+#: explain tables — so one env var retunes the whole DCN story to a
+#: fleet's measured interconnect.
+DCN_BETA_ENV = "SMI_TPU_DCN_BETA"
+
+
+def dcn_beta_bytes_per_s() -> float:
+    """The resolved DCN bandwidth: ``$SMI_TPU_DCN_BETA`` when set
+    (loud on malformed), else :data:`DCN_BETA_BYTES_PER_S`."""
+    raw = os.environ.get(DCN_BETA_ENV, "").strip()
+    if not raw:
+        return DCN_BETA_BYTES_PER_S
+    try:
+        value = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"${DCN_BETA_ENV} must be a bytes-per-second number, "
+            f"got {raw!r}"
+        ) from None
+    if not value > 0 or math.isinf(value) or math.isnan(value):
+        raise ValueError(
+            f"${DCN_BETA_ENV} must be a positive finite bandwidth, "
+            f"got {raw!r}"
+        )
+    return value
+
+
+def dcn_link_model(alpha_s: float = DCN_ALPHA_S) -> LinkModel:
+    """The DCN tier as a :class:`LinkModel`, env-resolved beta."""
+    return LinkModel(alpha_s=alpha_s,
+                     beta_bytes_per_s=dcn_beta_bytes_per_s())
 
 #: Per-collective-phase overhead (launch + dispatch + first-byte
 #: latency). Calibrated so :func:`rs_ag_crossover_bytes` at n=8 equals
@@ -157,6 +204,34 @@ def hierarchical_allreduce_us(
     return t
 
 
+def hierarchical_advantage(
+    payload_bytes: float,
+    topo: TopologySpec,
+    link: LinkModel = LinkModel(),
+    dcn: Optional[LinkModel] = None,
+) -> float:
+    """Modeled speedup of the two-tier form over the best flat form
+    (``> 1`` = hierarchical wins). ``0.0`` when the topology is not
+    hierarchical-eligible — a single-slice mesh has no DCN tier to
+    amortize, so the two-tier form can never be advised there."""
+    if not topo.hierarchical_eligible:
+        return 0.0
+    if dcn is None:
+        dcn = dcn_link_model()
+    # a flat ring over a pod advances in lockstep at its SLOWEST hop:
+    # the slice-crossing DCN wires gate every lap, so the flat forms
+    # are priced at the DCN rate (the single-tier pricing would call
+    # the flat ring ICI-fast on a topology where it never is)
+    flat = min(
+        ring_allreduce_us(payload_bytes, topo.n, dcn),
+        rs_ag_allreduce_us(payload_bytes, topo.n, dcn),
+    )
+    hier = hierarchical_allreduce_us(payload_bytes, topo, link, dcn)
+    if hier <= 0.0:
+        return math.inf if flat > 0 else 0.0
+    return flat / hier
+
+
 def rs_ag_crossover_bytes(n: int, link: LinkModel = LinkModel()) -> float:
     """Payload size where ``rs_ag`` overtakes ``ring``:
     ``alpha * beta * n / (n - 2)`` (from equating the two formulas).
@@ -171,25 +246,36 @@ def allreduce_candidates(
     payload_bytes: int,
     topo: TopologySpec,
     link: LinkModel = LinkModel(),
-    dcn: LinkModel = LinkModel(beta_bytes_per_s=DCN_BETA_BYTES_PER_S),
+    dcn: Optional[LinkModel] = None,
 ) -> List[Candidate]:
     """Modeled candidate table for an ADD allreduce, best first.
 
     Ties keep declaration order (``ring`` first): at a tie the fused
-    single collective wins — fewer launches, no epilogue.
+    single collective wins — fewer launches, no epilogue. The DCN tier
+    defaults to :func:`dcn_link_model` (env-resolved beta) at CALL
+    time, so ``$SMI_TPU_DCN_BETA`` reprices every table consistently.
     """
+    if dcn is None:
+        dcn = dcn_link_model()
     n = topo.n
+    # on a pod, a flat collective's lockstep laps are gated by the
+    # slice-crossing DCN wires — price the flat forms at that tier
+    # (see hierarchical_advantage); single-slice stays pure ICI
+    flat_link = dcn if topo.hierarchical_eligible else link
+    flat_note = (", every lap gated by DCN"
+                 if topo.hierarchical_eligible else "")
     cands = [
         Candidate(
             "ring", {"algorithm": "ring"},
-            modeled_us=ring_allreduce_us(payload_bytes, n, link),
-            note=f"1 collective, {n - 1} hops x full payload/link",
+            modeled_us=ring_allreduce_us(payload_bytes, n, flat_link),
+            note=f"1 collective, {n - 1} hops x full payload/link"
+                 + flat_note,
         ),
         Candidate(
             "rs_ag", {"algorithm": "rs_ag"},
-            modeled_us=rs_ag_allreduce_us(payload_bytes, n, link),
+            modeled_us=rs_ag_allreduce_us(payload_bytes, n, flat_link),
             note=f"2 phases, 2(n-1)/n = {2 * (n - 1) / n:.2f}x "
-                 f"payload/link",
+                 f"payload/link" + flat_note,
         ),
     ]
     if topo.hierarchical_eligible:

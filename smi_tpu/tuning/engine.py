@@ -58,6 +58,15 @@ from smi_tpu.tuning.seeded import seeded_cache
 #: enabling the model layer cannot change an untuned program.
 RS_AG_MODEL_MARGIN = 4.0
 
+#: Model-confidence margin for the two-tier gate: the model may engage
+#: (or veto) the hierarchical form only when its modeled advantage over
+#: the best flat form is at least this factor (either direction).
+#: Inside the band the conservative answer — today's flat path — wins
+#: until a sweep has measured the crossover. Single-slice topologies
+#: are never eligible at all, which is what keeps the untuned
+#: single-slice byte-identity invariant trivially intact.
+HIER_MODEL_MARGIN = 4.0
+
 
 def _valid_flash_block(v) -> bool:
     """A flash tile target the kernels can actually use: a positive
@@ -163,6 +172,29 @@ class PlanEngine:
         threshold, thr_layer = self.rs_ag_threshold(device_kind=dk)
         knobs["rs_ag_min_bytes"] = threshold
         decided["rs_ag_min_bytes"] = thr_layer
+        if topo.hierarchical_eligible:
+            hier, hier_layer = self.use_hierarchical(
+                payload_bytes, topo, dtype
+            )
+            knobs["hierarchical"] = hier
+            decided["hierarchical"] = hier_layer
+            thr = self.hier_threshold(topo.outer or 0)
+            if thr is not None:
+                rationale.append(
+                    f"two-tier gate: measured flat/hierarchical "
+                    f"crossover at {thr[0]} B for dcn{topo.outer} "
+                    f"(plan cache)"
+                )
+            else:
+                advantage = cm.hierarchical_advantage(
+                    payload_bytes, topo, link=self.link
+                )
+                rationale.append(
+                    f"two-tier gate: modeled advantage "
+                    f"{advantage:.2f}x over best flat (engages "
+                    f"outside the {HIER_MODEL_MARGIN:g}x confidence "
+                    f"band only)"
+                )
         return Plan(key=key, knobs=knobs, decided_by=decided,
                     candidates=cands, rationale=rationale)
 
@@ -227,9 +259,88 @@ class PlanEngine:
                     return False, "model"
             return payload_bytes >= thr, thr_layer
 
+        # exact bytes, not the bucket: the threshold/model comparisons
+        # are exact, so a bucket-wide memo would be first-call-wins
+        # for payloads straddling a crossover inside one bucket
         return self._memoized(
-            ("use_rs_ag", payload_bucket(payload_bytes), topo, dtype,
+            ("use_rs_ag", payload_bytes, topo, dtype,
              threshold, threshold_layer, dk),
+            compute,
+        )
+
+    def hier_threshold(
+        self, outer: int, device_kind: Optional[str] = None
+    ) -> Optional[Tuple[int, str]]:
+        """(bytes, "cache") of the measured flat/hierarchical
+        crossover for an ``outer``-slice pod, or ``None`` when no
+        sweep has persisted one. Written by
+        ``sweep.sweep_allreduce_hierarchical`` per (device kind,
+        slice count) — the ATLAS discipline: the crossover is a
+        measured artifact, not a frozen constant."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+
+        def compute():
+            for kind in (dk, "unknown"):
+                hit = self.cache.lookup(
+                    PlanKey("all_reduce", "hier_threshold", "", kind,
+                            f"dcn{outer}")
+                )
+                if hit is not None and "hier_min_bytes" in hit.knobs:
+                    return int(hit.knobs["hier_min_bytes"]), "cache"
+            return None
+
+        return self._memoized(("hier_threshold", outer, dk), compute)
+
+    def use_hierarchical(
+        self,
+        payload_bytes: int,
+        topo: cm.TopologySpec,
+        dtype: str = "float32",
+        min_slices: Optional[int] = None,
+        min_slices_layer: str = "env",
+    ) -> Tuple[bool, str]:
+        """Trace-time gate for the two-tier allreduce on an *eligible*
+        payload (ADD, hybrid multi-slice communicator, divisible
+        leading dim — structural eligibility is the caller's check).
+
+        ``min_slices`` given = the explicit ``$SMI_TPU_HIER_MIN_SLICES``
+        override — it decides ALONE (not even a measured cache entry
+        outranks the operator's word), mirroring the rs+ag env
+        semantics. Otherwise: per-bucket cache entry, then the
+        measured crossover threshold, then the model where its
+        advantage is confidently (:data:`HIER_MODEL_MARGIN`) away
+        from parity, then the conservative flat default.
+        """
+        dk = self.device_kind()
+
+        def compute():
+            if not topo.hierarchical_eligible:
+                return False, "heuristic"
+            if min_slices is not None:
+                return (topo.outer or 0) >= min_slices, min_slices_layer
+            key = PlanKey("all_reduce", payload_bucket(payload_bytes),
+                          dtype, dk, _collective_topology(topo))
+            hit = self.cache.lookup(key)
+            if hit is not None and "algorithm" in hit.knobs:
+                return hit.knobs["algorithm"] == "hierarchical", "cache"
+            thr = self.hier_threshold(topo.outer or 0)
+            if thr is not None:
+                return payload_bytes >= thr[0], "cache"
+            advantage = cm.hierarchical_advantage(
+                payload_bytes, topo, link=self.link
+            )
+            if advantage >= HIER_MODEL_MARGIN:
+                return True, "model"
+            if advantage and advantage <= 1.0 / HIER_MODEL_MARGIN:
+                return False, "model"
+            return False, "heuristic"
+
+        # keyed on EXACT bytes: the threshold/model branches compare
+        # exact payloads, so a bucket-wide memo would be
+        # first-call-wins for every other payload in the bucket
+        return self._memoized(
+            ("use_hier", payload_bytes, topo, dtype,
+             min_slices, min_slices_layer, dk),
             compute,
         )
 
@@ -353,16 +464,31 @@ class PlanEngine:
         n: int = 8,
         dtype: str = "float32",
         sizes_kb: Tuple[int, ...] = (4, 64, 1024, 16384),
+        slices: Optional[int] = None,
     ) -> str:
         """The ``smi-tpu tune --explain OP`` payload: candidate tables
         with modeled vs measured costs and the deciding layer per knob.
         Deterministic on CPU — no devices are touched beyond reading
-        the local device kind."""
+        the local device kind. ``slices >= 2`` models a multi-slice
+        pod: the all_reduce table then prices all THREE candidates
+        (flat ring / rs+ag / hierarchical) and names the two-tier
+        gate's deciding layer."""
         op = op.replace("-", "_")
         if op in ("all_reduce", "allreduce"):
-            topo = cm.TopologySpec(n=n)
+            if slices is not None and slices > 1:
+                if n % slices:
+                    raise ValueError(
+                        f"n={n} ranks do not split into {slices} slices"
+                    )
+                topo = cm.TopologySpec(n=n, inner=n // slices,
+                                       outer=slices)
+                where = (f"{slices} slices x {n // slices} "
+                         f"ranks (ICI x DCN pod)")
+            else:
+                topo = cm.TopologySpec(n=n)
+                where = f"n={n} ranks"
             parts = [
-                f"all_reduce over n={n} ranks, dtype={dtype}, device "
+                f"all_reduce over {where}, dtype={dtype}, device "
                 f"kind '{self.device_kind()}'"
             ]
             for kb in sizes_kb:
@@ -475,6 +601,29 @@ def planned_chunks(
         )[0]
     except Exception:
         return 1
+
+
+def planned_hierarchical(
+    payload_bytes: int,
+    n: int,
+    inner: int,
+    outer: int,
+    dtype: str,
+    min_slices: Optional[int] = None,
+) -> bool:
+    """Trace-time two-tier gate for an eligible ADD allreduce on a
+    hybrid multi-slice communicator. ``min_slices`` carries the
+    explicit ``$SMI_TPU_HIER_MIN_SLICES`` override. Never raises; the
+    fallback is today's flat path (False)."""
+    try:
+        return get_engine().use_hierarchical(
+            payload_bytes,
+            cm.TopologySpec(n=n, inner=inner, outer=outer),
+            dtype,
+            min_slices=min_slices,
+        )[0]
+    except Exception:
+        return False if min_slices is None else outer >= min_slices
 
 
 def planned_rs_ag(

@@ -115,6 +115,102 @@ def sweep_allreduce(
     return cache
 
 
+def sweep_allreduce_hierarchical(
+    comm,
+    sizes_kb: Sequence[int] = (64, 256, 1024, 4096),
+    runs: int = 5,
+    device_kind: Optional[str] = None,
+    verbose: bool = False,
+) -> PlanCache:
+    """Time flat vs two-tier allreduce per payload on a hybrid
+    multi-slice communicator; persist the winners per (slices,
+    payload bucket) and distill the measured crossover into the
+    ``hier_threshold`` entry — the ATLAS rule applied to the DCN
+    tier: the flat/hierarchical switch point is a swept artifact in
+    the plan cache, never a frozen constant. Entries are keyed by the
+    MEASURED device kind and the ``n{n}:dcn{slices}`` topology, so a
+    CPU sweep can neither shadow a v5e entry nor leak across pod
+    shapes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from smi_tpu.parallel import collectives as coll
+    from smi_tpu.ops.types import SmiOp
+
+    topo = cm.topology_from_comm(comm)
+    if not topo.hierarchical_eligible:
+        raise ValueError(
+            f"the hierarchical sweep needs a multi-slice hybrid "
+            f"communicator (make_hybrid_communicator); got axes "
+            f"{comm.axis_names} with sizes {comm.axis_sizes}"
+        )
+    n, inner, outer = topo.n, topo.inner, topo.outer
+    dk = normalize_device_kind(
+        device_kind or jax.devices()[0].device_kind
+    )
+    spec = P(tuple(comm.axis_names))
+    cache = PlanCache()
+    hier_wins = []   # payload bytes where the two-tier form measured best
+
+    for kb in sizes_kb:
+        elems = max(inner, (kb * 1024 // 4) // inner * inner)
+        payload_bytes = elems * 4
+
+        def make(hierarchical: bool):
+            def shard_fn(x):
+                y = coll.allreduce(x, comm, hierarchical=hierarchical)
+                return jnp.sum(y)[None]
+
+            fn = jax.jit(jax.shard_map(
+                shard_fn, mesh=comm.mesh, in_specs=P(),
+                out_specs=spec, check_vma=False,
+            ))
+            return lambda x: np.asarray(fn(x))
+
+        x = jnp.ones(elems, jnp.float32)
+        results = []
+        for hierarchical in (False, True):
+            secs = _measure(make(hierarchical), x, runs)
+            results.append((secs, hierarchical))
+            if verbose:
+                name = "hierarchical" if hierarchical else "flat"
+                print(f"  {kb:>7} KiB {name:>12}: {secs * 1e6:.1f} us")
+        secs, hierarchical = min(results)
+        if hierarchical:
+            hier_wins.append(payload_bytes)
+            algo = "hierarchical"
+        else:
+            # name the flat form the gate would actually run at this
+            # payload, so the entry stays one of the three candidates
+            algo = ("rs_ag" if coll._use_rs_ag(x, comm, SmiOp.ADD, None)
+                    else "ring")
+        key = PlanKey("all_reduce", payload_bucket(payload_bytes),
+                      "float32", dk, _collective_topology(topo))
+        cache.put(key, CacheEntry(
+            {"algorithm": algo},
+            cost_us=secs * 1e6,
+            provenance=f"sweep:allreduce-hier:{kb}KiB:"
+                       f"{outer}x{inner}",
+        ))
+
+    if hier_wins:
+        # the SMALLEST payload the two-tier form won at, regardless of
+        # --sizes-kb iteration order — the measured crossover the
+        # trace-time gate consults between per-bucket entries
+        cache.put(
+            PlanKey("all_reduce", "hier_threshold", "", dk,
+                    f"dcn{outer}"),
+            CacheEntry(
+                {"hier_min_bytes": int(min(hier_wins))},
+                cost_us=None,
+                provenance=f"sweep:hier-crossover:{outer}x{inner}",
+            ),
+        )
+    return cache
+
+
 def sweep_flash(
     s: int = 8192,
     d: int = 128,

@@ -145,6 +145,58 @@ def test_robustness_doc_quotes_elastic_config():
     assert f"${checkpoint.DIR_ENV}" in text
 
 
+def test_two_tier_docs_quote_the_shipped_rates_and_gates():
+    """The r6 two-tier sections (docs/tuning.md decision table,
+    docs/perf_notes.md "Two-tier collectives (r6)") must state the
+    tier rates, env override names, and confidence margin the code
+    ships — the same drift discipline as every other table. (Pure
+    Python imports: cost_model and engine constants, no devices.)"""
+    from smi_tpu.tuning import cost_model as cm
+    from smi_tpu.tuning.engine import HIER_MODEL_MARGIN
+
+    tuning = _read("docs/tuning.md")
+    notes = _read("docs/perf_notes.md")
+    assert "Two-tier collectives (r6)" in notes
+    for text in (tuning, notes):
+        assert f"{cm.V5E_ICI_BETA_BYTES_PER_S / 1e9:g} GB/s" in text
+        assert f"{cm.DCN_BETA_BYTES_PER_S / 1e9:g} GB/s" in text
+        assert f"{cm.DCN_ALPHA_S * 1e6:g} us" in text
+        assert f"${cm.DCN_BETA_ENV}" in text
+    # the three candidates and the gate ladder live in the table
+    for name in ("ring", "rs_ag", "hierarchical"):
+        assert name in tuning
+    assert "SMI_TPU_HIER_MIN_SLICES" in tuning
+    assert f"{HIER_MODEL_MARGIN:g}x" in tuning
+
+
+def test_two_tier_docs_quote_the_simulated_wallclock(monkeypatch):
+    """The quoted 2x2-pod wall-clock numbers are re-derived from the
+    deterministic credits simulator, so the docs can never drift from
+    what the tier-1 assertion actually measures. (Pure Python — the
+    simulator and cost model import no JAX.) The docs quote the
+    PUBLISHED rates, so a fleet $SMI_TPU_DCN_BETA must not leak into
+    the recomputation."""
+    from smi_tpu.parallel import credits as C
+    from smi_tpu.tuning import cost_model as cm
+
+    monkeypatch.delenv(cm.DCN_BETA_ENV, raising=False)
+    rep = C.pod_wallclock_comparison(2, 2, 4 << 20)
+    flat_us = f"{round(rep['flat_s'] * 1e6, 1):g}"
+    hier_us = f"{round(rep['hierarchical_s'] * 1e6, 1):g}"
+    speedup = f"{rep['flat_s'] / rep['hierarchical_s']:.1f}x"
+    for name in ("docs/tuning.md", "docs/perf_notes.md"):
+        text = _read(name)
+        assert flat_us in text, (
+            f"{name} does not quote the simulated flat wall-clock "
+            f"{flat_us} us — regenerate the two-tier numbers"
+        )
+        assert hier_us in text, (
+            f"{name} does not quote the simulated two-tier wall-clock "
+            f"{hier_us} us — regenerate the two-tier numbers"
+        )
+    assert speedup in _read("docs/perf_notes.md")
+
+
 def test_tuning_doc_quotes_the_seeded_knobs():
     """docs/tuning.md's decision table must state the seeded values the
     code ships (block tiles, depth, threshold) — the table is the
