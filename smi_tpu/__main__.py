@@ -176,6 +176,8 @@ def _route_check(args: argparse.Namespace, topology, ctx) -> int:
         rc = max(rc, _check_slices(args.slices, topology, ctx,
                                    excluded, healthy,
                                    routes_ok=rc == 0))
+    if getattr(args, "lint", False):
+        rc = max(rc, _check_lint(getattr(args, "slices", None), healthy))
     if args.hostfile:
         try:
             with open(args.hostfile) as f:
@@ -381,6 +383,77 @@ def _check_slices(n_slices: int, topology, ctx, excluded, healthy,
     return rc
 
 
+def _check_lint(n_slices, healthy) -> int:
+    """``route --check --lint``: statically verify the protocols the
+    plan engine would select for this topology.
+
+    After reachability has passed, the remaining launch risk is the
+    *protocol* tier: the collectives the plan engine will pick for this
+    shape (the four base rings plus the chunked pipeline on any
+    topology; the two-tier pod protocol when ``--slices`` declares one)
+    must be deadlock- and race-free at this rank count — so a
+    misconfigured pod fails at check time, not trace time. Rank counts
+    above ``analysis.MAX_LINT_N`` verify a representative instance (the
+    protocols are size-generic); the output names the shape used.
+    """
+    from smi_tpu import analysis
+    from smi_tpu.parallel import faults
+
+    n = len(healthy)
+    if n < 2:
+        print("lint: skipped (needs >= 2 healthy devices)")
+        return 0
+    vn = min(n, analysis.MAX_LINT_N)
+    # derive the job list from the registries the verifier itself
+    # covers — a protocol added to faults.PROTOCOLS/CHUNKED_PROTOCOLS
+    # joins the launch gate without this list needing to remember it
+    jobs = [
+        (p, {"n": vn})
+        for p in faults.PROTOCOLS + faults.CHUNKED_PROTOCOLS
+    ]
+    if n_slices and n_slices > 1:
+        if n % n_slices:
+            print(
+                f"lint: FAIL — {n} healthy devices do not divide into "
+                f"{n_slices} slices; the pod protocol cannot shape"
+            )
+            return 1
+        per = n // n_slices
+        pod_slices = n_slices
+        if pod_slices * per > analysis.MAX_LINT_N:
+            # keep the declared slice STRUCTURE whenever it fits the
+            # budget: shrink the per-slice ring first, the slice count
+            # only as a last resort — a defect that needs an odd slice
+            # count must not vanish behind a 2-slice cap
+            per = min(per, 2)
+            if pod_slices * per > analysis.MAX_LINT_N:
+                pod_slices = max(2, analysis.MAX_LINT_N // per)
+        jobs.extend(
+            (p, {"n": pod_slices * per, "slices": pod_slices})
+            for p in faults.POD_PROTOCOLS
+        )
+    rc = 0
+    for protocol, shape in jobs:
+        report = analysis.verify_protocol(protocol, **shape)
+        if not report.ok:
+            print("lint: FAIL — " + report.describe())
+            rc = 1
+    if not rc:
+        # name each protocol's ACTUAL verified shape — a capped pod
+        # must read as the representative it is, not as the full size
+        names = ", ".join(
+            p if shape == {"n": vn} else
+            p + "[" + ", ".join(f"{k}={v}"
+                                for k, v in sorted(shape.items())) + "]"
+            for p, shape in jobs
+        )
+        print(
+            f"lint: ok ({len(jobs)} protocols statically verified at "
+            f"n={vn}: {names})"
+        )
+    return rc
+
+
 def cmd_route(args: argparse.Namespace) -> int:
     from smi_tpu.parallel.routing import (
         NoRouteFound,
@@ -393,11 +466,12 @@ def cmd_route(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     if not args.check and (args.down or args.hostfile
-                           or getattr(args, "slices", None) is not None):
+                           or getattr(args, "slices", None) is not None
+                           or getattr(args, "lint", False)):
         # writing healthy tables while silently ignoring a declared
         # failure set would hand the launcher routes over dead wires
-        print("error: --down/--hostfile/--slices only apply with "
-              "--check", file=sys.stderr)
+        print("error: --down/--hostfile/--slices/--lint only apply "
+              "with --check", file=sys.stderr)
         return 2
     if args.check and args.dest_dir is not None:
         # in check mode there is no output directory: the second
@@ -874,6 +948,98 @@ def _cmd_chaos_elastic(args: argparse.Namespace) -> int:
     return 0 if report["ok"] else 1
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """``smi-tpu lint``: the static protocol verifier as a merge gate.
+
+    Verifies every registered protocol (or the ``--protocol`` subset)
+    over the default shape grid: deadlock-freedom, slot-race-freedom,
+    credit conservation, and wire-lane monotonicity, proven for the
+    WHOLE schedule space from one symbolic replay per rank
+    (:mod:`smi_tpu.analysis`). Pure Python — no JAX, no devices,
+    milliseconds — so CI gates merges on it the way the reference's
+    codegen rejects ill-formed programs before anything runs. Exit is
+    nonzero on any finding; ``--json`` emits the schema-tested report.
+
+    ``--mutant`` applies one deliberately broken variant
+    (:data:`smi_tpu.analysis.MUTANTS`) across the protocol's whole
+    default shape grid before verifying — the demonstration (and test)
+    path for the nonzero exit and the diagnostics' (rank, step,
+    primitive) coordinates. A mutant absorbed at every default shape
+    (possible: some damage is benign at small sizes) exits 0 with an
+    explicit note, never a silent ok.
+    """
+    from smi_tpu import analysis
+
+    if args.all and args.protocol:
+        # silently dropping the filter (or the --all) would let a CI
+        # caller believe a different gate ran than the one that did
+        print("error: --all and --protocol are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.mutant:
+            if not args.protocol:
+                print("error: --mutant needs --protocol NAME",
+                      file=sys.stderr)
+                return 2
+            if args.mutant not in analysis.MUTANTS:
+                print(f"error: unknown mutant {args.mutant!r}; known: "
+                      f"{list(analysis.MUTANTS)}", file=sys.stderr)
+                return 2
+            unknown = [p for p in args.protocol
+                       if p not in analysis.DEFAULT_SHAPES]
+            if unknown:
+                # same diagnostic as the non-mutant path — a typo must
+                # not surface as a bare KeyError repr
+                print(f"error: unknown protocol(s) {unknown}; known: "
+                      f"{list(analysis.DEFAULT_SHAPES)}",
+                      file=sys.stderr)
+                return 2
+            # sweep the protocol's WHOLE default shape grid, like the
+            # non-mutant path: some protocol x mutant pairs are benign
+            # at one size but fire at another
+            reports = []
+            for protocol in args.protocol:
+                for shape in analysis.DEFAULT_SHAPES[protocol]:
+                    shape = dict(shape)
+                    reports.append(analysis.verify_generators(
+                        lambda p=protocol, s=shape:
+                            analysis.mutant_generators(
+                                p, mutant=args.mutant, **s
+                            ),
+                        protocol=f"{protocol}[{args.mutant}]",
+                        shape=shape,
+                    ))
+        else:
+            protocols = None if args.all else (args.protocol or None)
+            reports = analysis.lint_all(protocols=protocols)
+    except (ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    payload = analysis.reports_to_json(reports)
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    else:
+        print(analysis.render_reports(reports))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        if not args.json:
+            print(f"report -> {args.out}")
+    if args.mutant and payload["ok"]:
+        # an ok mutant run must not read as "the gate is broken" —
+        # the injected damage is genuinely absorbed at every default
+        # shape of this protocol (the dynamic fuzzer agrees)
+        print(
+            f"note: mutant {args.mutant!r} did not manifest at any "
+            f"default shape of {list(args.protocol)} — the damage is "
+            f"benign at these sizes, not missed by the verifier",
+            file=sys.stderr,
+        )
+    return 0 if payload["ok"] else 1
+
+
 def cmd_traffic(args: argparse.Namespace) -> int:
     """Offline traffic/overlap analysis of an HLO text dump.
 
@@ -895,6 +1061,23 @@ def cmd_traffic(args: argparse.Namespace) -> int:
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    if getattr(args, "lint", False):
+        if args.overlap or args.require_overlap:
+            # silently dropping either flag would let a CI caller
+            # believe a gate ran that never did
+            print("error: --lint and --overlap/--require-overlap are "
+                  "separate modes", file=sys.stderr)
+            return 2
+        findings = T.traffic_lint(hlo_text=text)
+        for f in findings:
+            print(f"[{f['check']}] {f['message']}")
+        print(f"{len(findings)} lint finding(s)")
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump({"lint": findings}, fh, indent=2)
+                fh.write("\n")
+            print(f"report -> {args.out}")
+        return 1 if findings else 0
     if args.overlap:
         report = T.overlap_report(hlo_text=text)
         print(
@@ -1173,6 +1356,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "and every slice's loss must leave a flat-ring "
                         "fallback over the survivors, naming the slice "
                         "that doesn't")
+    p.add_argument("--lint", action="store_true",
+                   help="with --check: after reachability, run the "
+                        "static protocol verifier on the protocols the "
+                        "plan engine would select for this topology "
+                        "(the base rings + chunked pipeline; the pod "
+                        "protocol too with --slices) — a misconfigured "
+                        "pod fails at check time, not trace time")
     p.set_defaults(fn=cmd_route)
 
     p = sub.add_parser(
@@ -1285,6 +1475,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="report compute schedulable (sync modules) or "
                         "scheduled (async pairs) during the "
                         "collectives instead of payload records")
+    p.add_argument("--lint", action="store_true",
+                   help="lint the artifact instead: flag sync "
+                        "collectives gating all compute, collectives "
+                        "inside loop bodies, and P2P channels missing "
+                        "verified-transport framing; exit nonzero on "
+                        "any finding")
     p.add_argument("--require-overlap", action="store_true",
                    help="exit nonzero when the report finds no "
                         "overlap (with --overlap) or no collectives — "
@@ -1292,6 +1488,35 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--out", default=None,
                    help="write the full JSON report here")
     p.set_defaults(fn=cmd_traffic)
+
+    p = sub.add_parser(
+        "lint",
+        help="static protocol verifier: prove deadlock-freedom, "
+             "slot-race-freedom, credit conservation, and wire-lane "
+             "monotonicity over the whole schedule space of every "
+             "registered protocol (pure Python, no devices); exit "
+             "nonzero on any finding",
+    )
+    p.add_argument("--protocol", action="append", default=None,
+                   metavar="NAME",
+                   help="verify only this protocol (repeatable; "
+                        "default: every registered protocol — the "
+                        "four base rings, the chunked pipeline, the "
+                        "two-tier pod)")
+    p.add_argument("--all", action="store_true",
+                   help="verify every registered protocol (the "
+                        "default when no --protocol is given)")
+    p.add_argument("--mutant", default=None, metavar="NAME",
+                   help="apply a deliberately broken variant before "
+                        "verifying (dropped_wait, reused_slot, "
+                        "unbalanced_grant, late_grant) — demonstrates "
+                        "the nonzero exit and the named diagnostics; "
+                        "needs --protocol")
+    p.add_argument("--json", action="store_true",
+                   help="print the JSON report instead of text")
+    p.add_argument("-o", "--out", default=None,
+                   help="also write the JSON report here")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser(
         "tune",

@@ -1,0 +1,39 @@
+"""Static verification of the credits protocol zoo.
+
+The compile-time correctness tier: :mod:`.verifier` proves
+deadlock-freedom, slot-race-freedom, credit conservation, and wire-lane
+monotonicity over every schedule of a registered protocol from a single
+symbolic replay per rank (happens-before analysis — Lamport CACM'78,
+Eraser SOSP'97; see PAPERS.md); :mod:`.mutants` ships the broken
+variants that prove the checks can fail. Pure Python — no JAX, no
+devices — so ``smi-tpu lint`` runs anywhere in milliseconds and CI can
+gate merges on it. The dynamic schedule fuzzer
+(``credits.explore_all_schedules``) and the chaos campaigns remain the
+authority on *faulted* behaviour; ``docs/analysis.md`` states exactly
+what each tier does and does not prove.
+"""
+
+from smi_tpu.analysis.verifier import (  # noqa: F401
+    CHECKS,
+    DEFAULT_SHAPES,
+    MAX_LINT_N,
+    AnalysisError,
+    CreditConservation,
+    Finding,
+    SlotRace,
+    StaticDeadlock,
+    StaticReport,
+    VerifyEvent,
+    WireLaneViolation,
+    build_generators,
+    lint_all,
+    render_reports,
+    reports_to_json,
+    symbolic_events,
+    verify_generators,
+    verify_protocol,
+)
+from smi_tpu.analysis.mutants import (  # noqa: F401
+    MUTANTS,
+    mutant_generators,
+)

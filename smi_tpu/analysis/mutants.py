@@ -1,0 +1,181 @@
+"""Deliberately broken protocol variants — the verifier's existence proof.
+
+A verifier that has never caught a bug proves nothing, so each mutant
+here reinstates one of the defect classes the credit discipline exists
+to prevent, expressed as an *event-stream transformer* wrapped around a
+healthy rank's generator (the same adapter shape as
+``credits.instance_steps``/``verified_steps``, so the clean state
+machines stay untouched):
+
+- :func:`drop_grant` — ``"dropped_wait"``: the credit grant a partner's
+  semaphore wait is matched against is dropped, leaving that wait
+  dangling forever. Statically a :class:`~.verifier.StaticDeadlock`
+  (the starved wait and the ranks transitively blocked behind it);
+  dynamically the exhaustive fuzzer's
+  :class:`~smi_tpu.parallel.credits.DeadlockError` on every schedule.
+- :func:`reuse_slots` — ``"reused_slot"``: two comm buffers collapse to
+  one VMEM address (an addressing/codegen bug): DMA destinations and
+  local reads/writes are remapped while the semaphore wiring stays
+  intact. Statically a :class:`~.verifier.SlotRace` naming both
+  accesses; dynamically a
+  :class:`~smi_tpu.parallel.credits.ClobberError` (or wrong delivery)
+  under the schedules that interleave the aliased writes.
+- :func:`duplicate_grant` — ``"unbalanced_grant"``: one credit grant is
+  signalled twice. Statically a
+  :class:`~.verifier.CreditConservation` finding naming the surplus
+  domain; dynamically
+  :class:`~smi_tpu.parallel.credits.CreditLeakError` at exit (or a
+  clobber when a schedule spends the surplus early).
+- :func:`delay_grant` — ``"late_grant"``: every rank holds its credit
+  grant until after its own wait — the neighbour handshake becomes a
+  genuine cross-rank wait-for *cycle* (every grant still exists; no
+  wait is starved), which the deadlock check must report as the
+  minimal cycle of (rank, step, primitive) events.
+
+``tests/test_analysis.py``'s differential harness runs every mutant
+through BOTH tiers and asserts the verdicts agree — same defect class,
+same named events — on every space the dynamic fuzzer can exhaust.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, List
+
+from smi_tpu.parallel import credits as C
+
+from smi_tpu.analysis.verifier import build_generators
+
+
+def _transformed(gen: Iterator, fn: Callable[[tuple], List[tuple]]):
+    """Apply ``fn`` (action -> replacement actions, possibly empty or
+    duplicated) to one rank's stream, staying ``send``-transparent for
+    ``read_slot`` feedback."""
+    value = None
+    while True:
+        try:
+            action = gen.send(value)
+        except StopIteration:
+            return
+        value = None
+        for out in fn(action):
+            value = yield out
+
+
+def drop_grant(gen: Iterator, nth: int = 0):
+    """Drop the ``nth`` credit grant this rank signals — the matched
+    downstream wait can never complete (the 'dropped wait')."""
+    state = {"k": 0}
+
+    def fn(action):
+        if action[0] == "signal" and action[2] == C.SEM_CREDIT:
+            k = state["k"]
+            state["k"] += 1
+            if k == nth:
+                return []
+        return [action]
+
+    return _transformed(gen, fn)
+
+
+def duplicate_grant(gen: Iterator, nth: int = 0):
+    """Signal the ``nth`` credit grant twice — a surplus unit the
+    protocol never consumes (or spends on an RDMA it had no right to)."""
+    state = {"k": 0}
+
+    def fn(action):
+        if action[0] == "signal" and action[2] == C.SEM_CREDIT:
+            k = state["k"]
+            state["k"] += 1
+            if k == nth:
+                return [action, action]
+        return [action]
+
+    return _transformed(gen, fn)
+
+
+def reuse_slots(gen: Iterator, slot_map: Callable[[int], int]):
+    """Remap the physical slot ADDRESS of every dma / read / write
+    while leaving semaphore indices untouched — aliased scratch, the
+    realistic codegen bug where two logical buffers share one VMEM
+    address."""
+
+    def fn(action):
+        kind = action[0]
+        if kind == "dma":
+            _, target, slot, payload, si, ri = action
+            return [("dma", target, slot_map(slot), payload, si, ri)]
+        if kind == "read_slot":
+            return [("read_slot", slot_map(action[1]))]
+        if kind == "write_slot":
+            return [("write_slot", slot_map(action[1]), action[2])]
+        return [action]
+
+    return _transformed(gen, fn)
+
+
+def delay_grant(gen: Iterator, nth: int = 0):
+    """Hold this rank's ``nth`` credit grant until after its next wait
+    has completed. Applied to EVERY rank (a shared scheduling bug),
+    each rank then waits for a grant its neighbour is holding behind
+    the same wait — a genuine cross-rank wait-for CYCLE, not a
+    starvation: every grant still exists in some remaining sequence."""
+    state = {"k": 0, "held": None}
+
+    def fn(action):
+        if action[0] == "signal" and action[2] == C.SEM_CREDIT:
+            k = state["k"]
+            state["k"] += 1
+            if k == nth:
+                state["held"] = action
+                return []
+        out = [action]
+        if action[0] == "wait" and state["held"] is not None:
+            out.append(state["held"])
+            state["held"] = None
+        return out
+
+    return _transformed(gen, fn)
+
+
+#: Mutant registry. The first three are the acceptance matrix
+#: (dropped wait -> StaticDeadlock, reused slot -> slot race,
+#: unbalanced grant -> credit-conservation); ``late_grant`` is the
+#: cyclic-deadlock shape (a wait-for cycle rather than a starved wait).
+MUTANTS = ("dropped_wait", "reused_slot", "unbalanced_grant",
+           "late_grant")
+
+
+def mutant_generators(protocol: str, n: int, mutant: str,
+                      chunks: int = 3, slices: int = 2,
+                      rank: int = 0, nth: int = 0) -> List[Iterator]:
+    """Per-rank generators of ``protocol`` with one mutant applied.
+
+    ``dropped_wait`` / ``unbalanced_grant`` damage a single ``rank``
+    (a one-rank firmware bug); ``reused_slot`` and ``late_grant``
+    apply to EVERY rank (the compiled kernel is shared, so an
+    addressing or scheduling bug ships to all of them).
+    """
+    gens = build_generators(protocol, n, chunks=chunks, slices=slices)
+    if mutant == "dropped_wait":
+        gens[rank] = drop_grant(gens[rank], nth=nth)
+    elif mutant == "unbalanced_grant":
+        gens[rank] = duplicate_grant(gens[rank], nth=nth)
+    elif mutant == "late_grant":
+        gens = [delay_grant(g, nth=nth) for g in gens]
+    elif mutant == "reused_slot":
+        if protocol == "all_reduce_chunked":
+            slot_map = lambda s: s % 2  # noqa: E731 — collapse the pairs
+        elif protocol == "allreduce_pod":
+            # collapse phase A's double buffer only: the CROSS-phase
+            # addresses are genuinely barrier-protected (aliasing them
+            # is race-free — the verifier proves it), so the mutant
+            # aliases within a phase where only the credits protect
+            slot_map = lambda s: 0 if s < 2 else s  # noqa: E731
+        else:
+            slot_map = lambda s: 0  # noqa: E731 — both buffers at addr 0
+        gens = [reuse_slots(g, slot_map) for g in gens]
+    else:
+        raise ValueError(
+            f"unknown mutant {mutant!r}; known: {MUTANTS}"
+        )
+    return gens

@@ -1404,21 +1404,58 @@ class RingSimulator:
 # ---------------------------------------------------------------------------
 
 
+class ScheduleCount(int):
+    """The count :func:`explore_all_schedules` returns, with coverage.
+
+    Behaves as the plain ``int`` it always was (``explored`` complete
+    schedules), plus the no-silent-caps bookkeeping:
+
+    - ``explored`` — complete schedules verified (== ``int(self)``);
+    - ``truncated`` — True when the budget stopped the DFS before the
+      space was exhausted;
+    - ``frontier`` — unexplored branch prefixes remaining at the stop
+      (each leads to >= 1 further schedule);
+    - ``estimated_total`` — the space size this run can attest:
+      exactly ``explored`` when the DFS completed, else the LOWER BOUND
+      ``explored + frontier`` (the true total is usually far larger —
+      the bound is what one truncated run can honestly claim).
+    """
+
+    explored: int
+    truncated: bool
+    frontier: int
+    estimated_total: int
+
+    def __new__(cls, explored: int, truncated: bool = False,
+                frontier: int = 0):
+        self = super().__new__(cls, explored)
+        self.explored = explored
+        self.truncated = truncated
+        self.frontier = frontier
+        self.estimated_total = explored + frontier
+        return self
+
+
 def explore_all_schedules(make_generators: Callable[[], Sequence[Iterator]],
                           max_schedules: int = 200_000,
-                          allow_budget: bool = False) -> int:
+                          allow_budget: bool = False) -> "ScheduleCount":
     """Depth-first over *every* scheduler choice for a tiny configuration.
 
     Re-instantiates the generators per path (generators are single-shot),
     replaying a prefix of choices then branching. Returns the number of
-    complete schedules explored; raises on any invariant violation.
+    complete schedules explored — a :class:`ScheduleCount`, an ``int``
+    subclass carrying explored/estimated-total coverage — and raises on
+    any invariant violation.
 
     ``allow_budget=True`` turns budget exhaustion from an error into a
     clean return of the count: the caller asserts "the first
     ``max_schedules`` schedules in deterministic DFS order all hold"
     — the honest claim for composites whose full space is beyond
     exhaustive reach (the 4-rank two-tier pod, the 2x2 halo), where
-    exceeding the budget is the expected outcome, not a test bug.
+    exceeding the budget is the expected outcome, not a test bug. A
+    truncating budget is never silent: the returned count has
+    ``truncated=True`` and a ``RuntimeWarning`` states how much of the
+    space the run actually covered.
     """
 
     class _Replay(Strategy):
@@ -1453,7 +1490,30 @@ def explore_all_schedules(make_generators: Callable[[], Sequence[Iterator]],
         explored += 1
         if explored >= max_schedules:
             if allow_budget:
-                return explored
+                # "no silent caps": the pending frontier bounds what
+                # was NOT covered — say so loudly instead of letting a
+                # capped DFS read as full coverage
+                frontier = len(stack) + sum(
+                    len(alts) for i, alts in strategy.branch_points
+                    if i >= len(prefix)
+                )
+                if frontier:
+                    import warnings
+
+                    warnings.warn(
+                        f"explore_all_schedules: budget of "
+                        f"{max_schedules} truncated the space after "
+                        f"{explored} schedules; >= "
+                        f"{explored + frontier} exist ({frontier} "
+                        f"unexplored branch prefixes remain) — the "
+                        f"verified claim is 'the first {explored} "
+                        f"schedules in DFS order hold', NOT full "
+                        f"coverage",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                return ScheduleCount(explored, truncated=bool(frontier),
+                                     frontier=frontier)
             raise ProtocolError(
                 f"exploration budget exceeded ({max_schedules} schedules)"
             )
@@ -1461,7 +1521,7 @@ def explore_all_schedules(make_generators: Callable[[], Sequence[Iterator]],
             if i >= len(prefix):  # only branch beyond the replayed prefix
                 for alt in alternatives:
                     stack.append(strategy.trace[:i] + [alt])
-    return explored
+    return ScheduleCount(explored)
 
 
 # ---------------------------------------------------------------------------

@@ -201,7 +201,7 @@ def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
                     rec = {
                         "op": "megascale-send", "name": ms.group("name"),
                         "dtype": dt, "elements": el, "bytes": by,
-                        "megascale": True,
+                        "megascale": True, "computation": cur_comp,
                     }
                     if cur_comp in loop_comps:
                         rec["in_loop"] = True
@@ -267,6 +267,7 @@ def collective_traffic(compiled, hlo_text: Optional[str] = None) -> List[dict]:
             "dtype": max(selected, key=lambda t: t[2])[0],
             "elements": sum(e for _, e, _ in selected),
             "bytes": sum(b for _, _, b in selected),
+            "computation": cur_comp,
         }
         if cur_comp in loop_comps:
             rec["in_loop"] = True
@@ -468,7 +469,8 @@ def overlap_report(compiled=None, hlo_text: Optional[str] = None) -> dict:
         if not coll_names:
             continue
         compute = [n for n in order if ops[n] not in _NON_COMPUTE_OPS]
-        compute_bytes += sum(comp["bytes"][n] for n in compute)
+        comp_compute_bytes = sum(comp["bytes"][n] for n in compute)
+        compute_bytes += comp_compute_bytes
         windowed_names: Set[str] = set()
         # the strict bucket: compute linked to NO collective at all —
         # upstream of none (not operand prep), downstream of none (not
@@ -490,6 +492,11 @@ def overlap_report(compiled=None, hlo_text: Optional[str] = None) -> dict:
                 "name": name,
                 "computation": comp_name,
                 "async": is_start,
+                # total compute in the surrounding computation: the
+                # denominator that tells "nothing to overlap" (0) apart
+                # from "overlap impossible" (>0 but fully dependent) —
+                # what traffic_lint's sync-no-overlap rule needs
+                "computation_compute_bytes": comp_compute_bytes,
             }
             # per-collective freedom: neither upstream nor downstream
             # of THIS collective (looser than free_all — operand prep
@@ -709,3 +716,155 @@ def predicted_program_us(
         predicted_us(collective_wire_bytes(r), link_bytes_per_s)
         for r in records
     )
+
+
+# ---------------------------------------------------------------------------
+# HLO lint tier
+# ---------------------------------------------------------------------------
+
+#: The lint rules ``traffic_lint`` applies — documented one-for-one in
+#: docs/analysis.md (drift-guarded by tests/test_perf_docs).
+TRAFFIC_LINT_CHECKS = ("sync-no-overlap", "collective-in-loop",
+                      "unframed-channel")
+
+#: ``unframed-channel`` abstains on groups whose largest record is at
+#: most this many bytes: at header scale a payload and a frame header
+#: are the same shape, so the rule cannot classify them.
+_UNFRAMED_MIN_BYTES = 64
+
+#: A frame-header candidate must be at most 1/this of the payload it
+#: vouches for — a real ``transfer_verified`` header is one s32 per
+#: chunk (4 B per >=chunk_elements-element chunk), far below this; two
+#: similarly-sized bare s32 transfers stay above it and both get
+#: flagged instead of silently clearing each other.
+_UNFRAMED_HEADER_RATIO = 8
+
+
+def traffic_lint(compiled=None, hlo_text: Optional[str] = None) -> List[dict]:
+    """Lint a compiled artifact's collective usage.
+
+    The static-artifact counterpart of the protocol verifier: each rule
+    flags a pattern that costs real wall-clock or durability at serving
+    scale, checkable from ``compiled.as_text()`` alone:
+
+    - ``sync-no-overlap`` — a sync collective in a computation that HAS
+      compute, yet no compute is independent of it
+      (``overlap_report``'s pairing): the transfer serializes the whole
+      step, the exact shape the overlap engine (PR 3) exists to fix. A
+      computation with no compute at all is NOT flagged — there is
+      nothing to overlap.
+    - ``collective-in-loop`` — a collective inside a ``while`` body: it
+      is re-traced per iteration, its traffic is invisible to volume
+      accounting (``in_loop`` records under-count by the trip count),
+      and ``executable_report`` must withhold predicted wall-clock.
+      Hoist or unroll it.
+    - ``unframed-channel`` — a P2P channel payload (a single-pair
+      ``collective-permute``) with no verified-transport frame header
+      riding the same route. A framed transfer
+      (``P2PChannel.transfer_verified``) moves its s32 checksum vector
+      over an identical source-target pair in the same computation, at
+      most ``1/_UNFRAMED_HEADER_RATIO`` of the payload's bytes; a bare
+      payload is silent-corruption surface (the PR 2 fault matrix's
+      existence proof). Every record of an unframed group is flagged
+      (two bare transfers on one route are two findings, and two bare
+      s32 transfers cannot clear each other as pseudo-headers).
+      Multi-pair permutes (ring shifts, halo exchanges) are NOT
+      channels and are not flagged, and groups at or below
+      ``_UNFRAMED_MIN_BYTES`` are skipped — at header scale payload
+      and header are indistinguishable by shape.
+
+    Returns one dict per finding: ``{"check", "name", "op", "bytes",
+    "message"}`` (empty list = clean) — the ``smi-tpu traffic --lint``
+    payload, exit-nonzero-on-findings at the CLI.
+    """
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    findings: List[dict] = []
+    records = collective_traffic(None, hlo_text=hlo_text)
+
+    report = overlap_report(hlo_text=hlo_text)
+    for rec in report["per_collective"]:
+        if rec["async"]:
+            continue
+        if (rec["computation_compute_bytes"] > 0
+                and rec["independent_bytes"] == 0):
+            findings.append({
+                "check": "sync-no-overlap",
+                "name": rec["name"],
+                "op": rec["op"],
+                "bytes": 0,
+                "message": (
+                    f"sync {rec['op']} %{rec['name']} gates every "
+                    f"compute instruction in its computation "
+                    f"({rec['computation_compute_bytes']} B of compute, "
+                    f"0 B independent) — the transfer cannot overlap "
+                    f"anything; restructure so some compute is free of "
+                    f"it (see overlap_report)"
+                ),
+            })
+
+    for rec in records:
+        if rec.get("in_loop"):
+            findings.append({
+                "check": "collective-in-loop",
+                "name": rec["name"],
+                "op": rec["op"],
+                "bytes": rec["bytes"],
+                "message": (
+                    f"{rec['op']} %{rec['name']} sits inside a while "
+                    f"body: it runs trip-count times per occurrence, "
+                    f"its volume is under-counted by traffic "
+                    f"accounting, and predicted wall-clock is withheld "
+                    f"— hoist it out of the loop or scale its budget "
+                    f"by the trip count explicitly"
+                ),
+            })
+
+    by_pairs: Dict[tuple, List[dict]] = {}
+    for rec in records:
+        pairs = rec.get("pairs")
+        if rec["op"] == "collective-permute" and pairs:
+            # a header only vouches for a payload in its OWN
+            # computation — an unrelated framed transfer elsewhere in
+            # the module must not clear this one
+            by_pairs.setdefault(
+                (rec.get("computation"),
+                 tuple(tuple(p) for p in pairs)), []
+            ).append(rec)
+    for (_, pairs), group in sorted(
+        by_pairs.items(), key=lambda kv: (str(kv[0][0]), kv[0][1])
+    ):
+        if len(pairs) != 1:
+            continue  # ring/halo shifts, not point-to-point channels
+        top = max(r["bytes"] for r in group)
+        if top <= _UNFRAMED_MIN_BYTES:
+            # below the classification floor a payload is the same
+            # size as a frame header — undecidable from shapes alone,
+            # so the rule abstains (documented in docs/analysis.md)
+            continue
+        framed = any(
+            r["dtype"] in ("s32", "u32")
+            and r["bytes"] * _UNFRAMED_HEADER_RATIO <= top
+            for r in group
+        )
+        if framed:
+            continue
+        # no plausible header: EVERY record in the group is a bare
+        # channel payload (not just the largest — two unframed
+        # transfers on one route are two findings)
+        for rec in group:
+            findings.append({
+                "check": "unframed-channel",
+                "name": rec["name"],
+                "op": rec["op"],
+                "bytes": rec["bytes"],
+                "message": (
+                    f"P2P channel payload %{rec['name']} "
+                    f"({rec['bytes']} B over pair "
+                    f"{list(pairs[0])}) moves with no verified-"
+                    f"transport frame header on the same route — "
+                    f"in-flight corruption lands silently; use "
+                    f"transfer_verified/stream_verified"
+                ),
+            })
+    return findings

@@ -197,6 +197,37 @@ def test_two_tier_docs_quote_the_simulated_wallclock(monkeypatch):
     assert speedup in _read("docs/perf_notes.md")
 
 
+def test_analysis_doc_quotes_the_shipped_checks():
+    """docs/analysis.md is the human-readable mirror of
+    ``smi_tpu/analysis`` and the traffic lint tier: every check the
+    verifier runs, every registered protocol, every mutant class, and
+    every HLO lint rule the code ships must be named in the doc — the
+    same drift discipline as docs/tuning.md. (Pure Python imports, no
+    devices.)"""
+    from smi_tpu import analysis
+    from smi_tpu.parallel import faults, traffic
+
+    text = _read("docs/analysis.md")
+    for check in analysis.CHECKS:
+        assert f"`{check}`" in text, f"check {check} undocumented"
+    for mutant in analysis.MUTANTS:
+        assert f"`{mutant}`" in text, f"mutant {mutant} undocumented"
+    registered = (faults.PROTOCOLS + faults.CHUNKED_PROTOCOLS
+                  + faults.POD_PROTOCOLS)
+    for protocol in registered:
+        assert f"`{protocol}`" in text, f"{protocol} undocumented"
+    # the default shape grid covers exactly the registered protocols
+    assert set(analysis.DEFAULT_SHAPES) == set(registered)
+    for rule in traffic.TRAFFIC_LINT_CHECKS:
+        assert f"`{rule}`" in text, f"lint rule {rule} undocumented"
+    # the honesty clauses: what the static tier does NOT prove
+    assert "fault-free only" in text
+    assert f"`analysis.MAX_LINT_N` ({analysis.MAX_LINT_N})" in text
+    assert "smi-tpu lint" in text
+    assert "--check --lint" in text
+    assert "traffic dump.hlo --lint" in text
+
+
 def test_tuning_doc_quotes_the_seeded_knobs():
     """docs/tuning.md's decision table must state the seeded values the
     code ships (block tiles, depth, threshold) — the table is the

@@ -574,3 +574,208 @@ def test_async_fused_all_reduce_sums_results():
     recs = T.collective_traffic(FakeCompiled(hlo))
     assert len(recs) == 1
     assert recs[0]["bytes"] == (384 * 1024 + 256) * 4
+
+
+# ---------------------------------------------------------------------------
+# HLO lint tier (traffic_lint) — the artifact-side half of `smi-tpu lint`
+# ---------------------------------------------------------------------------
+
+LINT_HLO = """
+HloModule jit_f
+
+%region_body.10 (arg.1: f32[64]) -> f32[64] {
+  %p = f32[64]{0} parameter(0)
+  %loop-psum.3 = f32[64]{0} all-reduce(%p), channel_id=5, replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, to_apply=%add.2
+  ROOT %r = f32[64]{0} add(%loop-psum.3, %p)
+}
+
+%region_cond.11 (arg.2: f32[64]) -> pred[] {
+  ROOT %lt = pred[] constant(true)
+}
+
+ENTRY %main.20 (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %cp.1 = f32[256]{0} collective-permute(%p0), channel_id=3, source_target_pairs={{0,1}}
+  %gated = f32[64]{0} all-reduce(%p0), channel_id=7, replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, to_apply=%add.2
+  %use = f32[64]{0} add(%gated, %p0)
+  ROOT %w = f32[64]{0} while(%use), condition=%region_cond.11, body=%region_body.10
+}
+"""
+
+
+@pytest.mark.lint
+def test_traffic_lint_flags_all_three_rules():
+    findings = T.traffic_lint(hlo_text=LINT_HLO)
+    by_check = {}
+    for f in findings:
+        by_check.setdefault(f["check"], []).append(f)
+    assert set(by_check) == set(T.TRAFFIC_LINT_CHECKS)
+    # the loop-resident psum is flagged twice: it gates all compute in
+    # its body AND re-traces per iteration
+    assert {f["name"] for f in by_check["collective-in-loop"]} == {
+        "loop-psum.3"
+    }
+    assert "gated" in {f["name"] for f in by_check["sync-no-overlap"]}
+    (unframed,) = by_check["unframed-channel"]
+    assert unframed["name"] == "cp.1"
+    assert unframed["bytes"] == 256 * 4
+
+
+@pytest.mark.lint
+def test_traffic_lint_sync_with_independent_compute_is_clean():
+    """A sync collective with compute free of it is the overlap
+    engine's happy case — not a finding."""
+    hlo = """
+ENTRY %main (p0: f32[64], p1: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %p1 = f32[64]{0} parameter(1)
+  %ar.1 = f32[64]{0} all-reduce(%p0), channel_id=2, replica_groups={{0,1}}, to_apply=%add.1
+  %free = f32[64]{0} multiply(%p1, %p1)
+  ROOT %out = f32[64]{0} add(%ar.1, %free)
+}
+"""
+    assert T.traffic_lint(hlo_text=hlo) == []
+
+
+@pytest.mark.lint
+def test_traffic_lint_compute_free_module_is_clean():
+    """Nothing to overlap is not a defect: a pure-collective module
+    (e.g. a collective microbenchmark) must not be flagged."""
+    hlo = """
+ENTRY %main (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  ROOT %ar.1 = f32[64]{0} all-reduce(%p0), channel_id=2, replica_groups={{0,1}}, to_apply=%add.1
+}
+"""
+    assert T.traffic_lint(hlo_text=hlo) == []
+
+
+@pytest.mark.lint
+def test_traffic_lint_framed_channel_is_clean_and_rings_are_not_channels():
+    """A payload permute with an s32 frame-header permute on the SAME
+    source-target pair is verified transport; a multi-pair permute is a
+    ring shift, not a channel — neither is a finding."""
+    hlo = """
+ENTRY %main (p0: f32[256]) -> f32[256] {
+  %p0 = f32[256]{0} parameter(0)
+  %payload.1 = f32[256]{0} collective-permute(%p0), channel_id=3, source_target_pairs={{0,1}}
+  %header.1 = s32[2]{0} collective-permute(%sums), channel_id=4, source_target_pairs={{0,1}}
+  %ring.1 = f32[256]{0} collective-permute(%p0), channel_id=5, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+  %c = f32[256]{0} multiply(%p0, %p0)
+  ROOT %out = f32[256]{0} add(%payload.1, %c)
+}
+"""
+    assert T.traffic_lint(hlo_text=hlo) == []
+
+
+@pytest.mark.lint
+def test_traffic_lint_unframed_floor_ratio_and_computation_scope():
+    """The three refinements of the unframed-channel heuristic:
+
+    - a route whose largest record is <= 64 B is below the
+      classification floor (a tiny framed payload's header is the
+      same shape as the payload) — the rule abstains;
+    - two similarly-sized bare s32 permutes cannot clear each other
+      as pseudo-headers (a header must be <= payload/8) — BOTH are
+      flagged, not just the largest;
+    - a header permute in a DIFFERENT computation does not vouch for
+      a payload on the same pair elsewhere in the module.
+    """
+    # floor: f32[1] payload + s32[1] "header", both 4 B — abstain
+    tiny = """
+ENTRY %main (p0: f32[1]) -> f32[1] {
+  %p0 = f32[1]{0} parameter(0)
+  %payload.1 = f32[1]{0} collective-permute(%p0), channel_id=3, source_target_pairs={{0,1}}
+  %header.1 = s32[1]{0} collective-permute(%sums), channel_id=4, source_target_pairs={{0,1}}
+  %c = f32[1]{0} multiply(%p0, %p0)
+  ROOT %out = f32[1]{0} add(%payload.1, %c)
+}
+"""
+    assert [f for f in T.traffic_lint(hlo_text=tiny)
+            if f["check"] == "unframed-channel"] == []
+    # ratio: two bare s32 permutes, 256 B and 64 B — 64*8 > 256, so
+    # neither is a plausible header; both are findings
+    bare_pair = """
+ENTRY %main (p0: s32[64]) -> s32[64] {
+  %p0 = s32[64]{0} parameter(0)
+  %big.1 = s32[64]{0} collective-permute(%p0), channel_id=3, source_target_pairs={{0,1}}
+  %small.1 = s32[16]{0} collective-permute(%p0), channel_id=4, source_target_pairs={{0,1}}
+  %c = s32[64]{0} multiply(%p0, %p0)
+  ROOT %out = s32[64]{0} add(%big.1, %c)
+}
+"""
+    flagged = [f for f in T.traffic_lint(hlo_text=bare_pair)
+               if f["check"] == "unframed-channel"]
+    assert {f["name"] for f in flagged} == {"big.1", "small.1"}
+    # scope: the header lives in a called computation, the payload in
+    # ENTRY — the payload stays flagged
+    split = """
+%sub.10 (arg.1: f32[256]) -> s32[2] {
+  %p = f32[256]{0} parameter(0)
+  ROOT %header.1 = s32[2]{0} collective-permute(%sums), channel_id=4, source_target_pairs={{0,1}}
+}
+
+ENTRY %main (p0: f32[256]) -> f32[256] {
+  %p0 = f32[256]{0} parameter(0)
+  %payload.1 = f32[256]{0} collective-permute(%p0), channel_id=3, source_target_pairs={{0,1}}
+  ROOT %out = f32[256]{0} add(%payload.1, %p0)
+}
+"""
+    names = {f["name"] for f in T.traffic_lint(hlo_text=split)
+             if f["check"] == "unframed-channel"}
+    assert names == {"payload.1"}
+
+
+@pytest.mark.lint
+def test_collective_traffic_records_carry_their_computation():
+    """Additive key the lint's per-computation grouping relies on."""
+    recs = T.collective_traffic(FakeCompiled(LINT_HLO))
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["loop-psum.3"]["computation"] == "region_body.10"
+    assert by_name["cp.1"]["computation"] == "main.20"
+    assert by_name["gated"]["computation"] == "main.20"
+
+
+@pytest.mark.lint
+def test_traffic_lint_matches_the_real_channel_lowering(comm8):
+    """End-to-end truth check on the heuristic: a bare `ctx.transfer`
+    compiles to exactly the single-pair permute the lint flags, and
+    `transfer_verified`'s checksum header permute clears it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import smi_tpu as smi
+
+    @smi.smi_kernel(comm8, in_specs=P(), out_specs=P("smi"))
+    def bare(ctx, x):
+        ch = ctx.open_channel(port=0, src=0, dst=1, count=64,
+                              dtype="float")
+        return ctx.transfer(ch, x)[None]
+
+    @smi.smi_kernel(comm8, in_specs=P(),
+                    out_specs=(P("smi"), P("smi"), P("smi")))
+    def framed(ctx, x):
+        ch = ctx.open_channel(port=0, src=0, dst=1, count=64,
+                              dtype="float")
+        got, check = ch.transfer_verified(x)
+        return got[None], check.expected[None], check.got[None]
+
+    x = jnp.arange(64, dtype=jnp.float32)
+    bare_hlo = jax.jit(bare).lower(x).compile().as_text()
+    framed_hlo = jax.jit(framed).lower(x).compile().as_text()
+    assert {f["check"] for f in T.traffic_lint(hlo_text=bare_hlo)} == {
+        "unframed-channel"
+    }
+    assert [f for f in T.traffic_lint(hlo_text=framed_hlo)
+            if f["check"] == "unframed-channel"] == []
+
+
+@pytest.mark.lint
+def test_overlap_report_records_computation_compute_bytes():
+    """The additive per-collective field traffic_lint keys on: total
+    compute of the surrounding computation, 0 for a compute-free one."""
+    rep = T.overlap_report(hlo_text=LINT_HLO)
+    by_name = {r["name"]: r for r in rep["per_collective"]}
+    assert by_name["gated"]["computation_compute_bytes"] == 64 * 4
+    assert by_name["loop-psum.3"]["computation_compute_bytes"] == 64 * 4
