@@ -134,6 +134,21 @@ def hierarchy_fields() -> dict:
     return fields
 
 
+def serving_fields() -> dict:
+    """Additive serving provenance on multichip measurements: a
+    deterministic CPU smoke of the multi-tenant front-end
+    (:func:`smi_tpu.serving.campaign.bench_fields` — pure Python,
+    milliseconds, fixed seed) reporting the offered load vs modeled
+    capacity, per-class accepted/shed counts, and p50/p99 admission
+    latency in step-clock ticks — the serving regime this build
+    sustains, measured next to the throughput it would serve. The
+    legacy metric/value/unit/vs_baseline contract is untouched
+    (schema-guarded by ``tests/test_serving.py``)."""
+    from smi_tpu.serving.campaign import bench_fields
+
+    return bench_fields()
+
+
 def plan_fields(depth) -> dict:
     """Additive plan-provenance evidence: which tuning layer (cache /
     model / heuristic) produced the knobs behind the headline metric
@@ -266,6 +281,11 @@ def main():
             payload["hierarchy"] = hierarchy_fields()
         except Exception as e:
             payload["hierarchy"] = {"error": f"{type(e).__name__}: {e}"}
+        # additive serving-regime field (same best-effort contract)
+        try:
+            payload["serving"] = serving_fields()
+        except Exception as e:
+            payload["serving"] = {"error": f"{type(e).__name__}: {e}"}
     # additive plan-provenance field (same best-effort contract)
     try:
         payload["plan"] = plan_fields(depth)

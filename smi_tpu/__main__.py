@@ -839,6 +839,15 @@ def _build_report(args: argparse.Namespace, out: str,
     return 0
 
 
+#: Ring-size sweep the base and elastic chaos campaigns share when
+#: --ranks is not given (one constant: the two campaigns and the help
+#: text can never drift on what the default sweep is).
+DEFAULT_CHAOS_RANKS = [2, 3, 4, 5]
+
+#: Faults per random plan when --max-faults is not given.
+DEFAULT_CHAOS_MAX_FAULTS = 2
+
+
 def cmd_chaos(args: argparse.Namespace) -> int:
     """Seeded randomized fault campaign over the ring protocols.
 
@@ -854,6 +863,17 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     from smi_tpu.parallel.faults import PROTOCOLS
     from smi_tpu.parallel.recovery import chaos_campaign
 
+    if args.elastic and args.load:
+        print("error: --elastic and --load are distinct campaigns; "
+              "pick one", file=sys.stderr)
+        return 2
+    if args.load:
+        return _cmd_chaos_load(args)
+    if args.duration is not None or args.n_ranks is not None:
+        print("error: --duration/-n apply only to --load (the base "
+              "and --elastic campaigns sweep --ranks/--trials)",
+              file=sys.stderr)
+        return 2
     if args.elastic:
         return _cmd_chaos_elastic(args)
     protocols = args.protocols or list(PROTOCOLS)
@@ -865,9 +885,11 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     report = chaos_campaign(
         seed=args.seed,
         protocols=protocols,
-        ns=args.ranks,
+        ns=(args.ranks if args.ranks is not None
+            else DEFAULT_CHAOS_RANKS),
         trials=args.trials,
-        max_faults=args.max_faults,
+        max_faults=(args.max_faults if args.max_faults is not None
+                    else DEFAULT_CHAOS_MAX_FAULTS),
     )
     for key in sorted(report["outcomes"]):
         print(f"{key:>12}: {report['outcomes'][key]}")
@@ -911,14 +933,17 @@ def _cmd_chaos_elastic(args: argparse.Namespace) -> int:
         print("error: --protocols does not apply to --elastic (the "
               "soak drives the sharded Jacobi job)", file=sys.stderr)
         return 2
-    if args.max_faults != 2:
+    if args.max_faults is not None:
         print("error: --max-faults does not apply to --elastic "
               "(elastic plans draw exactly one job-level fault; "
               "sweep more cells with --trials/--ranks instead)",
               file=sys.stderr)
         return 2
     report = elastic_campaign(
-        seed=args.seed, ns=args.ranks, trials=args.trials,
+        seed=args.seed,
+        ns=(args.ranks if args.ranks is not None
+            else DEFAULT_CHAOS_RANKS),
+        trials=args.trials,
     )
     for key in sorted(report["outcomes"]):
         print(f"{key:>18}: {report['outcomes'][key]}")
@@ -945,6 +970,126 @@ def _cmd_chaos_elastic(args: argparse.Namespace) -> int:
     if report["ok"]:
         print("elastic campaign ok: every cell detected, restored, "
               "regrew, and matched the fault-free grid bit-for-bit")
+    return 0 if report["ok"] else 1
+
+
+def _cmd_chaos_load(args: argparse.Namespace) -> int:
+    """``chaos --load``: the chaos-under-load campaign
+    (:mod:`smi_tpu.serving.campaign`).
+
+    Open-loop multi-tenant traffic drives the serving front-end
+    through an overload cell (2x capacity), a kill-one-rank cell
+    (phi-accrual detect + heir failover + replay DURING traffic), and
+    a consumer-stall backpressure cell per trial. Exit gate: zero
+    silent corruption, zero lost-accepted requests, zero stale-epoch
+    leaks, bounded queue occupancy, lowest-class-first shedding, and
+    the interactive p99 admission-latency bound.
+    """
+    from smi_tpu.serving.campaign import load_campaign
+
+    if args.protocols:
+        print("error: --protocols does not apply to --load (the "
+              "campaign drives the serving front-end)",
+              file=sys.stderr)
+        return 2
+    if args.max_faults is not None:
+        print("error: --max-faults does not apply to --load (cells "
+              "draw one serving-level fault each; sweep more cells "
+              "with --trials)", file=sys.stderr)
+        return 2
+    if args.ranks is not None:
+        print("error: --ranks does not apply to --load (the serving "
+              "front-end runs one rank count per campaign; use "
+              "-n/--n instead)", file=sys.stderr)
+        return 2
+    try:
+        report = load_campaign(
+            seed=args.seed,
+            n=args.n_ranks if args.n_ranks is not None else 4,
+            duration=(args.duration if args.duration is not None
+                      else 240),
+            trials=args.trials,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for cell in report["reports"]:
+        lat = cell["admission_latency"]["interactive"]
+        print(
+            f"{cell['cell']:>12}: {cell['verdict']}"
+            f" | accepted {sum(cell['accepted'].values())}"
+            f" shed {sum(sum(s.values()) for s in cell['shed'].values())}"
+            f" | interactive p99 {lat['p99']} ticks"
+        )
+    print(
+        f"{report['cells']} cells (seed {args.seed}), "
+        f"{report['silent_corruptions']} silent corruptions, "
+        f"{report['lost_accepted']} lost accepted, "
+        f"{report['stale_epoch_leaks']} stale-epoch leaks"
+    )
+    for failure in report["failures"]:
+        print(
+            f"FAILURE {failure['cell']} trial {failure['trial']}: "
+            f"{failure['verdict']}"
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"report -> {args.out}")
+    if report["ok"]:
+        print("load campaign ok: every accepted stream delivered "
+              "bit-identically, shedding lowest-class-first, queues "
+              "bounded")
+    return 0 if report["ok"] else 1
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """``serve --selftest``: the deterministic serving smoke.
+
+    One seeded admit→stream→shed→drain pass of the multi-tenant
+    front-end at 2x overload on the CPU (pure Python, milliseconds):
+    every acceptance must end in bit-identical delivery, shedding must
+    be lowest-class-first with named errors, queue occupancy must stay
+    inside the structural bound, and the interactive p99
+    admission-latency bound must hold. Nonzero exit on any gate
+    failure — the CI hook for the serving layer.
+    """
+    from smi_tpu.serving.campaign import serve_selftest
+
+    if not args.selftest:
+        print("error: serve requires --selftest (the live serving "
+              "loop needs a mesh; only the deterministic smoke runs "
+              "from the CLI)", file=sys.stderr)
+        return 2
+    report = serve_selftest(seed=args.seed)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        lat = report["admission_latency"]
+        print(f"selftest (seed {args.seed}): {report['verdict']}")
+        print(f"   accepted: {report['accepted']}")
+        print(f"  delivered: {report['delivered']}")
+        print(f"       shed: " + ", ".join(
+            f"{c}={sum(report['shed'][c].values())}"
+            for c in report["shed"]
+        ))
+        print(
+            f"  admission p99 (ticks): " + ", ".join(
+                f"{c}={lat[c]['p99']}" for c in lat
+            )
+        )
+        print(
+            f"  queue depth max {report['max_queue_depth']} "
+            f"(bound {report['queue_bound']}), "
+            f"{report['silent_corruptions']} silent corruptions, "
+            f"{report['lost_accepted']} lost accepted"
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"report -> {args.out}")
     return 0 if report["ok"] else 1
 
 
@@ -1446,12 +1591,15 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="PROTO",
                    help="protocols to sweep (default: all four ring "
                         "protocols)")
-    p.add_argument("--ranks", nargs="+", type=int, default=[2, 3, 4, 5],
-                   metavar="N", help="ring sizes to sweep")
+    p.add_argument("--ranks", nargs="+", type=int, default=None,
+                   metavar="N",
+                   help="ring sizes to sweep (default 2 3 4 5; not "
+                        "applicable to --load)")
     p.add_argument("--trials", type=int, default=3,
                    help="random plans per (protocol, n) cell")
-    p.add_argument("--max-faults", type=int, default=2,
-                   help="faults per random plan (1..N drawn)")
+    p.add_argument("--max-faults", type=int, default=None,
+                   help="faults per random plan (1..N drawn; default "
+                        "2; not applicable to --elastic/--load)")
     p.add_argument("--elastic", action="store_true",
                    help="run the elastic runtime soak instead: seeded "
                         "kill→detect→shrink→checkpoint-restore→regrow "
@@ -1459,9 +1607,44 @@ def build_parser() -> argparse.ArgumentParser:
                         "zero silent corruption and zero stale-epoch "
                         "leaks (--ranks/--trials apply; --protocols "
                         "does not)")
+    p.add_argument("--load", action="store_true",
+                   help="run the chaos-under-load campaign instead: "
+                        "open-loop multi-tenant traffic through the "
+                        "serving front-end with overload, "
+                        "kill-one-rank, and consumer-stall cells, "
+                        "gated on zero silent corruption, zero "
+                        "lost-accepted requests, zero stale-epoch "
+                        "leaks, bounded queues, and "
+                        "lowest-class-first shedding (--trials "
+                        "applies; --protocols/--ranks/--max-faults "
+                        "do not)")
+    p.add_argument("--duration", type=int, default=None, metavar="TICKS",
+                   help="ticks of open-loop traffic per --load cell "
+                        "(default 240; --load only)")
+    p.add_argument("-n", "--n", type=int, default=None, dest="n_ranks",
+                   help="serving ranks for --load cells (default 4; "
+                        "--load only)")
     p.add_argument("-o", "--out", default=None,
                    help="write the JSON campaign report here")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "serve",
+        help="the multi-tenant streaming front-end; --selftest runs "
+             "the deterministic CPU admit→stream→shed→drain smoke "
+             "(nonzero exit on any serving gate failure)",
+    )
+    p.add_argument("--selftest", action="store_true",
+                   help="run the deterministic serving smoke and exit "
+                        "nonzero on any gate failure")
+    p.add_argument("--seed", type=int, default=0,
+                   help="selftest seed (default 0; the report is "
+                        "deterministic per seed)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full cell report as JSON")
+    p.add_argument("-o", "--out", default=None,
+                   help="write the JSON report here")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
         "traffic",

@@ -39,6 +39,7 @@ TPU re-design — one SPMD collective instead of two endpoint loops:
 from __future__ import annotations
 
 import dataclasses
+import zlib
 from functools import partial
 from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple, Union
 
@@ -583,6 +584,54 @@ class P2PChannel:
             expected=int(expected[k]), got=int(got[k]),
             kind="checksum",
         )
+
+
+#: Port space for transient per-tenant stream channels. Ports in this
+#: range are derived, never hand-assigned; they fold onto the ring
+#: tier's barrier-semaphore stream domains via
+#: :meth:`P2PChannel._ring_stream` exactly like static ports do.
+TENANT_PORT_SPACE = 1 << 16
+
+
+def tenant_stream_port(tenant: str, stream_seq: int) -> int:
+    """Deterministic transient port for one tenant stream.
+
+    The serving front-end's (tenant, per-tenant sequence) stream
+    identity hashed into the port space — stable across processes, so
+    every rank of an SPMD program derives the same port without
+    coordination, the way the reference's transient channels derive
+    CK routing-table entries from the (port, comm) pair at open time.
+    """
+    if stream_seq < 0:
+        raise ValueError(f"stream_seq must be >= 0, got {stream_seq}")
+    return zlib.crc32(
+        f"tenant-stream:{tenant}:{stream_seq}".encode()
+    ) % TENANT_PORT_SPACE
+
+
+def open_tenant_channel(
+    comm: Communicator,
+    tenant: str,
+    stream_seq: int,
+    src: int,
+    dst: int,
+    count: int,
+    dtype: SmiDtype = SmiDtype.FLOAT,
+    **kwargs,
+) -> P2PChannel:
+    """A transient per-tenant P2P channel — the serving analog of
+    ``SMI_Open_send_channel`` opening a channel per message: metadata
+    only (no device work), with the port derived from the tenant
+    stream identity (:func:`tenant_stream_port`) so concurrent tenants
+    land on distinct ring stream domains (up to the tier's domain
+    count) and a tenant's consecutive streams rotate domains instead
+    of serializing behind one barrier semaphore. All other
+    :class:`P2PChannel` knobs (buffer size, rendezvous,
+    consecutive_reads) pass through."""
+    return P2PChannel(
+        comm, port=tenant_stream_port(tenant, stream_seq),
+        src=src, dst=dst, count=count, dtype=dtype, **kwargs,
+    )
 
 
 def stream_concurrent(

@@ -824,6 +824,7 @@ def concurrent_stream_generators(
     domains: Optional[Sequence[int]] = None,
     flow_control: bool = True,
     swap_order_rank: Optional[int] = None,
+    chunk_counts: Optional[Sequence[int]] = None,
 ):
     """Per-rank composite programs of burst-interleaved concurrent P2P
     streams over one ``n``-ring.
@@ -842,9 +843,28 @@ def concurrent_stream_generators(
     deadlocks loudly at the misordered barrier; with a shared domain
     the barrier lets it through and the fuzzer sees the resulting
     scratch clobber instead — both detectable, which is the point.
+
+    ``chunk_counts`` (per-channel TOTAL chunks) models UNEQUAL tenant
+    streams sharing the wire: round ``b`` moves channel ``i``'s chunks
+    ``[b*cpb, (b+1)*cpb)`` while any remain, exhausted channels simply
+    stop contributing instances — the ``READS_LIMIT`` fairness bound
+    between unequal sources, where a small stream must finish within
+    its own rounds instead of queueing behind a large one. Overrides
+    ``bursts``; chunk labels carry the channel-absolute index.
     """
     if domains is None:
         domains = [port for port, _ in channels]
+    if chunk_counts is not None:
+        if len(chunk_counts) != len(channels):
+            raise ValueError(
+                f"need one chunk count per channel, got "
+                f"{len(chunk_counts)} for {len(channels)}"
+            )
+        if any(c < 1 for c in chunk_counts):
+            raise ValueError(f"chunk counts must be >= 1: {chunk_counts}")
+        bursts = max(
+            -(-total // chunks_per_burst) for total in chunk_counts
+        )
     programs = []
     for g in range(n):
         subs = []
@@ -853,9 +873,16 @@ def concurrent_stream_generators(
             if g == swap_order_rank:
                 order = order[::-1]
             for i, (port, direction) in order:
-                labels = [
-                    ((g, i, b), k) for k in range(chunks_per_burst)
-                ]
+                if chunk_counts is None:
+                    ks = range(chunks_per_burst)
+                else:
+                    ks = range(
+                        b * chunks_per_burst,
+                        min((b + 1) * chunks_per_burst, chunk_counts[i]),
+                    )
+                    if not ks:
+                        continue  # this stream already drained
+                labels = [((g, i, b), k) for k in ks]
                 subs.append(
                     instance_steps(
                         neighbour_stream_rank(
@@ -900,6 +927,63 @@ def simulate_stream_concurrent(
             raise ProtocolError(
                 f"rank {g} received {outputs[g]}, wanted {want}"
             )
+
+
+def simulate_tenant_streams(
+    n: int,
+    strategy: Strategy,
+    chunk_counts: Sequence[int],
+    chunks_per_burst: int = 2,
+    flow_control: bool = True,
+) -> List[Dict]:
+    """Fuzz one schedule of UNEQUAL concurrent tenant streams on one
+    wire (every channel direction +1 around the same ring, distinct
+    port domains) and verify per-stream delivery. Returns the per-rank
+    output dicts — their insertion order IS each rank's consumption
+    order, which is what the fairness regression measures
+    (:func:`fairness_gap`)."""
+    channels = [(i, 1) for i in range(len(chunk_counts))]
+    outputs = RingSimulator(
+        concurrent_stream_generators(
+            n, channels, chunks_per_burst=chunks_per_burst,
+            flow_control=flow_control, chunk_counts=chunk_counts,
+        ),
+        strategy,
+    ).run()
+    for g in range(n):
+        up = (g - 1) % n
+        want = {}
+        for i, total in enumerate(chunk_counts):
+            for k in range(total):
+                b, c = divmod(k, chunks_per_burst)
+                # output keys are burst-relative positions (the
+                # kernel's chunk loop index); payloads carry the
+                # channel-absolute chunk label
+                want[((i, b), c)] = ((up, i, b), k)
+        if outputs[g] != want:
+            raise ProtocolError(
+                f"rank {g} received {outputs[g]}, wanted {want}"
+            )
+    return outputs
+
+
+def fairness_gap(rank_outputs: Dict, stream: int) -> int:
+    """Largest number of OTHER streams' chunks consumed between two
+    consecutive chunks of ``stream`` (including before its first) in
+    one rank's delivery order — the interleaving-gap metric of the
+    starvation regression: the burst-interleaved schedule must bound
+    it by ``(streams - 1) * chunks_per_burst`` no matter how adversarial
+    the schedule, because the credit discipline admits at most one
+    burst of each other stream between a live stream's bursts."""
+    gap = 0
+    run = 0
+    for (instance, _k) in rank_outputs:
+        if instance[0] == stream:
+            gap = max(gap, run)
+            run = 0
+        else:
+            run += 1
+    return gap
 
 
 # ---------------------------------------------------------------------------

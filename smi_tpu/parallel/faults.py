@@ -101,6 +101,17 @@ ELASTIC_FAULT_CLASSES = ("flapping_rank", "stalled_heartbeat")
 #: :func:`credits.allreduce_pod_rank`.
 POD_PROTOCOLS = ("allreduce_pod",)
 
+#: Serving-level fault classes, deliberately NOT in
+#: :data:`FAULT_CLASSES` (same seed-pinning rule as
+#: :data:`ELASTIC_FAULT_CLASSES`). They drive the multi-tenant
+#: front-end (:mod:`smi_tpu.serving`) across ticks of a serving loop,
+#: not actions of one collective: a ``SlowConsumer`` is the
+#: *saturated-not-dead* regime — the destination keeps heartbeating
+#: while its consumer stalls, so wire credits exhaust and the stall
+#: must surface as named admission-edge shedding, never as a
+#: membership transition. ``smi-tpu chaos --load`` sweeps them.
+SERVING_FAULT_CLASSES = ("slow_consumer",)
+
 #: DCN-tier fault classes, deliberately NOT in :data:`FAULT_CLASSES`
 #: (the seed-pinned base chaos campaign would re-roll; same rule as
 #: :data:`ELASTIC_FAULT_CLASSES`). They target the pod's slow wire
@@ -279,6 +290,35 @@ class FlappingRank:
 
 
 @dataclasses.dataclass(frozen=True)
+class SlowConsumer:
+    """Rank ``rank``'s consumer stalls for ``stall_ticks`` step-clock
+    ticks starting at ``from_tick`` — alive, heartbeating, computing
+    nothing.
+
+    The serving-level fault the end-to-end credit chain exists for:
+    landed chunks stop being consumed, the destination's wire credits
+    exhaust within :data:`~smi_tpu.serving.scheduler.WIRE_CREDITS`
+    chunks, its accepted streams stop completing, their stream credits
+    stay held, and the admission edge must shed NEW work to that
+    destination with a named error (``backpressure:rank<r>``) instead
+    of growing any queue. The phi-accrual detector must at most
+    suspect-and-clear the rank — a membership transition on a merely
+    saturated rank is a campaign failure (the dead-vs-saturated
+    distinction, exercised from the saturated side).
+    """
+
+    rank: int
+    from_tick: int = 40
+    stall_ticks: int = 60
+
+    def __post_init__(self):
+        if self.stall_ticks < 1:
+            raise ValueError(
+                f"stall_ticks must be >= 1, got {self.stall_ticks}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class StalledHeartbeat:
     """``rank`` stays alive and computing but its heartbeats go silent
     for ``silent_for`` step-clock ticks starting at ``from_tick``.
@@ -368,6 +408,9 @@ class FaultPlan:
     #: the membership layer's elastic soak).
     flapping_ranks: Tuple[FlappingRank, ...] = ()
     stalled_heartbeats: Tuple[StalledHeartbeat, ...] = ()
+    #: Serving-level faults (no simulator-hook effect; consumed by the
+    #: multi-tenant front-end's chaos-under-load cells).
+    slow_consumers: Tuple[SlowConsumer, ...] = ()
     #: DCN-tier faults (slice-pair link cuts, cross-slice-only DMA
     #: holds) — consulted through the same hooks, slice-resolved.
     dcn_link_downs: Tuple[DcnLinkDown, ...] = ()
@@ -450,6 +493,7 @@ class FaultPlan:
             or self.delayed_dmas or self.stalled_ranks or self.down_links
             or self.bit_flips or self.reorders or self.truncations
             or self.flapping_ranks or self.stalled_heartbeats
+            or self.slow_consumers
             or self.dcn_link_downs or self.dcn_delays
         )
 
@@ -462,6 +506,7 @@ class FaultPlan:
             + tuple(DownLink(a, b) for a, b in sorted(self.down_links))
             + self.bit_flips + self.reorders + self.truncations
             + self.flapping_ranks + self.stalled_heartbeats
+            + self.slow_consumers
             + self.dcn_link_downs + self.dcn_delays
         )
 
@@ -496,6 +541,8 @@ class FaultPlan:
             return cls(flapping_ranks=(fault,))
         if isinstance(fault, StalledHeartbeat):
             return cls(stalled_heartbeats=(fault,))
+        if isinstance(fault, SlowConsumer):
+            return cls(slow_consumers=(fault,))
         if isinstance(fault, DcnLinkDown):
             return cls(dcn_link_downs=(fault,))
         if isinstance(fault, DcnDelay):
@@ -523,6 +570,8 @@ class FaultPlan:
                                 + single.flapping_ranks),
                 stalled_heartbeats=(plan.stalled_heartbeats
                                     + single.stalled_heartbeats),
+                slow_consumers=(plan.slow_consumers
+                                + single.slow_consumers),
                 dcn_link_downs=(plan.dcn_link_downs
                                 + single.dcn_link_downs),
                 dcn_delays=plan.dcn_delays + single.dcn_delays,
@@ -576,6 +625,15 @@ class FaultPlan:
                 rank, from_tick=50 + rng.randrange(40),
                 silent_for=16 + rng.randrange(9),
             ))
+        if fault_class == "slow_consumer":
+            # stall starts after the serving bootstrap has traffic in
+            # flight, lasts long enough that backpressure must reach
+            # the admission edge (the wire window is WIRE_CREDITS=4
+            # chunks; tens of ticks of stall guarantee exhaustion)
+            return cls.single(SlowConsumer(
+                rank, from_tick=30 + rng.randrange(40),
+                stall_ticks=40 + rng.randrange(41),
+            ))
         if fault_class in DCN_FAULT_CLASSES:
             # pod shape convention for random draws: 2 slices of n//2
             # (the n-rank ring split in half) — n must be even
@@ -593,7 +651,7 @@ class FaultPlan:
             ))
         raise ValueError(
             f"unknown fault class {fault_class!r}; "
-            f"known: {FAULT_CLASSES + ELASTIC_FAULT_CLASSES + DCN_FAULT_CLASSES}"
+            f"known: {FAULT_CLASSES + ELASTIC_FAULT_CLASSES + SERVING_FAULT_CLASSES + DCN_FAULT_CLASSES}"
         )
 
 

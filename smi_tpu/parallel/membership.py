@@ -362,6 +362,25 @@ class MembershipView:
         )
 
 
+def route_owner(view: MembershipView, rank: int,
+                n: Optional[int] = None) -> Optional[int]:
+    """The member currently serving ``rank``'s duties: the rank itself
+    while it is a member, else its heir among the current members
+    (:func:`~smi_tpu.parallel.recovery.heir_of` — nearest surviving
+    successor), else ``None`` when nobody survives. The single
+    authority for "who owns rank r now", shared by the elastic soak's
+    block ownership and the serving front-end's tenant failover."""
+    from smi_tpu.parallel.recovery import heir_of
+
+    n = view.n if n is None else n
+    members = view.members
+    if rank in members:
+        return rank
+    if not members:
+        return None
+    return heir_of(rank, members, n)
+
+
 def plan_regrow_ring(view: MembershipView,
                      down_pairs: Sequence[Tuple[int, int]] = ()
                      ) -> List[int]:
@@ -871,18 +890,7 @@ def run_elastic_cell(
     silences = {f.rank: f for f in plan.stalled_heartbeats}
 
     def owners_now() -> Dict[int, Optional[int]]:
-        from smi_tpu.parallel.recovery import heir_of
-
-        members = view.members
-        out: Dict[int, Optional[int]] = {}
-        for r in range(n):
-            if r in members:
-                out[r] = r
-            elif members:
-                out[r] = heir_of(r, members, n)
-            else:
-                out[r] = None
-        return out
+        return {r: route_owner(view, r, n) for r in range(n)}
 
     report: Dict = {
         "n": n, "seed": seed, "plan": plan.describe(),

@@ -151,6 +151,18 @@ class ProgressLog:
     def missing(self, expected_keys) -> Set:
         return {k for k in expected_keys if k not in self.entries}
 
+    def void_deliveries(self) -> int:
+        """Forget every delivered entry while keeping the durable
+        contribution — the input-restart discipline of the reduction
+        protocols applied to a failed-over stream: deliveries consumed
+        by a dead destination died with its consumer state, so the
+        heir's replay must restart from the contribution, never from
+        partial delivery records. Returns the number voided (the
+        serving front-end books them as replayed chunks)."""
+        voided = len(self.entries)
+        self.entries.clear()
+        return voided
+
     # -- durability -----------------------------------------------------
 
     @staticmethod

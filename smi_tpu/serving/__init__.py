@@ -1,0 +1,64 @@
+"""Multi-tenant streaming front-end: admission, QoS, backpressure.
+
+The serving-side analogue of the paper's transient per-message
+channels switched under credit flow control: many concurrent tenant
+streams multiplexed onto the channel/collective substrate, with
+admission control chained end to end into the wire credit discipline,
+priority classes with an explicit brownout policy, deadline
+propagation into the watchdog layer, and membership-driven failover
+under faults. Pure Python and step-clock deterministic (the elastic
+runtime's discipline) — ``smi-tpu serve --selftest`` and
+``smi-tpu chaos --load`` are the CLI surfaces.
+"""
+
+from smi_tpu.serving.admission import AdmissionGate, TokenBucket
+from smi_tpu.serving.campaign import (
+    load_campaign,
+    run_load_cell,
+    serve_selftest,
+)
+from smi_tpu.serving.frontend import ServingFrontend, tenant_base_rank
+from smi_tpu.serving.qos import (
+    CLASS_ADMISSION_WAIT_TICKS,
+    CLASS_DEADLINE_TICKS,
+    CLASS_POOL_CEILING,
+    CLASS_PRIORITY,
+    INTERACTIVE_P99_TICKS,
+    QOS_CLASSES,
+    AdmissionRejected,
+    Request,
+)
+from smi_tpu.serving.scheduler import (
+    CONSUME_RATE,
+    MAX_STARVE_ROUNDS,
+    TRANSIT_TICKS,
+    WIRE_CREDITS,
+    StreamScheduler,
+    StreamState,
+    WireLane,
+)
+
+__all__ = [
+    "AdmissionGate",
+    "AdmissionRejected",
+    "CLASS_ADMISSION_WAIT_TICKS",
+    "CLASS_DEADLINE_TICKS",
+    "CLASS_POOL_CEILING",
+    "CLASS_PRIORITY",
+    "CONSUME_RATE",
+    "INTERACTIVE_P99_TICKS",
+    "MAX_STARVE_ROUNDS",
+    "QOS_CLASSES",
+    "Request",
+    "ServingFrontend",
+    "StreamScheduler",
+    "StreamState",
+    "TokenBucket",
+    "TRANSIT_TICKS",
+    "WIRE_CREDITS",
+    "WireLane",
+    "load_campaign",
+    "run_load_cell",
+    "serve_selftest",
+    "tenant_base_rank",
+]

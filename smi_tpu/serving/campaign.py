@@ -1,0 +1,395 @@
+"""Chaos under load: open-loop traffic cells and the seeded campaign.
+
+Every robustness layer before this one ran against a single batch job;
+these cells run the front-end against *sustained open-loop traffic* —
+arrivals keep coming whether or not the system keeps up — and assert
+the overload story end to end. Three cell shapes:
+
+- **overload** — 2x the service capacity, no faults: admission must
+  shed lowest-class-first (brownout ceilings), queue occupancy must
+  stay inside the structural bound, interactive p99 admission latency
+  must hold, and every accepted stream must still be delivered
+  bit-identically;
+- **kill** — a seeded kill-one-rank *during* the traffic: phi-accrual
+  must confirm the death inside the watchdog budget, tenant routes
+  must fail over to heirs, accepted in-flight streams must replay and
+  complete bit-identically, straggler traffic from the dead
+  incarnation must be rejected by epoch (counted; zero leaks);
+- **backpressure** — one rank's consumer stalls (alive, heartbeating:
+  the *saturated* half of the dead-vs-saturated distinction): the
+  stall must propagate to the admission edge as named shedding, must
+  NOT trigger any membership transition beyond a cleared suspicion,
+  and every accepted stream must complete once the stall lifts.
+
+Gates per cell (the campaign exit is nonzero if any fails):
+zero silent corruption, zero lost-accepted, zero stale-epoch leaks,
+bounded queue occupancy, lowest-class-first shedding (brownout sheds
+ordered best_effort >= batch >= interactive, with zero interactive
+brownout sheds), and interactive p99 admission wait <=
+:data:`~smi_tpu.serving.qos.INTERACTIVE_P99_TICKS`. Deterministic per
+seed — a red campaign reproduces from its JSON alone
+(``smi-tpu chaos --load --seed N``).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+from smi_tpu.parallel import faults as F
+from smi_tpu.parallel.membership import WATCHDOG_TICKS
+from smi_tpu.serving.admission import DEFAULT_POOL
+from smi_tpu.serving.frontend import ServingFrontend
+from smi_tpu.serving.qos import (
+    CLASS_ADMISSION_WAIT_TICKS,
+    INTERACTIVE_P99_TICKS,
+    QOS_CLASSES,
+    AdmissionRejected,
+    percentile,
+)
+
+#: Traffic mix (weights) and chunks-per-request per class: interactive
+#: requests are small and frequent, best_effort large and patient.
+CLASS_MIX = {"interactive": 3, "batch": 3, "best_effort": 4}
+CLASS_CHUNKS = {"interactive": 2, "batch": 4, "best_effort": 6}
+
+#: Minimum campaign cell duration: every seeded fault the campaign can
+#: draw (kill at tick 60, SlowConsumer from_tick <= 69) must land
+#: INSIDE the traffic schedule with room for its effects to reach the
+#: admission edge — a shorter run would report a misleading
+#: "fault never fired" gate failure instead of a usage error.
+MIN_CAMPAIGN_DURATION = 120
+
+
+def _payload(tenant: str, stream_seq: int, chunk: int) -> str:
+    """Deterministic, content-addressed chunk payload — bit-identity
+    of delivery is checked against exactly this."""
+    return f"{tenant}/s{stream_seq}/c{chunk}"
+
+
+def open_loop_traffic(
+    seed: int,
+    tenants: int,
+    duration: int,
+    requests_per_tick: float,
+):
+    """Seeded open-loop arrival schedule: a list per tick of
+    ``(tenant, qos)`` submissions. Open-loop means the schedule never
+    consults the system's state — arrivals continue regardless of
+    shedding, which is what makes overload expressible at all."""
+    rng = random.Random(f"traffic:{seed}")
+    classes = [c for c in QOS_CLASSES for _ in range(CLASS_MIX[c])]
+    schedule: List[List[Tuple[str, str]]] = []
+    acc = 0.0
+    for _ in range(duration):
+        acc += requests_per_tick
+        burst = []
+        while acc >= 1.0:
+            acc -= 1.0
+            tenant = f"t{rng.randrange(tenants)}"
+            burst.append((tenant, rng.choice(classes)))
+        schedule.append(burst)
+    return schedule
+
+
+def run_load_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 240,
+    overload: float = 1.0,
+    kill_rank: Optional[int] = None,
+    kill_at: int = 60,
+    stall_rank: Optional[int] = None,
+    stall_at: int = 40,
+    stall_ticks: int = 60,
+    tenants: int = 6,
+    pool: int = DEFAULT_POOL,
+    plan: Optional[F.FaultPlan] = None,
+) -> Dict:
+    """One chaos-under-load cell: open-loop traffic, optional fault,
+    full drain, gates evaluated. Deterministic per (shape, seed).
+
+    Faults come either as explicit knobs (``kill_rank``/``kill_at``,
+    ``stall_rank``/...) or as a :class:`~smi_tpu.parallel.faults.FaultPlan`
+    carrying serving-level faults: each
+    :class:`~smi_tpu.parallel.faults.SlowConsumer` maps onto a
+    consumer stall in ticks (the seeded draw
+    ``FaultPlan.random("slow_consumer", n, seed)`` is how the campaign
+    sweeps the class)."""
+    fe = ServingFrontend(n, seed=seed, pool=pool)
+    if plan is not None:
+        if plan.slow_consumers and stall_rank is not None:
+            raise ValueError(
+                "pass a stall either explicitly or via the plan, "
+                "not both"
+            )
+        if len(plan.slow_consumers) > 1:
+            raise ValueError(
+                f"run_load_cell drives one SlowConsumer per cell; "
+                f"the plan carries {len(plan.slow_consumers)} — "
+                f"sweep more cells instead"
+            )
+        for f in plan.slow_consumers:
+            stall_rank, stall_at = f.rank, f.from_tick
+            stall_ticks = f.stall_ticks
+    if kill_rank is not None and kill_at >= duration:
+        raise ValueError(
+            f"kill_at={kill_at} never fires inside a {duration}-tick "
+            f"schedule — raise duration past the fault tick"
+        )
+    if stall_rank is not None and stall_at >= duration:
+        raise ValueError(
+            f"stall at tick {stall_at} never fires inside a "
+            f"{duration}-tick schedule — raise duration past the "
+            f"fault tick"
+        )
+    mean_chunks = (
+        sum(CLASS_MIX[c] * CLASS_CHUNKS[c] for c in QOS_CLASSES)
+        / sum(CLASS_MIX.values())
+    )
+    capacity = n * fe.consume_rate  # chunks/tick
+    requests_per_tick = overload * capacity / mean_chunks
+    schedule = open_loop_traffic(seed, tenants, duration,
+                                 requests_per_tick)
+    tenant_seq: Dict[str, int] = {}
+    submitted = 0
+    verdict = "ok"
+    try:
+        for tick, burst in enumerate(schedule):
+            now = fe.clock.now()
+            if kill_rank is not None and tick == kill_at:
+                fe.kill(kill_rank)
+            if stall_rank is not None and tick == stall_at:
+                fe.stall_consumer(stall_rank, now + stall_ticks)
+            for tenant, qos in burst:
+                submitted += 1
+                seq = tenant_seq.get(tenant, 0)
+                tenant_seq[tenant] = seq + 1
+                chunks = tuple(
+                    _payload(tenant, seq, c)
+                    for c in range(CLASS_CHUNKS[qos])
+                )
+                try:
+                    fe.submit(tenant, qos, chunks)
+                except AdmissionRejected:
+                    pass  # named + recorded by the gate
+            fe.step()
+        fe.drain()
+    except Exception as e:  # a watchdog/assert firing IS the verdict
+        verdict = f"{type(e).__name__}: {e}"
+
+    report = fe.report()
+    report.update({
+        "seed": seed,
+        "duration": duration,
+        "overload": overload,
+        "kill_rank": kill_rank,
+        "stall_rank": stall_rank,
+        "plan": plan.describe() if plan is not None else [],
+        "submitted_total": submitted,
+        "offered_chunks_per_tick": round(
+            requests_per_tick * mean_chunks, 3
+        ),
+        "capacity_chunks_per_tick": capacity,
+    })
+
+    # -- gates ----------------------------------------------------------
+    problems: List[str] = []
+    if verdict != "ok":
+        problems.append(verdict)
+    if report["silent_corruptions"]:
+        problems.append(
+            f"silent corruption: {report['silent_corruptions']} "
+            f"stream(s) delivered wrong bits"
+        )
+    if report["lost_accepted"]:
+        problems.append(
+            f"lost accepted: {report['lost_accepted']} admitted "
+            f"stream(s) never delivered"
+        )
+    if report["stale_epoch_leaks"]:
+        problems.append("stale-epoch traffic accepted")
+    if report["max_queue_depth"] > report["queue_bound"]:
+        problems.append(
+            f"queue occupancy {report['max_queue_depth']} exceeded "
+            f"bound {report['queue_bound']}"
+        )
+    brownout = {
+        c: sum(v for k, v in report["shed"][c].items()
+               if k.startswith("brownout") or k == "admission-timeout")
+        for c in QOS_CLASSES
+    }
+    report["brownout_shed"] = brownout
+    # destination-unavailability sheds (per-route backpressure) are a
+    # separate, named category: class-blind by design, so they are
+    # excluded from the lowest-class-first ordering gate
+    report["backpressure_shed"] = {
+        c: sum(v for k, v in report["shed"][c].items()
+               if k.startswith("backpressure:"))
+        for c in QOS_CLASSES
+    }
+    if kill_rank is None and brownout["interactive"] > 0:
+        # fair weather and saturation: interactive never browns out.
+        # During a kill's detection blackout the pool can genuinely
+        # exhaust (stalled streams hold their credits by design), so
+        # there the guarantee is ORDERING + the bounded wait cap.
+        problems.append(
+            f"interactive brownout-shed {brownout['interactive']} "
+            f"(> 0): shedding is not lowest-class-first"
+        )
+    if (brownout["best_effort"] < brownout["batch"]
+            or brownout["batch"] < brownout["interactive"]):
+        problems.append(
+            "shedding not lowest-class-first: best_effort "
+            f"{brownout['best_effort']} / batch {brownout['batch']} / "
+            f"interactive {brownout['interactive']}"
+        )
+    waits = report["admission_waits"]["interactive"]
+    p99 = percentile(waits, 0.99)
+    report["admission_latency"] = {
+        c: {
+            "p50": percentile(report["admission_waits"][c], 0.50),
+            "p99": percentile(report["admission_waits"][c], 0.99),
+        }
+        for c in QOS_CLASSES
+    }
+    # the p99 bound: tight in fair weather, the structural wait cap
+    # during a kill's detection blackout (bounded either way — the
+    # admission edge sheds rather than queue past the cap)
+    p99_bound = (INTERACTIVE_P99_TICKS if kill_rank is None
+                 else CLASS_ADMISSION_WAIT_TICKS["interactive"])
+    report["interactive_p99_bound"] = p99_bound
+    if p99 is not None and p99 > p99_bound:
+        problems.append(
+            f"interactive p99 admission latency {p99:g} ticks "
+            f"exceeds the {p99_bound}-tick bound"
+        )
+    if kill_rank is not None:
+        if report["confirmed"] != [kill_rank]:
+            problems.append(
+                f"kill of rank {kill_rank} not confirmed "
+                f"(confirmed: {report['confirmed']})"
+            )
+        elif report["detect_ticks"] is None or (
+            report["detect_ticks"] > WATCHDOG_TICKS
+        ):
+            problems.append(
+                f"detect latency {report['detect_ticks']} ticks "
+                f"outside the {WATCHDOG_TICKS}-tick watchdog budget"
+            )
+        if not report["stale_epoch_rejections"]:
+            problems.append("straggler from dead incarnation was "
+                            "never presented/rejected")
+    if stall_rank is not None:
+        if report["confirmed"]:
+            problems.append(
+                f"stalled-but-alive consumer confirmed dead: "
+                f"{report['confirmed']} (saturation mistaken for "
+                f"death)"
+            )
+        if not any(report["backpressure_shed"].values()):
+            problems.append(
+                "consumer stall never propagated to the admission "
+                "edge (zero backpressure sheds)"
+            )
+    # drop the unhashed per-request wait lists from the shipped report
+    # (the percentiles above carry the evidence)
+    del report["admission_waits"]
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    return report
+
+
+def load_campaign(
+    seed: int = 0,
+    n: int = 4,
+    duration: int = 240,
+    trials: int = 1,
+) -> Dict:
+    """The seeded chaos-under-load campaign: one overload cell, one
+    kill-one-rank cell, and one backpressure cell per trial, each
+    deterministic per seed. Exit gate: every cell ``ok``.
+
+    ``duration`` below :data:`MIN_CAMPAIGN_DURATION` is a loud
+    ``ValueError``: the seeded fault ticks would fall outside the
+    schedule and report as (bogus) detection failures."""
+    if duration < MIN_CAMPAIGN_DURATION:
+        raise ValueError(
+            f"campaign duration {duration} is below the "
+            f"{MIN_CAMPAIGN_DURATION}-tick minimum: the seeded kill "
+            f"(tick 60) and consumer-stall (from_tick <= 69) cells "
+            f"need the fault inside the traffic schedule"
+        )
+    cells: List[Dict] = []
+    for trial in range(trials):
+        base = random.Random(f"load:{seed}:{trial}").randrange(1 << 30)
+        kill = random.Random(f"kill:{seed}:{trial}").randrange(n)
+        stall_plan = F.FaultPlan.random(
+            "slow_consumer", n,
+            random.Random(f"stall:{seed}:{trial}").randrange(1 << 30),
+        )
+        shapes = [
+            ("overload", dict(overload=2.0)),
+            ("kill", dict(overload=1.0, kill_rank=kill, kill_at=60)),
+            ("backpressure", dict(overload=1.0, plan=stall_plan)),
+        ]
+        for name, kwargs in shapes:
+            report = run_load_cell(
+                n=n, seed=base, duration=duration, **kwargs
+            )
+            report["cell"] = name
+            report["trial"] = trial
+            cells.append(report)
+    failures = [c for c in cells if not c["ok"]]
+    return {
+        "seed": seed,
+        "n": n,
+        "duration": duration,
+        "trials": trials,
+        "cells": len(cells),
+        "outcomes": {
+            c["cell"]: ("ok" if c["ok"] else "failed") for c in cells
+        },
+        "failures": [
+            {"cell": c["cell"], "trial": c["trial"],
+             "verdict": c["verdict"]}
+            for c in failures
+        ],
+        "silent_corruptions": sum(
+            c["silent_corruptions"] for c in cells
+        ),
+        "lost_accepted": sum(c["lost_accepted"] for c in cells),
+        "stale_epoch_leaks": sum(
+            c["stale_epoch_leaks"] for c in cells
+        ),
+        "reports": cells,
+        "ok": not failures,
+    }
+
+
+def serve_selftest(seed: int = 0) -> Dict:
+    """The ``smi-tpu serve --selftest`` smoke: a deterministic CPU
+    admit -> stream -> shed -> drain pass (overload cell at a fast
+    shape) whose gates must all hold. Returns the cell report;
+    ``ok=False`` on any gate failure."""
+    return run_load_cell(
+        n=4, seed=seed, duration=160, overload=2.0
+    )
+
+
+def bench_fields(seed: int = 0) -> Dict:
+    """The additive ``serving`` field for ``bench.py``: a small
+    deterministic front-end smoke (pure Python, milliseconds) whose
+    offered load, per-class accept/shed counts, and admission-latency
+    percentiles ride next to the headline number — the serving regime
+    the build would sustain, measured, not asserted."""
+    rep = run_load_cell(n=4, seed=seed, duration=120, overload=2.0)
+    return {
+        "offered_chunks_per_tick": rep["offered_chunks_per_tick"],
+        "capacity_chunks_per_tick": rep["capacity_chunks_per_tick"],
+        "accepted": rep["accepted"],
+        "shed": {c: sum(rep["shed"][c].values())
+                 for c in QOS_CLASSES},
+        "admission_latency": rep["admission_latency"],
+        "ok": rep["ok"],
+    }

@@ -1,0 +1,498 @@
+"""The multi-tenant streaming front-end: admit, stream, shed, heal.
+
+One deterministic machine ties the serving layers together on the
+membership step clock (:class:`~smi_tpu.parallel.membership.StepClock`
+— no wall time anywhere, every run replays bit-identically per seed):
+
+- **routing**: a tenant hashes to a base rank; the live owner is
+  :func:`~smi_tpu.parallel.membership.route_owner` (the rank itself,
+  or its heir once membership confirms a death). Streams carry
+  transient per-tenant stream IDs — the serving analog of the
+  reference's per-message channels, and the identity
+  :func:`~smi_tpu.parallel.channels.open_tenant_channel` maps onto a
+  real :class:`~smi_tpu.parallel.channels.P2PChannel` port on the
+  runtime tier;
+- **admission** (:class:`~smi_tpu.serving.admission.AdmissionGate`):
+  stream credits chain end to end into the wire credits — a stream's
+  credit returns only when its last chunk is consumed and verified,
+  so a stalled consumer backpressures the admission edge instead of
+  growing a queue;
+- **delivery**: chunks move as CRC+sequence frames over per-rank
+  :class:`~smi_tpu.serving.scheduler.WireLane` credit windows; damage
+  is a named ``IntegrityError`` and the chunk replays from the
+  stream's WAL (:class:`~smi_tpu.parallel.recovery.ProgressLog`,
+  written at acceptance — which is what makes "accepted" a durable
+  promise);
+- **degradation**: ranks heartbeat on the clock; the phi-accrual
+  detector (:class:`~smi_tpu.parallel.membership.PhiAccrualDetector`)
+  distinguishes *dead* from *merely saturated* — a kill is suspected,
+  confirmed, the view shrinks under a new epoch, tenant routes fail
+  over to heirs, and every incomplete stream to the dead rank voids
+  its partial deliveries (``ProgressLog.void_deliveries`` — the
+  input-restart discipline of the reduction protocols) and replays to
+  the heir on a fresh sequence lane. Straggler traffic from the dead
+  incarnation is rejected by epoch
+  (:class:`~smi_tpu.parallel.membership.StaleEpochError`), counted,
+  never folded in.
+
+The exit gates the campaigns assert
+(:mod:`smi_tpu.serving.campaign`): zero silent corruption (every
+delivered stream bit-identical to its submission), zero
+lost-accepted-requests (every admitted stream delivered, or the run
+fails with a named error), bounded queue occupancy, lowest-class-first
+shedding, and bounded interactive admission latency.
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+from typing import Dict, List, Optional
+
+from smi_tpu.parallel.membership import (
+    HEARTBEAT_INTERVAL,
+    ConfirmedDead,
+    MembershipView,
+    PhiAccrualDetector,
+    StaleEpochError,
+    StepClock,
+    SuspectRank,
+    SuspicionCleared,
+    route_owner,
+)
+from smi_tpu.parallel.credits import IntegrityError
+from smi_tpu.parallel.recovery import ProgressLog
+from smi_tpu.serving.admission import AdmissionGate, DEFAULT_POOL
+from smi_tpu.serving.qos import QOS_CLASSES, Request, check_qos
+from smi_tpu.serving.scheduler import (
+    CONSUME_RATE,
+    StreamScheduler,
+    StreamState,
+    WireLane,
+    verify_chunk,
+)
+from smi_tpu.utils.watchdog import Deadline
+
+
+def tenant_base_rank(tenant: str, n: int) -> int:
+    """Deterministic tenant -> base rank map (stable across runs and
+    processes; failover rides :func:`membership.route_owner`)."""
+    return zlib.crc32(f"tenant:{tenant}".encode()) % n
+
+
+class ServingFrontend:
+    """Deterministic multi-tenant front-end over ``n`` ranks."""
+
+    def __init__(
+        self,
+        n: int,
+        seed: int = 0,
+        pool: int = DEFAULT_POOL,
+        consume_rate: int = CONSUME_RATE,
+        tenant_rate: float = 4.0,
+        tenant_burst: float = 64.0,
+        check_deadlines: bool = True,
+    ):
+        if n < 2:
+            raise ValueError(f"serving needs >= 2 ranks, got {n}")
+        self.n = n
+        self.rng = random.Random(f"serving:{n}:{seed}")
+        self.clock = StepClock()
+        self.view = MembershipView(n)
+        self.detector = PhiAccrualDetector(self.clock, range(n))
+        self.gate = AdmissionGate(
+            pool=pool, tenant_rate=tenant_rate,
+            tenant_burst=tenant_burst,
+        )
+        self.gate.on_admit = self._on_admit
+        #: per-destination accepted-stream cap: one saturated (or
+        #: silently dead) destination may hold at most twice its fair
+        #: share of the pool — and never more than pool minus one fair
+        #: share, so even on a 2-rank front-end a sick destination
+        #: leaves headroom and its backlog can never starve admission
+        #: to healthy destinations. The backpressure edge is
+        #: per-route, not just global.
+        fair = -(-pool // n)
+        self.dst_cap = max(2, min(2 * fair, pool - fair))
+        # the cap holds for PENDING requests too: a request parked
+        # while its destination was healthy must not slip past the
+        # backlog cap when a credit frees later (it stays parked and
+        # may time out with a named shed instead)
+        self.gate.admit_filter = lambda req: (
+            self._backlog(self._route_new(req.tenant, record=False))
+            < self.dst_cap
+        )
+        self.lanes = [WireLane(r) for r in range(n)]
+        self.scheduler = StreamScheduler(
+            check_deadlines=check_deadlines
+        )
+        self.consume_rate = consume_rate
+        #: externally-killed ranks (stop heartbeating and consuming);
+        #: membership catches up via phi-accrual
+        self.killed: set = set()
+        self.active: List[StreamState] = []
+        self.completed: List[StreamState] = []
+        self._stream_count = 0
+        self._tenant_seq: Dict[str, int] = {}
+        # report material
+        self.delivered: Dict[str, int] = {c: 0 for c in QOS_CLASSES}
+        self.silent_corruptions = 0
+        self.integrity_detections = 0
+        self.resequenced = 0
+        self.stale_epoch_rejections = 0
+        self.stale_epoch_leaks = 0
+        self.drained_routes = 0
+        self.suspected: List[int] = []
+        self.cleared: List[int] = []
+        self.confirmed: List[int] = []
+        self.detect_ticks: Optional[int] = None
+        self.replayed_chunks = 0
+        self.lost_in_flight = 0
+        self._kill_tick: Optional[int] = None
+        self._next_beat = 0
+        self._bootstrap()
+
+    # -- clock & membership plumbing ------------------------------------
+
+    def _bootstrap(self) -> None:
+        """Seed the detector's inter-arrival window before any traffic
+        (the elastic soak's discipline): four quiet heartbeat periods,
+        no transitions allowed."""
+        for _ in range(4):
+            for _ in range(HEARTBEAT_INTERVAL):
+                self.clock.advance(1)
+                self._heartbeats()
+                for tr in self.detector.poll():
+                    raise RuntimeError(
+                        f"transition during bootstrap: {tr}"
+                    )
+
+    def _heartbeats(self) -> None:
+        if self.clock.now() < self._next_beat:
+            return
+        for r in sorted(self.view.members):
+            if r in self.killed:
+                continue
+            self.detector.heartbeat(r)
+        self._next_beat = (
+            self.clock.now() + HEARTBEAT_INTERVAL
+            + self.rng.randrange(-1, 2)
+        )
+
+    def kill(self, rank: int) -> None:
+        """Crash-stop a rank: no more heartbeats, no more consumption.
+        Membership learns of it only through phi-accrual — the window
+        in which "dead" and "saturated" look identical at the edge."""
+        if not 0 <= rank < self.n:
+            raise ValueError(f"rank {rank} out of range")
+        self.killed.add(rank)
+        self._kill_tick = self.clock.now()
+
+    def stall_consumer(self, rank: int, until_tick: int) -> None:
+        """A live-but-stalled consumer (the saturation half of the
+        dead-vs-saturated distinction): the lane stops consuming until
+        the tick, wire credits exhaust, and backpressure must reach
+        the admission edge — with NO membership consequence."""
+        self.lanes[rank].stalled_until = max(
+            self.lanes[rank].stalled_until, until_tick
+        )
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, tenant: str, qos: str, chunks) -> Request:
+        """One tenant request at the admission edge. Returns the
+        :class:`Request` (admitted now, parked, or — when shed on the
+        spot — raises the named
+        :class:`~smi_tpu.serving.qos.AdmissionRejected`)."""
+        check_qos(qos)
+        seq = self._tenant_seq.get(tenant, 0)
+        self._tenant_seq[tenant] = seq + 1
+        request = Request(
+            tenant=tenant, qos=qos, chunks=tuple(chunks),
+            arrived_at=self.clock.now(), stream_id=(tenant, seq),
+        )
+        # per-destination backpressure: a route whose destination
+        # already holds its stream-cap of credits (stalled consumer,
+        # undetected death) sheds at the edge with a named error —
+        # class-blind but destination-targeted, so one sick rank can
+        # never starve admission to the healthy ones
+        dst = self._route_new(tenant, record=False)
+        if self._backlog(dst) >= self.dst_cap:
+            raise self.gate.shed_named(
+                request, f"backpressure:rank{dst}"
+            )
+        self.gate.offer(request, self.clock.now())
+        return request
+
+    def _route_new(self, tenant: str, record: bool = True) -> int:
+        """Routing for a NEWLY admitted stream: the tenant's live
+        owner, except that a *suspected* owner receives no new work —
+        the phi-accrual two-threshold semantics (suspect = drain new
+        work away, keep in the ring; confirm = shrink). New streams
+        divert to the heir-presumptive among unsuspected members;
+        in-flight streams stay put (suspicion is reversible — flapping
+        half-finished streams on a false positive would replay for
+        nothing)."""
+        from smi_tpu.parallel.recovery import heir_of
+
+        base = tenant_base_rank(tenant, self.n)
+        owner = route_owner(self.view, base, self.n)
+        if owner is None:  # pragma: no cover - last member can't die
+            raise RuntimeError("no surviving rank to route to")
+        if owner in self.detector.suspected:
+            trusted = self.view.members - self.detector.suspected
+            if trusted:
+                owner = heir_of(owner, trusted, self.n)
+                if record:
+                    self.drained_routes += 1
+        return owner
+
+    def _backlog(self, rank: int) -> int:
+        return sum(1 for st in self.active if st.dst == rank)
+
+    def _on_admit(self, request: Request, waited: int) -> None:
+        """Acceptance: durable WAL contribution + deadline start +
+        stream activation. From here on the request must be delivered
+        bit-identically — it holds a stream credit until it is."""
+        index = self._stream_count
+        self._stream_count += 1
+        wal = ProgressLog(rank=index)
+        wal.contribution = request.chunks
+        dst = self._route_new(request.tenant)
+        deadline = Deadline(
+            float(request.deadline_ticks),
+            clock=lambda: float(self.clock.now()),
+        )
+        self.active.append(StreamState(
+            request=request, index=index, dst=dst,
+            deadline=deadline, wal=wal,
+            lane_epoch=self.view.epoch,
+            admitted_at=self.clock.now(),
+        ))
+
+    # -- the serving loop -----------------------------------------------
+
+    def _state_provider(self):
+        """Per-stream serving state for watchdog dumps: (text,
+        structured) — the protocol-mirror discipline of
+        :func:`faults.mirror_state_provider` at the serving tier."""
+        state = {}
+        for st in self.active:
+            state[st.index] = {
+                "stream": st.request.stream_id,
+                "qos": st.request.qos,
+                "dst": st.dst,
+                "sent": st.next_to_send,
+                "delivered": len(st.delivered),
+                "of": st.total_chunks,
+            }
+        lines = [
+            f"  stream {v['stream']} ({v['qos']}) -> rank {v['dst']}: "
+            f"{v['delivered']}/{v['of']} delivered, {v['sent']} sent"
+            for v in state.values()
+        ]
+        return "\n".join(lines) or "  (no active streams)", state
+
+    def _complete(self, st: StreamState) -> None:
+        st.completed_at = self.clock.now()
+        assembled = tuple(
+            st.delivered[i] for i in range(st.total_chunks)
+        )
+        if assembled != st.request.chunks:
+            # the one forbidden outcome: counted, and the campaign
+            # gate fails the run
+            self.silent_corruptions += 1
+        self.delivered[st.request.qos] += 1
+        self.active.remove(st)
+        self.completed.append(st)
+        self.gate.release(st.request.qos, self.clock.now())
+
+    def _consume(self) -> None:
+        now = self.clock.now()
+        for lane in self.lanes:
+            lane.land(now)
+            if lane.rank in self.killed:
+                continue
+            if lane.stalled_until > now:
+                continue
+            budget = self.consume_rate
+            while budget > 0 and lane.landed:
+                item = lane.landed.popleft()
+                lane.credits += 1  # the slot frees either way
+                budget -= 1
+                st = item.stream
+                if item.lane_epoch != st.lane_epoch:
+                    # a pre-failover chunk reached a live consumer:
+                    # the DATA-PATH stale-epoch gate (not the
+                    # synthetic injection in _failover) — it must be
+                    # rejected by epoch before any seq/dst reasoning;
+                    # a validate() that passed here would mean the
+                    # epoch machinery lost track of a failover, which
+                    # is exactly what the leak counter exists to catch
+                    try:
+                        self.view.validate(
+                            lane.rank, item.view_epoch,
+                            what="pre-failover chunk",
+                        )
+                        self.stale_epoch_leaks += 1
+                    except StaleEpochError:
+                        self.stale_epoch_rejections += 1
+                    continue
+                try:
+                    payload = verify_chunk(lane, item)
+                except IntegrityError as e:
+                    if e.kind == "checksum":
+                        self.integrity_detections += 1
+                    else:
+                        self.resequenced += 1
+                    if not st.complete and st.dst == lane.rank:
+                        # replay from the receiver's expectation — the
+                        # PR-2 discipline: only undelivered chunks move
+                        want = lane.next_seq.get(st.lane_key, 0)
+                        if want < st.next_to_send:
+                            delta = st.next_to_send - want
+                            self.replayed_chunks += delta
+                            st.replayed_chunks += delta
+                            st.next_to_send = want
+                    continue
+                if st.complete or st.dst != lane.rank:
+                    continue  # straggler to a failed-over route
+                st.delivered[item.seq] = payload
+                st.wal.record((st.index, item.seq), payload)
+                if st.complete:
+                    self._complete(st)
+
+    def _failover(self, dead: int) -> None:
+        """Membership confirmed a death: shrink, re-route, replay."""
+        old_epoch = self.view.epoch
+        self.view.confirm_dead(dead)
+        if self.detect_ticks is None and self._kill_tick is not None:
+            self.detect_ticks = self.clock.now() - self._kill_tick
+        self.lost_in_flight += self.lanes[dead].drop_all()
+        for st in self.active:
+            if st.dst != dead:
+                # a live route stays put — including one the suspect
+                # diversion already steered away from its base owner:
+                # flapping a partially-delivered stream onto whatever
+                # route_owner(base) now says (possibly a still-
+                # suspected, saturated rank) would abandon progress
+                # for nothing
+                continue
+            owner = self._route_new(st.request.tenant, record=False)
+            # the dead consumer's partial state died with it: void
+            # the stream's delivery record and replay everything
+            # from the durable contribution on a fresh lane
+            st.wal.void_deliveries()
+            st.delivered.clear()
+            self.replayed_chunks += st.next_to_send
+            st.replayed_chunks += st.next_to_send
+            st.next_to_send = 0
+            st.lane_epoch = self.view.epoch
+            st.dst = owner
+        # one straggler from the dead incarnation arrives after the
+        # shrink: it must be rejected by epoch, never folded in
+        try:
+            self.view.validate(dead, old_epoch, what="straggler chunk")
+            self.stale_epoch_leaks += 1
+        except StaleEpochError:
+            self.stale_epoch_rejections += 1
+
+    def step(self) -> None:
+        """One tick of the serving loop. Order matters and is fixed:
+        heartbeats/detection first (failover reroutes before sends),
+        then landing+consumption (frees credits), then scheduling
+        (uses them), then the admission pump (newly freed stream
+        credits admit pending requests highest-class-first)."""
+        self.clock.advance(1)
+        now = self.clock.now()
+        self._heartbeats()
+        for tr in self.detector.poll():
+            if isinstance(tr, SuspectRank):
+                self.suspected.append(tr.rank)
+            elif isinstance(tr, SuspicionCleared):
+                self.cleared.append(tr.rank)
+            elif isinstance(tr, ConfirmedDead):
+                self.confirmed.append(tr.rank)
+                self._failover(tr.rank)
+        self._consume()
+        for lane in self.lanes:
+            lane.view_epoch = self.view.epoch
+        provider = self._state_provider
+        if self.scheduler.check_deadlines:
+            # the send-time checks only fire while a stream still has
+            # chunks to schedule; a fully-sent stream parked behind a
+            # stalled consumer must ALSO surface when its budget runs
+            # out — every active stream is checked every tick, so an
+            # accepted stream can never miss its deadline silently
+            for st in list(self.active):
+                st.deadline.with_provider(provider).check(
+                    f"stream {st.request.stream_id} "
+                    f"({st.request.qos}) awaiting delivery at rank "
+                    f"{st.dst} ({len(st.delivered)}/"
+                    f"{st.total_chunks} delivered)"
+                )
+        for lane in self.lanes:
+            self.scheduler.schedule_lane(
+                lane, self.active, now, provider
+            )
+        self.gate.pump(now)
+        self.gate.assert_bounded()
+
+    def drain(self, max_ticks: int = 5000) -> None:
+        """Run the loop until every accepted stream completes. A
+        stream that cannot finish hits its per-chunk deadline
+        (``WatchdogTimeout`` with the serving state dump) long before
+        the tick bound; the bound is the backstop for a scheduler bug,
+        and exceeding it raises with the same dump."""
+        for _ in range(max_ticks):
+            if not self.active and not any(
+                q for q in self.gate.pending.values()
+            ):
+                return
+            self.step()
+        text, state = self._state_provider()
+        raise RuntimeError(
+            f"drain did not converge in {max_ticks} ticks; "
+            f"active streams:\n{text}"
+        )
+
+    # -- report ---------------------------------------------------------
+
+    def report(self) -> Dict:
+        gate = self.gate
+        delivered_total = sum(self.delivered.values())
+        accepted_total = sum(gate.admitted.values())
+        # accepted == delivered + still-active; after a full drain
+        # active is empty, so any imbalance IS a lost accepted stream
+        return {
+            "n": self.n,
+            "epoch": self.view.epoch,
+            "members": sorted(self.view.members),
+            "submitted": {
+                c: gate.admitted[c] + gate.shed_total(c)
+                for c in QOS_CLASSES
+            },
+            "accepted": dict(gate.admitted),
+            "shed": {c: dict(gate.shed[c]) for c in QOS_CLASSES},
+            "delivered": dict(self.delivered),
+            "lost_accepted": accepted_total - delivered_total
+            - len(self.active),
+            "in_flight": len(self.active),
+            "silent_corruptions": self.silent_corruptions,
+            "integrity_detections": self.integrity_detections,
+            "resequenced": self.resequenced,
+            "replayed_chunks": self.replayed_chunks,
+            "lost_in_flight": self.lost_in_flight,
+            "stale_epoch_rejections": self.stale_epoch_rejections,
+            "stale_epoch_leaks": self.stale_epoch_leaks,
+            "drained_routes": self.drained_routes,
+            "suspected": list(self.suspected),
+            "cleared": list(self.cleared),
+            "confirmed": list(self.confirmed),
+            "detect_ticks": self.detect_ticks,
+            "max_queue_depth": gate.max_queue_depth,
+            "queue_bound": gate.pool * (1 + len(QOS_CLASSES)),
+            "admission_waits": {
+                c: list(gate.admission_waits[c]) for c in QOS_CLASSES
+            },
+        }

@@ -33,6 +33,7 @@ layer and from test tooling alike.
 
 from __future__ import annotations
 
+import math
 import os
 import queue
 import threading
@@ -149,11 +150,30 @@ def default_deadline(
 
     Unset, empty, and non-positive values all mean "no watchdog" —
     ``SMI_WATCHDOG_SECS=0`` is off, not an instantly-expired budget.
+    A malformed value is a LOUD error naming the knob and the value
+    (the ``$SMI_TPU_RS_AG_MIN_BYTES`` discipline): a typo silently
+    disabling the watchdog would undo the operator's intent without
+    a trace.
     """
     raw = os.environ.get(WATCHDOG_ENV, "").strip()
     if not raw:
         return None
-    seconds = float(raw)
+    try:
+        seconds = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"${WATCHDOG_ENV} must be a number of seconds (watchdog "
+            f"budget; 0 or negative disables), got {raw!r}"
+        ) from None
+    if not math.isfinite(seconds):
+        # NaN never compares expired; +inf is a watchdog that never
+        # fires — both silently disable the watchdog, the exact
+        # outcome malformed values must not have (0 is the explicit
+        # off switch)
+        raise ValueError(
+            f"${WATCHDOG_ENV} must be a finite number of seconds "
+            f"(watchdog budget; 0 or negative disables), got {raw!r}"
+        )
     if seconds <= 0:
         return None
     return Deadline(seconds, state_provider=state_provider)

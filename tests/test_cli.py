@@ -465,3 +465,86 @@ def test_device_rejects_bad_name(tmp_path, capsys):
     bad.write_text("{}")
     assert run_cli("device", str(tmp_path / "o.py"), str(bad)) == 1
     assert "identifier" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# serve (the multi-tenant front-end selftest) + chaos --load
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.serving
+def test_serve_selftest_exits_zero_and_reports(tmp_path, capsys):
+    out = tmp_path / "serve.json"
+    assert run_cli("serve", "--selftest", "--seed", "1729",
+                   "-o", str(out)) == 0
+    printed = capsys.readouterr().out
+    assert "selftest (seed 1729): ok" in printed
+    assert "0 silent corruptions" in printed
+    assert "0 lost accepted" in printed
+    report = json.loads(out.read_text())
+    assert report["ok"] is True
+    assert report["lost_accepted"] == 0
+    assert report["silent_corruptions"] == 0
+    # deterministic per seed: the JSON reproduces bit-identically
+    out2 = tmp_path / "serve2.json"
+    assert run_cli("serve", "--selftest", "--seed", "1729",
+                   "-o", str(out2)) == 0
+    capsys.readouterr()
+    assert out.read_text() == out2.read_text()
+
+
+@pytest.mark.serving
+def test_serve_selftest_json_mode(capsys):
+    assert run_cli("serve", "--selftest", "--json") == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["verdict"] == "ok"
+
+
+@pytest.mark.serving
+def test_serve_without_selftest_is_usage_error(capsys):
+    assert run_cli("serve") == 2
+    assert "--selftest" in capsys.readouterr().err
+
+
+@pytest.mark.serving
+def test_chaos_load_cli_gate_and_report(tmp_path, capsys):
+    out = tmp_path / "load.json"
+    assert run_cli("chaos", "--load", "--seed", "1729", "--trials",
+                   "1", "--duration", "160", "-o", str(out)) == 0
+    printed = capsys.readouterr().out
+    assert "load campaign ok" in printed
+    assert "0 silent corruptions" in printed
+    report = json.loads(out.read_text())
+    assert report["ok"] and report["cells"] == 3
+    assert report["lost_accepted"] == 0
+    assert report["stale_epoch_leaks"] == 0
+
+
+@pytest.mark.serving
+def test_chaos_load_cli_flag_conflicts(capsys):
+    assert run_cli("chaos", "--load", "--elastic") == 2
+    assert "distinct campaigns" in capsys.readouterr().err
+    assert run_cli("chaos", "--load", "--protocols", "all_gather") == 2
+    assert "--protocols" in capsys.readouterr().err
+    assert run_cli("chaos", "--load", "--max-faults", "3") == 2
+    assert "--max-faults" in capsys.readouterr().err
+
+
+@pytest.mark.serving
+def test_chaos_load_cli_rejects_ranks_and_short_duration(capsys):
+    assert run_cli("chaos", "--load", "--ranks", "8", "9") == 2
+    assert "-n/--n instead" in capsys.readouterr().err
+    assert run_cli("chaos", "--load", "--duration", "50") == 2
+    assert "minimum" in capsys.readouterr().err
+
+
+@pytest.mark.serving
+def test_chaos_flag_scoping_between_campaign_modes(capsys):
+    # --ranks with --load: usage error even at the default values
+    assert run_cli("chaos", "--load", "--ranks", "2", "3", "4", "5") == 2
+    assert "-n/--n instead" in capsys.readouterr().err
+    # --duration/-n without --load: usage error, not silently ignored
+    assert run_cli("chaos", "--duration", "100") == 2
+    assert "--load" in capsys.readouterr().err
+    assert run_cli("chaos", "--elastic", "-n", "8") == 2
+    assert "--load" in capsys.readouterr().err

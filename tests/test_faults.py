@@ -194,6 +194,45 @@ def test_default_deadline_env(monkeypatch):
     assert d is not None and d.budget == 2.5
 
 
+@pytest.mark.parametrize("raw,outcome", [
+    ("", None),            # empty = unset = no watchdog
+    ("   ", None),         # whitespace-only = unset
+    ("0", None),           # zero = off, not an instantly-expired budget
+    ("0.0", None),
+    ("-5", None),          # negative = off (documented)
+    ("-0.01", None),
+    ("2.5", 2.5),          # well-formed budgets construct Deadlines
+    ("  30  ", 30.0),      # surrounding whitespace tolerated
+    ("1e-3", 1e-3),
+    ("abc", "raise"),      # malformed must be LOUD, never a silent off
+    ("2.5s", "raise"),
+    ("1,5", "raise"),
+    ("nan", "raise"),      # NaN parses as float but is not a budget
+    ("NaN", "raise"),
+    ("inf", "raise"),      # a watchdog that never fires = silent off
+    ("-inf", "raise"),
+    ("Infinity", "raise"),
+])
+def test_default_deadline_env_matrix(monkeypatch, raw, outcome):
+    """The $SMI_WATCHDOG_SECS parse matrix: unset/empty/zero/negative
+    mean OFF, numbers mean budgets, and anything malformed raises a
+    named error citing the knob and the bad value — the
+    SMI_TPU_RS_AG_MIN_BYTES discipline (a typo must not silently
+    disable the watchdog)."""
+    monkeypatch.setenv(W.WATCHDOG_ENV, raw)
+    if outcome == "raise":
+        with pytest.raises(ValueError) as e:
+            W.default_deadline()
+        msg = str(e.value)
+        assert W.WATCHDOG_ENV in msg
+        assert raw.strip() in msg
+    elif outcome is None:
+        assert W.default_deadline() is None
+    else:
+        d = W.default_deadline()
+        assert d is not None and d.budget == outcome
+
+
 def test_run_with_deadline_times_out():
     import time as _time
 

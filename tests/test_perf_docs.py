@@ -145,6 +145,46 @@ def test_robustness_doc_quotes_elastic_config():
     assert f"${checkpoint.DIR_ENV}" in text
 
 
+def test_serving_doc_quotes_the_shipped_constants():
+    """docs/robustness.md's "Serving under overload" section must
+    state the pool size, brownout ceilings, wait caps, deadline
+    budgets, wire window, per-route cap formula, and the interactive
+    p99 bound the serving code ships — the same drift discipline as
+    the elastic section. (Pure Python imports, no devices.)"""
+    from smi_tpu.serving import admission, qos, scheduler
+
+    text = _read("docs/robustness.md")
+    assert "Serving under overload" in text
+    assert (f"pool of {admission.DEFAULT_POOL} stream credits"
+            in text)
+    for cls, pct in (("best_effort", 50), ("batch", 75),
+                     ("interactive", 100)):
+        assert qos.CLASS_POOL_CEILING[cls] == pct / 100
+        assert f"{cls} {pct}%" in text
+    assert (
+        f"interactive {qos.CLASS_ADMISSION_WAIT_TICKS['interactive']}"
+        f", batch {qos.CLASS_ADMISSION_WAIT_TICKS['batch']}, "
+        f"best_effort {qos.CLASS_ADMISSION_WAIT_TICKS['best_effort']}"
+        f" ticks" in text
+    )
+    assert (
+        f"interactive {qos.CLASS_DEADLINE_TICKS['interactive']}, "
+        f"batch {qos.CLASS_DEADLINE_TICKS['batch']}, best_effort\n"
+        f"{qos.CLASS_DEADLINE_TICKS['best_effort']} ticks" in text
+    )
+    assert (f"WIRE_CREDITS={scheduler.WIRE_CREDITS} per destination "
+            f"lane" in text)
+    assert f"<= {qos.INTERACTIVE_P99_TICKS}\nticks" in text
+    assert (f"{qos.CLASS_ADMISSION_WAIT_TICKS['interactive']}-tick\n"
+            f"wait cap" in text)
+    assert "2*pool/n streams" in text
+    assert "`backpressure:rank<r>`" in text
+    assert "`brownout:best_effort`" in text
+    # the named fault class and its registry stay quoted
+    assert "`faults.SlowConsumer`" in text
+    assert "SERVING_FAULT_CLASSES" in text
+
+
 def test_two_tier_docs_quote_the_shipped_rates_and_gates():
     """The r6 two-tier sections (docs/tuning.md decision table,
     docs/perf_notes.md "Two-tier collectives (r6)") must state the

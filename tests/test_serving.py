@@ -1,0 +1,877 @@
+"""Multi-tenant streaming front-end: admission, QoS, backpressure,
+fairness, and chaos under load.
+
+The serving tier's contract, asserted layer by layer:
+
+- the admission gate's token buckets, brownout ceilings (lowest class
+  first by construction), bounded pending queues, and named
+  ``AdmissionRejected`` errors — nothing is ever dropped silently;
+- the end-to-end credit chain: a stalled consumer exhausts its wire
+  credits, holds its streams' credits, and sheds NEW work at the
+  admission edge with a named error, while queue occupancy stays
+  inside the structural bound;
+- scheduler fairness: strict class priority with the aging bound, and
+  the credits-simulator tenant-fairness regression (unequal streams
+  on one wire never starve the small one past the burst-interleave
+  gap);
+- deadline propagation from request budgets into per-chunk watchdog
+  checks carrying the serving state mirror;
+- degradation: kill-one-rank under open-loop traffic — phi-accrual
+  detect, heir failover, WAL replay, stale-epoch rejection — and the
+  seed-pinned chaos-under-load campaign with its zero-silent-
+  corruption / zero-lost-accepted / bounded-queue gates (fast shape
+  in tier-1, long soak behind ``slow``).
+
+Pure Python except the transient-channel bridge tests (8 virtual CPU
+devices via conftest).
+"""
+
+import json
+
+import pytest
+
+from smi_tpu.parallel import credits as C
+from smi_tpu.parallel import faults as F
+from smi_tpu.parallel.membership import (
+    MembershipView,
+    WATCHDOG_TICKS,
+    route_owner,
+)
+from smi_tpu.parallel.recovery import ProgressLog
+from smi_tpu.serving import admission as A
+from smi_tpu.serving import qos as Q
+from smi_tpu.serving import scheduler as S
+from smi_tpu.serving.campaign import (
+    bench_fields,
+    load_campaign,
+    run_load_cell,
+    serve_selftest,
+)
+from smi_tpu.serving.frontend import ServingFrontend, tenant_base_rank
+from smi_tpu.utils.watchdog import WatchdogTimeout
+
+pytestmark = pytest.mark.serving
+
+
+# ---------------------------------------------------------------------------
+# Token bucket + admission gate policy
+# ---------------------------------------------------------------------------
+
+
+def _req(tenant="t0", qos="interactive", chunks=("a", "b"), at=0):
+    return Q.Request(tenant=tenant, qos=qos, chunks=tuple(chunks),
+                     arrived_at=at)
+
+
+def test_token_bucket_rate_and_burst():
+    b = A.TokenBucket(rate_per_tick=0.5, burst=2.0)
+    assert b.try_take(0) and b.try_take(0)      # the burst
+    assert not b.try_take(0)                    # drained
+    assert not b.try_take(1)                    # 0.5 tokens: not enough
+    assert b.try_take(2)                        # refilled to 1.0
+    # deterministic: same call sequence, same outcomes
+    b2 = A.TokenBucket(0.5, 2.0)
+    assert [b2.try_take(t) for t in (0, 0, 0, 1, 2)] == [
+        True, True, False, False, True,
+    ]
+
+
+def test_gate_admits_within_pool_and_ceilings():
+    gate = A.AdmissionGate(pool=4, tenant_rate=10, tenant_burst=100)
+    # best_effort ceiling = ceil(0.5*4) = 2 slots
+    assert gate.offer(_req("t0", "best_effort"), 0)
+    assert gate.offer(_req("t1", "best_effort"), 0)
+    assert not gate.offer(_req("t2", "best_effort"), 0)  # parked
+    # batch ceiling = 3: one more admission
+    assert gate.offer(_req("t3", "batch"), 0)
+    assert not gate.offer(_req("t4", "batch"), 0)        # parked
+    # interactive rides to the full pool
+    assert gate.offer(_req("t5", "interactive"), 0)
+    assert gate.occupancy() == 4
+    # pool exhausted: even interactive parks now
+    assert not gate.offer(_req("t6", "interactive"), 0)
+    gate.assert_bounded()
+
+
+def test_gate_brownout_is_lowest_class_first_and_named():
+    gate = A.AdmissionGate(pool=2, tenant_rate=10, tenant_burst=100)
+    assert gate.offer(_req("t0", "interactive"), 0)
+    assert gate.offer(_req("t1", "interactive"), 0)
+    # fill best_effort's pending tier (bound == pool == 2)
+    assert not gate.offer(_req("t2", "best_effort"), 0)
+    assert not gate.offer(_req("t3", "best_effort"), 0)
+    # sustained brownout: the next one sheds immediately, named
+    with pytest.raises(Q.AdmissionRejected) as e:
+        gate.offer(_req("t4", "best_effort"), 0)
+    assert e.value.reason == "brownout:best_effort"
+    assert e.value.tenant == "t4"
+    assert e.value.qos == "best_effort"
+    assert e.value.queue_depth == 4
+    assert gate.shed["best_effort"]["brownout:best_effort"] == 1
+
+
+def test_gate_tenant_rate_is_isolated_and_class_blind():
+    gate = A.AdmissionGate(pool=100, tenant_rate=0.1, tenant_burst=1)
+    assert gate.offer(_req("hot", "interactive"), 0)
+    with pytest.raises(Q.AdmissionRejected) as e:
+        gate.offer(_req("hot", "interactive"), 0)
+    assert e.value.reason == "tenant-rate"
+    # a different tenant is unaffected
+    assert gate.offer(_req("cold", "best_effort"), 0)
+
+
+def test_gate_pending_admits_by_class_priority_on_release():
+    gate = A.AdmissionGate(pool=2, tenant_rate=10, tenant_burst=100)
+    assert gate.offer(_req("t0", "interactive"), 0)
+    assert gate.offer(_req("t1", "interactive"), 0)
+    # park one of each lower class, batch FIRST in arrival order
+    assert not gate.offer(_req("t2", "best_effort", at=1), 1)
+    assert not gate.offer(_req("t3", "batch", at=1), 1)
+    assert not gate.offer(_req("t4", "interactive", at=1), 1)
+    # one credit frees: the interactive waiter wins despite arriving
+    # last
+    admitted = gate.release("interactive", 2)
+    assert [r.qos for r in admitted] == ["interactive"]
+    waits = gate.admission_waits["interactive"]
+    assert waits[-1] == 1  # parked at 1, admitted at 2
+
+
+def test_gate_admission_timeout_sheds_named_after_cap():
+    gate = A.AdmissionGate(pool=1, tenant_rate=10, tenant_burst=100)
+    assert gate.offer(_req("t0", "interactive"), 0)
+    assert not gate.offer(_req("t1", "interactive"), 0)
+    cap = Q.CLASS_ADMISSION_WAIT_TICKS["interactive"]
+    gate.pump(cap)          # still waiting, inside the cap
+    assert len(gate.pending["interactive"]) == 1
+    gate.pump(cap + 1)      # one past: shed, named
+    assert not gate.pending["interactive"]
+    assert gate.shed["interactive"]["admission-timeout"] == 1
+    rejection = gate.rejections[-1]
+    assert rejection.reason == "admission-timeout"
+    assert rejection.tenant == "t1"
+
+
+def test_gate_occupancy_bound_is_asserted():
+    gate = A.AdmissionGate(pool=2, tenant_rate=10, tenant_burst=100)
+    gate.held["interactive"] = 3  # corrupt the invariant by hand
+    with pytest.raises(AssertionError):
+        gate.assert_bounded()
+    with pytest.raises(AssertionError):
+        A.AdmissionGate(pool=2).release("batch", 0)  # never held
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: class priority, aging bound, wire credits
+# ---------------------------------------------------------------------------
+
+
+def _stream(index, qos, dst=0, chunks=("x",) * 8, clock=None):
+    from smi_tpu.utils.watchdog import Deadline
+
+    req = Q.Request(tenant=f"t{index}", qos=qos, chunks=tuple(chunks),
+                    arrived_at=0, stream_id=(f"t{index}", 0))
+    return S.StreamState(
+        request=req, index=index, dst=dst,
+        deadline=Deadline(None if clock is None else 10_000,
+                          clock=clock or (lambda: 0.0)),
+        wal=ProgressLog(rank=index),
+    )
+
+
+def test_scheduler_strict_priority_then_admission_order():
+    lane = S.WireLane(0)
+    streams = [
+        _stream(0, "best_effort"),
+        _stream(1, "interactive"),
+        _stream(2, "batch"),
+    ]
+    sched = S.StreamScheduler(check_deadlines=False)
+    sent = sched.schedule_lane(lane, streams, now=0)
+    assert sent == S.WIRE_CREDITS
+    order = [item.stream.index for item in lane.in_flight]
+    # interactive drains first (4 credits: 4 of its chunks)
+    assert order == [1, 1, 1, 1]
+
+
+def test_scheduler_aging_bound_prevents_starvation():
+    lane = S.WireLane(0)
+    starving = _stream(0, "best_effort")
+    streams = [starving, _stream(1, "interactive", chunks=("x",) * 64)]
+    sched = S.StreamScheduler(check_deadlines=False)
+    sends = []
+    for tick in range(40):
+        sched.schedule_lane(lane, streams, now=tick)
+        while lane.in_flight:
+            item = lane.in_flight.popleft()
+            lane.credits += 1
+            sends.append(item.stream.index)
+    # the best_effort stream is served within the aging bound: its
+    # first chunk is sent after at most MAX_STARVE_ROUNDS decisions
+    first = sends.index(0)
+    assert first <= S.MAX_STARVE_ROUNDS + 1
+    assert starving.next_to_send > 0
+
+
+def test_wire_lane_credits_exhaust_without_consumption():
+    lane = S.WireLane(0)
+    st = _stream(0, "interactive", chunks=("x",) * 10)
+    sched = S.StreamScheduler(check_deadlines=False)
+    assert sched.schedule_lane(lane, [st], now=0) == S.WIRE_CREDITS
+    # no consumption -> no credits -> no further sends (backpressure)
+    assert sched.schedule_lane(lane, [st], now=1) == 0
+    assert not lane.can_send()
+
+
+def test_deadline_propagates_to_per_chunk_checks_with_state():
+    now = {"t": 0.0}
+    from smi_tpu.utils.watchdog import Deadline
+
+    st = _stream(0, "interactive")
+    st.deadline = Deadline(5.0, clock=lambda: now["t"])
+    lane = S.WireLane(0)
+    sched = S.StreamScheduler()
+    now["t"] = 6.0  # budget spent before the first chunk moves
+    provider = lambda: ("stream 0 parked at chunk 0", {"stream": 0})
+    with pytest.raises(WatchdogTimeout) as e:
+        sched.schedule_lane(lane, [st], now=6, state_provider=provider)
+    msg = str(e.value)
+    assert "chunk 0/8" in msg and "interactive" in msg
+    assert e.value.state == {"stream": 0}  # the serving mirror rides
+
+
+def test_verify_chunk_catches_crc_and_sequence_damage():
+    lane = S.WireLane(0)
+    st = _stream(7, "batch")
+    sched = S.StreamScheduler(check_deadlines=False)
+    sched.schedule_lane(lane, [st], now=0)
+    lane.land(1)
+    item = lane.landed.popleft()
+    # CRC damage: a flipped payload with the sender's CRC
+    bad = C.Frame(item.frame.src, item.frame.seq, True,
+                  "corrupted!", item.frame.crc)
+    import dataclasses as _dc
+
+    with pytest.raises(C.IntegrityError) as e:
+        S.verify_chunk(lane, _dc.replace(item, frame=bad))
+    assert e.value.kind == "checksum"
+    # healthy frame passes, advancing the lane; a stale re-send of
+    # seq 0 is then an out-of-sequence error
+    assert S.verify_chunk(lane, item) == "x"
+    with pytest.raises(C.IntegrityError) as e:
+        S.verify_chunk(lane, item)
+    assert e.value.kind == "sequence"
+
+
+# ---------------------------------------------------------------------------
+# Frontend: healthy runs, backpressure, integrity, failover
+# ---------------------------------------------------------------------------
+
+
+def test_frontend_healthy_run_delivers_bit_identically():
+    fe = ServingFrontend(4, seed=0, pool=8)
+    reqs = []
+    for i in range(12):
+        reqs.append(fe.submit(
+            f"t{i % 3}", "interactive", (f"p{i}a", f"p{i}b", f"p{i}c")
+        ))
+        fe.step()
+    fe.drain()
+    rep = fe.report()
+    assert rep["lost_accepted"] == 0
+    assert rep["silent_corruptions"] == 0
+    assert rep["delivered"]["interactive"] == 12
+    assert fe.gate.occupancy() == 0  # every stream credit returned
+    # per-stream delivery is bit-identical and WAL-complete
+    for st in fe.completed:
+        assert tuple(
+            st.delivered[i] for i in range(st.total_chunks)
+        ) == st.request.chunks
+        assert not st.wal.missing(
+            {(st.index, i) for i in range(st.total_chunks)}
+        )
+
+
+def test_frontend_transient_stream_ids_are_per_tenant_sequences():
+    fe = ServingFrontend(4, seed=0)
+    a0 = fe.submit("alice", "interactive", ("x",))
+    b0 = fe.submit("bob", "interactive", ("y",))
+    a1 = fe.submit("alice", "interactive", ("z",))
+    assert a0.stream_id == ("alice", 0)
+    assert a1.stream_id == ("alice", 1)
+    assert b0.stream_id == ("bob", 0)
+
+
+def test_frontend_stalled_consumer_backpressures_to_admission():
+    fe = ServingFrontend(4, seed=1, pool=8,
+                         tenant_rate=10, tenant_burst=100)
+    victim = tenant_base_rank("t0", 4)
+    fe.stall_consumer(victim, fe.clock.now() + 10_000)  # forever
+    shed = None
+    for i in range(40):
+        try:
+            fe.submit("t0", "interactive", (f"c{i}",))
+        except Q.AdmissionRejected as e:
+            shed = e
+            break
+        fe.step()
+    assert shed is not None, "stall never reached the admission edge"
+    assert shed.reason == f"backpressure:rank{victim}"
+    # the backlog cap held: the stalled destination owns at most its
+    # per-route share of the pool
+    assert fe._backlog(victim) <= fe.dst_cap
+    fe.gate.assert_bounded()
+    # no membership consequence: the rank still heartbeats
+    assert not fe.confirmed and not fe.suspected
+
+
+def test_frontend_integrity_damage_is_detected_and_replayed():
+    fe = ServingFrontend(4, seed=2, pool=8)
+    req = fe.submit("t1", "batch", ("aa", "bb", "cc", "dd"))
+    # tamper the first chunk in flight once: flip payload, keep CRC
+    state = {"done": False}
+    orig_send = S.WireLane.send
+
+    def tampering_send(lane, stream, seq, payload, now):
+        orig_send(lane, stream, seq, payload, now)
+        if not state["done"] and seq == 1:
+            state["done"] = True
+            item = lane.in_flight[-1]
+            item.frame = C.Frame(
+                item.frame.src, item.frame.seq, True,
+                "garbage", item.frame.crc,
+            )
+
+    try:
+        S.WireLane.send = tampering_send
+        fe.drain()
+    finally:
+        S.WireLane.send = orig_send
+    rep = fe.report()
+    assert rep["integrity_detections"] == 1   # named, at the chunk
+    assert rep["silent_corruptions"] == 0     # and NOT delivered wrong
+    assert rep["lost_accepted"] == 0
+    assert rep["replayed_chunks"] >= 1        # the damaged chunk moved again
+    st = fe.completed[0]
+    assert tuple(
+        st.delivered[i] for i in range(4)
+    ) == req.chunks
+
+
+def test_frontend_kill_detect_failover_replay():
+    fe = ServingFrontend(4, seed=3, pool=12,
+                         tenant_rate=10, tenant_burst=100)
+    # aim a tenant at a known rank, get streams in flight, then kill
+    victim_tenant = next(
+        f"t{i}" for i in range(32) if tenant_base_rank(f"t{i}", 4) == 2
+    )
+    submitted = []
+    for i in range(3):
+        submitted.append(fe.submit(
+            victim_tenant, "batch", tuple(f"s{i}c{c}" for c in range(6))
+        ))
+        fe.step()
+    fe.kill(2)
+    fe.drain()
+    rep = fe.report()
+    assert rep["confirmed"] == [2]
+    assert rep["detect_ticks"] is not None
+    assert rep["detect_ticks"] <= WATCHDOG_TICKS
+    assert rep["members"] == [0, 1, 3]
+    assert rep["epoch"] == 1
+    # the failover voided partial deliveries and replayed: accepted
+    # streams completed bit-identically at the heir
+    assert rep["lost_accepted"] == 0
+    assert rep["silent_corruptions"] == 0
+    assert rep["replayed_chunks"] > 0
+    assert rep["stale_epoch_rejections"] >= 1
+    assert rep["stale_epoch_leaks"] == 0
+    heir = route_owner(fe.view, 2, 4)
+    assert heir == 3
+    for st in fe.completed:
+        assert st.dst != 2
+
+
+def test_frontend_fully_sent_stream_still_fires_its_deadline():
+    """A stream whose chunks are ALL sent into a stalled lane has
+    nothing left to schedule, so the send-time checks alone would
+    never fire — the per-tick check must still surface the budget
+    expiry as a named WatchdogTimeout with the serving dump (the
+    'never a silent loss' contract)."""
+    fe = ServingFrontend(4, seed=5, pool=8,
+                         tenant_rate=10, tenant_burst=100)
+    victim = tenant_base_rank("t0", 4)
+    req = fe.submit("t0", "interactive", ("a", "b"))
+    fe.step()  # both chunks send into the lane
+    fe.stall_consumer(
+        victim, fe.clock.now() + req.deadline_ticks + 200
+    )
+    with pytest.raises(WatchdogTimeout) as e:
+        for _ in range(req.deadline_ticks + 50):
+            fe.step()
+    msg = str(e.value)
+    assert "awaiting delivery" in msg
+    assert "('t0', 0)" in msg
+    assert e.value.state  # the per-stream serving mirror rides along
+
+
+def test_frontend_pending_admissions_respect_the_backlog_cap():
+    """The per-destination cap must hold for requests admitted LATER
+    from the pending queue, not just at submit time: a credit freeing
+    while a destination is sick must not slip parked requests past
+    its backlog cap."""
+    fe = ServingFrontend(4, seed=6, pool=4,
+                         tenant_rate=10, tenant_burst=100)
+    victim = tenant_base_rank("sick", 4)
+    healthy = next(
+        f"h{i}" for i in range(32)
+        if tenant_base_rank(f"h{i}", 4) != victim
+    )
+    fe.stall_consumer(victim, fe.clock.now() + 10_000)
+    # fill the pool: dst_cap streams to the sick rank + healthy rest
+    parked = 0
+    for i in range(fe.dst_cap):
+        fe.submit("sick", "interactive", (f"s{i}",))
+    for i in range(fe.gate.pool - fe.dst_cap):
+        fe.submit(healthy, "interactive", (f"h{i}",))
+    # park more sick-bound requests while the pool is full (they pass
+    # the submit-time cap check only until the backlog builds, so
+    # offer until two are parked)
+    for i in range(8):
+        try:
+            if not fe.gate.offer(
+                Q.Request(tenant="sick", qos="interactive",
+                          chunks=(f"p{i}",),
+                          arrived_at=fe.clock.now()),
+                fe.clock.now(),
+            ):
+                parked += 1
+        except Q.AdmissionRejected:
+            break
+    assert parked > 0
+    # drain the healthy streams: credits free, pump runs — the parked
+    # sick-bound requests must stay parked (filter), never pushing the
+    # sick backlog past the cap
+    for _ in range(60):
+        fe.step()
+        assert fe._backlog(victim) <= fe.dst_cap, (
+            f"backlog {fe._backlog(victim)} exceeded dst_cap "
+            f"{fe.dst_cap} via a pending admission"
+        )
+
+
+def test_failover_leaves_live_routes_alone_even_when_diverted():
+    """A confirmed death elsewhere must not touch streams on LIVE
+    routes — including one the suspect diversion already steered off
+    its base owner. Force-moving a partially-delivered stream back
+    onto a still-suspected rank would abandon progress for nothing."""
+    fe = ServingFrontend(4, seed=21, pool=12,
+                         tenant_rate=10, tenant_burst=100)
+    # suspend rank 1 (kill it but don't let confirmation land yet)
+    fe.kill(1)
+    for _ in range(400):
+        fe.step()
+        if 1 in fe.detector.suspected:
+            break
+    assert 1 in fe.detector.suspected and 1 in fe.view.members
+    # a new stream for a rank-1 tenant diverts to the heir-presumptive
+    t1 = next(f"d{i}" for i in range(32)
+              if tenant_base_rank(f"d{i}", 4) == 1)
+    fe.submit(t1, "batch", tuple(f"c{c}" for c in range(6)))
+    diverted = fe.active[-1]
+    assert diverted.dst != 1
+    diverted_dst = diverted.dst
+    # now a DIFFERENT rank is confirmed dead: the diverted stream must
+    # keep its live route
+    other_dead = next(r for r in (0, 2, 3) if r != diverted_dst)
+    fe._failover(other_dead)
+    assert diverted.dst == diverted_dst
+    assert diverted.replayed_chunks == 0
+
+
+def test_consume_rejects_pre_failover_chunks_by_epoch():
+    """The data-path half of the stale-epoch gate: a chunk sent under
+    an old route incarnation that reaches a live consumer is rejected
+    by epoch (counted), never folded into the failed-over stream."""
+    fe = ServingFrontend(4, seed=22, pool=8,
+                         tenant_rate=10, tenant_burst=100)
+    fe.submit("t0", "batch", ("a", "b", "c", "d"))
+    st = fe.active[0]
+    # let a chunk get in flight, then simulate a failover of the
+    # stream (fresh lane incarnation) while the old chunk still flies
+    lane = fe.lanes[st.dst]
+    fe.scheduler.schedule_lane(lane, fe.active, fe.clock.now())
+    assert lane.in_flight
+    # model what a real failover does: membership epoch bumps and the
+    # stream restarts on a fresh lane incarnation (a real failover
+    # would also reroute; keeping the rank makes the straggler land
+    # at a LIVE consumer — the exact case the data-path gate covers)
+    fe.view.epoch += 1
+    st.lane_epoch = fe.view.epoch
+    st.delivered.clear()
+    st.next_to_send = 0
+    before = fe.stale_epoch_rejections
+    for _ in range(4):
+        fe.step()
+    assert fe.stale_epoch_rejections > before
+    assert fe.stale_epoch_leaks == 0
+    # the stale chunks were never folded in; the replayed ones were
+    fe.drain()
+    assert fe.report()["silent_corruptions"] == 0
+    assert fe.report()["lost_accepted"] == 0
+
+
+def test_run_load_cell_rejects_multi_stall_plans_with_clear_error():
+    plan = F.FaultPlan.of([
+        F.SlowConsumer(0, from_tick=30, stall_ticks=40),
+        F.SlowConsumer(1, from_tick=35, stall_ticks=40),
+    ])
+    with pytest.raises(ValueError, match="one SlowConsumer per cell"):
+        run_load_cell(n=4, seed=0, plan=plan)
+    with pytest.raises(ValueError, match="not both"):
+        run_load_cell(n=4, seed=0, stall_rank=2,
+                      plan=F.FaultPlan.single(
+                          F.SlowConsumer(0, from_tick=30)
+                      ))
+
+
+def test_run_load_cell_rejects_faults_outside_the_schedule():
+    with pytest.raises(ValueError, match="never fires"):
+        run_load_cell(n=4, seed=0, duration=50, kill_rank=1,
+                      kill_at=60)
+    with pytest.raises(ValueError, match="never fires"):
+        run_load_cell(n=4, seed=0, duration=30, stall_rank=1,
+                      stall_at=40)
+    from smi_tpu.serving.campaign import MIN_CAMPAIGN_DURATION
+
+    with pytest.raises(ValueError, match="minimum"):
+        load_campaign(seed=0, duration=MIN_CAMPAIGN_DURATION - 1)
+
+
+def test_frontend_suspect_drains_new_routes_only():
+    fe = ServingFrontend(4, seed=4, pool=12,
+                         tenant_rate=10, tenant_burst=100)
+    victim_tenant = next(
+        f"t{i}" for i in range(32) if tenant_base_rank(f"t{i}", 4) == 1
+    )
+    fe.kill(1)
+    # run until the detector suspects (but does not confirm) rank 1
+    for _ in range(400):
+        fe.step()
+        if 1 in fe.detector.suspected:
+            break
+    assert 1 in fe.detector.suspected
+    before = fe.drained_routes
+    fe.submit(victim_tenant, "interactive", ("a", "b"))
+    assert fe.drained_routes == before + 1
+    st = fe.active[-1]
+    assert st.dst != 1  # routed to the heir-presumptive
+
+
+# ---------------------------------------------------------------------------
+# Tenant fairness on the credits simulator (satellite: unequal bursts)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("counts", [
+    [2, 12, 4],            # small first
+    [12, 6, 2],            # small last (the starvation-prone order)
+    [1, 16, 1, 8],         # four tenants, two tiny
+])
+def test_stream_concurrent_fairness_bounded_gap(seed, counts):
+    """>= 3 tenants with unequal burst totals on ONE wire: under
+    seeded and adversarial schedules the credit scheduler never
+    starves a small stream behind a large one — every stream's
+    interleaving gap is bounded by (tenants-1) * chunks_per_burst,
+    CPU-deterministic."""
+    cpb = 2
+    n = 4
+    bound = (len(counts) - 1) * cpb
+    for strategy in (
+        C.Strategy(seed),
+        C.DelayDmaStrategy(seed),
+        C.FavourRankStrategy(seed % n, seed),
+    ):
+        outs = C.simulate_tenant_streams(
+            n, strategy, counts, chunks_per_burst=cpb
+        )
+        for s in range(len(counts)):
+            for g in range(n):
+                gap = C.fairness_gap(outs[g], s)
+                assert gap <= bound, (
+                    f"stream {s} starved at rank {g}: gap {gap} > "
+                    f"bound {bound} (strategy "
+                    f"{type(strategy).__name__}, seed {seed})"
+                )
+
+
+def test_stream_concurrent_fairness_counterexample_detects():
+    """The regression's teeth: a channel-major schedule (one giant
+    burst per stream — what dropping round-interleaving would do)
+    blows the small stream's gap far past the round-robin bound."""
+    outs = C.simulate_tenant_streams(
+        3, C.Strategy(1), [20, 6, 2], chunks_per_burst=20
+    )
+    gap = max(C.fairness_gap(outs[g], 2) for g in range(3))
+    assert gap >= 26  # 20 + 6 chunks ahead of the small stream
+    assert gap > (3 - 1) * 2
+
+
+def test_tenant_streams_delivery_verified_and_exhaustive_smoke():
+    # delivery correctness is asserted inside the harness; a tiny
+    # configuration additionally sweeps EVERY schedule
+    count = C.explore_all_schedules(
+        lambda: C.concurrent_stream_generators(
+            2, [(0, 1), (1, 1)], chunks_per_burst=1,
+            chunk_counts=[1, 2],
+        ),
+        max_schedules=150_000,
+    )
+    assert count.explored > 0 and not count.truncated
+
+
+def test_concurrent_generators_validate_chunk_counts():
+    with pytest.raises(ValueError):
+        C.concurrent_stream_generators(
+            2, [(0, 1), (1, 1)], chunk_counts=[1]
+        )
+    with pytest.raises(ValueError):
+        C.concurrent_stream_generators(
+            2, [(0, 1)], chunk_counts=[0]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Transient tenant channels (the P2PChannel bridge)
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_stream_port_is_deterministic_and_spread():
+    from smi_tpu.parallel.channels import (
+        TENANT_PORT_SPACE,
+        tenant_stream_port,
+    )
+
+    assert tenant_stream_port("alice", 0) == tenant_stream_port(
+        "alice", 0
+    )
+    ports = {
+        tenant_stream_port(f"tenant-{i}", s)
+        for i in range(16) for s in range(4)
+    }
+    assert len(ports) >= 60  # 64 identities, near-zero collisions
+    assert all(0 <= p < TENANT_PORT_SPACE for p in ports)
+    with pytest.raises(ValueError):
+        tenant_stream_port("alice", -1)
+
+
+def test_open_tenant_channel_maps_onto_ring_stream_domains(comm8):
+    from smi_tpu.kernels.ring import RING_STREAMS
+    from smi_tpu.parallel.channels import open_tenant_channel
+
+    ch = open_tenant_channel(
+        comm8, "alice", 0, src=1, dst=5, count=16
+    )
+    assert ch._ring_stream() == ch.port % RING_STREAMS
+    # consecutive streams of one tenant rotate barrier domains rather
+    # than serializing behind one semaphore
+    domains = {
+        open_tenant_channel(
+            comm8, "alice", s, src=1, dst=5, count=16
+        )._ring_stream()
+        for s in range(8)
+    }
+    assert len(domains) > 1
+
+
+def test_open_tenant_channel_transfers_for_real(comm8):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from smi_tpu.parallel.channels import open_tenant_channel
+
+    n = 16
+
+    def shard_fn(x):
+        ch = open_tenant_channel(
+            comm8, "alice", 3, src=1, dst=5, count=n
+        )
+        return ch.transfer(x)[None]
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm8.mesh, in_specs=P(), out_specs=P("smi"),
+        check_vma=False,
+    ))
+    x = jnp.arange(n, dtype=jnp.float32)
+    out = np.asarray(fn(x))
+    np.testing.assert_array_equal(out[5], np.asarray(x))
+    for r in range(8):
+        if r != 5:
+            np.testing.assert_array_equal(out[r], 0)
+
+
+# ---------------------------------------------------------------------------
+# Chaos under load: seed-pinned campaign (tier-1) + soak (slow)
+# ---------------------------------------------------------------------------
+
+PINNED_SEED = 1729
+
+
+def test_load_cell_overload_sheds_lowest_class_first():
+    rep = run_load_cell(n=4, seed=PINNED_SEED, duration=200,
+                        overload=2.0)
+    assert rep["ok"], rep["verdict"]
+    b = rep["brownout_shed"]
+    assert b["interactive"] == 0
+    assert b["best_effort"] >= b["batch"] >= b["interactive"]
+    assert b["best_effort"] > 0  # 2x overload MUST shed something
+    assert rep["max_queue_depth"] <= rep["queue_bound"]
+    assert rep["admission_latency"]["interactive"]["p99"] <= (
+        Q.INTERACTIVE_P99_TICKS
+    )
+
+
+def test_load_cell_kill_one_rank_under_open_loop_traffic():
+    """The seed-pinned kill-under-load cell (fast shape, tier-1):
+    detection inside the watchdog budget, zero lost accepted, zero
+    silent corruption, stale-epoch stragglers rejected, replay
+    actually exercised."""
+    rep = run_load_cell(n=4, seed=PINNED_SEED, duration=200,
+                        overload=1.0, kill_rank=2, kill_at=60)
+    assert rep["ok"], rep["verdict"]
+    assert rep["confirmed"] == [2]
+    assert rep["detect_ticks"] <= WATCHDOG_TICKS
+    assert rep["lost_accepted"] == 0
+    assert rep["silent_corruptions"] == 0
+    assert rep["stale_epoch_rejections"] >= 1
+    assert rep["stale_epoch_leaks"] == 0
+    assert rep["replayed_chunks"] > 0
+    assert rep["members"] == [0, 1, 3]
+
+
+def test_load_cell_is_deterministic_per_seed():
+    a = run_load_cell(n=4, seed=7, duration=120, overload=1.5)
+    b = run_load_cell(n=4, seed=7, duration=120, overload=1.5)
+    assert json.dumps(a, sort_keys=True) == json.dumps(
+        b, sort_keys=True
+    )
+    c = run_load_cell(n=4, seed=8, duration=120, overload=1.5)
+    assert json.dumps(a, sort_keys=True) != json.dumps(
+        c, sort_keys=True
+    )
+
+
+def test_load_campaign_seed_pinned_gates():
+    camp = load_campaign(seed=PINNED_SEED, trials=1, duration=200)
+    assert camp["ok"], camp["failures"]
+    assert camp["cells"] == 3
+    assert set(camp["outcomes"]) == {
+        "overload", "kill", "backpressure"
+    }
+    assert camp["silent_corruptions"] == 0
+    assert camp["lost_accepted"] == 0
+    assert camp["stale_epoch_leaks"] == 0
+    # the backpressure cell really propagated to the edge
+    bp = next(c for c in camp["reports"]
+              if c["cell"] == "backpressure")
+    assert any(bp["backpressure_shed"].values())
+    assert bp["plan"]  # drawn from FaultPlan.random("slow_consumer")
+
+
+def test_serving_fault_class_registry_stays_seed_pinned():
+    """SERVING_FAULT_CLASSES must stay OUT of the seed-pinned base
+    FAULT_CLASSES (and the elastic tuple) — the same digest rule that
+    protects the PR-2 campaign cells."""
+    assert F.SERVING_FAULT_CLASSES == ("slow_consumer",)
+    assert not set(F.SERVING_FAULT_CLASSES) & set(F.FAULT_CLASSES)
+    assert not set(F.SERVING_FAULT_CLASSES) & set(
+        F.ELASTIC_FAULT_CLASSES
+    )
+    plan = F.FaultPlan.random("slow_consumer", 4, 11)
+    assert len(plan.slow_consumers) == 1
+    f = plan.slow_consumers[0]
+    assert 0 <= f.rank < 4 and f.stall_ticks >= 40
+    assert not plan.empty
+    assert any("SlowConsumer" in line for line in plan.describe())
+    with pytest.raises(ValueError):
+        F.SlowConsumer(0, stall_ticks=0)
+
+
+def test_route_owner_is_the_single_failover_authority():
+    view = MembershipView(4)
+    assert route_owner(view, 2, 4) == 2
+    view.confirm_dead(2)
+    assert route_owner(view, 2, 4) == 3   # nearest surviving successor
+    view.confirm_dead(3)
+    assert route_owner(view, 3, 4) == 0
+    assert route_owner(view, 1, 4) == 1   # members route to themselves
+
+
+def test_progress_log_void_deliveries_keeps_contribution():
+    log = ProgressLog(rank=0)
+    log.contribution = ("a", "b", "c")
+    log.record((0, 0), "a")
+    log.record((0, 1), "b")
+    assert log.void_deliveries() == 2
+    assert log.contribution == ("a", "b", "c")
+    assert log.missing({(0, 0), (0, 1), (0, 2)}) == {
+        (0, 0), (0, 1), (0, 2)
+    }
+    assert log.void_deliveries() == 0
+
+
+@pytest.mark.slow
+def test_load_campaign_long_soak():
+    """The long chaos-under-load soak: many seeds, several shapes —
+    every cell must pass its gates."""
+    for seed in range(24):
+        camp = load_campaign(seed=seed, trials=1)
+        assert camp["ok"], (seed, camp["failures"])
+    for n in (2, 3, 5, 6, 8):
+        camp = load_campaign(seed=PINNED_SEED, n=n)
+        assert camp["ok"], (n, camp["failures"])
+
+
+# ---------------------------------------------------------------------------
+# CLI + bench schema
+# ---------------------------------------------------------------------------
+
+
+def test_serve_selftest_gates_hold():
+    rep = serve_selftest(seed=0)
+    assert rep["ok"], rep["verdict"]
+    assert rep["silent_corruptions"] == 0
+    assert rep["lost_accepted"] == 0
+
+
+def test_bench_serving_field_is_additive_and_schema_stable():
+    """bench.py's `serving` field: the legacy metric/value/unit/
+    vs_baseline contract is untouched, the new field is additive and
+    carries offered load, per-class accept/shed, and latency
+    percentiles — the overlap/hierarchy/elastic discipline."""
+    import bench
+
+    fields = bench.serving_fields()
+    assert set(fields) >= {
+        "offered_chunks_per_tick", "capacity_chunks_per_tick",
+        "accepted", "shed", "admission_latency", "ok",
+    }
+    assert fields["ok"] is True
+    for c in Q.QOS_CLASSES:
+        assert c in fields["accepted"] and c in fields["shed"]
+        assert set(fields["admission_latency"][c]) == {"p50", "p99"}
+    payload = {
+        "metric": "m", "value": 1.0, "unit": "u",
+        "vs_baseline": 2.0, "serving": fields,
+    }
+    line = bench.render_line(payload)
+    parsed = json.loads(line)
+    assert parsed["serving"]["accepted"] == fields["accepted"]
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in parsed
+    # legacy keys must never be dropped
+    with pytest.raises(ValueError):
+        bench.render_line({"metric": "m", "value": 1.0, "unit": "u",
+                           "serving": fields})
