@@ -1121,6 +1121,13 @@ def cmd_lint(args: argparse.Namespace) -> int:
         print("error: --all and --protocol are mutually exclusive",
               file=sys.stderr)
         return 2
+    if getattr(args, "scope", None) and not args.model:
+        print("error: --scope applies only to --model (protocol "
+              "instances are sized by the default shape grid)",
+              file=sys.stderr)
+        return 2
+    if args.model:
+        return _cmd_lint_model(args)
     try:
         if args.mutant:
             if not args.protocol:
@@ -1180,6 +1187,82 @@ def cmd_lint(args: argparse.Namespace) -> int:
             f"note: mutant {args.mutant!r} did not manifest at any "
             f"default shape of {list(args.protocol)} — the damage is "
             f"benign at these sizes, not missed by the verifier",
+            file=sys.stderr,
+        )
+    return 0 if payload["ok"] else 1
+
+
+def _cmd_lint_model(args: argparse.Namespace) -> int:
+    """``smi-tpu lint --model``: the control-plane model checker.
+
+    Exhaustively verifies the five control-plane properties —
+    queue-occupancy bound, stream-credit conservation,
+    starvation-freedom, epoch safety, no-lost-accepted — over every
+    reachable state of each scope in the default grid (or the single
+    ``--scope SPEC``), driving the REAL admission gate / scheduler /
+    membership / WAL objects (:mod:`smi_tpu.analysis.model`). Exit 1
+    on any finding, each carried as a minimal counterexample trace
+    that ``smi_tpu.serving.campaign.replay_model_trace`` re-executes
+    as a failing campaign cell. ``--mutant`` applies one control-plane
+    mutant (:data:`smi_tpu.analysis.MODEL_MUTANTS`) across the grid.
+    Truncated budgets are never silent: the report carries
+    explored/estimated_total/truncated per scope and in the coverage
+    summary.
+    """
+    from smi_tpu import analysis
+
+    if args.protocol:
+        print("error: --protocol applies to the protocol tier; the "
+              "model tier is sized by --scope", file=sys.stderr)
+        return 2
+    if args.all and args.scope:
+        # same discipline as --all vs --protocol: silently narrowing
+        # the sweep to one scope would let a CI caller believe the
+        # whole grid ran
+        print("error: --all and --scope are mutually exclusive "
+              "(--all is the default grid; --scope checks one scope)",
+              file=sys.stderr)
+        return 2
+    try:
+        scopes = (
+            [analysis.parse_scope(args.scope)] if args.scope
+            else list(analysis.DEFAULT_SCOPES)
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.mutant:
+        if args.mutant not in analysis.MODEL_MUTANTS:
+            print(f"error: unknown control-plane mutant "
+                  f"{args.mutant!r}; known: "
+                  f"{list(analysis.MODEL_MUTANTS)} (protocol mutants "
+                  f"{list(analysis.MUTANTS)} apply without --model)",
+                  file=sys.stderr)
+            return 2
+        factory = analysis.model_mutant_world(args.mutant)
+        reports = [
+            analysis.check_scope(scope, world_factory=factory,
+                                 mutant=args.mutant)
+            for scope in scopes
+        ]
+    else:
+        reports = analysis.check_scopes(scopes)
+    payload = analysis.model_reports_to_json(reports)
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    else:
+        print(analysis.render_model_reports(reports))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        if not args.json:
+            print(f"report -> {args.out}")
+    if args.mutant and payload["ok"]:
+        print(
+            f"note: control-plane mutant {args.mutant!r} did not "
+            f"manifest at any checked scope — the damage is benign at "
+            f"these sizes, not missed by the checker",
             file=sys.stderr,
         )
     return 0 if payload["ok"] else 1
@@ -1692,9 +1775,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mutant", default=None, metavar="NAME",
                    help="apply a deliberately broken variant before "
                         "verifying (dropped_wait, reused_slot, "
-                        "unbalanced_grant, late_grant) — demonstrates "
+                        "unbalanced_grant, late_grant; with --model: "
+                        "leaked_stream_credit, skipped_aging, "
+                        "epoch_bump_without_void, "
+                        "heartbeat_after_confirm) — demonstrates "
                         "the nonzero exit and the named diagnostics; "
-                        "needs --protocol")
+                        "needs --protocol (or --model)")
+    p.add_argument("--model", action="store_true",
+                   help="run the control-plane model checker instead: "
+                        "exhaustive BFS over every reachable state of "
+                        "each small scope, driving the real admission/"
+                        "scheduling/membership/WAL objects, checking "
+                        "queue bounds, stream-credit conservation, "
+                        "starvation-freedom, epoch safety, and "
+                        "no-lost-accepted; findings carry minimal "
+                        "counterexample traces replayable as failing "
+                        "campaign cells")
+    p.add_argument("--scope", default=None, metavar="SPEC",
+                   help="with --model: check one scope instead of the "
+                        "default grid, e.g. "
+                        "'tenants=2,ranks=2,chunks=2,kill=1' "
+                        "(keys: tenants/ranks/chunks/streams/pool/"
+                        "kill/silence/consume/starve)")
     p.add_argument("--json", action="store_true",
                    help="print the JSON report instead of text")
     p.add_argument("-o", "--out", default=None,

@@ -1,16 +1,21 @@
-"""Static verification of the credits protocol zoo.
+"""Static verification of the credits protocol zoo + the control plane.
 
-The compile-time correctness tier: :mod:`.verifier` proves
+The compile-time correctness tiers: :mod:`.verifier` proves
 deadlock-freedom, slot-race-freedom, credit conservation, and wire-lane
 monotonicity over every schedule of a registered protocol from a single
 symbolic replay per rank (happens-before analysis — Lamport CACM'78,
-Eraser SOSP'97; see PAPERS.md); :mod:`.mutants` ships the broken
-variants that prove the checks can fail. Pure Python — no JAX, no
-devices — so ``smi-tpu lint`` runs anywhere in milliseconds and CI can
-gate merges on it. The dynamic schedule fuzzer
-(``credits.explore_all_schedules``) and the chaos campaigns remain the
-authority on *faulted* behaviour; ``docs/analysis.md`` states exactly
-what each tier does and does not prove.
+Eraser SOSP'97; see PAPERS.md); :mod:`.model` + :mod:`.properties` are
+the control-plane analog — an explicit-state model checker that
+exhaustively verifies the epoch, admission, and recovery state machines
+at small scopes by driving the REAL serving/membership/WAL objects
+(``smi-tpu lint --model``); :mod:`.mutants` ships the broken variants —
+protocol-tier event-stream transformers and control-plane seam breaks —
+that prove every check can fail. Pure Python — no JAX, no devices — so
+``smi-tpu lint`` runs anywhere in seconds and CI can gate merges on it.
+The dynamic schedule fuzzer (``credits.explore_all_schedules``) and the
+chaos campaigns remain the authority on *faulted wire* behaviour;
+``docs/analysis.md`` states exactly what each tier does and does not
+prove.
 """
 
 from smi_tpu.analysis.verifier import (  # noqa: F401
@@ -34,6 +39,22 @@ from smi_tpu.analysis.verifier import (  # noqa: F401
     verify_protocol,
 )
 from smi_tpu.analysis.mutants import (  # noqa: F401
+    MODEL_MUTANT_PROPERTY,
+    MODEL_MUTANTS,
     MUTANTS,
+    model_mutant_world,
     mutant_generators,
 )
+from smi_tpu.analysis.model import (  # noqa: F401
+    DEFAULT_SCOPES,
+    ModelFinding,
+    ModelReport,
+    Scope,
+    World,
+    check_scope,
+    check_scopes,
+    model_reports_to_json,
+    parse_scope,
+    render_model_reports,
+)
+from smi_tpu.analysis.properties import PROPERTIES  # noqa: F401

@@ -1,0 +1,1011 @@
+"""Explicit-state model checker for the control plane.
+
+PR 7's verifier proves the *wire* protocols deadlock- and race-free for
+every schedule, but the control plane layered on top — phi-accrual
+membership with epoch bumps, WAL replay, and the serving admission /
+backpressure / shedding gates — has so far only been *sampled* by
+seeded chaos campaigns. This module closes that gap the same way the
+reference's routing tables are verifiable by construction: exhaustive
+small-scope verification (the "small scope hypothesis": control-plane
+bugs manifest at tiny instance sizes) of the epoch, admission, and
+recovery state machines.
+
+The one design rule — **the transition functions drive the real
+objects**. A :class:`World` composes the shipped
+:class:`~smi_tpu.serving.admission.AdmissionGate`,
+:class:`~smi_tpu.serving.scheduler.StreamScheduler` /
+:class:`~smi_tpu.serving.scheduler.WireLane`,
+:class:`~smi_tpu.parallel.membership.MembershipView` /
+:class:`~smi_tpu.parallel.membership.PhiAccrualDetector`, and
+:class:`~smi_tpu.parallel.recovery.ProgressLog`, and every transition
+calls their real methods (``offer``/``pump``/``release``,
+``schedule_lane``, ``land``/``verify_chunk``,
+``confirm_dead``/``regrow``/``validate``, ``heartbeat``/``poll``,
+``record``/``void_deliveries``). There is no hand-written re-model to
+drift from the shipped code; the only model-owned glue is the thin
+frontend wiring (routing, failover, rejoin) that
+:class:`~smi_tpu.serving.frontend.ServingFrontend` performs between
+those same calls, and the control-plane mutants of
+:mod:`smi_tpu.analysis.mutants` break exactly that glue (or swap in a
+broken subclass of one real object) to prove each property can fail.
+
+Exploration is breadth-first over **canonicalized** states:
+
+- the fingerprint renders only *relative* time (ages, deltas), so the
+  unbounded step clock never splits behaviourally identical states;
+- **symmetry reduction** on tenant and rank identities: the fingerprint
+  is minimized over all (tenant, rank) permutation pairs compatible
+  with the deterministic tenant->base-rank routing, so interchangeable
+  tenants/ranks collapse to one orbit representative;
+- BFS order makes the first violation found a **minimal** (shortest)
+  counterexample trace; the trace is a plain tuple of named actions
+  that :func:`smi_tpu.serving.campaign.replay_model_trace` re-executes
+  against a fresh ``World`` as a failing campaign cell — differential
+  soundness in both directions;
+- a state budget bounds runaway scopes with the same loud
+  ``ScheduleCount``-style coverage reporting as
+  ``credits.explore_all_schedules``: a truncated run warns AND carries
+  ``explored``/``frontier``/``estimated_total``/``truncated`` in its
+  report, so "no silent caps" holds for machine consumers too.
+
+The action alphabet (one BFS edge each):
+
+- ``tick`` — advance one heartbeat period with NO beats (the silence
+  the detector must tolerate; quota-bounded by ``Scope.silence``),
+  then poll the detector, land in-flight frames, pump admissions;
+- ``heartbeat`` — the same period advance with every live, unkilled
+  member beating first (the normal serving cadence);
+- ``admit t`` — tenant ``t`` submits its next request through the
+  real admission gate (sheds are named and recorded, never findings);
+- ``send r`` — the real scheduler issues sends on rank ``r``'s lane
+  until its wire credits or the ready work run out;
+- ``consume r`` — rank ``r`` lands and consumes up to
+  ``Scope.consume`` chunks (CRC + dense-sequence verification via the
+  real :func:`~smi_tpu.serving.scheduler.verify_chunk`);
+- ``kill r`` — crash-stop rank ``r`` (no more beats, no more
+  consumption; membership catches up through the real detector);
+- ``rejoin r`` — the dead rank's new incarnation first presents its
+  pre-shrink epoch (which the view must reject loudly), then regrows
+  under a fresh epoch.
+
+Scope: everything here is **fault-free wire, faulty control plane** —
+the wire tier's own invariants are the PR 7 verifier's job; what is
+checked exhaustively here is the layer above it, at scopes of at most
+a few tenants x ranks x chunks (see :data:`DEFAULT_SCOPES`). What
+exhaustive-at-small-scope does and does not prove is spelled out in
+``docs/analysis.md``.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import itertools
+import pickle
+import warnings
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from smi_tpu.parallel.membership import (
+    HEARTBEAT_INTERVAL,
+    ConfirmedDead,
+    MembershipView,
+    PhiAccrualDetector,
+    StaleEpochError,
+    StepClock,
+    SuspectRank,
+    SuspicionCleared,
+    plan_regrow_ring,
+    route_owner,
+)
+from smi_tpu.parallel.credits import IntegrityError
+from smi_tpu.parallel.recovery import ProgressLog
+from smi_tpu.serving.admission import AdmissionGate
+from smi_tpu.serving.qos import QOS_CLASSES, Request
+from smi_tpu.serving.scheduler import (
+    WIRE_CREDITS,
+    StreamScheduler,
+    StreamState,
+    WireLane,
+    verify_chunk,
+)
+
+#: Hard ceiling on tenants/ranks/chunks a scope may declare: the model
+#: is an *exhaustive small-scope* tier, and larger instances belong to
+#: the sampled campaigns (the state space grows combinatorially).
+MAX_SCOPE_DIM = 3
+
+#: Default BFS state budget. Exceeding it is never silent: the report
+#: carries ``truncated``/``frontier``/``estimated_total`` and a
+#: ``RuntimeWarning`` states the honest claim.
+DEFAULT_BUDGET = 60_000
+
+
+@dataclasses.dataclass(frozen=True)
+class Scope:
+    """One exhaustively-checked instance size.
+
+    ``tenants``/``ranks``/``chunks`` are capped at
+    :data:`MAX_SCOPE_DIM` (the small-scope contract); ``streams`` is
+    requests per tenant; ``pool`` the stream-credit pool; ``kill`` the
+    number of crash-stops the explorer may inject (0 or 1);
+    ``silence`` the number of beat-less period advances the explorer
+    may choose (the alive-but-silent scenarios); ``consume`` the
+    chunks one consume action drains; ``starve`` the scope-scaled
+    aging bound handed to the real scheduler.
+    """
+
+    tenants: int = 2
+    ranks: int = 2
+    chunks: int = 2
+    streams: int = 1
+    pool: int = 3
+    kill: int = 0
+    silence: int = 0
+    consume: int = 2
+    starve: int = 3
+
+    def __post_init__(self):
+        for dim in ("tenants", "ranks", "chunks"):
+            v = getattr(self, dim)
+            if not 1 <= v <= MAX_SCOPE_DIM:
+                raise ValueError(
+                    f"scope {dim}={v} outside 1..{MAX_SCOPE_DIM}: the "
+                    f"model tier is exhaustive-at-small-scope only — "
+                    f"larger instances are the campaigns' job"
+                )
+        if self.streams < 1 or self.pool < 1 or self.consume < 1:
+            raise ValueError(
+                f"streams/pool/consume must be >= 1 (got "
+                f"{self.streams}/{self.pool}/{self.consume})"
+            )
+        if self.kill not in (0, 1):
+            raise ValueError(f"kill must be 0 or 1, got {self.kill}")
+        if self.kill and self.ranks < 2:
+            raise ValueError(
+                "kill=1 needs ranks >= 2 (the last member cannot die)"
+            )
+        if self.silence < 0:
+            raise ValueError(f"silence must be >= 0, got {self.silence}")
+        if self.silence > 3:
+            # >= 4 silent periods crosses the confirmation grace and a
+            # healthy rank would be confirmed dead by design — a legal
+            # behaviour, but one that turns every scope into a kill
+            # scope; keep the knob below the grace so silence means
+            # suspect-and-clear
+            raise ValueError(
+                f"silence={self.silence} reaches the confirmation "
+                f"grace (4 periods): a healthy rank would be confirmed "
+                f"dead; use kill=1 for death scenarios"
+            )
+        if self.starve < 1:
+            raise ValueError(f"starve must be >= 1, got {self.starve}")
+
+    def describe(self) -> str:
+        return ",".join(
+            f"{f.name}={getattr(self, f.name)}"
+            for f in dataclasses.fields(self)
+        )
+
+    def to_json(self) -> dict:
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+
+
+def parse_scope(spec: str) -> Scope:
+    """Parse a ``--scope`` spec like ``tenants=2,ranks=2,kill=1``.
+
+    Loud on unknown keys, malformed values, and out-of-range
+    dimensions — a typo'd scope must be a usage error, not a silently
+    different verification run.
+    """
+    fields = {f.name for f in dataclasses.fields(Scope)}
+    kwargs: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"malformed scope item {part!r} (want key=value); "
+                f"known keys: {sorted(fields)}"
+            )
+        key, _, value = part.partition("=")
+        key = key.strip()
+        if key not in fields:
+            raise ValueError(
+                f"unknown scope key {key!r}; known: {sorted(fields)}"
+            )
+        try:
+            kwargs[key] = int(value)
+        except ValueError:
+            raise ValueError(
+                f"scope {key}={value.strip()!r} is not an integer"
+            ) from None
+    return Scope(**kwargs)
+
+
+#: The scope grid ``smi-tpu lint --model --all`` verifies — each one
+#: exhaustible in well under the default budget, together covering
+#: admission/brownout, lane backpressure, scheduling contention,
+#: alive-but-silent suspicion, and the kill->shrink->regrow arc.
+#: docs/analysis.md's scope table quotes these (drift-guarded).
+DEFAULT_SCOPES: Tuple[Scope, ...] = (
+    # admission + brownout with all three QoS classes in play
+    Scope(tenants=3, ranks=2, chunks=2, streams=1, pool=2),
+    # one hot lane, recycled credits: scheduling contention + aging
+    # (pool=3 lets two interactive streams exhaust the wire window
+    # while a batch stream waits — the shape the aging bound exists
+    # for)
+    Scope(tenants=2, ranks=1, chunks=2, streams=3, pool=3, starve=3),
+    # alive-but-silent: suspect -> clear without a kill
+    Scope(tenants=1, ranks=2, chunks=2, streams=1, pool=2, silence=2),
+    # the kill arc: detect -> shrink -> void+replay -> reject -> regrow
+    Scope(tenants=2, ranks=2, chunks=2, streams=1, pool=3, kill=1,
+          consume=1),
+)
+
+
+# ---------------------------------------------------------------------------
+# The world: real control-plane objects + thin frontend glue
+# ---------------------------------------------------------------------------
+
+
+class World:
+    """One concrete control-plane state, built from the real objects.
+
+    Subclass hooks (``_make_scheduler``, ``_release_credit``,
+    ``_reroute_stream``, ``_beat_ranks``) are the seams the
+    control-plane mutants override — each hook's default is exactly
+    what :class:`~smi_tpu.serving.frontend.ServingFrontend` does, and
+    everything else goes straight through the shipped objects.
+    """
+
+    def __init__(self, scope: Scope):
+        self.scope = scope
+        self.clock = StepClock()
+        self.view = MembershipView(scope.ranks)
+        # window=4 keeps the detector's interval history — hence the
+        # canonical fingerprint — bounded; the phi math is untouched
+        self.detector = PhiAccrualDetector(
+            self.clock, range(scope.ranks), window=4
+        )
+        # rate/burst sized so tenant-rate isolation never sheds inside
+        # a scope's quota: the five checked properties live in the
+        # pool/lane/epoch machinery, not the per-tenant bucket.
+        # Wait caps are scope-scaled (strictly ordered like the
+        # production 12/48/96): the timeout MECHANISM is what the
+        # model checks, and production-sized caps would add ~10
+        # behaviourally-inert aging periods per parked request to
+        # every interleaving
+        self.gate = AdmissionGate(
+            pool=scope.pool,
+            tenant_rate=1.0,
+            tenant_burst=float(max(scope.streams, 1)),
+            wait_caps={
+                "interactive": HEARTBEAT_INTERVAL + 2,
+                "batch": 2 * HEARTBEAT_INTERVAL + 2,
+                "best_effort": 3 * HEARTBEAT_INTERVAL + 2,
+            },
+        )
+        self.lanes = [WireLane(r) for r in range(scope.ranks)]
+        self.scheduler = self._make_scheduler(scope)
+        self.active: List[StreamState] = []
+        self.completed: List[StreamState] = []
+        self.killed: set = set()
+        self.zombie_beats: set = set()
+        self.rejoin_pending: List[int] = []
+        self.death_epoch: Dict[int, int] = {}
+        self.submissions_left = [scope.streams] * scope.tenants
+        self.kills_left = scope.kill
+        self.silence_left = scope.silence
+        self.suspected_events = 0
+        self.cleared_events = 0
+        self.confirmed: List[int] = []
+        self.stale_rejections = 0
+        self.stale_leaks = 0
+        self.corruptions = 0
+        self.replayed_chunks = 0
+        #: stream index -> {seq: (rank, lane_epoch)} at delivery time —
+        #: the evidence the epoch-safety property audits
+        self.delivery_meta: Dict[int, Dict[int, Tuple[int, int]]] = {}
+        self._stream_count = 0
+        self._tenant_seq = [0] * scope.tenants
+        self._epoch_watermark = 0
+        self._beaten_this_period = True
+        self._bootstrap()
+
+    # -- mutant seams (defaults == the shipped frontend behaviour) ------
+
+    def _make_scheduler(self, scope: Scope) -> StreamScheduler:
+        return StreamScheduler(check_deadlines=False,
+                               max_starve_rounds=scope.starve)
+
+    def _release_credit(self, st: StreamState) -> None:
+        """A completed stream's credit returns to the pool and the
+        pending tier re-pumps — the end-to-end chain's upstream edge."""
+        for req in self.gate.release(st.request.qos, self.clock.now()):
+            self._activate(req)
+
+    def _reroute_stream(self, st: StreamState, owner: int) -> None:
+        """Failover of one accepted stream: the dead consumer's
+        partial state died with it — void the WAL deliveries, clear
+        the delivery record, replay everything from the durable
+        contribution on a fresh epoch-keyed sequence lane."""
+        st.wal.void_deliveries()
+        st.delivered.clear()
+        self.delivery_meta[st.index] = {}
+        self.replayed_chunks += st.next_to_send
+        st.replayed_chunks += st.next_to_send
+        st.next_to_send = 0
+        st.lane_epoch = self.view.epoch
+        st.dst = owner
+
+    def _beat_ranks(self) -> List[int]:
+        """Who heartbeats on a beat period: live, unkilled members —
+        a killed rank's silence is the detector's evidence channel."""
+        return [r for r in sorted(self.view.members)
+                if r not in self.killed]
+
+    # -- plumbing -------------------------------------------------------
+
+    def _bootstrap(self) -> None:
+        """Seed the detector's inter-arrival window before exploration
+        (the serving front-end's discipline): four quiet beat periods,
+        no transitions allowed."""
+        for _ in range(4):
+            self.clock.advance(HEARTBEAT_INTERVAL)
+            for r in self._beat_ranks():
+                self.detector.heartbeat(r)
+            for tr in self.detector.poll():
+                raise RuntimeError(f"transition during bootstrap: {tr}")
+
+    def _base_rank(self, tenant: int) -> int:
+        """Deterministic tenant -> base rank map (the model's analog
+        of ``frontend.tenant_base_rank``; index-based so the symmetry
+        reduction can reason about it)."""
+        return tenant % self.scope.ranks
+
+    def _route(self, tenant: int) -> int:
+        owner = route_owner(self.view, self._base_rank(tenant),
+                            self.scope.ranks)
+        if owner is None:  # pragma: no cover — last member cannot die
+            raise RuntimeError("no surviving rank to route to")
+        return owner
+
+    def _payloads(self, tenant: int, seq: int) -> Tuple[str, ...]:
+        return tuple(
+            f"t{tenant}/s{seq}/c{c}" for c in range(self.scope.chunks)
+        )
+
+    def _activate(self, request: Request) -> None:
+        index = self._stream_count
+        self._stream_count += 1
+        wal = ProgressLog(rank=index)
+        wal.contribution = request.chunks
+        tenant = int(request.tenant[1:])
+        self.active.append(StreamState(
+            request=request, index=index, dst=self._route(tenant),
+            deadline=None, wal=wal, lane_epoch=self.view.epoch,
+            admitted_at=self.clock.now(),
+        ))
+        self.delivery_meta[index] = {}
+
+    def _complete(self, st: StreamState) -> None:
+        st.completed_at = self.clock.now()
+        assembled = tuple(
+            st.delivered[i] for i in range(st.total_chunks)
+        )
+        if assembled != st.request.chunks:
+            self.corruptions += 1
+        self.active.remove(st)
+        self.completed.append(st)
+        self._release_credit(st)
+
+    def _failover(self, dead: int) -> None:
+        """Membership confirmed a death: shrink under a new epoch,
+        validate the survivors still ring up, drop the dead lane,
+        replay every stream routed there, and reject the dead
+        incarnation's straggler loudly."""
+        old_epoch = self.view.epoch
+        self.view.confirm_dead(dead)
+        self.death_epoch[dead] = old_epoch
+        plan_regrow_ring(self.view)
+        self.lanes[dead].drop_all()
+        for st in self.active:
+            if st.dst != dead:
+                continue
+            tenant = int(st.request.tenant[1:])
+            self._reroute_stream(st, self._route(tenant))
+        # one straggler from the dead incarnation presents its old
+        # epoch after the shrink: reject, never fold in
+        try:
+            self.view.validate(dead, old_epoch, what="straggler chunk")
+            self.stale_leaks += 1
+        except StaleEpochError:
+            self.stale_rejections += 1
+        if dead in self.killed:
+            self.rejoin_pending.append(dead)
+
+    def _advance(self, beat: bool) -> None:
+        self.clock.advance(HEARTBEAT_INTERVAL)
+        if beat:
+            for r in self._beat_ranks():
+                if r in self.killed:
+                    # only a broken _beat_ranks (the
+                    # heartbeat_after_confirm mutant) emits this: a
+                    # killed rank's beat keeps phi low forever
+                    self.zombie_beats.add(r)
+                self.detector.heartbeat(r)
+            self._beaten_this_period = True
+        else:
+            self.silence_left -= 1
+            self._beaten_this_period = False
+        for tr in self.detector.poll():
+            if isinstance(tr, SuspectRank):
+                self.suspected_events += 1
+            elif isinstance(tr, SuspicionCleared):
+                self.cleared_events += 1
+            elif isinstance(tr, ConfirmedDead):
+                self.confirmed.append(tr.rank)
+                self._failover(tr.rank)
+        now = self.clock.now()
+        for lane in self.lanes:
+            lane.land(now)
+            lane.view_epoch = self.view.epoch
+        for req in self.gate.pump(now):
+            self._activate(req)
+
+    # -- transitions ----------------------------------------------------
+
+    def _do_admit(self, tenant: int) -> None:
+        self.submissions_left[tenant] -= 1
+        seq = self._tenant_seq[tenant]
+        self._tenant_seq[tenant] = seq + 1
+        qos = QOS_CLASSES[tenant % len(QOS_CLASSES)]
+        request = Request(
+            tenant=f"t{tenant}", qos=qos,
+            chunks=self._payloads(tenant, seq),
+            arrived_at=self.clock.now(),
+            stream_id=(f"t{tenant}", seq),
+        )
+        from smi_tpu.serving.qos import AdmissionRejected
+
+        try:
+            if self.gate.offer(request, self.clock.now()):
+                self._activate(request)
+        except AdmissionRejected:
+            pass  # named + recorded by the real gate
+
+    def _do_send(self, rank: int) -> None:
+        self.scheduler.schedule_lane(
+            self.lanes[rank], self.active, self.clock.now()
+        )
+
+    def _do_consume(self, rank: int) -> None:
+        lane = self.lanes[rank]
+        now = self.clock.now()
+        lane.land(now)
+        budget = self.scope.consume
+        while budget > 0 and lane.landed:
+            item = lane.landed.popleft()
+            lane.credits += 1
+            budget -= 1
+            st = item.stream
+            if item.lane_epoch != st.lane_epoch:
+                # a pre-failover chunk reached a live consumer: the
+                # data-path stale-epoch gate (the frontend's exact
+                # discipline) — rejected by epoch, never folded in
+                try:
+                    self.view.validate(lane.rank, item.view_epoch,
+                                       what="pre-failover chunk")
+                    self.stale_leaks += 1
+                except StaleEpochError:
+                    self.stale_rejections += 1
+                continue
+            try:
+                payload = verify_chunk(lane, item)
+            except IntegrityError:
+                if not st.complete and st.dst == lane.rank:
+                    want = lane.next_seq.get(st.lane_key, 0)
+                    if want < st.next_to_send:
+                        delta = st.next_to_send - want
+                        self.replayed_chunks += delta
+                        st.replayed_chunks += delta
+                        st.next_to_send = want
+                continue
+            if st.complete or st.dst != lane.rank:
+                continue
+            st.delivered[item.seq] = payload
+            self.delivery_meta[st.index][item.seq] = (
+                lane.rank, st.lane_epoch
+            )
+            st.wal.record((st.index, item.seq), payload)
+            if st.complete:
+                self._complete(st)
+
+    def _do_kill(self, rank: int) -> None:
+        self.kills_left -= 1
+        self.killed.add(rank)
+
+    def _do_rejoin(self, rank: int) -> None:
+        """The dead rank's new incarnation: its pre-shrink epoch must
+        be rejected loudly, then it regrows under a fresh epoch and a
+        fresh detector bootstrap."""
+        try:
+            self.view.validate(rank, self.death_epoch[rank],
+                               what="rejoin request")
+            self.stale_leaks += 1
+        except StaleEpochError:
+            self.stale_rejections += 1
+        self.view.regrow(rank)
+        plan_regrow_ring(self.view)
+        self.detector.forget(rank)
+        self.killed.discard(rank)
+        self.zombie_beats.discard(rank)
+        self.rejoin_pending.remove(rank)
+
+    def apply(self, action: Tuple) -> None:
+        kind = action[0]
+        if kind == "tick":
+            self._advance(beat=False)
+        elif kind == "heartbeat":
+            self._advance(beat=True)
+        elif kind == "admit":
+            self._do_admit(action[1])
+        elif kind == "send":
+            self._do_send(action[1])
+        elif kind == "consume":
+            self._do_consume(action[1])
+        elif kind == "kill":
+            self._do_kill(action[1])
+        elif kind == "rejoin":
+            self._do_rejoin(action[1])
+        else:
+            raise ValueError(f"unknown model action {action!r}")
+        self._epoch_watermark = max(self._epoch_watermark,
+                                    self.view.epoch)
+
+    # -- enabled actions ------------------------------------------------
+
+    def _time_useful(self) -> bool:
+        """A period advance can change behaviour: frames need landing,
+        pending admissions can pump or time out, an undetected kill or
+        an open suspicion needs the detector's clock."""
+        if any(lane.in_flight for lane in self.lanes):
+            return True
+        if any(q for q in self.gate.pending.values()):
+            return True
+        if any(r in self.view.members for r in self.killed):
+            return True
+        if self.detector.suspected:
+            return True
+        if self.silence_left > 0:
+            # unspent silence quota is scenario fuel: the
+            # alive-but-silent arcs need consecutive beat-less
+            # periods even when no frame is mid-flight
+            return True
+        return False
+
+    def enabled_actions(self) -> List[Tuple]:
+        out: List[Tuple] = []
+        if self._time_useful():
+            out.append(("heartbeat",))
+            if self.silence_left > 0:
+                out.append(("tick",))
+        for t in range(self.scope.tenants):
+            if self.submissions_left[t] > 0:
+                out.append(("admit", t))
+        for lane in self.lanes:
+            if lane.rank in self.killed:
+                continue
+            if lane.can_send() and any(
+                st.dst == lane.rank
+                and st.next_to_send < st.total_chunks
+                for st in self.active
+            ):
+                out.append(("send", lane.rank))
+        now = self.clock.now()
+        for lane in self.lanes:
+            if lane.rank in self.killed:
+                continue
+            if lane.rank not in self.view.members:
+                continue
+            if lane.landed or any(f.ready_at <= now
+                                  for f in lane.in_flight):
+                out.append(("consume", lane.rank))
+        if self.kills_left > 0 and len(self.view.members) > 1:
+            # the victim is pinned to the lowest live rank (tenant
+            # 0's base): at these scopes rank symmetry makes every
+            # other victim choice isomorphic, and pinning halves the
+            # branching the reduction would otherwise have to merge
+            victim = min(self.view.members)
+            if victim not in self.killed:
+                out.append(("kill", victim))
+        for r in self.rejoin_pending:
+            out.append(("rejoin", r))
+        return out
+
+    # -- canonical fingerprint (relative time + symmetry orbits) --------
+
+    def _render(self, tau: Sequence[int], rho: Sequence[int]) -> tuple:
+        """Render the behaviour-relevant state under a tenant
+        permutation ``tau`` and a rank permutation ``rho``, with every
+        clock value made relative to *now* and every epoch stamp made
+        relative to the current view epoch."""
+        now = self.clock.now()
+        epoch = self.view.epoch
+
+        # canonical stream relabelling: order preserved (the scheduler
+        # tie-breaks on index ORDER, never on absolute value)
+        order = {st.index: i
+                 for i, st in enumerate(
+                     sorted(self.active, key=lambda s: s.index))}
+
+        def stream_key(st: StreamState) -> tuple:
+            tenant = tau[int(st.request.tenant[1:])]
+            return (
+                order[st.index], tenant, st.request.qos,
+                rho[st.dst], st.next_to_send,
+                tuple(sorted(st.delivered)), st.skips,
+                epoch - st.lane_epoch, st.total_chunks,
+            )
+
+        streams = tuple(
+            stream_key(st)
+            for st in sorted(self.active, key=lambda s: s.index)
+        )
+
+        def bucket_state(t: int) -> tuple:
+            b = self.gate._buckets.get(f"t{t}")
+            if b is None:
+                return (-1.0,)  # no bucket yet (type-stable sentinel)
+            effective = min(b.burst, b.tokens + (now - b._last) * b.rate)
+            return (round(effective, 6),)
+
+        tenants = tuple(
+            (tau[t], self.submissions_left[t], self._tenant_seq[t])
+            + bucket_state(t)
+            for t in range(self.scope.tenants)
+        )
+
+        pending = tuple(
+            (qos, tuple(
+                (tau[int(p.request.tenant[1:])], now - p.since)
+                for p in self.gate.pending[qos]
+            ))
+            for qos in QOS_CLASSES
+        )
+        held = tuple(self.gate.held[c] for c in QOS_CLASSES)
+
+        def frame_key(item) -> tuple:
+            st = item.stream
+            # frames of completed streams are behaviourally inert
+            # (consumption skips them) — one label covers them all
+            owner = ((0, order[st.index]) if st.index in order
+                     else (1, 0))
+            return (
+                owner,
+                item.seq, max(0, item.ready_at - now),
+                item.lane_epoch - st.lane_epoch,
+                epoch - item.view_epoch,
+            )
+
+        lanes = tuple(
+            (
+                rho[lane.rank], lane.credits,
+                tuple(frame_key(f) for f in lane.in_flight),
+                tuple(frame_key(f) for f in lane.landed),
+                tuple(sorted(
+                    (order[idx], epoch - le, seq)
+                    for (idx, le), seq in lane.next_seq.items()
+                    if idx in order
+                )),
+            )
+            for lane in self.lanes
+        )
+
+        det = tuple(
+            (
+                rho[r],
+                r in self.detector.dead,
+                (now - self.detector._suspected_at[r]
+                 if r in self.detector.suspected else -1),
+                (now - self.detector._last[r]
+                 if r in self.detector._last else -1),
+                tuple(self.detector._intervals.get(r, ())),
+            )
+            for r in range(self.scope.ranks)
+        )
+
+        return (
+            tuple(sorted(tenants)),
+            held, pending, streams,
+            tuple(sorted(lanes)),
+            tuple(sorted(det)),
+            frozenset(rho[r] for r in self.view.members),
+            frozenset(rho[r] for r in self.killed),
+            frozenset(rho[r] for r in self.zombie_beats),
+            tuple(sorted(
+                (rho[r], epoch - self.death_epoch[r])
+                for r in self.rejoin_pending
+            )),
+            self.kills_left, self.silence_left,
+            self._beaten_this_period,
+        )
+
+    def fingerprint(self) -> tuple:
+        """Orbit representative: the minimum render over every
+        (tenant, rank) permutation pair that commutes with BOTH
+        deterministic tenant-identity maps — the routing map
+        (``tau(t) % ranks == rho(t % ranks)``) and the QoS assignment
+        (``tau(t) % classes == t % classes``, since future admissions
+        draw their class from the raw tenant index). Only genuinely
+        interchangeable identities collapse; a permutation that would
+        swap an interactive tenant with a best_effort one is not an
+        isomorphism and is rejected."""
+        nt, nr = self.scope.tenants, self.scope.ranks
+        nc = len(QOS_CLASSES)
+        best: Optional[tuple] = None
+        for rho in itertools.permutations(range(nr)):
+            for tau in itertools.permutations(range(nt)):
+                if any(tau[t] % nr != rho[t % nr]
+                       or tau[t] % nc != t % nc
+                       for t in range(nt)):
+                    continue
+                r = self._render(tau, rho)
+                if best is None or r < best:
+                    best = r
+        assert best is not None  # identity is always compatible
+        return best
+
+    # -- campaign-style report (the replay cell reads this) -------------
+
+    def report(self) -> Dict:
+        gate = self.gate
+        accepted = sum(gate.admitted.values())
+        delivered = len(self.completed)
+        return {
+            "scope": self.scope.to_json(),
+            "epoch": self.view.epoch,
+            "members": sorted(self.view.members),
+            "accepted": dict(gate.admitted),
+            "shed": {c: dict(gate.shed[c]) for c in QOS_CLASSES},
+            "delivered": delivered,
+            "in_flight": len(self.active),
+            "lost_accepted": accepted - delivered - len(self.active),
+            "silent_corruptions": self.corruptions,
+            "replayed_chunks": self.replayed_chunks,
+            "stale_epoch_rejections": self.stale_rejections,
+            "stale_epoch_leaks": self.stale_leaks,
+            "confirmed": list(self.confirmed),
+            "max_queue_depth": gate.max_queue_depth,
+            "queue_bound": gate.pool * (1 + len(QOS_CLASSES)),
+        }
+
+
+def _fork(world: World) -> World:
+    """An independent copy of a world (pickle round-trip — faster than
+    deepcopy for this object graph — with deepcopy as the fallback for
+    mutant subclasses that carry unpicklable state)."""
+    try:
+        return pickle.loads(pickle.dumps(world, protocol=4))
+    except Exception:
+        return copy.deepcopy(world)
+
+
+# ---------------------------------------------------------------------------
+# Findings + report
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelFinding:
+    """One property violation with its minimal counterexample trace.
+
+    ``trace`` is the BFS-shortest action sequence from the initial
+    state to the violating state —
+    :func:`smi_tpu.serving.campaign.replay_model_trace` re-executes it
+    against a fresh :class:`World` as a failing campaign cell."""
+
+    property: str
+    message: str
+    trace: Tuple[Tuple, ...]
+
+    def to_json(self) -> dict:
+        return {
+            "property": self.property,
+            "message": self.message,
+            "trace": [list(a) for a in self.trace],
+        }
+
+    def __str__(self) -> str:
+        steps = " -> ".join(
+            " ".join(str(x) for x in a) for a in self.trace
+        )
+        return (f"[{self.property}] {self.message}\n"
+                f"    trace ({len(self.trace)} steps): {steps}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelReport:
+    """Verdict of one scope: either every reachable state satisfies
+    every property (``ok`` with full coverage), or the minimal
+    counterexample. Coverage mirrors ``credits.ScheduleCount``:
+    ``truncated`` runs report ``explored``/``frontier``/
+    ``estimated_total`` instead of claiming exhaustiveness."""
+
+    scope: Scope
+    explored: int
+    truncated: bool
+    frontier: int
+    findings: Tuple[ModelFinding, ...]
+    properties: Tuple[str, ...]
+    mutant: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    @property
+    def estimated_total(self) -> int:
+        return self.explored + self.frontier
+
+    def to_json(self) -> dict:
+        return {
+            "scope": self.scope.to_json(),
+            "mutant": self.mutant,
+            "explored": self.explored,
+            "truncated": self.truncated,
+            "frontier": self.frontier,
+            "estimated_total": self.estimated_total,
+            "ok": self.ok,
+            "properties": list(self.properties),
+            "findings": [f.to_json() for f in self.findings],
+        }
+
+    def describe(self) -> str:
+        head = f"model [{self.scope.describe()}]"
+        if self.mutant:
+            head += f" [{self.mutant}]"
+        cov = (f"{self.explored} states"
+               if not self.truncated else
+               f"{self.explored} states explored, TRUNCATED — >= "
+               f"{self.estimated_total} exist")
+        if self.ok:
+            return (f"{head}: ok ({cov}; properties: "
+                    f"{', '.join(self.properties)})")
+        body = "\n".join(f"  {line}" for f in self.findings
+                         for line in str(f).splitlines())
+        return f"{head}: {len(self.findings)} finding(s) ({cov})\n{body}"
+
+
+# ---------------------------------------------------------------------------
+# BFS driver
+# ---------------------------------------------------------------------------
+
+
+def check_scope(
+    scope: Scope,
+    budget: int = DEFAULT_BUDGET,
+    world_factory=None,
+    mutant: Optional[str] = None,
+) -> ModelReport:
+    """Exhaustively check one scope; stop at the first (hence minimal)
+    violation.
+
+    ``world_factory`` builds the initial world (default: the clean
+    :class:`World`; the mutants module passes its broken subclasses —
+    ``mutant`` is the label stamped into the report either way).
+    """
+    from smi_tpu.analysis.properties import (
+        PROPERTIES,
+        check_state,
+        check_terminal,
+    )
+
+    factory = world_factory or World
+    init = factory(scope)
+    seen = {init.fingerprint()}
+    queue = deque([(init, ())])
+    explored = 0
+    truncated = False
+    frontier = 0
+    findings: List[ModelFinding] = []
+    while queue:
+        world, trace = queue.popleft()
+        explored += 1
+        if explored > budget:
+            truncated = True
+            frontier = len(queue) + 1
+            warnings.warn(
+                f"model checker: budget of {budget} states truncated "
+                f"the scope [{scope.describe()}] with {frontier} "
+                f"frontier states unexplored — the verified claim is "
+                f"'the first {explored - 1} states in BFS order "
+                f"hold', NOT exhaustive coverage",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            break
+        actions = world.enabled_actions()
+        if not actions:
+            violations = check_terminal(world)
+            if violations:
+                prop, message = violations[0]
+                findings.append(ModelFinding(prop, message, trace))
+                break
+            continue
+        stop = False
+        for action in actions:
+            child = _fork(world)
+            child.apply(action)
+            child_trace = trace + (action,)
+            violations = check_state(child)
+            if violations:
+                prop, message = violations[0]
+                findings.append(
+                    ModelFinding(prop, message, child_trace)
+                )
+                stop = True
+                break
+            fp = child.fingerprint()
+            if fp in seen:
+                continue
+            seen.add(fp)
+            queue.append((child, child_trace))
+        if stop:
+            break
+    return ModelReport(
+        scope=scope,
+        explored=min(explored, budget),
+        truncated=truncated,
+        frontier=frontier,
+        findings=tuple(findings),
+        properties=PROPERTIES,
+        mutant=mutant,
+    )
+
+
+def check_scopes(
+    scopes: Optional[Sequence[Scope]] = None,
+    budget: int = DEFAULT_BUDGET,
+) -> List[ModelReport]:
+    """The ``smi-tpu lint --model`` engine: every default scope (or
+    the given ones), clean world, first-violation-minimal."""
+    return [check_scope(s, budget=budget)
+            for s in (DEFAULT_SCOPES if scopes is None else scopes)]
+
+
+def model_reports_to_json(reports: Sequence[ModelReport]) -> dict:
+    """The ``smi-tpu lint --model --json`` payload (schema-tested).
+
+    Coverage is explicit per scope AND summarized at top level —
+    the machine-consumer half of "no silent caps"."""
+    from smi_tpu.analysis.properties import PROPERTIES
+
+    return {
+        "ok": all(r.ok for r in reports),
+        "tier": "model",
+        "findings": sum(len(r.findings) for r in reports),
+        "properties": list(PROPERTIES),
+        "coverage": {
+            "explored": sum(r.explored for r in reports),
+            "truncated": any(r.truncated for r in reports),
+            "estimated_total": sum(r.estimated_total
+                                   for r in reports),
+        },
+        "scopes": [r.to_json() for r in reports],
+    }
+
+
+def render_model_reports(reports: Sequence[ModelReport]) -> str:
+    lines = [r.describe() for r in reports]
+    n_findings = sum(len(r.findings) for r in reports)
+    total = sum(r.explored for r in reports)
+    tail = f"{len(reports)} scope(s), {total} states, " \
+           f"{n_findings} finding(s)"
+    if any(r.truncated for r in reports):
+        tail += " [TRUNCATED — coverage incomplete]"
+    lines.append(tail)
+    return "\n".join(lines)

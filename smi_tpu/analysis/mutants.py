@@ -145,6 +145,131 @@ MUTANTS = ("dropped_wait", "reused_slot", "unbalanced_grant",
            "late_grant")
 
 
+# ---------------------------------------------------------------------------
+# Control-plane mutants: the model checker's falsifiability story
+# ---------------------------------------------------------------------------
+#
+# Each one breaks exactly one seam of the model's frontend glue — or
+# swaps one REAL object for a broken subclass — and must be convicted
+# by exactly its named property
+# (:data:`smi_tpu.analysis.properties.PROPERTIES`), with the minimal
+# counterexample trace replaying as a failing campaign cell
+# (``smi_tpu.serving.campaign.replay_model_trace``).
+
+
+def _model_world_base():
+    from smi_tpu.analysis.model import World
+
+    return World
+
+
+def _leaked_stream_credit_world():
+    """``leaked_stream_credit``: a completed stream's credit never
+    returns to the admission pool (the release call is lost, e.g. an
+    exception path skipping it). Conviction: ``stream-credit`` — the
+    pool holds more credits than accepted-incomplete streams at the
+    first completion."""
+    World = _model_world_base()
+
+    class _LeakedStreamCredit(World):
+        def _release_credit(self, st):
+            pass  # the completed stream's credit is never released
+
+    return _LeakedStreamCredit
+
+
+def _skipped_aging_world():
+    """``skipped_aging``: the scheduler ships without the starved-first
+    ordering term (the aging bump is skipped), so strict class priority
+    can pass a ready low-class stream over without bound. Conviction:
+    ``starvation`` — a stream's skip counter crosses the aging bound
+    plus the concurrent-stream slack."""
+    from smi_tpu.serving.qos import CLASS_PRIORITY
+    from smi_tpu.serving.scheduler import StreamScheduler
+
+    World = _model_world_base()
+
+    class _NoAgingScheduler(StreamScheduler):
+        def _order(self, eligible):
+            return sorted(
+                eligible,
+                key=lambda s: (CLASS_PRIORITY[s.request.qos], s.index),
+            )
+
+    class _SkippedAging(World):
+        def _make_scheduler(self, scope):
+            return _NoAgingScheduler(check_deadlines=False,
+                                     max_starve_rounds=scope.starve)
+
+    return _SkippedAging
+
+
+def _epoch_bump_without_void_world():
+    """``epoch_bump_without_void``: the failover bumps the epoch and
+    reroutes the stream but skips ``ProgressLog.void_deliveries`` (and
+    the delivery/lane-epoch reset), so the dead consumer's partial
+    deliveries are silently folded into the rerouted stream.
+    Conviction: ``epoch-safety`` — an active stream retains deliveries
+    recorded at the dead rank under the old lane epoch."""
+    World = _model_world_base()
+
+    class _EpochBumpWithoutVoid(World):
+        def _reroute_stream(self, st, owner):
+            st.dst = owner  # ...but the dead rank's deliveries remain
+
+    return _EpochBumpWithoutVoid
+
+
+def _heartbeat_after_confirm_world():
+    """``heartbeat_after_confirm``: a killed rank keeps heartbeating
+    (the zombie NIC — the host crashed mid-consume but its heartbeat
+    path survived), so phi never accrues and the detector can never
+    confirm the death. Conviction: ``lost-accepted`` — a stream parked
+    on the zombie destination can never complete or fail over."""
+    World = _model_world_base()
+
+    class _HeartbeatAfterConfirm(World):
+        def _beat_ranks(self):
+            return sorted(self.view.members)  # killed ranks beat too
+
+    return _HeartbeatAfterConfirm
+
+
+#: Control-plane mutant registry: name -> World factory.
+_MODEL_MUTANT_FACTORIES = {
+    "leaked_stream_credit": _leaked_stream_credit_world,
+    "skipped_aging": _skipped_aging_world,
+    "epoch_bump_without_void": _epoch_bump_without_void_world,
+    "heartbeat_after_confirm": _heartbeat_after_confirm_world,
+}
+
+#: The shipped control-plane mutants, in acceptance-matrix order.
+MODEL_MUTANTS = tuple(_MODEL_MUTANT_FACTORIES)
+
+#: The exactly-one property each mutant must be convicted by
+#: (docs/analysis.md's control-plane mutant table, drift-guarded).
+MODEL_MUTANT_PROPERTY = {
+    "leaked_stream_credit": "stream-credit",
+    "skipped_aging": "starvation",
+    "epoch_bump_without_void": "epoch-safety",
+    "heartbeat_after_confirm": "lost-accepted",
+}
+
+
+def model_mutant_world(mutant: str):
+    """The broken-``World`` class for one control-plane mutant — pass
+    it to :func:`smi_tpu.analysis.model.check_scope` as
+    ``world_factory``."""
+    try:
+        factory = _MODEL_MUTANT_FACTORIES[mutant]
+    except KeyError:
+        raise ValueError(
+            f"unknown control-plane mutant {mutant!r}; known: "
+            f"{list(MODEL_MUTANTS)}"
+        ) from None
+    return factory()
+
+
 def mutant_generators(protocol: str, n: int, mutant: str,
                       chunks: int = 3, slices: int = 2,
                       rank: int = 0, nth: int = 0) -> List[Iterator]:

@@ -1,0 +1,238 @@
+"""The five model-checked control-plane properties.
+
+Each check is a pure predicate over a :class:`~smi_tpu.analysis.model.World`
+state — it reads the REAL objects (the gate's occupancy, the lanes'
+credit windows, the scheduler's skip counters, the view's epoch, the
+WAL) and returns ``(property, message)`` violations. The model
+checker runs :func:`check_state` on every reachable state and
+:func:`check_terminal` on every terminal one; the first violation (in
+BFS order) becomes the minimal counterexample.
+
+The properties are the campaign gates of
+:mod:`smi_tpu.serving.campaign` and the elastic soak, turned from
+sampled assertions into exhaustively-checked invariants:
+
+- **queue-bound** — stream-credit occupancy never exceeds the pool,
+  each pending queue stays inside its cap, and total queue depth stays
+  inside ``pool * (1 + classes)`` (the campaigns' bounded-occupancy
+  gate, checked on every transition instead of at the end of a run).
+- **stream-credit** — conservation end to end: credits held by the
+  admission pool equal the accepted-but-incomplete streams (per class
+  and in total), and every wire lane's window balances
+  (``credits + in_flight + landed == WIRE_CREDITS``). A completed
+  stream whose credit never returned — or a lane that minted or lost
+  a wire credit — is caught at the first state it happens.
+- **starvation** — the aging bound: an eligible stream is never
+  passed over more than ``max_starve_rounds`` times plus one slot per
+  concurrently active stream (the structural worst case of the
+  starved-first ordering; see ``StreamScheduler._order``).
+- **epoch-safety** — epoch monotonicity (the view's epoch never
+  regresses), zero stale-epoch leaks (every stale presentation —
+  straggler, rejoin request, pre-failover chunk — raised
+  ``StaleEpochError``), and the shrink discipline: after a failover,
+  no active stream retains deliveries recorded at its dead
+  destination under an old lane epoch (``void_deliveries`` must have
+  run before the replay).
+- **lost-accepted** — an accepted stream is delivered bit-identically
+  or the run fails loudly: zero silent corruptions, no zombie
+  heartbeats (a killed rank that still beats pins its streams on a
+  destination the detector will never confirm dead), and at every
+  terminal state zero incomplete accepted streams, zero parked
+  requests, and zero held credits.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from smi_tpu.serving.qos import QOS_CLASSES
+from smi_tpu.serving.scheduler import WIRE_CREDITS
+
+#: The checked properties, in reporting order. docs/analysis.md's
+#: property table must name every one (drift-guarded by
+#: tests/test_perf_docs.py).
+PROPERTIES = ("queue-bound", "stream-credit", "starvation",
+              "epoch-safety", "lost-accepted")
+
+Violation = Tuple[str, str]
+
+
+def check_queue_bound(world) -> List[Violation]:
+    out: List[Violation] = []
+    gate = world.gate
+    occ = gate.occupancy()
+    if occ > gate.pool:
+        out.append((
+            "queue-bound",
+            f"stream-credit occupancy {occ} exceeds pool {gate.pool}",
+        ))
+    for qos, q in gate.pending.items():
+        if len(q) > gate.pending_bound:
+            out.append((
+                "queue-bound",
+                f"pending queue for {qos} grew to {len(q)} "
+                f"(bound {gate.pending_bound})",
+            ))
+    bound = gate.pool * (1 + len(QOS_CLASSES))
+    depth = gate.queue_depth()
+    if depth > bound:
+        out.append((
+            "queue-bound",
+            f"queue depth {depth} exceeds the structural bound {bound}",
+        ))
+    return out
+
+
+def check_stream_credit(world) -> List[Violation]:
+    out: List[Violation] = []
+    gate = world.gate
+    active_by_class = {c: 0 for c in QOS_CLASSES}
+    for st in world.active:
+        active_by_class[st.request.qos] += 1
+    for qos in QOS_CLASSES:
+        if gate.held[qos] != active_by_class[qos]:
+            out.append((
+                "stream-credit",
+                f"pool holds {gate.held[qos]} {qos} credit(s) but "
+                f"{active_by_class[qos]} {qos} stream(s) are "
+                f"accepted-and-incomplete — a stream credit "
+                f"{'leaked' if gate.held[qos] > active_by_class[qos] else 'was double-released'}",
+            ))
+    for lane in world.lanes:
+        window = lane.credits + len(lane.in_flight) + len(lane.landed)
+        if window != WIRE_CREDITS:
+            out.append((
+                "stream-credit",
+                f"rank {lane.rank}'s wire lane balances to {window} "
+                f"credit(s) instead of {WIRE_CREDITS} — the credit "
+                f"window {'minted' if window > WIRE_CREDITS else 'lost'}"
+                f" a wire credit",
+            ))
+    return out
+
+
+def check_starvation(world) -> List[Violation]:
+    out: List[Violation] = []
+    bound = world.scheduler.max_starve_rounds + len(world.active)
+    for st in world.active:
+        if st.next_to_send >= st.total_chunks:
+            continue  # fully sent: no longer competing for the lane
+        if st.skips > bound:
+            out.append((
+                "starvation",
+                f"stream {st.request.stream_id} ({st.request.qos}) "
+                f"was passed over {st.skips} times — past the aging "
+                f"bound {world.scheduler.max_starve_rounds} plus the "
+                f"{len(world.active)} concurrent stream(s); the "
+                f"starved-first ordering is not engaging",
+            ))
+    return out
+
+
+def check_epoch_safety(world) -> List[Violation]:
+    out: List[Violation] = []
+    if world.view.epoch < world._epoch_watermark:
+        out.append((
+            "epoch-safety",
+            f"membership epoch regressed from "
+            f"{world._epoch_watermark} to {world.view.epoch}",
+        ))
+    if world.stale_leaks:
+        out.append((
+            "epoch-safety",
+            f"{world.stale_leaks} stale-epoch presentation(s) were "
+            f"accepted instead of raising StaleEpochError — traffic "
+            f"from a dead incarnation folded into the current epoch",
+        ))
+    for st in world.active:
+        meta = world.delivery_meta.get(st.index, {})
+        for seq, (rank, lane_epoch) in meta.items():
+            if rank != st.dst or lane_epoch != st.lane_epoch:
+                out.append((
+                    "epoch-safety",
+                    f"stream {st.request.stream_id} retains chunk "
+                    f"{seq} delivered at rank {rank} under lane "
+                    f"epoch {lane_epoch}, but the stream now routes "
+                    f"to rank {st.dst} at lane epoch "
+                    f"{st.lane_epoch} — the epoch bump did not void "
+                    f"the dead consumer's deliveries "
+                    f"(ProgressLog.void_deliveries never ran)",
+                ))
+                return out
+    return out
+
+
+def check_lost_accepted(world) -> List[Violation]:
+    out: List[Violation] = []
+    if world.corruptions:
+        out.append((
+            "lost-accepted",
+            f"{world.corruptions} accepted stream(s) completed with "
+            f"wrong bits — delivery is not bit-identical to the "
+            f"submission",
+        ))
+    for st in world.active:
+        if st.dst in world.zombie_beats:
+            out.append((
+                "lost-accepted",
+                f"accepted stream {st.request.stream_id} targets "
+                f"killed rank {st.dst}, which heartbeated AFTER the "
+                f"kill — the detector will never confirm the death, "
+                f"so the stream can never complete or fail over",
+            ))
+            return out
+    return out
+
+
+def check_state(world) -> List[Violation]:
+    """All per-state invariants, in property order."""
+    out: List[Violation] = []
+    out.extend(check_queue_bound(world))
+    out.extend(check_stream_credit(world))
+    out.extend(check_starvation(world))
+    out.extend(check_epoch_safety(world))
+    out.extend(check_lost_accepted(world))
+    return out
+
+
+def check_terminal(world) -> List[Violation]:
+    """Terminal states additionally owe completion: every accepted
+    stream delivered (its WAL holding every chunk), nothing parked,
+    and every stream credit back in the pool."""
+    out = check_state(world)
+    if world.active:
+        stuck = ", ".join(
+            f"{st.request.stream_id} ({len(st.delivered)}/"
+            f"{st.total_chunks} delivered at rank {st.dst})"
+            for st in world.active
+        )
+        out.append((
+            "lost-accepted",
+            f"terminal state with {len(world.active)} accepted "
+            f"stream(s) undelivered: {stuck}",
+        ))
+    pending = sum(len(q) for q in world.gate.pending.values())
+    if pending:
+        out.append((
+            "lost-accepted",
+            f"terminal state with {pending} request(s) still parked "
+            f"at the admission edge — neither admitted nor shed",
+        ))
+    if not world.active and world.gate.occupancy():
+        out.append((
+            "stream-credit",
+            f"terminal state holds {world.gate.occupancy()} stream "
+            f"credit(s) with zero active streams — credits leaked",
+        ))
+    for st in world.completed:
+        missing = st.wal.missing(
+            (st.index, seq) for seq in range(st.total_chunks)
+        )
+        if missing:
+            out.append((
+                "lost-accepted",
+                f"completed stream {st.request.stream_id}'s WAL is "
+                f"missing delivery record(s) {sorted(missing)} — the "
+                f"durable log disagrees with the delivery",
+            ))
+    return out

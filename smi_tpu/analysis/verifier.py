@@ -837,11 +837,31 @@ def verify_generators(
     seqs = [symbolic_events(gen) for gen in make_generators()]
     seqs2 = [symbolic_events(gen) for gen in make_generators()]
     norm = [[_describe(a) for a in s] for s in seqs]
-    if norm != [[_describe(a) for a in s] for s in seqs2]:
+    norm2 = [[_describe(a) for a in s] for s in seqs2]
+    if norm != norm2:
+        # name the first diverging (rank, step, primitive) pair — a
+        # bare "sequences differ" leaves the author of a
+        # nondeterministic protocol grepping blind
+        if len(norm) != len(norm2):
+            raise AnalysisError(
+                f"{protocol}: the two symbolic replays produced "
+                f"{len(norm)} vs {len(norm2)} rank sequences — the "
+                f"factory is not rebuilding the same instance, and no "
+                f"static claim is possible"
+            )
+        rank, step, first, second = next(
+            (r, i,
+             s1[i] if i < len(s1) else "<end of sequence>",
+             s2[i] if i < len(s2) else "<end of sequence>")
+            for r, (s1, s2) in enumerate(zip(norm, norm2))
+            for i in range(max(len(s1), len(s2)))
+            if (s1[i:i + 1] or ["<end>"]) != (s2[i:i + 1] or ["<end>"])
+        )
         raise AnalysisError(
-            f"{protocol}: rank sequences differ between two symbolic "
-            f"replays — the one-yield-per-primitive discipline is "
-            f"violated and no static claim is possible"
+            f"{protocol}: rank {rank} diverges at step {step} between "
+            f"two symbolic replays — first replay yielded {first}, "
+            f"second yielded {second}; the one-yield-per-primitive "
+            f"discipline is violated and no static claim is possible"
         )
     g = _Graph(seqs)
     findings: List[Finding] = []

@@ -1519,6 +1519,18 @@ class ScheduleCount(int):
         self.estimated_total = explored + frontier
         return self
 
+    def to_json(self) -> dict:
+        """Machine-readable coverage (the ``smi-tpu lint --json``
+        field shape): a truncating budget is never a warning-only
+        event — report consumers see explored/estimated_total/
+        truncated explicitly."""
+        return {
+            "explored": self.explored,
+            "truncated": self.truncated,
+            "frontier": self.frontier,
+            "estimated_total": self.estimated_total,
+        }
+
 
 def explore_all_schedules(make_generators: Callable[[], Sequence[Iterator]],
                           max_schedules: int = 200_000,

@@ -367,6 +367,73 @@ def load_campaign(
     }
 
 
+#: Model-checker property -> the campaign gate it instantiates. The
+#: model tier (:mod:`smi_tpu.analysis.model`) checks these same gates
+#: exhaustively at small scope; a counterexample trace replayed here
+#: must fail with the matching campaign verdict — differential
+#: soundness in both directions (tests/test_serving.py pins it).
+MODEL_GATES = {
+    "queue-bound": "queue occupancy exceeded bound",
+    "stream-credit": "stream-credit conservation violated",
+    "starvation": "ready stream starved past the aging bound",
+    "epoch-safety": "stale-epoch traffic accepted",
+    "lost-accepted": "lost accepted",
+}
+
+
+def replay_model_trace(scope, trace, mutant: Optional[str] = None) -> Dict:
+    """Re-execute a model-checker counterexample as a campaign cell.
+
+    ``scope`` is an :class:`~smi_tpu.analysis.model.Scope`, a scope
+    dict (the JSON report's ``scope`` field), or a ``--scope`` spec
+    string; ``trace`` the finding's action list (tuples or the JSON
+    report's lists); ``mutant`` the control-plane mutant the trace was
+    found under (None replays against the clean world). The trace is
+    driven through a fresh :class:`~smi_tpu.analysis.model.World` —
+    the same real gate/scheduler/membership/WAL objects — and the
+    cell's gates are evaluated on the resulting state. A
+    counterexample must come back ``ok=False`` with the matching
+    :data:`MODEL_GATES` verdict; any trace of a clean world must come
+    back ``ok=True``.
+    """
+    from smi_tpu.analysis import model as M
+    from smi_tpu.analysis import model_mutant_world
+    from smi_tpu.analysis.properties import check_state, check_terminal
+
+    if isinstance(scope, str):
+        scope = M.parse_scope(scope)
+    elif isinstance(scope, dict):
+        scope = M.Scope(**scope)
+    factory = M.World if mutant is None else model_mutant_world(mutant)
+    world = factory(scope)
+    for action in trace:
+        action = tuple(action)
+        enabled = world.enabled_actions()
+        if action not in enabled:
+            raise ValueError(
+                f"trace step {action!r} is not enabled in the replayed "
+                f"state (enabled: {enabled}) — the trace does not "
+                f"belong to this scope/mutant"
+            )
+        world.apply(action)
+    violations = check_state(world)
+    if not violations and not world.enabled_actions():
+        violations = check_terminal(world)
+    report = world.report()
+    problems = [
+        f"{MODEL_GATES[prop]}: {message}"
+        for prop, message in violations
+    ]
+    report.update({
+        "cell": "model-replay",
+        "mutant": mutant,
+        "trace_steps": len(list(trace)),
+        "verdict": "; ".join(problems) if problems else "ok",
+        "ok": not problems,
+    })
+    return report
+
+
 def serve_selftest(seed: int = 0) -> Dict:
     """The ``smi-tpu serve --selftest`` smoke: a deterministic CPU
     admit -> stream -> shed -> drain pass (overload cell at a fast

@@ -110,6 +110,15 @@ class AdmissionRejected(RuntimeError):
         self.queue_depth = queue_depth
         self.reason = reason
 
+    def __reduce__(self):
+        # exceptions pickle as cls(*args), but args holds the rendered
+        # message, not the constructor fields — without this, a gate
+        # whose rejection audit trail is copied (the model checker
+        # forks worlds; campaign reports deep-copy cells) dies with a
+        # TypeError instead of round-tripping
+        return (type(self), (self.tenant, self.qos,
+                             self.queue_depth, self.reason))
+
 
 def check_qos(qos: str) -> str:
     if qos not in QOS_CLASSES:

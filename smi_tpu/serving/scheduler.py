@@ -161,10 +161,25 @@ class WireLane:
 
 
 class StreamScheduler:
-    """Class-priority scheduling with a bounded starvation gap."""
+    """Class-priority scheduling with a bounded starvation gap.
 
-    def __init__(self, check_deadlines: bool = True):
+    ``max_starve_rounds`` defaults to the production
+    :data:`MAX_STARVE_ROUNDS`; the control-plane model checker
+    (:mod:`smi_tpu.analysis.model`) instantiates the same class with a
+    scope-scaled bound so the aging property is reachable inside a
+    small exhaustive scope — the bound is structural in the ordering
+    rule, not in the constant, so checking it at 3 proves the same
+    mechanism that ships at 16.
+    """
+
+    def __init__(self, check_deadlines: bool = True,
+                 max_starve_rounds: int = MAX_STARVE_ROUNDS):
+        if max_starve_rounds < 1:
+            raise ValueError(
+                f"max_starve_rounds must be >= 1, got {max_starve_rounds}"
+            )
         self.check_deadlines = check_deadlines
+        self.max_starve_rounds = max_starve_rounds
 
     def _order(self, eligible: List[StreamState]) -> List[StreamState]:
         """Starved streams first (aging bound), then strict class
@@ -172,7 +187,7 @@ class StreamScheduler:
         return sorted(
             eligible,
             key=lambda s: (
-                0 if s.skips >= MAX_STARVE_ROUNDS else 1,
+                0 if s.skips >= self.max_starve_rounds else 1,
                 CLASS_PRIORITY[s.request.qos],
                 s.index,
             ),

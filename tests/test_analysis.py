@@ -304,7 +304,7 @@ def test_nondeterministic_sequences_are_rejected():
 
         return [rank()]
 
-    with pytest.raises(A.AnalysisError, match="differ"):
+    with pytest.raises(A.AnalysisError, match="diverges at step"):
         A.verify_generators(make)
 
 
@@ -413,6 +413,265 @@ def test_without_allow_budget_still_raises():
 
     with pytest.raises(C.ProtocolError, match="budget"):
         C.explore_all_schedules(make, max_schedules=10)
+
+
+# ---------------------------------------------------------------------------
+# 3b. Control-plane model checker (PR 10): exhaustive small scopes
+# ---------------------------------------------------------------------------
+
+#: The designated scope per control-plane mutant — the grid entry
+#: whose feature set (contention / kill) makes the defect reachable.
+#: The full-grid exactly-one-finding sweep runs behind `slow`.
+MODEL_MUTANT_SCOPE = {
+    "leaked_stream_credit": A.DEFAULT_SCOPES[0],
+    "skipped_aging": A.DEFAULT_SCOPES[1],
+    "epoch_bump_without_void": A.DEFAULT_SCOPES[3],
+    "heartbeat_after_confirm": A.DEFAULT_SCOPES[3],
+}
+
+
+@pytest.mark.model
+@pytest.mark.parametrize(
+    "scope", A.DEFAULT_SCOPES,
+    ids=[s.describe()[:40] for s in A.DEFAULT_SCOPES])
+def test_model_clean_default_scopes(scope):
+    """Every default scope exhausts (no truncation) with zero
+    findings — all five control-plane properties hold on every
+    reachable state, matching the campaign gates' clean sweeps."""
+    report = A.check_scope(scope)
+    assert report.ok, report.describe()
+    assert not report.truncated, "default scope exceeded the budget"
+    assert report.frontier == 0
+    assert report.estimated_total == report.explored
+    assert report.explored > 1
+    assert report.properties == A.PROPERTIES
+
+
+@pytest.mark.model
+def test_model_scope_registry_is_consistent():
+    assert set(A.MODEL_MUTANT_PROPERTY) == set(A.MODEL_MUTANTS)
+    assert set(A.MODEL_MUTANT_PROPERTY.values()) <= set(A.PROPERTIES)
+    assert set(MODEL_MUTANT_SCOPE) == set(A.MODEL_MUTANTS)
+
+
+@pytest.mark.model
+@pytest.mark.parametrize("mutant", A.MODEL_MUTANTS)
+def test_model_mutants_yield_named_minimal_counterexamples(mutant):
+    """Each control-plane mutant is convicted at its designated scope
+    by EXACTLY its named property, with a minimal counterexample
+    trace whose every step re-validates against a fresh world."""
+    scope = MODEL_MUTANT_SCOPE[mutant]
+    report = A.check_scope(
+        scope, world_factory=A.model_mutant_world(mutant),
+        mutant=mutant,
+    )
+    assert not report.ok, f"{mutant} not caught at {scope.describe()}"
+    assert {f.property for f in report.findings} == {
+        A.MODEL_MUTANT_PROPERTY[mutant]
+    }
+    finding = report.findings[0]
+    assert finding.trace, "a counterexample must carry its trace"
+    # the trace replays step-for-step on a fresh mutant world: every
+    # action enabled where the trace uses it, and the final state
+    # violating exactly the named property
+    world = A.model_mutant_world(mutant)(scope)
+    from smi_tpu.analysis.properties import check_state
+
+    for action in finding.trace:
+        assert tuple(action) in world.enabled_actions(), action
+        world.apply(tuple(action))
+    assert {p for p, _ in check_state(world)} == {finding.property}
+
+
+@pytest.mark.model
+def test_model_counterexample_is_minimal():
+    """BFS order: no strictly shorter trace reaches a violation. The
+    zombie-heartbeat conviction needs admit+kill+heartbeat — three
+    steps, and the checker reports exactly three."""
+    report = A.check_scope(
+        MODEL_MUTANT_SCOPE["heartbeat_after_confirm"],
+        world_factory=A.model_mutant_world("heartbeat_after_confirm"),
+        mutant="heartbeat_after_confirm",
+    )
+    assert len(report.findings[0].trace) == 3
+    kinds = [a[0] for a in report.findings[0].trace]
+    assert kinds == ["admit", "kill", "heartbeat"]
+
+
+@pytest.mark.model
+def test_model_truncation_warns_and_reports_coverage():
+    """A budget that cuts the BFS short is never silent: the report
+    says truncated with explored/frontier/estimated_total (the
+    machine-readable half of "no silent caps"), AND a RuntimeWarning
+    fires for interactive callers."""
+    scope = A.DEFAULT_SCOPES[1]
+    with pytest.warns(RuntimeWarning, match="truncated the scope"):
+        report = A.check_scope(scope, budget=50)
+    assert report.truncated
+    assert report.explored == 50
+    assert report.frontier > 0
+    assert report.estimated_total == report.explored + report.frontier
+    payload = A.model_reports_to_json([report])
+    assert payload["coverage"]["truncated"] is True
+    assert payload["scopes"][0]["truncated"] is True
+    assert payload["scopes"][0]["estimated_total"] > 50
+
+
+@pytest.mark.model
+def test_model_complete_run_reports_full_coverage():
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a complete run must NOT warn
+        report = A.check_scope(A.DEFAULT_SCOPES[2])
+    assert not report.truncated and report.frontier == 0
+
+
+@pytest.mark.model
+def test_schedule_count_to_json_carries_coverage():
+    """The explore_all_schedules satellite: truncation coverage is a
+    first-class JSON payload, not a RuntimeWarning only."""
+
+    def make():
+        return A.build_generators("all_reduce", n=3)
+
+    with pytest.warns(RuntimeWarning):
+        count = C.explore_all_schedules(make, max_schedules=10,
+                                        allow_budget=True)
+    payload = count.to_json()
+    assert payload == {
+        "explored": 10,
+        "truncated": True,
+        "frontier": count.frontier,
+        "estimated_total": 10 + count.frontier,
+    }
+    full = C.explore_all_schedules(
+        lambda: A.build_generators("all_reduce", n=2),
+        max_schedules=500_000, allow_budget=True,
+    )
+    assert full.to_json()["truncated"] is False
+    assert full.to_json()["estimated_total"] == full.explored
+
+
+@pytest.mark.model
+def test_parse_scope_is_loud():
+    s = A.parse_scope("tenants=2, ranks=1, kill=0")
+    assert s.tenants == 2 and s.ranks == 1
+    with pytest.raises(ValueError, match="unknown scope key"):
+        A.parse_scope("tenant=2")
+    with pytest.raises(ValueError, match="not an integer"):
+        A.parse_scope("tenants=two")
+    with pytest.raises(ValueError, match="small-scope"):
+        A.parse_scope("tenants=9")
+    with pytest.raises(ValueError, match="last member"):
+        A.parse_scope("ranks=1,kill=1")
+    with pytest.raises(ValueError, match="confirmation grace"):
+        A.parse_scope("silence=7")
+
+
+@pytest.mark.model
+def test_model_symmetry_reduction_merges_orbits():
+    """Two tenants of the same class/quota on symmetric ranks are
+    interchangeable: the canonical space of a symmetric scope must be
+    well below the raw interleaving count (the 3-tenant admission
+    scope would blow past thousands of raw states)."""
+    report = A.check_scope(A.DEFAULT_SCOPES[0])
+    assert report.explored < 1000, report.explored
+
+
+@pytest.mark.model
+def test_model_symmetry_never_crosses_qos_classes():
+    """Soundness regression: a tenant permutation that would swap
+    tenants of DIFFERENT QoS classes is not an isomorphism (future
+    admissions draw their class from the raw tenant index), so the
+    states 'interactive tenant done' and 'best_effort tenant done'
+    must keep distinct fingerprints — merging them would prune
+    class-specific arcs (e.g. best_effort brownout) from a sweep that
+    claims exhaustiveness."""
+    scope = A.DEFAULT_SCOPES[0]  # tenants=3: one tenant per class
+
+    def after_completing(tenant):
+        world = A.World(scope)
+        for action in [("admit", tenant), ("send", tenant % 2),
+                       ("heartbeat",), ("consume", tenant % 2)]:
+            assert action in world.enabled_actions(), action
+            world.apply(action)
+        assert not world.active  # the stream completed
+        return world.fingerprint()
+
+    assert after_completing(0) != after_completing(2)
+
+
+@pytest.mark.model
+@pytest.mark.slow
+def test_model_mutant_full_grid_convicts_exactly_one_property():
+    """The wide sweep: each mutant over the WHOLE grid never trips a
+    property other than its own (benign-at-some-scopes is fine)."""
+    for mutant in A.MODEL_MUTANTS:
+        props = set()
+        for scope in A.DEFAULT_SCOPES:
+            report = A.check_scope(
+                scope, world_factory=A.model_mutant_world(mutant),
+                mutant=mutant,
+            )
+            props |= {f.property for f in report.findings}
+        assert props == {A.MODEL_MUTANT_PROPERTY[mutant]}, mutant
+
+
+@pytest.mark.model
+@pytest.mark.slow
+def test_model_wide_scope_exhausts():
+    """A 3x2 kill scope (beyond the default grid) still exhausts
+    inside the default budget — headroom for growing the grid."""
+    scope = A.Scope(tenants=3, ranks=2, chunks=2, streams=1, pool=3,
+                    kill=1, consume=1)
+    report = A.check_scope(scope)
+    assert report.ok and not report.truncated
+
+
+def test_verifier_divergence_names_rank_step_primitive():
+    """PR-10 satellite: a nondeterministic factory is rejected with
+    the first diverging (rank, step, primitive) pair named — not a
+    bare 'sequences differ'."""
+    calls = {"k": 0}
+
+    def make():
+        calls["k"] += 1
+        extra = calls["k"] % 2 == 0
+
+        def rank0():
+            yield ("output", 0, "x")
+
+        def rank1():
+            yield ("output", 0, "x")
+            if extra:
+                yield ("write_slot", 3, "y")
+
+        return [rank0(), rank1()]
+
+    with pytest.raises(A.AnalysisError) as err:
+        A.verify_generators(make, protocol="diverging")
+    msg = str(err.value)
+    assert "rank 1" in msg
+    assert "step 1" in msg
+    assert "write_slot" in msg
+    assert "end of sequence" in msg
+    assert "diverging" in msg
+
+
+def test_verifier_divergence_names_rank_count_mismatch():
+    calls = {"k": 0}
+
+    def make():
+        calls["k"] += 1
+
+        def rank():
+            yield ("output", 0, "x")
+
+        return [rank() for _ in range(1 + calls["k"] % 2)]
+
+    with pytest.raises(A.AnalysisError, match="rank sequences"):
+        A.verify_generators(make)
 
 
 # ---------------------------------------------------------------------------

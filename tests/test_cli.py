@@ -548,3 +548,100 @@ def test_chaos_flag_scoping_between_campaign_modes(capsys):
     assert "--load" in capsys.readouterr().err
     assert run_cli("chaos", "--elastic", "-n", "8") == 2
     assert "--load" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# lint --model (the control-plane model checker tier, PR 10)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.model
+def test_lint_model_all_smoke(tmp_path, capsys):
+    """``smi-tpu lint --model --all``: the whole default scope grid
+    exhausts clean — the acceptance gate."""
+    out = tmp_path / "model.json"
+    assert run_cli("lint", "--model", "--all", "-o", str(out)) == 0
+    text = capsys.readouterr().out
+    assert "0 finding(s)" in text
+    assert "TRUNCATED" not in text
+    payload = json.loads(out.read_text())
+    assert payload["ok"] is True and payload["tier"] == "model"
+    assert payload["coverage"]["truncated"] is False
+
+
+@pytest.mark.model
+def test_lint_model_json_schema(capsys):
+    """The --json schema, including the no-silent-caps coverage
+    fields per scope and in the summary."""
+    from smi_tpu import analysis
+
+    assert run_cli("lint", "--model", "--scope",
+                   "tenants=1,ranks=2,chunks=2,silence=2,pool=2",
+                   "--json") == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert set(payload) == {"ok", "tier", "findings", "properties",
+                            "coverage", "scopes"}
+    assert payload["properties"] == list(analysis.PROPERTIES)
+    assert set(payload["coverage"]) == {"explored", "truncated",
+                                        "estimated_total"}
+    (entry,) = payload["scopes"]
+    assert set(entry) == {"scope", "mutant", "explored", "truncated",
+                          "frontier", "estimated_total", "ok",
+                          "properties", "findings"}
+    assert entry["ok"] is True and entry["findings"] == []
+    assert entry["explored"] == entry["estimated_total"]
+    assert entry["mutant"] is None
+
+
+@pytest.mark.model
+def test_lint_model_mutant_exits_nonzero_with_trace(capsys):
+    assert run_cli(
+        "lint", "--model", "--mutant", "heartbeat_after_confirm",
+        "--scope", "tenants=2,ranks=2,chunks=2,kill=1,consume=1,pool=3",
+        "--json",
+    ) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is False and payload["findings"] == 1
+    (finding,) = payload["scopes"][0]["findings"]
+    assert set(finding) == {"property", "message", "trace"}
+    assert finding["property"] == "lost-accepted"
+    assert finding["trace"], "the counterexample must carry its trace"
+    assert payload["scopes"][0]["mutant"] == "heartbeat_after_confirm"
+
+
+@pytest.mark.model
+def test_lint_model_benign_mutant_notes_it(capsys):
+    """A control-plane mutant that cannot manifest at the checked
+    scope (no kill action for the zombie heartbeat) exits 0 with an
+    explicit note, never a silent ok."""
+    rc = run_cli("lint", "--model", "--mutant",
+                 "heartbeat_after_confirm",
+                 "--scope", "tenants=1,ranks=1,chunks=1,pool=1")
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "did not manifest" in captured.err
+
+
+@pytest.mark.model
+def test_lint_model_usage_errors(capsys):
+    # --scope needs --model
+    assert run_cli("lint", "--scope", "tenants=2") == 2
+    assert "--model" in capsys.readouterr().err
+    # --protocol belongs to the protocol tier
+    assert run_cli("lint", "--model", "--protocol", "all_reduce") == 2
+    assert "protocol tier" in capsys.readouterr().err
+    # a protocol mutant on the model tier names both registries
+    assert run_cli("lint", "--model", "--mutant", "dropped_wait") == 2
+    err = capsys.readouterr().err
+    assert "leaked_stream_credit" in err and "dropped_wait" in err
+    # malformed scope specs are loud
+    assert run_cli("lint", "--model", "--scope", "bogus=1") == 2
+    assert "unknown scope key" in capsys.readouterr().err
+    assert run_cli("lint", "--model", "--scope", "tenants=99") == 2
+    assert "small-scope" in capsys.readouterr().err
+    # --all (the full grid) combined with a single --scope is
+    # ambiguous, not a narrower run — same discipline as
+    # --all/--protocol on the protocol tier
+    assert run_cli("lint", "--model", "--all", "--scope",
+                   "tenants=2") == 2
+    assert "mutually exclusive" in capsys.readouterr().err

@@ -268,6 +268,50 @@ def test_analysis_doc_quotes_the_shipped_checks():
     assert "traffic dump.hlo --lint" in text
 
 
+def test_analysis_doc_quotes_the_model_tier():
+    """docs/analysis.md's "Model-checked control plane" section must
+    name every checked property, every control-plane mutant with its
+    convicting property, and every default scope the code ships — the
+    same drift discipline as the protocol-tier check/mutant tables.
+    (Pure Python imports, no devices.)"""
+    from smi_tpu import analysis
+
+    text = _read("docs/analysis.md")
+    assert "Model-checked control plane" in text
+    for prop in analysis.PROPERTIES:
+        assert f"`{prop}`" in text, f"property {prop} undocumented"
+    for mutant in analysis.MODEL_MUTANTS:
+        assert f"`{mutant}`" in text, f"mutant {mutant} undocumented"
+        # the conviction column quotes the exactly-one property
+        row = next(line for line in text.splitlines()
+                   if line.startswith(f"| `{mutant}`"))
+        assert f"`{analysis.MODEL_MUTANT_PROPERTY[mutant]}`" in row, (
+            f"{mutant}'s documented conviction drifted from "
+            f"MODEL_MUTANT_PROPERTY"
+        )
+    # the scope grid table quotes the shipped DEFAULT_SCOPES
+    for scope in analysis.DEFAULT_SCOPES:
+        row = (f"tenants={scope.tenants}, ranks={scope.ranks}, "
+               f"chunks={scope.chunks}, streams={scope.streams}, "
+               f"pool={scope.pool}")
+        assert row in text, (
+            f"default scope {scope.describe()} missing from the "
+            f"scope grid table"
+        )
+        if scope.kill:
+            assert f"{row}, kill={scope.kill}" in text
+        if scope.silence:
+            assert f"{row}, silence={scope.silence}" in text
+    # the honesty clause: what small-scope exhaustiveness does NOT
+    # prove, and the no-silent-caps coverage fields
+    assert "does not prove" in text
+    assert "small-scope hypothesis" in text
+    for field in ("`explored`", "`estimated_total`", "`truncated`"):
+        assert field in text, f"coverage field {field} undocumented"
+    assert "lint --model" in text
+    assert "replay_model_trace" in text
+
+
 def test_tuning_doc_quotes_the_seeded_knobs():
     """docs/tuning.md's decision table must state the seeded values the
     code ships (block tiles, depth, threshold) — the table is the

@@ -875,3 +875,105 @@ def test_bench_serving_field_is_additive_and_schema_stable():
     with pytest.raises(ValueError):
         bench.render_line({"metric": "m", "value": 1.0, "unit": "u",
                            "serving": fields})
+
+
+# ---------------------------------------------------------------------------
+# PR 10: model-checker <-> campaign differential soundness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.model
+class TestModelCampaignDifferential:
+    """Differential soundness in both directions: every control-plane
+    mutant counterexample replays as a FAILING campaign cell with the
+    matching gate verdict, and traces of the clean world replay as
+    passing cells — while the clean model sweep and the seeded
+    campaign gates agree on what "healthy" means."""
+
+    def _mutant_finding(self, mutant):
+        from smi_tpu import analysis
+
+        from tests.test_analysis import MODEL_MUTANT_SCOPE
+
+        scope = MODEL_MUTANT_SCOPE[mutant]
+        report = analysis.check_scope(
+            scope, world_factory=analysis.model_mutant_world(mutant),
+            mutant=mutant,
+        )
+        assert report.findings, f"{mutant} did not manifest"
+        return scope, report.findings[0]
+
+    @pytest.mark.parametrize(
+        "mutant", ("leaked_stream_credit", "skipped_aging",
+                   "epoch_bump_without_void", "heartbeat_after_confirm"))
+    def test_counterexample_replays_as_failing_cell(self, mutant):
+        from smi_tpu import analysis
+        from smi_tpu.serving.campaign import (
+            MODEL_GATES,
+            replay_model_trace,
+        )
+
+        scope, finding = self._mutant_finding(mutant)
+        cell = replay_model_trace(scope, finding.trace, mutant=mutant)
+        assert cell["ok"] is False
+        assert cell["cell"] == "model-replay"
+        assert MODEL_GATES[finding.property] in cell["verdict"]
+        assert cell["trace_steps"] == len(finding.trace)
+        # the JSON round-trip works too: the report's list-form trace
+        # and scope dict replay identically
+        json_trace = [list(a) for a in finding.trace]
+        cell2 = replay_model_trace(scope.to_json(), json_trace,
+                                   mutant=mutant)
+        assert cell2["verdict"] == cell["verdict"]
+        # ...and without the mutant, the same trace diverges or stays
+        # clean — the defect lives in the mutated seam, not the trace
+        assert analysis.MODEL_MUTANT_PROPERTY[mutant] == finding.property
+
+    def test_clean_trace_replays_ok(self):
+        from smi_tpu import analysis
+        from smi_tpu.serving.campaign import replay_model_trace
+
+        scope = analysis.DEFAULT_SCOPES[0]
+        cell = replay_model_trace(
+            scope, [("admit", 0), ("send", 0), ("heartbeat",),
+                    ("consume", 0)],
+        )
+        assert cell["ok"] is True and cell["verdict"] == "ok"
+        assert cell["silent_corruptions"] == 0
+        assert cell["stale_epoch_leaks"] == 0
+
+    def test_alien_trace_is_rejected_loudly(self):
+        from smi_tpu import analysis
+        from smi_tpu.serving.campaign import replay_model_trace
+
+        with pytest.raises(ValueError, match="not enabled"):
+            replay_model_trace(analysis.DEFAULT_SCOPES[0],
+                               [("kill", 0)])  # kill=0 scope
+
+    def test_model_gates_cover_exactly_the_properties(self):
+        """The property -> campaign-gate map stays total: a property
+        added to the checker must name its campaign gate (and the
+        campaign phrases stay aligned with run_load_cell's verdicts)."""
+        from smi_tpu import analysis
+        from smi_tpu.serving.campaign import MODEL_GATES
+
+        assert set(MODEL_GATES) == set(analysis.PROPERTIES)
+        # the shared gates quote the campaign's own verdict phrasing
+        assert "lost accepted" in MODEL_GATES["lost-accepted"]
+        assert "stale-epoch" in MODEL_GATES["epoch-safety"]
+        assert "queue occupancy" in MODEL_GATES["queue-bound"]
+
+    def test_clean_sweep_agrees_with_campaign_gates(self):
+        """Both tiers green on the same machine: the smallest model
+        scope exhausts clean AND the seeded serving selftest passes
+        its gates — the exhaustive tier and the sampled tier agree on
+        health (the full-grid clean sweep runs in test_analysis)."""
+        from smi_tpu import analysis
+
+        report = analysis.check_scope(analysis.DEFAULT_SCOPES[0])
+        assert report.ok and not report.truncated
+        selftest = serve_selftest(seed=0)
+        assert selftest["ok"], selftest["verdict"]
+        # the kill cell's campaign gates and the kill scope's model
+        # properties describe the same contract
+        assert report.properties == analysis.PROPERTIES
