@@ -451,6 +451,30 @@ def _check_lint(n_slices, healthy) -> int:
             f"lint: ok ({len(jobs)} protocols statically verified at "
             f"n={vn}: {names})"
         )
+        # safety held — the remaining launch risk is performance: the
+        # same protocol set runs through the makespan decomposition,
+        # and a perf finding (idle upstream, collapsed pipeline) fails
+        # the check exactly like a safety finding would
+        max_idle = 0.0
+        for protocol, shape in jobs:
+            # verify=False: the safety pass above JUST proved these
+            # exact instances — the decomposition need not re-prove
+            perf = analysis.decompose_protocol(protocol, verify=False,
+                                               **shape)
+            max_idle = max(
+                max_idle,
+                max(r["idle_fraction"] for r in perf.per_rank),
+            )
+            if not perf.ok:
+                for finding in perf.findings:
+                    print("perf: FAIL — " + str(finding))
+                rc = 1
+        if not rc:
+            print(
+                f"perf: ok ({len(jobs)} protocol makespans decomposed,"
+                f" max idle fraction {max_idle:.3f} <= "
+                f"{analysis.IDLE_FRACTION_THRESHOLD})"
+            )
     return rc
 
 
@@ -1115,19 +1139,53 @@ def cmd_lint(args: argparse.Namespace) -> int:
     """
     from smi_tpu import analysis
 
+    if getattr(args, "combined", False):
+        # the combined gate runs the full default grid of every tier —
+        # narrowing flags would let a CI caller believe the whole gate
+        # ran when a subset did. --hlo is NOT a narrowing flag: it
+        # supplies an artifact that ADDS the serialized-dma check to
+        # the perf tier, so the one-command gate accepts it.
+        conflicts = [
+            flag for flag, val in (
+                ("--model", args.model),
+                ("--perf", getattr(args, "perf", False)),
+                ("--protocol", args.protocol),
+                ("--mutant", args.mutant),
+                ("--scope", getattr(args, "scope", None)),
+            ) if val
+        ]
+        if conflicts:
+            print(f"error: --combined runs all three tiers at their "
+                  f"default grids; {', '.join(conflicts)} "
+                  f"{'select' if len(conflicts) > 1 else 'selects'} a "
+                  f"subset — drop it or run the tier alone",
+                  file=sys.stderr)
+            return 2
+        return _cmd_lint_combined(args)
     if args.all and args.protocol:
         # silently dropping the filter (or the --all) would let a CI
         # caller believe a different gate ran than the one that did
         print("error: --all and --protocol are mutually exclusive",
               file=sys.stderr)
         return 2
+    if args.model and getattr(args, "perf", False):
+        print("error: --model and --perf are distinct tiers; pick one "
+              "(or --combined for all of them)", file=sys.stderr)
+        return 2
     if getattr(args, "scope", None) and not args.model:
         print("error: --scope applies only to --model (protocol "
               "instances are sized by the default shape grid)",
               file=sys.stderr)
         return 2
+    if getattr(args, "hlo", None) and not getattr(args, "perf", False):
+        print("error: --hlo applies only to --perf or --combined (the "
+              "serialized-dma rule reads a compiled artifact; the "
+              "protocol/model tiers read none)", file=sys.stderr)
+        return 2
     if args.model:
         return _cmd_lint_model(args)
+    if getattr(args, "perf", False):
+        return _cmd_lint_perf(args)
     try:
         if args.mutant:
             if not args.protocol:
@@ -1135,8 +1193,12 @@ def cmd_lint(args: argparse.Namespace) -> int:
                       file=sys.stderr)
                 return 2
             if args.mutant not in analysis.MUTANTS:
-                print(f"error: unknown mutant {args.mutant!r}; known: "
-                      f"{list(analysis.MUTANTS)}", file=sys.stderr)
+                print(f"error: unknown mutant {args.mutant!r} for the "
+                      f"protocol tier; known: {list(analysis.MUTANTS)} "
+                      f"(perf mutants {list(analysis.PERF_MUTANTS)} "
+                      f"apply with --perf; control-plane mutants "
+                      f"{list(analysis.MODEL_MUTANTS)} with --model)",
+                      file=sys.stderr)
                 return 2
             unknown = [p for p in args.protocol
                        if p not in analysis.DEFAULT_SHAPES]
@@ -1189,6 +1251,25 @@ def cmd_lint(args: argparse.Namespace) -> int:
             f"benign at these sizes, not missed by the verifier",
             file=sys.stderr,
         )
+    return 0 if payload["ok"] else 1
+
+
+def _emit_lint_report(args: argparse.Namespace, payload: dict,
+                      text: str) -> int:
+    """The shared lint-report epilogue: print JSON or the rendered
+    text, optionally also write the JSON artifact — one copy for every
+    lint tier, so the output contract cannot drift between them.
+    Returns the exit code (1 on findings)."""
+    if args.json:
+        print(json.dumps(payload, indent=2))
+    else:
+        print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        if not args.json:
+            print(f"report -> {args.out}")
     return 0 if payload["ok"] else 1
 
 
@@ -1248,16 +1329,8 @@ def _cmd_lint_model(args: argparse.Namespace) -> int:
     else:
         reports = analysis.check_scopes(scopes)
     payload = analysis.model_reports_to_json(reports)
-    if args.json:
-        print(json.dumps(payload, indent=2))
-    else:
-        print(analysis.render_model_reports(reports))
-    if args.out:
-        with open(args.out, "w") as f:
-            json.dump(payload, f, indent=2)
-            f.write("\n")
-        if not args.json:
-            print(f"report -> {args.out}")
+    rc = _emit_lint_report(args, payload,
+                           analysis.render_model_reports(reports))
     if args.mutant and payload["ok"]:
         print(
             f"note: control-plane mutant {args.mutant!r} did not "
@@ -1265,7 +1338,168 @@ def _cmd_lint_model(args: argparse.Namespace) -> int:
             f"these sizes, not missed by the checker",
             file=sys.stderr,
         )
-    return 0 if payload["ok"] else 1
+    return rc
+
+
+def _cmd_lint_perf(args: argparse.Namespace) -> int:
+    """``smi-tpu lint --perf``: the static performance analyzer.
+
+    Sub-tier (a) decomposes every registered protocol's makespan (or
+    the ``--protocol`` subset) on the timestamped credits simulator
+    into alpha/beta/serialization/idle per rank and per wire tier,
+    naming the binding wait edge as (rank, step, primitive) events;
+    sub-tier (b) runs the kernel roofline lint (VMEM double-buffer
+    bound, tile roofline fraction, analytic drift vs the committed
+    expectations, and — with ``--hlo DUMP`` — serialized dependent DMA
+    chains). Exit 1 on findings / 2 on usage. ``--mutant`` applies one
+    safe-but-slow variant (:data:`smi_tpu.analysis.PERF_MUTANTS`);
+    each must be convicted by exactly its rule.
+    """
+    from smi_tpu import analysis
+
+    hlo_text = None
+    if getattr(args, "hlo", None):
+        try:
+            with open(args.hlo) as f:
+                hlo_text = f.read()
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    try:
+        if args.mutant:
+            return _cmd_lint_perf_mutant(args, analysis, hlo_text)
+        protocols = None if args.all else (args.protocol or None)
+        reports = analysis.perf_all(protocols=protocols)
+        roofline = analysis.roofline_lint(hlo_text=hlo_text)
+    except (ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    payload = analysis.perf_reports_to_json(reports, roofline)
+    return _emit_lint_report(
+        args, payload, analysis.render_perf_reports(reports, roofline)
+    )
+
+
+def _cmd_lint_perf_mutant(args, analysis, hlo_text) -> int:
+    """The ``lint --perf --mutant NAME`` path: protocol-timing mutants
+    sweep their protocol's default shape grid; the roofline mutant
+    prices its mis-tiled compile. Benign-at-every-shape exits 0 with an
+    explicit note, mirroring the protocol tier."""
+    if args.mutant not in analysis.PERF_MUTANTS:
+        print(f"error: unknown perf mutant {args.mutant!r}; known: "
+              f"{list(analysis.PERF_MUTANTS)} (protocol mutants "
+              f"{list(analysis.MUTANTS)} apply without --perf; "
+              f"control-plane mutants {list(analysis.MODEL_MUTANTS)} "
+              f"with --model)", file=sys.stderr)
+        return 2
+    reports = []
+    roofline = []
+    if args.mutant == "oversized_flash_tile":
+        if args.protocol:
+            print("error: oversized_flash_tile is a roofline-tier "
+                  "mutant (a tile choice, not a protocol transform); "
+                  "drop --protocol", file=sys.stderr)
+            return 2
+        roofline = analysis.roofline_lint(
+            flash_tiles=[analysis.OVERSIZED_FLASH_TILE],
+            hlo_text=hlo_text, check_expectations=False,
+        )
+    else:
+        protocols = args.protocol or (
+            ["all_reduce_chunked"]
+            if args.mutant == "unoverlapped_chunks"
+            else list(analysis.DEFAULT_SHAPES)
+        )
+        unknown = [p for p in protocols
+                   if p not in analysis.DEFAULT_SHAPES]
+        if unknown:
+            print(f"error: unknown protocol(s) {unknown}; known: "
+                  f"{list(analysis.DEFAULT_SHAPES)}", file=sys.stderr)
+            return 2
+        from smi_tpu.analysis.perf import _costs_for
+
+        for protocol in protocols:
+            for shape in analysis.DEFAULT_SHAPES[protocol]:
+                shape = dict(shape)
+                costs, _message, pipeline = _costs_for(
+                    protocol, shape, float(analysis.PERF_PAYLOAD_BYTES)
+                )
+                try:
+                    reports.append(analysis.decompose_generators(
+                        lambda p=protocol, s=shape:
+                            analysis.perf_mutant_generators(
+                                p, args.mutant, s["n"],
+                                chunks=s.get("chunks", 3),
+                                slices=s.get("slices", 2),
+                            ),
+                        costs,
+                        protocol=f"{protocol}[{args.mutant}]",
+                        shape=shape,
+                        pipeline_chunks=pipeline,
+                    ))
+                except ValueError as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 2
+    payload = analysis.perf_reports_to_json(reports, roofline)
+    rc = _emit_lint_report(
+        args, payload, analysis.render_perf_reports(reports, roofline)
+    )
+    if payload["ok"]:
+        print(
+            f"note: perf mutant {args.mutant!r} did not manifest at "
+            f"any checked shape — the damage is benign at these "
+            f"sizes, not missed by the analyzer",
+            file=sys.stderr,
+        )
+    return rc
+
+
+def _cmd_lint_combined(args: argparse.Namespace) -> int:
+    """``smi-tpu lint --combined``: protocol + model + perf tiers in
+    one invocation — the one-command merge gate. Each tier runs its
+    full default grid (an ``--hlo`` artifact additionally feeds the
+    perf tier's serialized-dma rule); the merged JSON carries one
+    section per tier and the exit code is 1 if ANY tier found
+    anything."""
+    from smi_tpu import analysis
+
+    hlo_text = None
+    if getattr(args, "hlo", None):
+        try:
+            with open(args.hlo) as f:
+                hlo_text = f.read()
+        except OSError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    protocol_reports = analysis.lint_all()
+    model_reports = analysis.check_scopes(list(analysis.DEFAULT_SCOPES))
+    # the protocol tier just verified the identical DEFAULT_SHAPES
+    # grid — re-proving safety inside the decomposition would double
+    # the static-analysis bill for nothing
+    perf_reports = analysis.perf_all(verify=False)
+    roofline = analysis.roofline_lint(hlo_text=hlo_text)
+    tiers = {
+        "protocol": analysis.reports_to_json(protocol_reports),
+        "model": analysis.model_reports_to_json(model_reports),
+        "perf": analysis.perf_reports_to_json(perf_reports, roofline),
+    }
+    findings = sum(t["findings"] for t in tiers.values())
+    payload = {
+        "ok": all(t["ok"] for t in tiers.values()),
+        "tier": "combined",
+        "findings": findings,
+        "tiers": tiers,
+    }
+    text = "\n".join([
+        "=== protocol tier ===",
+        analysis.render_reports(protocol_reports),
+        "=== model tier ===",
+        analysis.render_model_reports(model_reports),
+        "=== perf tier ===",
+        analysis.render_perf_reports(perf_reports, roofline),
+        f"combined: {findings} finding(s) across {len(tiers)} tiers",
+    ])
+    return _emit_lint_report(args, payload, text)
 
 
 def cmd_traffic(args: argparse.Namespace) -> int:
@@ -1797,6 +2031,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "'tenants=2,ranks=2,chunks=2,kill=1' "
                         "(keys: tenants/ranks/chunks/streams/pool/"
                         "kill/silence/consume/starve)")
+    p.add_argument("--perf", action="store_true",
+                   help="run the static performance analyzer instead: "
+                        "decompose every registered protocol's "
+                        "simulated makespan into alpha/beta/"
+                        "serialization/idle per rank and wire tier "
+                        "(naming the binding wait edge), plus the "
+                        "kernel roofline lint (VMEM double-buffer "
+                        "bound, tile roofline fraction, analytic "
+                        "drift vs committed expectations); perf "
+                        "mutants: halved_wire_credits, "
+                        "unoverlapped_chunks, oversized_flash_tile")
+    p.add_argument("--hlo", default=None, metavar="DUMP",
+                   help="with --perf or --combined: also lint this "
+                        "HLO text dump for serialized dependent DMA "
+                        "chains (async pairs moving with zero "
+                        "scheduled compute)")
+    p.add_argument("--combined", action="store_true",
+                   help="run protocol + model + perf tiers in one "
+                        "invocation at their full default grids; "
+                        "merged JSON report with per-tier sections, "
+                        "single exit code")
     p.add_argument("--json", action="store_true",
                    help="print the JSON report instead of text")
     p.add_argument("-o", "--out", default=None,

@@ -187,6 +187,11 @@ class _Dma:
     recv_index: int
     #: wire-time the copy lands (cost model active; 0.0 otherwise)
     ready_at: float = 0.0
+    #: (rank, step) of the issuing primitive in the rank's executed
+    #: sequence — the same coordinates the static analysis tiers name
+    #: events by, so a timing attribution can point back at the exact
+    #: ``("dma", ...)`` primitive that started the copy
+    origin: Optional[Tuple[int, int]] = None
 
 
 def _identity(rank: int) -> int:
@@ -1348,7 +1353,8 @@ class RingSimulator:
                 if tamper is not None:
                     payload = tamper(r, nth, payload)
             dma = _Dma(src=r, target=target, slot=slot, payload=payload,
-                       send_index=send_index, recv_index=recv_index)
+                       send_index=send_index, recv_index=recv_index,
+                       origin=(r, self.actions_done[r] - 1))
             if self.costs is not None:
                 dma.ready_at = (
                     self.clock[r] + self.costs.dma_seconds(r, target)

@@ -468,6 +468,16 @@ def overlap_report(compiled=None, hlo_text: Optional[str] = None) -> dict:
         ]
         if not coll_names:
             continue
+        # every collective-ish instruction (starts, syncs, AND dones):
+        # the ancestor test below uses it to tell "this transfer is a
+        # link of a dependent collective chain" — the input the perf
+        # tier's serialized-dma rule consumes
+        collectivish = {
+            n for n in order
+            if ops[n] in _COLLECTIVES
+            or any(ops[n] in (f"{c}-start", f"{c}-done")
+                   for c in _COLLECTIVES)
+        }
         compute = [n for n in order if ops[n] not in _NON_COMPUTE_OPS]
         comp_compute_bytes = sum(comp["bytes"][n] for n in compute)
         compute_bytes += comp_compute_bytes
@@ -510,6 +520,16 @@ def overlap_report(compiled=None, hlo_text: Optional[str] = None) -> dict:
             ]
             rec["independent_ops"] = len(free)
             rec["independent_bytes"] = sum(comp["bytes"][n] for n in free)
+            # additive chain column: the nearest upstream collective
+            # this transfer's start depends on (None = chain head) —
+            # a dependent chain whose links move with zero scheduled
+            # compute is the perf tier's serialized-dma finding
+            upstream = [n for n in ancestors
+                        if n != name and n in collectivish]
+            rec["depends_on_collective"] = (
+                max(upstream, key=lambda n: index[n]) if upstream
+                else None
+            )
             if is_start:
                 done = next(
                     (

@@ -338,24 +338,49 @@ def flash_fwd_vmem_bytes(bq: int, bk: int, d: int, itemsize: int) -> int:
     return tiles + scratch
 
 
+class FlashCandidates(List[Candidate]):
+    """The feasible flash-tile candidate list, PLUS the candidates the
+    VMEM gate rejected (``excluded``) — the ``ScheduleCount`` pattern
+    applied to candidate filtering: existing callers keep receiving the
+    plain list they always did, and "no silent caps" consumers
+    (``smi-tpu tune --explain``, the perf lint tier) can state exactly
+    which targets were dropped and at what footprint instead of letting
+    a silently shorter table read as the whole search space."""
+
+    def __init__(self, feasible: Sequence[Candidate] = (),
+                 excluded: Sequence[Candidate] = ()):
+        super().__init__(feasible)
+        self.excluded: List[Candidate] = list(excluded)
+
+
 def flash_block_candidates(
     s: int, d: int, dtype: str, windowed: bool,
     targets: Sequence[Tuple[int, int]] = (
         (512, 512), (512, 1024), (1024, 512), (1024, 1024),
     ),
-) -> List[Candidate]:
+) -> FlashCandidates:
     """Feasible forward-tile candidates, ranked by modeled grid-step
     overhead (fewer, larger tiles amortize per-tile masking); the
-    VMEM-infeasible ones are *excluded*. This ranking is deliberately
-    coarse — it seeds the sweep order; measurement (the cache layer)
-    has the last word, which is exactly why f32 keeps bk=512 despite
-    the model preferring 1024 (PERF.json: f32 measured slower at 1024).
+    VMEM-infeasible ones are *excluded* — and returned on the result's
+    ``excluded`` list with the failing footprint in the note, never
+    silently dropped. This ranking is deliberately coarse — it seeds
+    the sweep order; measurement (the cache layer) has the last word,
+    which is exactly why f32 keeps bk=512 despite the model preferring
+    1024 (PERF.json: f32 measured slower at 1024).
     """
     itemsize = 2 if dtype == "bfloat16" else 4
     out = []
+    excluded = []
     for bq, bk in targets:
         vmem = flash_fwd_vmem_bytes(bq, bk, d, itemsize)
         if vmem > VMEM_LIMIT_BYTES:
+            excluded.append(Candidate(
+                f"bq{bq}/bk{bk}", {"block_q": bq, "block_k": bk},
+                modeled_us=None,
+                note=(f"EXCLUDED: vmem {vmem // 1024} KiB exceeds the "
+                      f"{VMEM_LIMIT_BYTES // 1024} KiB scoped-VMEM "
+                      f"frame"),
+            ))
             continue
         steps = max(1, s // bq) * max(1, s // bk)
         # per-step overhead ~2us (grid bookkeeping + edge masking);
@@ -369,6 +394,7 @@ def flash_block_candidates(
             modeled_us=overhead,
             note=f"vmem {vmem // 1024} KiB, {steps} grid steps",
         ))
-    return sorted(
-        out, key=lambda c: (c.modeled_us, -c.knobs["block_q"])
+    return FlashCandidates(
+        sorted(out, key=lambda c: (c.modeled_us, -c.knobs["block_q"])),
+        excluded,
     )

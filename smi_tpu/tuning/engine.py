@@ -433,11 +433,16 @@ class PlanEngine:
                 "measured constants apply (model ranking shown is "
                 "advisory until swept)"
             ]
+        # no silent caps: VMEM-rejected targets are named with their
+        # failing footprint (tune --explain prints rationale lines), so
+        # a shorter candidate table never reads as the full search space
+        for dropped in getattr(cands, "excluded", ()):
+            rationale.append(f"excluded {dropped.name}: {dropped.note}")
         return Plan(
             key=key,
             knobs={"block_q": bq, "block_k": bk},
             decided_by={"block_q": layer, "block_k": layer},
-            candidates=cands,
+            candidates=list(cands),
             rationale=rationale,
         )
 

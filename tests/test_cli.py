@@ -622,6 +622,202 @@ def test_lint_model_benign_mutant_notes_it(capsys):
     assert "did not manifest" in captured.err
 
 
+# ---------------------------------------------------------------------------
+# lint --perf (the static performance analyzer tier) + lint --combined
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.perflint
+def test_lint_perf_all_runs_clean(tmp_path, capsys):
+    """``smi-tpu lint --perf --all``: the whole registered grid
+    decomposes with zero perf findings — the acceptance gate."""
+    out = tmp_path / "perf.json"
+    assert run_cli("lint", "--perf", "--all", "-o", str(out)) == 0
+    text = capsys.readouterr().out
+    assert "0 perf finding(s)" in text
+    assert "binding edge" in text
+    payload = json.loads(out.read_text())
+    assert payload["ok"] is True and payload["tier"] == "perf"
+    assert payload["roofline"] == []
+
+
+@pytest.mark.perflint
+def test_lint_perf_json_schema(capsys):
+    from smi_tpu import analysis
+
+    assert run_cli("lint", "--perf", "--protocol", "all_reduce",
+                   "--json") == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert set(payload) == {"ok", "tier", "findings", "checks",
+                            "idle_fraction_threshold", "protocols",
+                            "roofline"}
+    assert payload["checks"] == list(analysis.PERF_CHECKS)
+    for proto in payload["protocols"]:
+        assert proto["ok"] is True
+        assert proto["makespan_us"] > 0
+        assert set(proto["binding"]["waiter"]) == {"rank", "step",
+                                                   "primitive"}
+
+
+@pytest.mark.perflint
+def test_lint_perf_mutants_exit_nonzero_by_their_rule(capsys):
+    assert run_cli("lint", "--perf", "--mutant", "halved_wire_credits",
+                   "--protocol", "all_gather", "--json") == 1
+    payload = json.loads(capsys.readouterr().out)
+    checks = {f["check"] for p in payload["protocols"]
+              for f in p["findings"]}
+    assert checks == {"idle-fraction"}
+    assert run_cli("lint", "--perf", "--mutant", "unoverlapped_chunks",
+                   "--json") == 1
+    payload = json.loads(capsys.readouterr().out)
+    checks = {f["check"] for p in payload["protocols"]
+              for f in p["findings"]}
+    assert checks == {"serialized-critical-path"}
+    assert run_cli("lint", "--perf", "--mutant",
+                   "oversized_flash_tile", "--json") == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert [f["check"] for f in payload["roofline"]] == [
+        "no-double-buffer"
+    ]
+
+
+@pytest.mark.perflint
+def test_lint_perf_benign_mutant_notes_it(capsys):
+    """halved credits inside the stream's 2-chunk window: benign —
+    exit 0 with an explicit note, never a silent ok."""
+    rc = run_cli("lint", "--perf", "--mutant", "halved_wire_credits",
+                 "--protocol", "neighbour_stream")
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "did not manifest" in captured.err
+
+
+@pytest.mark.perflint
+def test_lint_perf_hlo_serialized_dma(tmp_path, capsys):
+    hlo = tmp_path / "chained.hlo"
+    hlo.write_text(
+        "ENTRY %main (p0: f32[256,128]) -> f32[256,128] {\n"
+        "  %p0 = f32[256,128]{1,0} parameter(0)\n"
+        "  %mul = f32[256,128]{1,0} multiply(f32[256,128]{1,0} %p0,"
+        " f32[256,128]{1,0} %p0)\n"
+        "  %cp1-start = (f32[256,128]{1,0}, f32[256,128]{1,0}, u32[],"
+        " u32[]) collective-permute-start(f32[256,128]{1,0} %mul),"
+        " source_target_pairs={{0,1},{1,0}}\n"
+        "  %cp1-done = f32[256,128]{1,0} collective-permute-done("
+        "(f32[256,128]{1,0}, f32[256,128]{1,0}, u32[], u32[])"
+        " %cp1-start)\n"
+        "  %cp2-start = (f32[256,128]{1,0}, f32[256,128]{1,0}, u32[],"
+        " u32[]) collective-permute-start(f32[256,128]{1,0}"
+        " %cp1-done), source_target_pairs={{0,1},{1,0}}\n"
+        "  %cp2-done = f32[256,128]{1,0} collective-permute-done("
+        "(f32[256,128]{1,0}, f32[256,128]{1,0}, u32[], u32[])"
+        " %cp2-start)\n"
+        "  ROOT %add = f32[256,128]{1,0} add(f32[256,128]{1,0}"
+        " %cp2-done, f32[256,128]{1,0} %mul)\n"
+        "}\n"
+    )
+    assert run_cli("lint", "--perf", "--protocol", "all_reduce",
+                   "--hlo", str(hlo), "--json") == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert "serialized-dma" in {f["check"] for f in payload["roofline"]}
+
+
+@pytest.mark.perflint
+def test_lint_combined_runs_all_three_tiers(tmp_path, capsys):
+    out = tmp_path / "combined.json"
+    assert run_cli("lint", "--combined", "-o", str(out)) == 0
+    text = capsys.readouterr().out
+    for tier in ("protocol", "model", "perf"):
+        assert f"=== {tier} tier ===" in text
+    payload = json.loads(out.read_text())
+    assert payload["ok"] is True and payload["tier"] == "combined"
+    assert set(payload["tiers"]) == {"protocol", "model", "perf"}
+    assert payload["tiers"]["model"]["coverage"]["truncated"] is False
+    assert payload["findings"] == 0
+
+
+@pytest.mark.perflint
+def test_lint_combined_accepts_an_hlo_artifact(tmp_path, capsys):
+    """--hlo ADDS the serialized-dma check to the combined gate (it is
+    an input artifact, not a grid-narrowing flag): a chained bare
+    artifact must fail the one-command gate too."""
+    hlo = tmp_path / "chained.hlo"
+    hlo.write_text(
+        "ENTRY %main (p0: f32[256,128]) -> f32[256,128] {\n"
+        "  %p0 = f32[256,128]{1,0} parameter(0)\n"
+        "  %mul = f32[256,128]{1,0} multiply(f32[256,128]{1,0} %p0,"
+        " f32[256,128]{1,0} %p0)\n"
+        "  %cp1-start = (f32[256,128]{1,0}, f32[256,128]{1,0}, u32[],"
+        " u32[]) collective-permute-start(f32[256,128]{1,0} %mul),"
+        " source_target_pairs={{0,1},{1,0}}\n"
+        "  %cp1-done = f32[256,128]{1,0} collective-permute-done("
+        "(f32[256,128]{1,0}, f32[256,128]{1,0}, u32[], u32[])"
+        " %cp1-start)\n"
+        "  %cp2-start = (f32[256,128]{1,0}, f32[256,128]{1,0}, u32[],"
+        " u32[]) collective-permute-start(f32[256,128]{1,0}"
+        " %cp1-done), source_target_pairs={{0,1},{1,0}}\n"
+        "  %cp2-done = f32[256,128]{1,0} collective-permute-done("
+        "(f32[256,128]{1,0}, f32[256,128]{1,0}, u32[], u32[])"
+        " %cp2-start)\n"
+        "  ROOT %add = f32[256,128]{1,0} add(f32[256,128]{1,0}"
+        " %cp2-done, f32[256,128]{1,0} %mul)\n"
+        "}\n"
+    )
+    assert run_cli("lint", "--combined", "--hlo", str(hlo),
+                   "--json") == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is False
+    checks = {f["check"] for f in payload["tiers"]["perf"]["roofline"]}
+    assert checks == {"serialized-dma"}
+
+
+@pytest.mark.perflint
+def test_lint_perf_usage_errors(capsys):
+    # --perf and --model are distinct tiers
+    assert run_cli("lint", "--perf", "--model") == 2
+    assert "--combined" in capsys.readouterr().err
+    # --scope belongs to the model tier
+    assert run_cli("lint", "--perf", "--scope", "tenants=2") == 2
+    assert "--model" in capsys.readouterr().err
+    # --hlo belongs to the perf tier
+    assert run_cli("lint", "--hlo", "x.hlo") == 2
+    assert "--perf" in capsys.readouterr().err
+    # a model mutant on the perf tier names all three registries
+    assert run_cli("lint", "--perf", "--mutant",
+                   "leaked_stream_credit") == 2
+    err = capsys.readouterr().err
+    assert "halved_wire_credits" in err and "dropped_wait" in err
+    # a perf mutant on the protocol tier names the registries too
+    assert run_cli("lint", "--protocol", "all_reduce", "--mutant",
+                   "halved_wire_credits") == 2
+    assert "--perf" in capsys.readouterr().err
+    # the roofline mutant takes no protocol
+    assert run_cli("lint", "--perf", "--mutant",
+                   "oversized_flash_tile", "--protocol",
+                   "all_gather") == 2
+    assert "roofline" in capsys.readouterr().err
+    # --combined runs every tier whole: narrowing flags are refused
+    assert run_cli("lint", "--combined", "--perf") == 2
+    assert "subset" in capsys.readouterr().err
+    assert run_cli("lint", "--combined", "--scope", "tenants=2") == 2
+    assert "subset" in capsys.readouterr().err
+    # unknown protocols stay loud under --perf
+    assert run_cli("lint", "--perf", "--protocol", "bogus") == 2
+    assert "unknown protocol" in capsys.readouterr().err
+
+
+@pytest.mark.perflint
+def test_route_check_lint_includes_the_perf_gate(tmp_path, capsys):
+    topo = tmp_path / "ring.json"
+    assert run_cli("topology", "-n", "4", "-p", "app", "-f",
+                   str(topo), "--ring") == 0
+    assert run_cli("route", str(topo), "--check", "--lint") == 0
+    out = capsys.readouterr().out
+    assert "lint: ok" in out
+    assert "perf: ok" in out
+    assert "makespans decomposed" in out
+
+
 @pytest.mark.model
 def test_lint_model_usage_errors(capsys):
     # --scope needs --model

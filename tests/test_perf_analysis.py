@@ -1,0 +1,459 @@
+"""Static performance analyzer: decomposition + roofline + mutants.
+
+Three layers of evidence that :mod:`smi_tpu.analysis.perf` tells the
+truth:
+
+1. **Clean matrix** — every registered protocol at every default shape
+   decomposes with zero perf findings, zero genuine idle, and a
+   makespan *bit-identical* to ``RingSimulator.elapsed_seconds()``.
+2. **Differential mutant harness** — each perf mutant is proven SAFE
+   by the PR 7 verifier, proven SLOWER by the timestamped simulator
+   (worse makespan, bit-identical delivery), and convicted by exactly
+   its named rule with (rank, step, primitive)-level findings.
+3. **Roofline rules** — each sub-tier (b) rule fires on its mis-tiled
+   / mis-chained / drifted input and stays silent on the shipped
+   configuration.
+
+Pure Python (no JAX, no devices) — tier-1.
+"""
+
+import pytest
+
+from smi_tpu import analysis as A
+from smi_tpu.analysis import perf as P
+from smi_tpu.analysis import perf_mutants as PM
+from smi_tpu.analysis.verifier import DEFAULT_SHAPES, build_generators
+from smi_tpu.parallel import credits as C
+from smi_tpu.tuning import cost_model as cm
+
+pytestmark = pytest.mark.perflint
+
+
+GRID = [
+    (protocol, shape)
+    for protocol, shapes in sorted(DEFAULT_SHAPES.items())
+    for shape in shapes
+]
+
+
+def _ids(cases):
+    return [
+        p + "-" + "-".join(f"{k}{v}" for k, v in sorted(s.items()))
+        for p, s in cases
+    ]
+
+
+def _clean_sim(protocol, shape, costs=None):
+    return C.RingSimulator(
+        build_generators(protocol, shape["n"],
+                         chunks=shape.get("chunks", 3),
+                         slices=shape.get("slices", 2)),
+        C.Strategy(0), costs=costs,
+    )
+
+
+# ---------------------------------------------------------------------------
+# 1. Clean matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("protocol,shape", GRID, ids=_ids(GRID))
+def test_clean_grid_decomposes_with_zero_findings(protocol, shape):
+    rep = P.decompose_protocol(protocol, **shape)
+    assert rep.ok, rep.describe()
+    assert rep.makespan_s > 0.0
+    # genuine idle is EXACTLY zero on every healthy protocol: each
+    # wait lands inside its producer's latency/bandwidth window
+    for row in rep.per_rank:
+        assert row["idle_fraction"] == 0.0, (protocol, shape, row)
+    # the binding wait edge names (rank, step, primitive) coordinates
+    assert rep.binding is not None
+    assert set(rep.binding["waiter"]) == {"rank", "step", "primitive"}
+
+
+@pytest.mark.parametrize("protocol,shape", GRID, ids=_ids(GRID))
+def test_makespan_matches_elapsed_seconds_exactly(protocol, shape):
+    """The acceptance bar: the static decomposition reproduces the
+    timestamped simulator bit-for-bit on the full registered grid."""
+    rep = P.decompose_protocol(protocol, **shape)
+    costs, _message, _k = P._costs_for(
+        protocol, dict(shape), float(P.PERF_PAYLOAD_BYTES)
+    )
+    sim = _clean_sim(protocol, shape, costs=costs)
+    sim.run()
+    assert rep.makespan_s == sim.elapsed_seconds()
+
+
+@pytest.mark.parametrize("protocol,shape", GRID, ids=_ids(GRID))
+def test_components_partition_each_rank_clock(protocol, shape):
+    """alpha + beta + serialization + idle == the rank's clock (the
+    decomposition is a partition, not a sampling)."""
+    rep = P.decompose_protocol(protocol, **shape)
+    for row in rep.per_rank:
+        total = sum(
+            v for tier in row["components_us"].values()
+            for v in tier.values()
+        )
+        assert total == pytest.approx(row["clock_us"], abs=1e-6)
+
+
+def test_pod_wallclock_vectors_are_the_analyzer_test_vectors():
+    """The PR 6 acceptance numbers (4894.3 us flat / 1197.3 us
+    two-tier at 2x2, 4 MiB) reproduce exactly through the analyzer."""
+    rep = C.pod_wallclock_comparison(2, 2, 4 << 20)
+    pod = P.decompose_protocol("allreduce_pod", n=4, slices=2)
+    assert pod.makespan_s == rep["hierarchical_s"]
+    assert round(pod.makespan_s * 1e6, 1) == 1197.3
+    # the flat ring priced the pod_wallclock way (full payload, pod
+    # tier split) through decompose_generators
+    flat_costs = C.default_tier_costs(float(4 << 20), 2)
+    flat = P.decompose_generators(
+        lambda: [
+            C.all_reduce_rank(
+                g, 4, frozenset((g, c) for c in range(2)),
+                lambda a, b: a | b,
+            )
+            for g in range(4)
+        ],
+        flat_costs, protocol="all_reduce_flat_pod",
+        shape={"n": 4},
+    )
+    assert flat.makespan_s == rep["flat_s"]
+    assert round(flat.makespan_s * 1e6, 1) == 4894.3
+
+
+def test_chunked_pipeline_depth_equals_declared_chunks():
+    """The healthy chunked ring's measured wire depth IS its chunk
+    count — the quantity the serialized-critical-path rule defends."""
+    for shape in DEFAULT_SHAPES["all_reduce_chunked"]:
+        rep = P.decompose_protocol("all_reduce_chunked", **shape)
+        assert rep.pipeline_chunks == shape["chunks"]
+        assert max(w["depth"] for w in rep.wires) == shape["chunks"]
+
+
+def test_pod_decomposition_splits_tiers():
+    """The two-tier pod's critical path carries BOTH tiers, and the
+    DCN share dominates (the cross-slice phase is the bottleneck the
+    decomposition exists to name)."""
+    rep = P.decompose_protocol("allreduce_pod", n=4, slices=2)
+    assert set(rep.components) >= {"ici", "dcn"}
+    dcn = sum(rep.components["dcn"].values())
+    ici = sum(rep.components["ici"].values())
+    assert dcn > ici
+
+
+def test_unsafe_protocol_is_refused_not_priced():
+    """A deadlocking mutant has no makespan: decomposition refuses
+    with the safety tier's finding instead of pricing garbage."""
+    with pytest.raises(A.AnalysisError, match="unsafe"):
+        P.decompose_generators(
+            lambda: A.mutant_generators("all_reduce", 3,
+                                        mutant="dropped_wait"),
+            C.default_tier_costs(1 << 20),
+            protocol="all_reduce[dropped_wait]", shape={"n": 3},
+        )
+
+
+# ---------------------------------------------------------------------------
+# 2. Differential mutant harness
+# ---------------------------------------------------------------------------
+
+
+def _decompose_mutant(protocol, shape, mutant):
+    costs, _message, pipeline = P._costs_for(
+        protocol, dict(shape), float(P.PERF_PAYLOAD_BYTES)
+    )
+    return P.decompose_generators(
+        lambda: PM.perf_mutant_generators(
+            protocol, mutant, shape["n"],
+            chunks=shape.get("chunks", 3),
+            slices=shape.get("slices", 2),
+        ),
+        costs, protocol=f"{protocol}[{mutant}]", shape=dict(shape),
+        pipeline_chunks=pipeline,
+    )
+
+
+HALVED_CASES = [
+    (p, s) for p, s in GRID if p != "neighbour_stream"
+]
+
+
+@pytest.mark.parametrize("protocol,shape", HALVED_CASES,
+                         ids=_ids(HALVED_CASES))
+def test_halved_wire_credits_convicted_by_idle_fraction(protocol, shape):
+    """Conviction by exactly its rule, differentially against the
+    timestamped simulator: the mutant is safe (the verifier ran inside
+    decompose), measurably slower, delivery-identical — and every
+    finding is idle-fraction with named (rank, step, primitive)
+    events."""
+    rep = _decompose_mutant(protocol, shape, "halved_wire_credits")
+    assert not rep.ok
+    assert {f.check for f in rep.findings} == {"idle-fraction"}
+    finding = rep.findings[0]
+    assert finding.fraction > A.IDLE_FRACTION_THRESHOLD
+    assert finding.lane is not None and finding.tier in ("ici", "dcn")
+    assert len(finding.events) == 2  # the blocked wait + its producer
+    for event in finding.events:
+        assert isinstance(event.rank, int) and isinstance(event.step, int)
+    clean = P.decompose_protocol(protocol, **shape)
+    assert rep.makespan_s > clean.makespan_s
+    # delivery identical: slower, never wrong
+    mutated = C.RingSimulator(
+        PM.perf_mutant_generators(
+            protocol, "halved_wire_credits", shape["n"],
+            chunks=shape.get("chunks", 3),
+            slices=shape.get("slices", 2),
+        ),
+        C.Strategy(0),
+    ).run()
+    assert mutated == PM.healthy_outputs(
+        protocol, shape["n"], chunks=shape.get("chunks", 3),
+        slices=shape.get("slices", 2),
+    )
+
+
+def test_halved_wire_credits_benign_on_neighbour_stream():
+    """The stream's 2-chunk window absorbs a one-round-late grant —
+    benign there, which the CLI reports as an explicit note rather
+    than a silent ok (mirrors the protocol tier's benign mutants)."""
+    for shape in DEFAULT_SHAPES["neighbour_stream"]:
+        rep = _decompose_mutant("neighbour_stream", shape,
+                                "halved_wire_credits")
+        assert rep.ok
+
+
+@pytest.mark.parametrize("shape", DEFAULT_SHAPES["all_reduce_chunked"],
+                         ids=["n2-k2", "n3-k3"])
+def test_unoverlapped_chunks_convicted_by_serialized_critical_path(shape):
+    rep = _decompose_mutant("all_reduce_chunked", shape,
+                            "unoverlapped_chunks")
+    assert not rep.ok
+    assert {f.check for f in rep.findings} == {"serialized-critical-path"}
+    finding = rep.findings[0]
+    assert finding.expected == shape["chunks"]  # declared pipeline
+    assert finding.got == 1                     # measured depth
+    assert len(finding.events) == 2             # collapse edge named
+    clean = P.decompose_protocol("all_reduce_chunked", **shape)
+    assert rep.makespan_s > clean.makespan_s
+    # the mutant is SAFE — only slow: the verifier passes it clean
+    safety = A.verify_generators(
+        lambda: PM.perf_mutant_generators(
+            "all_reduce_chunked", "unoverlapped_chunks", shape["n"],
+            chunks=shape["chunks"],
+        ),
+        protocol="serial", shape=shape,
+    )
+    assert safety.ok
+    # and delivery-identical
+    mutated = C.RingSimulator(
+        PM.perf_mutant_generators(
+            "all_reduce_chunked", "unoverlapped_chunks", shape["n"],
+            chunks=shape["chunks"],
+        ),
+        C.Strategy(0),
+    ).run()
+    assert mutated == PM.healthy_outputs(
+        "all_reduce_chunked", shape["n"], chunks=shape["chunks"],
+    )
+
+
+def test_oversized_flash_tile_convicted_by_no_double_buffer():
+    findings = P.no_double_buffer_findings([PM.OVERSIZED_FLASH_TILE])
+    assert {f.check for f in findings} == {"no-double-buffer"}
+    single = P.flash_single_buffer_bytes(4096, 4096, 128, 2)
+    assert findings[0].got == single
+    assert single > A.VMEM_DOUBLE_BUFFER_BOUND
+    # the mutant footprint arithmetic mirrors the cost model's
+    # double-buffered bookkeeping: single-buffer + one more tile
+    # generation == flash_fwd_vmem_bytes
+    tiles = (4096 * 128 + 2 * 4096 * 128) * 2
+    assert single + tiles == cm.flash_fwd_vmem_bytes(4096, 4096, 128, 2)
+
+
+def test_every_perf_mutant_has_exactly_one_convicting_rule():
+    assert set(PM.PERF_MUTANT_RULE) == set(PM.PERF_MUTANTS)
+    assert set(PM.PERF_MUTANT_RULE.values()) <= set(P.PERF_CHECKS)
+
+
+def test_perf_mutant_registry_is_loud_on_misuse():
+    with pytest.raises(ValueError, match="all_reduce_chunked"):
+        PM.perf_mutant_generators("all_gather", "unoverlapped_chunks", 3)
+    with pytest.raises(ValueError, match="roofline"):
+        PM.perf_mutant_generators("all_gather", "oversized_flash_tile", 3)
+    with pytest.raises(ValueError, match="unknown perf mutant"):
+        PM.perf_mutant_generators("all_gather", "bogus", 3)
+
+
+# ---------------------------------------------------------------------------
+# 3. Roofline rules
+# ---------------------------------------------------------------------------
+
+
+def test_roofline_lint_clean_on_shipped_tree():
+    assert P.roofline_lint() == []
+
+
+def test_below_roofline_tile_fires_on_narrow_block_q():
+    """A bq=64 tile forces 128 k/v streaming passes — far off the
+    roofline; the shipped seeded tiles stay on it."""
+    findings = P.below_roofline_findings([
+        {"name": "narrow", "dtype": "bfloat16",
+         "block_q": 64, "block_k": 512},
+    ])
+    assert {f.check for f in findings} == {"below-roofline-tile"}
+    assert findings[0].fraction < A.BELOW_ROOFLINE_FRACTION
+    assert P.below_roofline_findings() == []
+
+
+def test_analytic_regression_fires_on_worse_and_missing_only():
+    expected = {"x_us": 100.0, "y_us": 100.0, "z_us": 100.0}
+    findings = P.analytic_regression_findings(
+        predictions={"x_us": 100.0, "y_us": 130.0},  # z missing
+        expected=expected,
+    )
+    assert len(findings) == 2
+    assert {f.check for f in findings} == {"analytic-regression"}
+    drifted = next(f for f in findings if f.got == 130.0)
+    assert drifted.expected == 100.0
+    missing = next(f for f in findings if f.got is None)
+    assert "no recomputed" in missing.message
+    # an improvement must NOT fire
+    assert P.analytic_regression_findings(
+        predictions={"x_us": 50.0}, expected={"x_us": 100.0}
+    ) == []
+    # inside the drift band: quiet
+    assert P.analytic_regression_findings(
+        predictions={"x_us": 120.0}, expected={"x_us": 100.0}
+    ) == []
+
+
+def test_analytic_expectations_match_recomputation():
+    """The committed expectation table IS today's prediction — zero
+    drift on the shipped tree (the clean half of the rule)."""
+    assert P.analytic_predictions() == P.ANALYTIC_EXPECTED_US
+
+
+_CHAINED_HLO = """HloModule chained
+
+ENTRY %main (p0: f32[1024,128]) -> f32[1024,128] {
+  %p0 = f32[1024,128]{1,0} parameter(0)
+  %mul = f32[1024,128]{1,0} multiply(f32[1024,128]{1,0} %p0, f32[1024,128]{1,0} %p0)
+  %cp1-start = (f32[1024,128]{1,0}, f32[1024,128]{1,0}, u32[], u32[]) collective-permute-start(f32[1024,128]{1,0} %mul), source_target_pairs={{0,1},{1,0}}
+  %cp1-done = f32[1024,128]{1,0} collective-permute-done((f32[1024,128]{1,0}, f32[1024,128]{1,0}, u32[], u32[]) %cp1-start)
+  %cp2-start = (f32[1024,128]{1,0}, f32[1024,128]{1,0}, u32[], u32[]) collective-permute-start(f32[1024,128]{1,0} %cp1-done), source_target_pairs={{0,1},{1,0}}
+  %cp2-done = f32[1024,128]{1,0} collective-permute-done((f32[1024,128]{1,0}, f32[1024,128]{1,0}, u32[], u32[]) %cp2-start)
+  ROOT %add = f32[1024,128]{1,0} add(f32[1024,128]{1,0} %cp2-done, f32[1024,128]{1,0} %mul)
+}
+"""
+
+
+def test_serialized_dma_fires_on_bare_dependent_chain():
+    findings = P.serialized_dma_findings(_CHAINED_HLO)
+    assert len(findings) == 1
+    assert findings[0].check == "serialized-dma"
+    assert "cp2-start" in findings[0].message
+    assert "cp1-done" in findings[0].message
+
+
+def test_serialized_dma_quiet_when_compute_hides_the_chain():
+    hidden = _CHAINED_HLO.replace(
+        "  %cp2-done =",
+        "  %mul2 = f32[1024,128]{1,0} multiply(f32[1024,128]{1,0} "
+        "%mul, f32[1024,128]{1,0} %mul)\n  %cp2-done =",
+    )
+    assert P.serialized_dma_findings(hidden) == []
+
+
+def test_overlap_report_carries_the_chain_column():
+    """The traffic.py satellite: every per-collective record now says
+    which upstream collective it depends on (None = chain head)."""
+    from smi_tpu.parallel import traffic as T
+
+    recs = {r["name"]: r for r in
+            T.overlap_report(hlo_text=_CHAINED_HLO)["per_collective"]}
+    assert recs["cp1-start"]["depends_on_collective"] is None
+    assert recs["cp2-start"]["depends_on_collective"] == "cp1-done"
+
+
+# ---------------------------------------------------------------------------
+# FlashCandidates: no silent caps in the tile search space
+# ---------------------------------------------------------------------------
+
+
+def test_flash_candidates_return_excluded_with_footprint():
+    cands = cm.flash_block_candidates(
+        8192, 128, "float32", False,
+        targets=((1024, 1024), (4096, 4096)),
+    )
+    assert [c.name for c in cands] == ["bq1024/bk1024"]
+    assert [c.name for c in cands.excluded] == ["bq4096/bk4096"]
+    note = cands.excluded[0].note
+    assert "EXCLUDED" in note and "KiB" in note
+    vmem = cm.flash_fwd_vmem_bytes(4096, 4096, 128, 4)
+    assert f"{vmem // 1024} KiB" in note
+
+
+def test_flash_candidates_default_targets_all_feasible():
+    """At the canonical d=128 every default target fits — the excluded
+    list is additive, not a behavior change."""
+    for dtype in ("bfloat16", "float32"):
+        cands = cm.flash_block_candidates(8192, 128, dtype, False)
+        assert isinstance(cands, list) and len(cands) == 4
+        assert cands.excluded == []
+
+
+def test_explain_prints_excluded_candidates():
+    from smi_tpu.tuning.engine import PlanEngine
+
+    eng = PlanEngine(device_kind="unknown")
+    text = eng.flash_plan(dtype="float32", d=1024).explain()
+    assert "excluded bq1024/bk1024" in text
+    assert "scoped-VMEM frame" in text
+
+
+# ---------------------------------------------------------------------------
+# Report plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_perf_json_schema():
+    reports = [P.decompose_protocol("all_reduce", n=3)]
+    roofline = P.no_double_buffer_findings([PM.OVERSIZED_FLASH_TILE])
+    payload = P.perf_reports_to_json(reports, roofline)
+    assert set(payload) == {"ok", "tier", "findings", "checks",
+                            "idle_fraction_threshold", "protocols",
+                            "roofline"}
+    assert payload["tier"] == "perf"
+    assert payload["ok"] is False and payload["findings"] == 1
+    assert payload["checks"] == list(P.PERF_CHECKS)
+    (proto,) = payload["protocols"]
+    assert {"protocol", "shape", "makespan_us", "components_us",
+            "per_rank", "wires", "binding", "ok",
+            "findings"} <= set(proto)
+    (rf,) = payload["roofline"]
+    assert rf["check"] == "no-double-buffer"
+
+
+def test_render_reports_name_the_binding_edge():
+    text = P.render_perf_reports([P.decompose_protocol("all_reduce", n=3)])
+    assert "binding edge" in text
+    assert "makespan" in text
+    assert "0 perf finding(s)" in text
+
+
+@pytest.mark.slow
+def test_wide_shape_sweep_stays_clean():
+    """Wider rings and pods than the default grid: idle stays exactly
+    zero and the exactness invariant holds."""
+    for protocol, shape in [
+        ("all_gather", {"n": 8}),
+        ("all_reduce", {"n": 8}),
+        ("reduce_scatter", {"n": 8}),
+        ("all_reduce_chunked", {"n": 4, "chunks": 4}),
+        ("allreduce_pod", {"n": 8, "slices": 2}),
+        ("allreduce_pod", {"n": 9, "slices": 3}),
+    ]:
+        rep = P.decompose_protocol(protocol, **shape)
+        assert rep.ok, rep.describe()
+        assert all(r["idle_fraction"] == 0.0 for r in rep.per_rank)

@@ -312,6 +312,181 @@ def test_analysis_doc_quotes_the_model_tier():
     assert "replay_model_trace" in text
 
 
+def test_perf_rule_constants_pin_their_cost_model_mirrors():
+    """The perf tier's thresholds are MIRRORS of cost-model/traffic
+    quantities, not free parameters: the VMEM double-buffer bound is
+    half the scoped-VMEM frame, the analytic drift bound is the
+    documented 25%, and the flash footprint helper decomposes the cost
+    model's double-buffered bookkeeping exactly. (Pure Python imports,
+    no devices.)"""
+    from smi_tpu import analysis
+    from smi_tpu.analysis import perf
+    from smi_tpu.parallel import traffic
+    from smi_tpu.tuning import cost_model as cm
+
+    assert analysis.VMEM_DOUBLE_BUFFER_BOUND == cm.VMEM_LIMIT_BYTES // 2
+    assert analysis.ANALYTIC_DRIFT_FRACTION == 0.25
+    assert 0.0 < analysis.IDLE_FRACTION_THRESHOLD < 1.0
+    assert 0.0 < analysis.BELOW_ROOFLINE_FRACTION < 1.0
+    # single-buffer + one more tile generation == the cost model's
+    # double-buffered footprint, for every default target
+    for bq, bk in ((512, 512), (512, 1024), (1024, 512), (1024, 1024),
+                   (4096, 4096)):
+        for itemsize in (2, 4):
+            tiles = (bq * 128 + 2 * bk * 128) * itemsize
+            assert (perf.flash_single_buffer_bytes(bq, bk, 128, itemsize)
+                    + tiles
+                    == cm.flash_fwd_vmem_bytes(bq, bk, 128, itemsize))
+    # the tier rates the decomposition prices at ARE the published
+    # cost-model rates (same constants traffic.py mirrors)
+    assert cm.V5E_ICI_BETA_BYTES_PER_S == traffic.V5E_ICI_LINK_BYTES_PER_S
+    from smi_tpu.parallel import credits as C
+
+    costs = C.default_tier_costs(1.0)
+    assert costs.ici.alpha_s == cm.DEFAULT_ALPHA_S
+    assert costs.ici.beta_bytes_per_s == cm.V5E_ICI_BETA_BYTES_PER_S
+
+
+def test_analyzer_reproduces_elapsed_seconds_on_the_full_grid():
+    """The acceptance bar restated next to the pins: for EVERY
+    registered protocol at every default shape, the static makespan
+    decomposition equals ``RingSimulator.elapsed_seconds()`` exactly
+    (``==``, not approx), and the committed two-tier acceptance
+    vectors reproduce to the tenth of a microsecond. (Pure Python —
+    the simulator and analyzer import no JAX.)"""
+    from smi_tpu import analysis
+    from smi_tpu.analysis.perf import PERF_PAYLOAD_BYTES, _costs_for
+    from smi_tpu.analysis.verifier import build_generators
+    from smi_tpu.parallel import credits as C
+
+    for protocol, shapes in sorted(analysis.DEFAULT_SHAPES.items()):
+        for shape in shapes:
+            rep = analysis.decompose_protocol(protocol, **shape)
+            costs, _m, _k = _costs_for(protocol, dict(shape),
+                                       float(PERF_PAYLOAD_BYTES))
+            sim = C.RingSimulator(
+                build_generators(protocol, shape["n"],
+                                 chunks=shape.get("chunks", 3),
+                                 slices=shape.get("slices", 2)),
+                C.Strategy(0), costs=costs,
+            )
+            sim.run()
+            assert rep.makespan_s == sim.elapsed_seconds(), (
+                protocol, shape,
+            )
+    pod = analysis.decompose_protocol("allreduce_pod", n=4, slices=2)
+    assert round(pod.makespan_s * 1e6, 1) == 1197.3
+    assert analysis.ANALYTIC_EXPECTED_US[
+        "pod_allreduce_flat_2x2_4mib_us"] == 4894.3
+    assert analysis.ANALYTIC_EXPECTED_US[
+        "pod_allreduce_two_tier_2x2_4mib_us"] == 1197.3
+
+
+def test_analysis_doc_quotes_the_perf_tier():
+    """docs/analysis.md's "Static performance tier" section must name
+    every decomposition component, every perf rule, every perf mutant
+    with its convicting rule, the thresholds, and the honesty clauses
+    (fault-free only; ATLAS: measurement outranks the analytics) —
+    the same drift discipline as the safety-tier tables."""
+    from smi_tpu import analysis
+
+    text = _read("docs/analysis.md")
+    assert "Static performance tier" in text
+    for check in analysis.PERF_CHECKS:
+        assert f"`{check}`" in text, f"perf rule {check} undocumented"
+    for component in ("alpha", "beta", "serialization", "idle"):
+        assert f"`{component}`" in text, (
+            f"component {component} undocumented"
+        )
+    for mutant in analysis.PERF_MUTANTS:
+        assert f"`{mutant}`" in text, f"perf mutant {mutant} undocumented"
+        row = next(line for line in text.splitlines()
+                   if line.startswith(f"| `{mutant}`"))
+        from smi_tpu.analysis.perf_mutants import PERF_MUTANT_RULE
+
+        assert f"`{PERF_MUTANT_RULE[mutant]}`" in row, (
+            f"{mutant}'s documented conviction drifted from "
+            f"PERF_MUTANT_RULE"
+        )
+    # thresholds quoted at their shipped values
+    assert f"({analysis.IDLE_FRACTION_THRESHOLD:g})" in text
+    assert f"({analysis.BELOW_ROOFLINE_FRACTION:g})" in text
+    assert f"({analysis.ANALYTIC_DRIFT_FRACTION:.0%})" in text
+    assert (f"{analysis.VMEM_DOUBLE_BUFFER_BOUND // 1024} KiB"
+            in text)
+    # the acceptance vectors are quoted
+    assert "4894.3" in text and "1197.3" in text
+    # honesty clauses: fault-free scope + ATLAS precedence
+    assert "Fault-free\nschedules only" in text.replace("\r", "") or (
+        "Fault-free schedules only" in " ".join(text.split())
+    )
+    assert ("measurement outranks any analytic prediction"
+            in " ".join(text.split()))
+    assert "lint --perf" in text
+    assert "--combined" in text
+    assert "depends_on_collective" in text
+    assert "excluded" in text  # the no-silent-caps tile satellite
+    # README carries the new gate commands
+    readme = _read("README.md")
+    assert "lint --perf --all" in readme
+    assert "lint --combined" in readme
+
+
+def test_bench_scoreboard_baselines_pin_the_committed_artifacts():
+    """The bench.py scoreboard's baselines are the committed
+    artifacts, not free constants: the stencil baseline is
+    BENCH_r05.json's parsed headline, the flash row quotes a real
+    PERF.json metric, the allreduce curve is the analyzer's committed
+    expectation set, and the committed-only scoreboard passes every
+    verdict (a clean tree regresses nothing)."""
+    import bench
+
+    r05 = json.load(open(os.path.join(ROOT, "BENCH_r05.json")))
+    assert bench.BENCH_R05_STENCIL_CELLS == r05["parsed"]["value"]
+    metrics = _load()
+    assert bench.SCOREBOARD_FLASH_METRIC in metrics
+    # the flash baseline is a PINNED constant equal to the committed
+    # measurement — a self-comparison could never regress; a PERF.json
+    # re-measure that lands lower must flip the verdict (and fail
+    # here until the baseline is consciously re-pinned)
+    assert bench.SCOREBOARD_FLASH_TFLOPS_BASELINE == round(
+        metrics[bench.SCOREBOARD_FLASH_METRIC]["value"], 2
+    )
+    board = bench.scoreboard_fields()
+    assert set(board) == {"stencil_gcells_per_chip",
+                          "flash_train_tflops",
+                          "allreduce_payload_curve_us"}
+    for name, entry in board.items():
+        assert entry["verdict"] == "pass", (name, entry)
+        assert entry["measured"] is False
+    from smi_tpu.analysis.perf import ANALYTIC_EXPECTED_US
+
+    curve = board["allreduce_payload_curve_us"]
+    assert curve["baseline"] == [
+        ANALYTIC_EXPECTED_US[f"allreduce_n8_{kb}kib_us"]
+        for kb in curve["payload_kib"]
+    ]
+    # live mode: a measured stencil run flips the verdict honestly
+    live = bench.scoreboard_fields(r05["parsed"]["value"])
+    assert live["stencil_gcells_per_chip"]["measured"] is True
+    assert live["stencil_gcells_per_chip"]["verdict"] == "pass"
+    worse = bench.scoreboard_fields(
+        r05["parsed"]["value"] * (1 - 2 * bench.SCOREBOARD_TOLERANCE)
+    )
+    assert worse["stencil_gcells_per_chip"]["verdict"] == "regress"
+    # the legacy line contract is untouched, and a verdict-less
+    # scoreboard is refused (the schema guard)
+    payload = {"metric": "m", "value": 1, "unit": "u",
+               "vs_baseline": 1, "scoreboard": board}
+    line = bench.render_line(payload)
+    assert "\n" not in line and json.loads(line)["scoreboard"]
+    broken = {k: dict(v) for k, v in board.items()}
+    del broken["flash_train_tflops"]["verdict"]
+    payload["scoreboard"] = broken
+    with pytest.raises(ValueError, match="verdict"):
+        bench.render_line(payload)
+
+
 def test_tuning_doc_quotes_the_seeded_knobs():
     """docs/tuning.md's decision table must state the seeded values the
     code ships (block tiles, depth, threshold) — the table is the
