@@ -164,6 +164,28 @@ def scoreboard_fields(stencil_per_chip=None) -> dict:
         "measured": False,
         "verdict": verdict(worst),
     }
+    # the all-to-all payload curve (best flat candidate: pairwise vs
+    # Bruck) — same one-pricing discipline: P.alltoall_curve_us is the
+    # SINGLE pricing shared with the analytic-regression lint rule, so
+    # a cost-model change that reprices the curve regresses the
+    # scoreboard even with no TPU in the loop
+    a2a_sizes = P.ALLTOALL_CURVE_SIZES_KB
+    a2a_predicted = P.alltoall_curve_us(a2a_sizes)
+    a2a_expected = [
+        P.ANALYTIC_EXPECTED_US[f"alltoall_n8_{kb}kib_us"]
+        for kb in a2a_sizes
+    ]
+    a2a_worst = min(
+        e / p for e, p in zip(a2a_expected, a2a_predicted)
+    )
+    board["alltoall_payload_curve_us"] = {
+        "payload_kib": list(a2a_sizes),
+        "value": a2a_predicted,
+        "baseline": a2a_expected,
+        "ratio": round(a2a_worst, 4),
+        "measured": False,
+        "verdict": verdict(a2a_worst),
+    }
     return board
 
 

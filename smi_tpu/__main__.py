@@ -411,6 +411,22 @@ def _check_lint(n_slices, healthy) -> int:
         (p, {"n": vn})
         for p in faults.PROTOCOLS + faults.CHUNKED_PROTOCOLS
     ]
+    for p in faults.ALLTOALL_PROTOCOLS:
+        if p.endswith("_pod"):
+            continue  # joins the pod jobs below when --slices declares
+        if p == "all_to_all_bruck":
+            # Bruck is power-of-two-only by construction: verify the
+            # largest power-of-two instance inside the budget and NAME
+            # the shape in the output — a non-power-of-two topology is
+            # a documented structural refusal for this variant, never
+            # a silently skipped gate ("no silent caps")
+            bn = 1 << (vn.bit_length() - 1)
+            if bn < 2:
+                print(f"lint: FAIL — {p} needs >= 2 ranks to shape")
+                return 1
+            jobs.append((p, {"n": bn}))
+        else:
+            jobs.append((p, {"n": vn}))
     if n_slices and n_slices > 1:
         if n % n_slices:
             print(
@@ -430,7 +446,10 @@ def _check_lint(n_slices, healthy) -> int:
                 pod_slices = max(2, analysis.MAX_LINT_N // per)
         jobs.extend(
             (p, {"n": pod_slices * per, "slices": pod_slices})
-            for p in faults.POD_PROTOCOLS
+            for p in faults.POD_PROTOCOLS + tuple(
+                q for q in faults.ALLTOALL_PROTOCOLS
+                if q.endswith("_pod")
+            )
         )
     rc = 0
     for protocol, shape in jobs:
@@ -887,15 +906,21 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     from smi_tpu.parallel.faults import PROTOCOLS
     from smi_tpu.parallel.recovery import chaos_campaign
 
-    if args.elastic and args.load:
-        print("error: --elastic and --load are distinct campaigns; "
-              "pick one", file=sys.stderr)
+    picked = [f for f, v in (("--elastic", args.elastic),
+                             ("--load", args.load),
+                             ("--moe", getattr(args, "moe", False)))
+              if v]
+    if len(picked) > 1:
+        print(f"error: {' and '.join(picked)} are distinct campaigns; "
+              f"pick one", file=sys.stderr)
         return 2
     if args.load:
         return _cmd_chaos_load(args)
+    if getattr(args, "moe", False):
+        return _cmd_chaos_moe(args)
     if args.duration is not None or args.n_ranks is not None:
-        print("error: --duration/-n apply only to --load (the base "
-              "and --elastic campaigns sweep --ranks/--trials)",
+        print("error: --duration/-n apply only to --load/--moe (the "
+              "base and --elastic campaigns sweep --ranks/--trials)",
               file=sys.stderr)
         return 2
     if args.elastic:
@@ -1065,6 +1090,79 @@ def _cmd_chaos_load(args: argparse.Namespace) -> int:
         print("load campaign ok: every accepted stream delivered "
               "bit-identically, shedding lowest-class-first, queues "
               "bounded")
+    return 0 if report["ok"] else 1
+
+
+def _cmd_chaos_moe(args: argparse.Namespace) -> int:
+    """``chaos --moe``: the MoE expert-dispatch campaign
+    (:mod:`smi_tpu.serving.moe`).
+
+    Seeded token batches scatter to experts and gather back through
+    the serving front-end — one uniform-routing cell and one
+    hot-expert cell (a seeded expert at 8x routing weight) per trial.
+    Exit gate: zero silent corruption (every accepted batch
+    reassembles bit-identically under the inverse routing
+    permutation), zero lost-accepted, lowest-class-first shedding,
+    bounded queues, and the hot rank's saturation surfacing as NAMED
+    per-route backpressure — never as a membership transition.
+    """
+    from smi_tpu.serving.moe import moe_campaign
+
+    if args.protocols:
+        print("error: --protocols does not apply to --moe (the "
+              "campaign drives the MoE dispatch workload)",
+              file=sys.stderr)
+        return 2
+    if args.max_faults is not None:
+        print("error: --max-faults does not apply to --moe (cells "
+              "draw the hot-expert skew, not wire faults; sweep more "
+              "cells with --trials)", file=sys.stderr)
+        return 2
+    if args.ranks is not None:
+        print("error: --ranks does not apply to --moe (one rank "
+              "count per campaign; use -n/--n instead)",
+              file=sys.stderr)
+        return 2
+    try:
+        report = moe_campaign(
+            seed=args.seed,
+            n=args.n_ranks if args.n_ranks is not None else 4,
+            duration=(args.duration if args.duration is not None
+                      else 120),
+            trials=args.trials,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for cell in report["reports"]:
+        print(
+            f"{cell['cell']:>16}: {cell['verdict']}"
+            f" | batches {cell['batches_accepted']}/{cell['batches']}"
+            f" accepted"
+            + (f" | hot rank {cell['hot_rank']} "
+               f"({cell['hot_factor']}x)"
+               if cell["hot_expert"] is not None else "")
+        )
+    print(
+        f"{report['cells']} cells (seed {args.seed}), "
+        f"{report['silent_corruptions']} silent corruptions, "
+        f"{report['lost_accepted']} lost accepted, "
+        f"{report['stale_epoch_leaks']} stale-epoch leaks"
+    )
+    for failure in report["failures"]:
+        print(
+            f"FAILURE {failure['cell']} trial {failure['trial']}: "
+            f"{failure['verdict']}"
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"report -> {args.out}")
+    if report["ok"]:
+        print("moe campaign ok: every accepted batch reassembled "
+              "bit-identically; hot-expert skew surfaced as named "
+              "backpressure, never as a membership transition")
     return 0 if report["ok"] else 1
 
 
@@ -1620,6 +1718,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
     from smi_tpu.tuning.sweep import (
         sweep_allreduce,
         sweep_allreduce_hierarchical,
+        sweep_alltoall,
         sweep_flash,
     )
 
@@ -1630,10 +1729,12 @@ def cmd_tune(args: argparse.Namespace) -> int:
         return 2
     ops = args.ops or ["all_reduce"]
     unknown = [o for o in ops
-               if o not in ("all_reduce", "flash_fwd", "hierarchical")]
+               if o not in ("all_reduce", "flash_fwd", "hierarchical",
+                            "alltoall")]
     if unknown:
         print(f"error: unknown op(s) {unknown}; sweepable: "
-              f"all_reduce, flash_fwd, hierarchical", file=sys.stderr)
+              f"all_reduce, flash_fwd, hierarchical, alltoall",
+              file=sys.stderr)
         return 2
     if "hierarchical" in ops and not args.slices:
         print("error: the hierarchical sweep needs --slices N (the "
@@ -1659,6 +1760,33 @@ def cmd_tune(args: argparse.Namespace) -> int:
             # e.g. --slices 1: the comm builds but has no DCN tier
             print(f"error: {e}", file=sys.stderr)
             return 2
+    if "alltoall" in ops:
+        if args.slices:
+            try:
+                acomm = make_hybrid_communicator(n_slices=args.slices)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        else:
+            acomm = make_communicator()
+        if acomm.size < 2:
+            print(
+                "error: the alltoall sweep needs >= 2 devices; on a "
+                "1-chip host run the CPU fake mesh (XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8) or drop "
+                "alltoall from --ops",
+                file=sys.stderr,
+            )
+            return 2
+        where = (f"{args.slices} slices x "
+                 f"{acomm.size // args.slices} ranks"
+                 if args.slices else f"{acomm.size} devices")
+        print(f"sweeping all_to_all candidates over {where} "
+              f"({', '.join(f'{kb} KiB' for kb in args.sizes_kb)})")
+        measured.merge(sweep_alltoall(
+            acomm, sizes_kb=args.sizes_kb, runs=args.runs,
+            verbose=True,
+        ))
     if "all_reduce" in ops:
         comm = make_communicator()
         if comm.size < 2:
@@ -1935,12 +2063,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "lowest-class-first shedding (--trials "
                         "applies; --protocols/--ranks/--max-faults "
                         "do not)")
+    p.add_argument("--moe", action="store_true",
+                   help="run the MoE expert-dispatch campaign "
+                        "instead: seeded token batches scatter to "
+                        "experts and gather back through the serving "
+                        "front-end — a uniform-routing cell plus a "
+                        "hot-expert cell (one expert at 8x routing "
+                        "weight) per trial, gated on bit-identical "
+                        "batch reassembly, zero lost-accepted, "
+                        "lowest-class-first shedding, and the hot "
+                        "rank surfacing as named backpressure "
+                        "(--trials/-n/--duration apply; "
+                        "--protocols/--ranks/--max-faults do not)")
     p.add_argument("--duration", type=int, default=None, metavar="TICKS",
-                   help="ticks of open-loop traffic per --load cell "
-                        "(default 240; --load only)")
+                   help="ticks of open-loop traffic per --load/--moe "
+                        "cell (defaults 240/120; --load/--moe only)")
     p.add_argument("-n", "--n", type=int, default=None, dest="n_ranks",
-                   help="serving ranks for --load cells (default 4; "
-                        "--load only)")
+                   help="serving ranks for --load/--moe cells "
+                        "(default 4; --load/--moe only)")
     p.add_argument("-o", "--out", default=None,
                    help="write the JSON campaign report here")
     p.set_defaults(fn=cmd_chaos)
@@ -2066,19 +2206,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--explain", default=None, metavar="OP",
                    help="print the plan decision table for OP "
-                        "(all_reduce, flash_fwd, stencil_temporal, "
-                        "ring_all_reduce) instead of sweeping — "
-                        "CPU-deterministic, no hardware needed")
+                        "(all_reduce, all_to_all, flash_fwd, "
+                        "stencil_temporal, ring_all_reduce) instead "
+                        "of sweeping — CPU-deterministic, no hardware "
+                        "needed")
     p.add_argument("--ops", nargs="+", default=None, metavar="OP",
                    help="ops to sweep (default: all_reduce; flash_fwd "
                         "needs a TPU backend; hierarchical sweeps "
                         "flat-vs-two-tier over --slices N virtual "
-                        "slices and persists the measured crossover)")
+                        "slices and persists the measured crossover; "
+                        "alltoall times pairwise vs Bruck vs "
+                        "hierarchical per payload bucket)")
     p.add_argument("--slices", type=int, default=None, metavar="N",
                    help="pod slice count: with --explain, price the "
-                        "all_reduce table for an N-slice pod (all "
-                        "three candidates); with --ops hierarchical, "
-                        "the shape the sweep tiers over")
+                        "all_reduce/all_to_all tables for an N-slice "
+                        "pod (all three candidates); with --ops "
+                        "hierarchical/alltoall, the shape the sweep "
+                        "tiers over")
     p.add_argument("--cache", default=None,
                    help="plan-cache JSON path (default: "
                         "$SMI_TPU_PLAN_CACHE or "

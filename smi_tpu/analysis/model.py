@@ -132,7 +132,13 @@ class Scope:
     ``silence`` the number of beat-less period advances the explorer
     may choose (the alive-but-silent scenarios); ``consume`` the
     chunks one consume action drains; ``starve`` the scope-scaled
-    aging bound handed to the real scheduler.
+    aging bound handed to the real scheduler; ``hot_rank`` (>= 0)
+    replaces the modulo tenant->rank routing with a SKEWED one —
+    every tenant's base rank is ``hot_rank`` (the hot-expert traffic
+    matrix: one destination absorbs the whole offered load, the shape
+    the MoE dispatch campaign samples and this scope checks
+    exhaustively for queue-bound/starvation); ``-1`` keeps the
+    uniform modulo routing.
     """
 
     tenants: int = 2
@@ -144,6 +150,7 @@ class Scope:
     silence: int = 0
     consume: int = 2
     starve: int = 3
+    hot_rank: int = -1
 
     def __post_init__(self):
         for dim in ("tenants", "ranks", "chunks"):
@@ -180,6 +187,11 @@ class Scope:
             )
         if self.starve < 1:
             raise ValueError(f"starve must be >= 1, got {self.starve}")
+        if self.hot_rank != -1 and not 0 <= self.hot_rank < self.ranks:
+            raise ValueError(
+                f"hot_rank={self.hot_rank} outside the rank range "
+                f"0..{self.ranks - 1} (-1 = uniform modulo routing)"
+            )
 
     def describe(self) -> str:
         return ",".join(
@@ -243,6 +255,12 @@ DEFAULT_SCOPES: Tuple[Scope, ...] = (
     # the kill arc: detect -> shrink -> void+replay -> reject -> regrow
     Scope(tenants=2, ranks=2, chunks=2, streams=1, pool=3, kill=1,
           consume=1),
+    # skewed routing: the hot-expert traffic matrix — all three QoS
+    # classes hammer ONE destination while the other rank sits idle,
+    # so the wire window, brownout ceilings, and aging bound are
+    # exercised under maximal per-route contention (the exhaustive
+    # counterpart of the MoE hot-expert campaign cell)
+    Scope(tenants=3, ranks=2, chunks=2, streams=1, pool=2, hot_rank=0),
 )
 
 
@@ -363,7 +381,11 @@ class World:
     def _base_rank(self, tenant: int) -> int:
         """Deterministic tenant -> base rank map (the model's analog
         of ``frontend.tenant_base_rank``; index-based so the symmetry
-        reduction can reason about it)."""
+        reduction can reason about it). A ``hot_rank`` scope replaces
+        the uniform modulo map with the hot-expert skew: every tenant
+        routes to the one hot destination."""
+        if self.scope.hot_rank >= 0:
+            return self.scope.hot_rank
         return tenant % self.scope.ranks
 
     def _route(self, tenant: int) -> int:
@@ -738,18 +760,21 @@ class World:
         """Orbit representative: the minimum render over every
         (tenant, rank) permutation pair that commutes with BOTH
         deterministic tenant-identity maps — the routing map
-        (``tau(t) % ranks == rho(t % ranks)``) and the QoS assignment
-        (``tau(t) % classes == t % classes``, since future admissions
-        draw their class from the raw tenant index). Only genuinely
-        interchangeable identities collapse; a permutation that would
-        swap an interactive tenant with a best_effort one is not an
-        isomorphism and is rejected."""
+        (``base(tau(t)) == rho(base(t))`` — the modulo map on uniform
+        scopes, the constant hot-rank map on skewed ones, where the
+        condition degenerates to ``rho`` fixing the hot destination)
+        and the QoS assignment (``tau(t) % classes == t % classes``,
+        since future admissions draw their class from the raw tenant
+        index). Only genuinely interchangeable identities collapse; a
+        permutation that would swap an interactive tenant with a
+        best_effort one is not an isomorphism and is rejected."""
         nt, nr = self.scope.tenants, self.scope.ranks
         nc = len(QOS_CLASSES)
         best: Optional[tuple] = None
         for rho in itertools.permutations(range(nr)):
             for tau in itertools.permutations(range(nt)):
-                if any(tau[t] % nr != rho[t % nr]
+                if any(self._base_rank(tau[t])
+                       != rho[self._base_rank(t)]
                        or tau[t] % nc != t % nc
                        for t in range(nt)):
                     continue

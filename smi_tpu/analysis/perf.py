@@ -464,6 +464,25 @@ def _costs_for(protocol: str, shape: Dict[str, int],
             C.default_tier_costs(message, per_slice),
             message, 1,
         )
+    if protocol == "all_to_all":
+        # per-destination block granularity: the payload splits n ways
+        message = payload_bytes / max(1, n)
+        return C.default_tier_costs(message, 0), message, 1
+    if protocol == "all_to_all_bruck":
+        # each round's n/2 block copies coalesce into one aggregate
+        # message (the alltoall_variant_wallclocks pricing convention)
+        message = payload_bytes / 2.0
+        return C.default_tier_costs(message, 0), message, 1
+    if protocol == "all_to_all_pod":
+        per_slice = n // shape["slices"]
+        block = payload_bytes / max(1, n)
+        # mixed granularity: blocks on ICI, per_slice-block bundles on
+        # DCN (the alltoall_wallclock_comparison convention)
+        return (
+            C.default_tier_costs(block, per_slice, ici_bytes=block,
+                                 dcn_bytes=per_slice * block),
+            block, 1,
+        )
     if protocol == "all_reduce_chunked":
         message = payload_bytes / max(1, chunks)
         return C.default_tier_costs(message, 0), message, chunks
@@ -646,7 +665,7 @@ def decompose_protocol(
     shape: Dict[str, int] = {"n": n}
     if protocol in ("neighbour_stream", "all_reduce_chunked"):
         shape["chunks"] = chunks
-    if protocol == "allreduce_pod":
+    if protocol in ("allreduce_pod", "all_to_all_pod"):
         shape["slices"] = slices
     costs, _message, pipeline = _costs_for(protocol, shape, payload_bytes)
     return decompose_generators(
@@ -862,6 +881,12 @@ ANALYTIC_EXPECTED_US = {
     "allreduce_n8_256kib_us": 163.3,
     "allreduce_n8_1024kib_us": 285.6,
     "allreduce_n8_4096kib_us": 408.1,
+    "alltoall_n8_64kib_us": 54.7,
+    "alltoall_n8_256kib_us": 61.2,
+    "alltoall_n8_1024kib_us": 87.5,
+    "alltoall_n8_4096kib_us": 192.3,
+    "alltoall_pairwise_2x2_1mib_us": 1548.6,
+    "alltoall_two_tier_2x2_1mib_us": 957.4,
     "flash_fwd_bf16_seeded_roofline_us": 174.4,
     "flash_fwd_f32_seeded_roofline_us": 523.2,
 }
@@ -869,6 +894,10 @@ ANALYTIC_EXPECTED_US = {
 
 #: The payload grid of the committed allreduce curve (KiB).
 ALLREDUCE_CURVE_SIZES_KB = (64, 256, 1024, 4096)
+
+#: The payload grid of the committed all-to-all curve (KiB, total
+#: per-rank payload — one payload/n block per destination).
+ALLTOALL_CURVE_SIZES_KB = (64, 256, 1024, 4096)
 
 
 def allreduce_curve_us(
@@ -889,6 +918,24 @@ def allreduce_curve_us(
     ]
 
 
+def alltoall_curve_us(
+    sizes_kb: Sequence[int] = ALLTOALL_CURVE_SIZES_KB, n: int = 8,
+) -> List[float]:
+    """The best-flat-candidate all-to-all latency curve (pairwise vs
+    Bruck) at the published ICI rates — the SINGLE pricing used by
+    both the ``analytic-regression`` lint rule and the bench.py
+    ``alltoall`` scoreboard row, mirroring
+    :func:`allreduce_curve_us`'s one-pricing discipline."""
+    link = cm.LinkModel()
+    return [
+        round(min(
+            cm.pairwise_alltoall_us(kb * 1024, n, link),
+            cm.bruck_alltoall_us(kb * 1024, n, link),
+        ), 1)
+        for kb in sizes_kb
+    ]
+
+
 def analytic_predictions() -> Dict[str, float]:
     """Recompute today's static predictions for the committed
     expectation set, at the PUBLISHED rates (a fleet
@@ -902,6 +949,16 @@ def analytic_predictions() -> Dict[str, float]:
     )
     for kb, us in zip(ALLREDUCE_CURVE_SIZES_KB, allreduce_curve_us()):
         out[f"allreduce_n8_{kb}kib_us"] = us
+    for kb, us in zip(ALLTOALL_CURVE_SIZES_KB, alltoall_curve_us()):
+        out[f"alltoall_n8_{kb}kib_us"] = us
+    a2a = C.alltoall_wallclock_comparison(2, 2, float(1 << 20),
+                                          dcn=dcn)
+    out["alltoall_pairwise_2x2_1mib_us"] = round(
+        a2a["pairwise_s"] * 1e6, 1
+    )
+    out["alltoall_two_tier_2x2_1mib_us"] = round(
+        a2a["hierarchical_s"] * 1e6, 1
+    )
     from smi_tpu.tuning import seeded
 
     for name, (bq, _bk), dtype in (

@@ -904,9 +904,10 @@ def verify_generators(
 
 
 def _registered() -> Tuple[str, ...]:
-    from smi_tpu.parallel import faults as F
-
-    return F.PROTOCOLS + F.CHUNKED_PROTOCOLS + F.POD_PROTOCOLS
+    # the consolidated registry (credits.all_protocol_registries) is
+    # the one enumeration — a protocol family registered there joins
+    # the verifier, the perf decomposer, and the launch gate at once
+    return C.registered_protocols()
 
 
 def build_generators(protocol: str, n: int, chunks: int = 3,
@@ -946,6 +947,21 @@ def build_generators(protocol: str, n: int, chunks: int = 3,
             )
         return C.allreduce_pod_generators(slices, n // slices,
                                           flow_control=flow_control)
+    if protocol == "all_to_all":
+        return C.all_to_all_generators(n, flow_control=flow_control)
+    if protocol == "all_to_all_bruck":
+        # non-power-of-two n raises inside the generator factory — the
+        # loud refusal the "no silent caps" satellite demands
+        return C.all_to_all_generators(n, variant="bruck",
+                                       flow_control=flow_control)
+    if protocol == "all_to_all_pod":
+        if n % slices:
+            raise ValueError(
+                f"all_to_all_pod needs n divisible by slices, got "
+                f"n={n} slices={slices}"
+            )
+        return C.all_to_all_pod_generators(slices, n // slices,
+                                           flow_control=flow_control)
     raise ValueError(
         f"unknown protocol {protocol!r}; known: {_registered()}"
     )
@@ -968,6 +984,13 @@ DEFAULT_SHAPES: Dict[str, Tuple[Dict[str, int], ...]] = {
         {"n": 4, "slices": 2}, {"n": 6, "slices": 2},
         {"n": 6, "slices": 3},
     ),
+    "all_to_all": ({"n": 2}, {"n": 3}, {"n": 5}),
+    # Bruck is power-of-two only (loud otherwise), so its grid is too
+    "all_to_all_bruck": ({"n": 2}, {"n": 4}, {"n": 8}),
+    "all_to_all_pod": (
+        {"n": 4, "slices": 2}, {"n": 6, "slices": 2},
+        {"n": 6, "slices": 3},
+    ),
 }
 
 
@@ -977,7 +1000,7 @@ def verify_protocol(protocol: str, n: int, chunks: int = 3,
     shape: Dict[str, int] = {"n": n}
     if protocol in ("neighbour_stream", "all_reduce_chunked"):
         shape["chunks"] = chunks
-    if protocol == "allreduce_pod":
+    if protocol in ("allreduce_pod", "all_to_all_pod"):
         shape["slices"] = slices
     return verify_generators(
         lambda: build_generators(protocol, n, chunks=chunks,

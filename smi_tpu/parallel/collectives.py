@@ -219,6 +219,33 @@ def _hier_env_min_slices() -> Optional[int]:
     return value
 
 
+#: Explicit algorithm override for :func:`all_to_all`: ``pairwise``
+#: (the fused ``lax.all_to_all`` — the untuned default), ``bruck``
+#: (log-step, power-of-two rank counts ONLY — structurally impossible
+#: shapes raise loudly), or ``hierarchical`` (the two-tier ICI x DCN
+#: composition, hybrid multi-slice communicators only). The operator's
+#: word: outranks cache and model; malformed values are a LOUD error,
+#: mirroring :data:`RS_AG_ENV`.
+ALLTOALL_ALGO_ENV = "SMI_TPU_ALLTOALL_ALGO"
+
+#: The algorithms :func:`all_to_all` accepts.
+ALLTOALL_ALGORITHMS = ("pairwise", "bruck", "hierarchical")
+
+
+def _alltoall_env_algorithm() -> Optional[str]:
+    """$SMI_TPU_ALLTOALL_ALGO validated, ``None`` when unset. A typo
+    must not silently hand the decision back to the engine."""
+    raw = os.environ.get(ALLTOALL_ALGO_ENV, "").strip()
+    if not raw:
+        return None
+    if raw not in ALLTOALL_ALGORITHMS:
+        raise ValueError(
+            f"${ALLTOALL_ALGO_ENV} must be one of "
+            f"{ALLTOALL_ALGORITHMS}, got {raw!r}"
+        )
+    return raw
+
+
 def _rs_ag_env_bytes() -> Optional[int]:
     """$SMI_TPU_RS_AG_MIN_BYTES as an int, ``None`` when unset. A
     malformed value is a LOUD error — a typo silently falling back to
@@ -887,3 +914,165 @@ def gather(x: jax.Array, comm: Communicator, root: int = 0,
     if all_ranks:
         return out
     return jnp.where(_is_root(comm, root), out, jnp.zeros_like(out))
+
+
+# ---------------------------------------------------------------------------
+# All-to-all: the first non-ring/tree traffic shape
+# ---------------------------------------------------------------------------
+
+
+def _bruck_all_to_all(x: jax.Array, name, size: int) -> jax.Array:
+    """Bruck-style log-step all-to-all over ``ppermute`` rounds.
+
+    The classic index algebra (Bruck et al., IEEE TPDS'97): a local
+    rotation puts the block destined ``(me + i) % n`` at index ``i``,
+    round ``k`` forwards every index with bit ``k`` set to rank
+    ``me + 2^k``, and the inverse rotation restores source-major
+    order. Pure routing — bit-identical to ``lax.all_to_all`` for
+    every dtype — at ``log2(n)`` collective steps of ``n/2``-block
+    aggregates instead of the pairwise schedule's ``n - 1``. Requires
+    a power-of-two ``size`` (validated loudly by the caller).
+    """
+    count = x.shape[0] // size
+    xu = x.reshape((size, count) + x.shape[1:])
+    me = lax.axis_index(name)
+    idx = jnp.arange(size)
+    buf = jnp.take(xu, (me + idx) % size, axis=0)
+    hop = 1
+    while hop < size:
+        bits = jnp.array([i for i in range(size) if i & hop])
+        perm = [(s, (s + hop) % size) for s in range(size)]
+        moved = lax.ppermute(buf[bits], name, perm)
+        buf = buf.at[bits].set(moved)
+        hop <<= 1
+    out = jnp.take(buf, (me - idx) % size, axis=0)
+    return out.reshape(x.shape)
+
+
+def alltoall_hierarchical(x: jax.Array, comm: Communicator,
+                          inner: Optional[str] = None,
+                          outer: Optional[str] = None) -> jax.Array:
+    """Two-tier all-to-all for hybrid (slice x in-slice) communicators.
+
+    The block from ``(s, i)`` to ``(t, j)`` hops ICI to the in-slice
+    column owner ``(s, j)``, then crosses DCN exactly once inside the
+    ``j`` column as part of an ``inner``-block bundle — DCN message
+    count per rank drops from ``(outer - 1) * inner`` to
+    ``outer - 1``, the reference's router economics (keep traffic on
+    the cheap tier, cross the expensive one with aggregated freight).
+    Pure routing: bit-identical to the flat ``lax.all_to_all`` for
+    every dtype (property-tested). ``x``'s leading dimension must be
+    ``comm.size * count``.
+    """
+    outer, inner = _hier_axes(comm, inner, outer)
+    m = int(comm.mesh.shape[outer])
+    k = int(comm.mesh.shape[inner])
+    n = m * k
+    if x.ndim == 0 or x.shape[0] % n:
+        raise ValueError(
+            f"all_to_all buffer leading dim {jnp.shape(x)} not "
+            f"divisible by comm size {n}"
+        )
+    count = x.shape[0] // n
+    tail = x.shape[1:]
+    xu = x.reshape((m, k, count) + tail)
+    # phase A (ICI): bundle by destination position j — send column
+    # j's freight (one m*count bundle) to slice-mate j
+    a = jnp.moveaxis(xu, 1, 0).reshape((k * m * count,) + tail)
+    a = lax.all_to_all(a, inner, split_axis=0, concat_axis=0,
+                       tiled=True)
+    # now [src position i'][dst slice t]: regroup by destination slice
+    au = a.reshape((k, m, count) + tail)
+    b = jnp.moveaxis(au, 1, 0).reshape((m * k * count,) + tail)
+    # phase B (DCN): one k-block bundle per destination slice
+    b = lax.all_to_all(b, outer, split_axis=0, concat_axis=0,
+                       tiled=True)
+    # received [src slice s'][src position i'] == rank-major sources,
+    # the flat all_to_all's delivery layout
+    return b.reshape(x.shape)
+
+
+def all_to_all(x: jax.Array, comm: Communicator,
+               algorithm: Optional[str] = None,
+               port: Optional[int] = None, backend: str = "xla",
+               program=None) -> jax.Array:
+    """Every rank scatters one block per destination and gathers one
+    block per source: ``x``'s leading dimension is ``size * count``
+    (block ``r`` = rows ``[r*count, (r+1)*count)``, destined rank
+    ``r``); the result holds the received blocks in source-major
+    order. The first registered traffic shape that is neither a ring
+    nor a tree — MoE expert dispatch, distributed shuffle, K-means
+    reassignment.
+
+    ``algorithm`` picks the decomposition: ``"pairwise"`` (one fused
+    ``lax.all_to_all``), ``"bruck"`` (log-step ``ppermute`` rounds —
+    power-of-two rank counts only, anything else a loud error),
+    ``"hierarchical"`` (the two-tier ICI x DCN composition on a hybrid
+    multi-slice communicator). All three are pure routing and
+    bit-identical. ``None`` (the default) resolves through the plan
+    engine's ladder — explicit :data:`ALLTOALL_ALGO_ENV` env override
+    (the operator's word, loud on malformed AND on structurally
+    impossible shapes), then a measured cache entry, then the
+    alpha-beta model where confidently away from parity, then the
+    fused pairwise collective, byte-for-byte what an explicit
+    ``algorithm="pairwise"`` call compiles (invariant-tested).
+
+    The credits-simulator reference protocols
+    (``credits.all_to_all_rank`` / ``all_to_all_bruck_rank`` /
+    ``all_to_all_pod_rank``) are the executable wire-level spec of the
+    three algorithms; the ring tier has no all-to-all kernel yet, so
+    ``backend="ring"`` is a loud error rather than a silent XLA
+    fallback.
+    """
+    _check_backend(backend)
+    if backend != "xla":
+        raise ValueError(
+            "all_to_all has no ring-tier kernel yet (the credits "
+            "simulator is the executable wire-level reference); use "
+            "backend='xla'"
+        )
+    size = comm.size
+    if x.ndim == 0 or x.shape[0] % size or x.shape[0] < size:
+        raise ValueError(
+            f"all_to_all buffer leading dim {jnp.shape(x)} not "
+            f"divisible by comm size {size}"
+        )
+    from smi_tpu.tuning import cost_model as cm
+
+    algo = algorithm
+    if algo is not None:
+        if algo not in ALLTOALL_ALGORITHMS:
+            raise ValueError(
+                f"unknown all_to_all algorithm {algo!r}; known: "
+                f"{ALLTOALL_ALGORITHMS}"
+            )
+    else:
+        env = _alltoall_env_algorithm()   # loud on malformed — first
+        if env is not None:
+            algo = env
+        else:
+            topo = cm.topology_from_comm(comm)
+            payload = int(x.size) * x.dtype.itemsize
+            try:
+                from smi_tpu.tuning.engine import planned_alltoall
+
+                algo = planned_alltoall(
+                    payload, topo.n, topo.inner or topo.n,
+                    topo.outer or 1, str(x.dtype),
+                )
+            except Exception:
+                algo = "pairwise"
+    if algo == "bruck":
+        if size < 1 or (size & (size - 1)):
+            # an explicit (or operator-pinned) Bruck on a
+            # non-power-of-two ring fails loudly — never a silent
+            # pairwise fallback ("no silent caps")
+            raise ValueError(
+                f"algorithm='bruck' needs a power-of-two comm size, "
+                f"got {size} — drop the pin or use pairwise"
+            )
+        return _bruck_all_to_all(x, _axis(comm), size)
+    if algo == "hierarchical":
+        return alltoall_hierarchical(x, comm)
+    return lax.all_to_all(x, _axis(comm), split_axis=0, concat_axis=0,
+                          tiled=True)

@@ -195,6 +195,16 @@ class SmiContext:
                             program=self.program, deadline=self.deadline,
                             chunks=chunks)
 
+    # ``algorithm`` resolves env -> cache -> model -> pairwise (the
+    # fused lax.all_to_all) — see parallel/collectives.all_to_all.
+    def all_to_all(self, x, algorithm: Optional[str] = None,
+                   port: Optional[int] = None,
+                   backend: Optional[str] = None):
+        return _coll.all_to_all(x, self.comm, algorithm=algorithm,
+                                port=port,
+                                backend=self._backend(backend),
+                                program=self.program)
+
     # -- tuning --------------------------------------------------------
     def explain_plan(self, op: str = "all_reduce",
                      dtype: str = "float32") -> str:

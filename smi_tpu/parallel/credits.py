@@ -96,6 +96,65 @@ SEM_RECV = "recv"
 SEM_CREDIT = "credit"
 SEM_BARRIER = "barrier"
 
+# ---------------------------------------------------------------------------
+# Protocol registries — the ONE source of truth
+# ---------------------------------------------------------------------------
+# Every consumer that enumerates "the registered protocols" — the fault
+# matrix (`faults.run_under_faults`), the static verifier
+# (`analysis/verifier.py`), the perf decomposer (`analysis/perf.py`),
+# and the `route --check --lint` launch gate — reads these tuples (the
+# fault layer re-exports them under its historical names). Keeping the
+# definitions HERE, next to the state machines they name, means a new
+# protocol family registers once and every tier follows; the
+# seed-pinned chaos sweep stays byte-stable because PROTOCOLS itself is
+# digest-tested (tests/test_alltoall.py) and the newer families live in
+# their own tuples, never appended to it.
+
+#: The four base ring protocols — the seed-pinned chaos sweep's draw
+#: set. NEVER extend this tuple: a fifth name would silently re-roll
+#: every pinned campaign cell (add a new registry tuple instead).
+PROTOCOLS = ("all_gather", "all_reduce", "reduce_scatter",
+             "neighbour_stream")
+
+#: Pipelined variants runnable through the fault harness but NOT in the
+#: seed-pinned base sweep.
+CHUNKED_PROTOCOLS = ("all_reduce_chunked",)
+
+#: The two-tier pod composition, same discipline.
+POD_PROTOCOLS = ("allreduce_pod",)
+
+#: The all-to-all family (sparse, data-dependent traffic): the pairwise
+#: exchange reference, the Bruck-style log-step variant (power-of-two
+#: ranks only — a non-power-of-two request fails loudly), and the
+#: two-tier ICI x DCN variant. Same seed-pinning discipline: its own
+#: tuple, never folded into PROTOCOLS.
+ALLTOALL_PROTOCOLS = ("all_to_all", "all_to_all_bruck", "all_to_all_pod")
+
+
+def all_protocol_registries() -> Dict[str, Tuple[str, ...]]:
+    """Every protocol registry, by name, in declaration order — the
+    single enumeration the fault layer, the static verifier, the perf
+    decomposer, and the launch gate all derive their coverage from.
+    Returned fresh per call (a dict, so a consumer cannot mutate the
+    shared tuples through it); digest-tested so a registry edit is a
+    conscious, test-visible act rather than a silent re-roll of the
+    seed-pinned chaos sweep."""
+    return {
+        "PROTOCOLS": PROTOCOLS,
+        "CHUNKED_PROTOCOLS": CHUNKED_PROTOCOLS,
+        "POD_PROTOCOLS": POD_PROTOCOLS,
+        "ALLTOALL_PROTOCOLS": ALLTOALL_PROTOCOLS,
+    }
+
+
+def registered_protocols() -> Tuple[str, ...]:
+    """The flattened registry: every protocol every analysis tier must
+    cover, in registry declaration order."""
+    out: Tuple[str, ...] = ()
+    for names in all_protocol_registries().values():
+        out += names
+    return out
+
 
 class ProtocolError(AssertionError):
     """A protocol invariant was violated under some schedule."""
@@ -512,6 +571,242 @@ def allreduce_pod_rank(g: int, slices: int, per_slice: int,
             final_read=False)
     else:
         yield ("output", 0, block)
+
+
+# ---------------------------------------------------------------------------
+# All-to-all protocol family
+# ---------------------------------------------------------------------------
+# The first protocol family whose traffic matrix is not a ring or a
+# tree: every rank holds one block per destination (MoE expert routing,
+# distributed shuffle, K-means reassignment). Three variants, one
+# delivery contract each, all one-yield-per-primitive and
+# schedule-independent (no generator ever observes a received payload —
+# receipts are forwarded or delivered opaquely, which is what keeps the
+# static verifier's symbolic replay exact):
+#
+# - ``all_to_all_rank`` — the pairwise-exchange reference: step ``s``
+#   sends to ``(me + s) % n`` and receives from ``(me - s) % n``,
+#   double-buffered slots (``s % 2``), one credit per step granted by
+#   the receiver two steps ahead. Per-STEP semaphore indices keep every
+#   credit/send/recv domain single-producer (the shape the verifier's
+#   happens-before matching is exact for); the verified-transport
+#   framing rides unchanged because ``verified_steps`` already numbers
+#   wire sequences PER DESTINATION — all-to-all is the protocol that
+#   finally exercises more than one lane per sender.
+# - ``all_to_all_bruck_rank`` — the Bruck-style log-step variant:
+#   ``log2(n)`` rounds, round ``k`` forwarding every buffer index with
+#   bit ``k`` set to rank ``me + 2^k``. Aggregation is modeled by
+#   pricing (the harness prices each round's messages at the
+#   ``n/2``-block aggregate the real kernel would coalesce into one
+#   send); n must be a power of two — anything else is a loud
+#   ValueError, never a silent fallback.
+# - ``all_to_all_pod_rank`` — the two-tier ICI x DCN variant: phase A
+#   exchanges per-destination items within the slice over ICI (routing
+#   each block to the slice-mate whose COLUMN matches the block's
+#   destination position), phase B crosses DCN exactly once per
+#   destination slice with a k-block bundle, and the local redistribute
+#   delivers per-source-slice bundles. DCN alphas drop from
+#   ``(n - per_slice)`` per rank (flat pairwise) to ``slices - 1``.
+
+
+def all_to_all_rank(me: int, n: int, blocks: Sequence,
+                    flow_control: bool = True,
+                    to_global: Callable[[int], int] = _identity):
+    """Pairwise-exchange all-to-all: ``blocks[d]`` is this rank's block
+    for destination ``d``; delivery is one ``("output", src, block)``
+    per source rank (own block delivered locally).
+
+    Credit discipline: step ``s`` lands in slot ``s % 2`` at the
+    receiver; the receiver grants step ``s``'s credit (semaphore index
+    ``s`` — single-producer, single-consumer) to that step's sender
+    after consuming the slot's previous tenant at step ``s - 2`` (the
+    first two steps are granted upfront — both slots start free). A
+    duplicate grant admits a clobber, a dropped one deadlocks: the
+    same failure surface as the ring protocols, on a rotating-partner
+    schedule.
+    """
+    if n < 1:
+        raise ValueError(f"all_to_all needs n >= 1, got {n}")
+    if len(blocks) != n:
+        raise ValueError(
+            f"rank {me} got {len(blocks)} blocks for n={n}"
+        )
+    if flow_control and n > 1:
+        yield from _barrier_steps(me, n, to_global)
+    yield ("output", me, blocks[me])
+    if flow_control:
+        for s in range(1, min(3, n)):
+            # both slots start free: grant the first tenant of each
+            yield ("signal", to_global((me - s) % n), SEM_CREDIT, s, 1)
+    for s in range(1, n):
+        dst = to_global((me + s) % n)
+        src = (me - s) % n
+        if flow_control:
+            yield ("wait", SEM_CREDIT, s, 1)
+        yield ("dma", dst, s % 2, blocks[(me + s) % n], s, s)
+        yield ("wait", SEM_SEND, s, 1)
+        yield ("wait", SEM_RECV, s, 1)
+        arrived = yield ("read_slot", s % 2)
+        yield ("output", src, arrived)
+        if flow_control and s + 2 < n:
+            # slot s % 2 is consumed: its next tenant may come
+            yield ("signal", to_global((me - (s + 2)) % n),
+                   SEM_CREDIT, s + 2, 1)
+
+
+def all_to_all_bruck_rank(me: int, n: int, blocks: Sequence,
+                          flow_control: bool = True,
+                          to_global: Callable[[int], int] = _identity):
+    """Bruck-style log-step all-to-all (power-of-two ``n`` ONLY — a
+    non-power-of-two rank count raises, it is never silently padded or
+    rerouted).
+
+    Round ``k`` forwards every buffer index ``i`` with bit ``k`` set to
+    rank ``me + 2^k`` and refills the same indices from ``me - 2^k``;
+    after ``log2(n)`` rounds buffer index ``i`` holds the block from
+    rank ``(me - i) % n``, delivered per source. Received values are
+    forwarded OPAQUELY (buffer entries, never inspected), so the
+    sequence is schedule-independent and the verified-transport
+    framing re-frames each hop on the forwarder's own destination
+    lane. Each round's ``n/2`` copies start together and the harness
+    prices them at the aggregate message size — the coalesced send a
+    real Bruck kernel performs.
+
+    Per-round-per-index semaphore domains (``("c"|"s"|"r", k, i)``)
+    keep every lane single-producer; slot ``i`` is reused across the
+    rounds whose bit is set in ``i``, protected by the per-round
+    credit granted only after the previous tenant was read.
+    """
+    if n < 1 or (n & (n - 1)):
+        raise ValueError(
+            f"all_to_all_bruck needs a power-of-two rank count, got "
+            f"n={n} — use the pairwise variant (or a padded shape) "
+            f"for non-power-of-two rings"
+        )
+    if len(blocks) != n:
+        raise ValueError(
+            f"rank {me} got {len(blocks)} blocks for n={n}"
+        )
+    if flow_control and n > 1:
+        yield from _barrier_steps(me, n, to_global)
+    yield ("output", me, blocks[me])
+    # local rotation: buf[i] = the block destined (me + i) % n
+    buf = {i: blocks[(me + i) % n] for i in range(1, n)}
+    rounds = n.bit_length() - 1
+    for k in range(rounds):
+        hop = 1 << k
+        dst = to_global((me + hop) % n)
+        src = to_global((me - hop) % n)
+        idxs = [i for i in range(1, n) if i & hop]
+        if flow_control:
+            for i in idxs:
+                # slot i's previous tenant (if any) was read in the
+                # last round whose bit is below k — program order
+                # makes this grant safe
+                yield ("signal", src, SEM_CREDIT, ("c", k, i), 1)
+        for i in idxs:  # phase A: start every copy of the round
+            if flow_control:
+                yield ("wait", SEM_CREDIT, ("c", k, i), 1)
+            yield ("dma", dst, i, buf[i], ("s", k, i), ("r", k, i))
+        for i in idxs:  # phase B: drain sends, refill the buffer
+            yield ("wait", SEM_SEND, ("s", k, i), 1)
+            yield ("wait", SEM_RECV, ("r", k, i), 1)
+            buf[i] = yield ("read_slot", i)
+    for i in range(1, n):
+        yield ("output", (me - i) % n, buf[i])
+
+
+def all_to_all_pod_rank(g: int, slices: int, per_slice: int,
+                        blocks: Sequence, flow_control: bool = True):
+    """One rank's two-tier ICI x DCN all-to-all over a pod.
+
+    ``blocks[d]`` is this rank's block for global destination ``d``
+    (row-major pod order, ``credits.pod_slice_of``). Routing: the
+    block from ``(s, i)`` to ``(t, j)`` hops ICI to the in-slice
+    COLUMN owner ``(s, j)`` (phase A), then crosses DCN once inside
+    the ``(t, j)`` column as part of a ``per_slice``-block bundle
+    (phase B). Delivery: one ``("output", ("slice", t), bundle)`` per
+    source slice ``t``, where ``bundle[j]`` is the block from rank
+    ``(t, j)`` — the concatenation over slices and positions is the
+    flat variants' per-source delivery, re-grouped by slice (bundles
+    stay opaque end to end, so the protocol never indexes a received
+    payload and the symbolic replay stays exact).
+
+    Degenerate tiers collapse exactly: ``per_slice == 1`` skips phase
+    A, ``slices == 1`` skips phase B, and the 1x1 pod is a local
+    delivery. Phase A/B run on disjoint slot spaces (``("Ad"|"At",
+    ...)`` vs ``("B", ...)``, each written once per run) with
+    per-phase neighbour barriers on their own semaphore domains.
+    """
+    m, k = slices, per_slice
+    if m < 1 or k < 1:
+        raise ValueError(f"pod must be >= 1x1, got {m}x{k}")
+    n = m * k
+    if len(blocks) != n:
+        raise ValueError(
+            f"rank {g} got {len(blocks)} blocks for a {m}x{k} pod"
+        )
+    s, i = divmod(g, k)
+
+    def in_slice(r: int) -> int:
+        return s * k + r
+
+    def x_slice(t: int) -> int:
+        return t * k + i
+
+    # -- phase A: per-destination-position exchange in the slice (ICI)
+    direct: Dict[int, object] = {}       # slice-mate pos -> block to me
+    transit: Dict[Tuple[int, int], object] = {}  # (dst slice, src pos)
+    if k > 1:
+        if flow_control:
+            yield from _pod_barrier(i, k, in_slice, "a2a_ici")
+        for o in range(1, k):
+            j = (i + o) % k
+            yield ("dma", in_slice(j), ("Ad", i), blocks[s * k + j],
+                   ("Ads", j), ("Ad", i))
+            for u in range(1, m):
+                t = (s + u) % m
+                yield ("dma", in_slice(j), ("At", i, t),
+                       blocks[t * k + j], ("Ats", j, t), ("At", i, t))
+        for o in range(1, k):
+            j = (i + o) % k
+            yield ("wait", SEM_SEND, ("Ads", j), 1)
+            for u in range(1, m):
+                t = (s + u) % m
+                yield ("wait", SEM_SEND, ("Ats", j, t), 1)
+        for o in range(1, k):
+            p = (i - o) % k
+            yield ("wait", SEM_RECV, ("Ad", p), 1)
+            direct[p] = yield ("read_slot", ("Ad", p))
+            for u in range(1, m):
+                t = (s + u) % m
+                yield ("wait", SEM_RECV, ("At", p, t), 1)
+                transit[(t, p)] = yield ("read_slot", ("At", p, t))
+    own_bundle = tuple(
+        blocks[s * k + i] if p == i else direct[p] for p in range(k)
+    )
+    yield ("output", ("slice", s), own_bundle)
+
+    # -- phase B: one bundle per destination slice across DCN ----------
+    if m > 1:
+        if flow_control:
+            yield from _pod_barrier(s, m, x_slice, "a2a_dcn")
+        for u in range(1, m):
+            t = (s + u) % m
+            bundle = tuple(
+                blocks[t * k + i] if p == i else transit[(t, p)]
+                for p in range(k)
+            )
+            yield ("dma", x_slice(t), ("B", s), bundle, ("Bs", t),
+                   ("B", s))
+        for u in range(1, m):
+            t = (s + u) % m
+            yield ("wait", SEM_SEND, ("Bs", t), 1)
+        for u in range(1, m):
+            src_slice = (s - u) % m
+            yield ("wait", SEM_RECV, ("B", src_slice), 1)
+            bundle = yield ("read_slot", ("B", src_slice))
+            yield ("output", ("slice", src_slice), bundle)
 
 
 # ---------------------------------------------------------------------------
@@ -1094,12 +1389,21 @@ class TierCostModel:
     circulating ring, ``payload / per_slice`` for every phase of the
     pod protocol). ``per_slice == 0`` means single-tier: every wire is
     ICI, which keeps all pre-pod harnesses pricable unchanged.
+
+    ``ici_bytes`` / ``dcn_bytes`` optionally override the message size
+    PER TIER — the two-tier all-to-all moves per-destination blocks on
+    ICI but ``per_slice``-block bundles across DCN, so one global
+    granularity cannot price both wire populations. ``None`` (the
+    default) keeps the single ``bytes_per_message``, so every existing
+    harness prices identically.
     """
 
     bytes_per_message: float
     ici: LinkCost
     dcn: LinkCost
     per_slice: int = 0
+    ici_bytes: Optional[float] = None
+    dcn_bytes: Optional[float] = None
 
     def crosses_dcn(self, a: int, b: int) -> bool:
         return bool(
@@ -1110,8 +1414,20 @@ class TierCostModel:
     def link(self, a: int, b: int) -> LinkCost:
         return self.dcn if self.crosses_dcn(a, b) else self.ici
 
+    def tier_bytes(self, src: int, dst: int) -> float:
+        """The message size this (src, dst) wire carries: the tier's
+        override when set, else the run-wide granularity."""
+        if self.crosses_dcn(src, dst):
+            if self.dcn_bytes is not None:
+                return self.dcn_bytes
+        elif self.ici_bytes is not None:
+            return self.ici_bytes
+        return self.bytes_per_message
+
     def dma_seconds(self, src: int, dst: int) -> float:
-        return self.link(src, dst).dma_seconds(self.bytes_per_message)
+        return self.link(src, dst).dma_seconds(
+            self.tier_bytes(src, dst)
+        )
 
     def signal_seconds(self, src: int, dst: int) -> float:
         """A bare semaphore signal pays its tier's latency (no payload)."""
@@ -1122,11 +1438,15 @@ class TierCostModel:
 
 def default_tier_costs(bytes_per_message: float, per_slice: int = 0,
                        ici: Optional[LinkCost] = None,
-                       dcn: Optional[LinkCost] = None) -> TierCostModel:
+                       dcn: Optional[LinkCost] = None,
+                       ici_bytes: Optional[float] = None,
+                       dcn_bytes: Optional[float] = None) -> TierCostModel:
     """Tier costs at the cost model's published rates: v5e ICI for the
     fast tier, the DCN alpha/beta (env-overridable beta,
     ``$SMI_TPU_DCN_BETA``) for the slow one. Deferred import — credits
-    stays importable without the tuning package."""
+    stays importable without the tuning package. ``ici_bytes`` /
+    ``dcn_bytes`` pass through the per-tier message-size overrides
+    (the two-tier all-to-all's mixed granularities)."""
     from smi_tpu.tuning import cost_model as cm
 
     return TierCostModel(
@@ -1138,6 +1458,8 @@ def default_tier_costs(bytes_per_message: float, per_slice: int = 0,
             cm.DCN_ALPHA_S, cm.dcn_beta_bytes_per_s()
         ),
         per_slice=per_slice,
+        ici_bytes=ici_bytes,
+        dcn_bytes=dcn_bytes,
     )
 
 
@@ -1832,6 +2154,175 @@ def pod_wallclock_comparison(slices: int, per_slice: int,
         "payload_bytes": payload_bytes,
         "flat_s": flat_sim.elapsed_seconds(),
         "hierarchical_s": hier_sim.elapsed_seconds(),
+    }
+
+
+def _alltoall_block(src: int, dst: int) -> str:
+    """The standard symbolic all-to-all payload: content-addressed per
+    (source, destination), so wrong routing OR wrong bits both fail
+    the delivery check."""
+    return f"b{src}->{dst}"
+
+
+def all_to_all_generators(n: int, variant: str = "pairwise",
+                          flow_control: bool = True):
+    """Per-rank flat all-to-all programs with the standard blocks."""
+    if variant == "pairwise":
+        rank_fn = all_to_all_rank
+    elif variant == "bruck":
+        if n < 1 or (n & (n - 1)):
+            # eager (factory-time) refusal: generators raise lazily,
+            # and a non-power-of-two Bruck request must fail before a
+            # harness starts consuming rank sequences
+            raise ValueError(
+                f"all_to_all_bruck needs a power-of-two rank count, "
+                f"got n={n}"
+            )
+        rank_fn = all_to_all_bruck_rank
+    else:
+        raise ValueError(
+            f"unknown all_to_all variant {variant!r}; known: "
+            f"pairwise, bruck (the pod variant builds through "
+            f"all_to_all_pod_generators)"
+        )
+    return [
+        rank_fn(r, n, [_alltoall_block(r, d) for d in range(n)],
+                flow_control=flow_control)
+        for r in range(n)
+    ]
+
+
+def simulate_all_to_all(n: int, strategy: Strategy,
+                        variant: str = "pairwise",
+                        flow_control: bool = True, faults=None,
+                        verified: bool = False,
+                        costs: Optional[TierCostModel] = None) -> float:
+    """Fuzz one schedule of a flat all-to-all variant and verify that
+    every rank received exactly its per-source blocks — wrong delivery
+    from ANY source is a :class:`ProtocolError`. Returns the simulated
+    wall-clock (0.0 without a cost model)."""
+    sim = RingSimulator(
+        _maybe_verified(
+            all_to_all_generators(n, variant, flow_control), verified
+        ),
+        strategy, faults=faults, costs=costs,
+    )
+    outputs = sim.run()
+    for r in range(n):
+        want = {src: _alltoall_block(src, r) for src in range(n)}
+        if outputs[r] != want:
+            raise ProtocolError(
+                f"rank {r} received {outputs[r]}, wanted {want}"
+            )
+    return sim.elapsed_seconds()
+
+
+def all_to_all_pod_generators(slices: int, per_slice: int,
+                              flow_control: bool = True):
+    """Per-rank two-tier all-to-all programs with the standard blocks."""
+    n = slices * per_slice
+    return [
+        all_to_all_pod_rank(
+            g, slices, per_slice,
+            [_alltoall_block(g, d) for d in range(n)],
+            flow_control=flow_control,
+        )
+        for g in range(n)
+    ]
+
+
+def simulate_all_to_all_pod(slices: int, per_slice: int,
+                            strategy: Strategy,
+                            flow_control: bool = True, faults=None,
+                            verified: bool = False,
+                            costs: Optional[TierCostModel] = None) -> float:
+    """Fuzz one schedule of the two-tier pod all-to-all and verify
+    delivery: every rank must hold, per source slice, the bundle of
+    that slice's blocks for it (the bundles' concatenation IS the flat
+    per-source delivery). Returns the simulated wall-clock."""
+    n = slices * per_slice
+    sim = RingSimulator(
+        _maybe_verified(
+            all_to_all_pod_generators(slices, per_slice, flow_control),
+            verified,
+        ),
+        strategy, faults=faults, costs=costs,
+    )
+    outputs = sim.run()
+    for g in range(n):
+        want = {
+            ("slice", t): tuple(
+                _alltoall_block(t * per_slice + j, g)
+                for j in range(per_slice)
+            )
+            for t in range(slices)
+        }
+        if outputs[g] != want:
+            raise ProtocolError(
+                f"rank {g} received {outputs[g]}, wanted {want}"
+            )
+    return sim.elapsed_seconds()
+
+
+def alltoall_wallclock_comparison(slices: int, per_slice: int,
+                                  block_bytes: float, seed: int = 0,
+                                  ici: Optional[LinkCost] = None,
+                                  dcn: Optional[LinkCost] = None) -> Dict:
+    """Same all-to-all traffic, flat pairwise vs the two-tier pod
+    variant, on the same deterministic schedule seed and wire rates.
+
+    The flat pairwise exchange sends one ``block_bytes`` message per
+    (source, destination) pair — ``per_slice * (slices - 1)`` of a
+    rank's ``n - 1`` messages cross DCN, each paying the DCN alpha.
+    The pod variant's ICI messages stay at block granularity but its
+    DCN crossings are ``slices - 1`` bundles of ``per_slice`` blocks —
+    the alpha amortization the hierarchy exists for. Both runs must
+    deliver the identical routing (each against its own delivery
+    contract — the bundles' concatenation is the flat delivery); the
+    returned dict carries the two makespans. Deterministic per
+    (shape, block size, seed, rates)."""
+    n = slices * per_slice
+    flat_costs = default_tier_costs(block_bytes, per_slice,
+                                    ici=ici, dcn=dcn)
+    flat_s = simulate_all_to_all(n, Strategy(seed), costs=flat_costs)
+    pod_costs = default_tier_costs(
+        block_bytes, per_slice, ici=ici, dcn=dcn,
+        ici_bytes=block_bytes, dcn_bytes=per_slice * block_bytes,
+    )
+    pod_s = simulate_all_to_all_pod(slices, per_slice, Strategy(seed),
+                                    costs=pod_costs)
+    return {
+        "slices": slices,
+        "per_slice": per_slice,
+        "block_bytes": block_bytes,
+        "pairwise_s": flat_s,
+        "hierarchical_s": pod_s,
+    }
+
+
+def alltoall_variant_wallclocks(n: int, block_bytes: float,
+                                seed: int = 0,
+                                ici: Optional[LinkCost] = None) -> Dict:
+    """Pairwise vs Bruck on one single-tier ring at one block size.
+
+    Pairwise pays ``n - 1`` message alphas at block granularity; Bruck
+    pays ``log2(n)`` round alphas at ``n/2``-block aggregate
+    granularity (each round's copies are priced at the coalesced
+    message a real kernel sends). Small blocks are alpha-bound — Bruck
+    wins; large blocks are volume-bound — pairwise's ``(n-1) * b``
+    total beats Bruck's ``log2(n) * n/2 * b``. Deterministic per
+    (n, block size, seed, rates); ``n`` must be a power of two."""
+    pair_costs = default_tier_costs(block_bytes, 0, ici=ici)
+    pairwise_s = simulate_all_to_all(n, Strategy(seed),
+                                     costs=pair_costs)
+    bruck_costs = default_tier_costs(n * block_bytes / 2.0, 0, ici=ici)
+    bruck_s = simulate_all_to_all(n, Strategy(seed), variant="bruck",
+                                  costs=bruck_costs)
+    return {
+        "n": n,
+        "block_bytes": block_bytes,
+        "pairwise_s": pairwise_s,
+        "bruck_s": bruck_s,
     }
 
 

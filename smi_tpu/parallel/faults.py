@@ -60,17 +60,19 @@ from typing import Dict, FrozenSet, Optional, Tuple
 from smi_tpu.parallel import credits as C
 
 #: The four ring protocols the plan can execute, keyed as the fault
-#: matrix names them. Values: (simulate_fn, kwargs_builder) — see
-#: :func:`run_under_faults`.
-PROTOCOLS = ("all_gather", "all_reduce", "reduce_scatter",
-             "neighbour_stream")
+#: matrix names them. Re-exported from the consolidated registry
+#: (:func:`credits.all_protocol_registries` — the ONE source of truth
+#: every analysis tier enumerates); this module keeps its historical
+#: names so the seed-pinned chaos campaign's draw set stays the same
+#: object, digest-tested in tests/test_alltoall.py.
+PROTOCOLS = C.PROTOCOLS
 
 #: Pipelined variants runnable through :func:`run_under_faults` but NOT
 #: part of the default chaos sweep (the seed-pinned campaign counts the
 #: four base protocols): ``all_reduce_chunked`` is the chunked
 #: double-buffered schedule of ``kernels/ring.py`` — ``chunks`` pipeline
 #: rows interleaving per ring step on their own slot pairs.
-CHUNKED_PROTOCOLS = ("all_reduce_chunked",)
+CHUNKED_PROTOCOLS = C.CHUNKED_PROTOCOLS
 
 #: Fault classes the matrix is exhaustive over. The last three damage
 #: payloads *in flight* — faults the credit protocol cannot see at all;
@@ -99,7 +101,14 @@ ELASTIC_FAULT_CLASSES = ("flapping_rank", "stalled_heartbeat")
 #: :data:`CHUNKED_PROTOCOLS`): ``allreduce_pod`` is the hierarchical
 #: rs(ICI) -> ring(DCN) -> ag(ICI) composition of
 #: :func:`credits.allreduce_pod_rank`.
-POD_PROTOCOLS = ("allreduce_pod",)
+POD_PROTOCOLS = C.POD_PROTOCOLS
+
+#: The all-to-all family (pairwise exchange / Bruck log-step /
+#: two-tier pod), runnable through :func:`run_under_faults` but NOT in
+#: the seed-pinned base sweep — same discipline as every
+#: post-seed registry. The Bruck variant refuses non-power-of-two
+#: rank counts loudly.
+ALLTOALL_PROTOCOLS = C.ALLTOALL_PROTOCOLS
 
 #: Serving-level fault classes, deliberately NOT in
 #: :data:`FAULT_CLASSES` (same seed-pinning rule as
@@ -704,10 +713,24 @@ def _simulate(protocol: str, n: int, strategy: C.Strategy,
             )
         C.simulate_allreduce_pod(slices, n // slices, strategy,
                                  faults=plan, verified=verified)
+    elif protocol == "all_to_all":
+        C.simulate_all_to_all(n, strategy, faults=plan,
+                              verified=verified)
+    elif protocol == "all_to_all_bruck":
+        C.simulate_all_to_all(n, strategy, variant="bruck",
+                              faults=plan, verified=verified)
+    elif protocol == "all_to_all_pod":
+        if n % slices:
+            raise ValueError(
+                f"all_to_all_pod needs n divisible by slices, got "
+                f"n={n} slices={slices}"
+            )
+        C.simulate_all_to_all_pod(slices, n // slices, strategy,
+                                  faults=plan, verified=verified)
     else:
         raise ValueError(
             f"unknown protocol {protocol!r}; known: "
-            f"{PROTOCOLS + CHUNKED_PROTOCOLS + POD_PROTOCOLS}"
+            f"{C.registered_protocols()}"
         )
 
 

@@ -112,6 +112,21 @@ class Communicator:
             mesh=self.mesh, axis_names=tuple(axis_names), topology=self.topology
         )
 
+    def alltoall_schedule(self):
+        """The pairwise all-to-all step schedule over THIS
+        communicator's current size
+        (:func:`smi_tpu.parallel.routing.alltoall_pairwise_schedule`):
+        per step, the (src, dst) logical-rank pairs the exchange
+        drives. Because it is derived from ``self.size``, the schedule
+        follows every membership change — a shrunk or regrown
+        communicator's schedule is exactly the smaller/larger
+        rotation over the surviving logical ranks, with every ordered
+        pair still covered exactly once (shrink/regrow compatibility,
+        property-tested in tests/test_alltoall.py)."""
+        from smi_tpu.parallel.routing import alltoall_pairwise_schedule
+
+        return alltoall_pairwise_schedule(self.size)
+
     def shrink(self, excluded_ranks) -> "Communicator":
         """Rebuild a healthy-subset communicator without the given ranks.
 

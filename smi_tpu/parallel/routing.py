@@ -706,6 +706,31 @@ def pod_slice_partition(topology: Topology, n_slices: int):
     return [devices[s * k:(s + 1) * k] for s in range(n_slices)]
 
 
+def alltoall_pairwise_schedule(n: int) -> List[List[Tuple[int, int]]]:
+    """The pairwise-exchange step schedule as data: step ``s`` (1-based
+    in protocol terms, list index ``s - 1`` here) pairs every rank
+    ``g`` with destination ``(g + s) % n`` — the exact rotation
+    ``credits.all_to_all_rank`` executes, exposed so launchers and the
+    membership layer can reason about which wires each step drives.
+
+    Invariants (property-tested): every ordered (src, dst) pair with
+    ``src != dst`` appears exactly once across the ``n - 1`` steps,
+    and within one step the send set is a permutation (each rank sends
+    once and receives once) — the schedule shape that lets a step's
+    exchanges share the fabric without head-of-line blocking. ``n``
+    follows the CURRENT communicator size, which is what makes the
+    schedule shrink/regrow-compatible: after a membership change the
+    surviving ranks' schedule is simply the smaller ``n``'s (see
+    :meth:`smi_tpu.parallel.mesh.Communicator.alltoall_schedule`).
+    """
+    if n < 1:
+        raise ValueError(f"need n >= 1 ranks, got {n}")
+    return [
+        [(g, (g + s) % n) for g in range(n)]
+        for s in range(1, n)
+    ]
+
+
 def egress_link_toward(
     src: Device,
     dst: Device,

@@ -18,6 +18,14 @@ from smi_tpu.serving.campaign import (
     serve_selftest,
 )
 from smi_tpu.serving.frontend import ServingFrontend, tenant_base_rank
+from smi_tpu.serving.moe import (
+    HOT_FACTOR,
+    MoeDispatcher,
+    expert_home,
+    moe_campaign,
+    route_tokens,
+    run_moe_cell,
+)
 from smi_tpu.serving.qos import (
     CLASS_ADMISSION_WAIT_TICKS,
     CLASS_DEADLINE_TICKS,
@@ -46,7 +54,9 @@ __all__ = [
     "CLASS_POOL_CEILING",
     "CLASS_PRIORITY",
     "CONSUME_RATE",
+    "HOT_FACTOR",
     "INTERACTIVE_P99_TICKS",
+    "MoeDispatcher",
     "MAX_STARVE_ROUNDS",
     "QOS_CLASSES",
     "Request",
@@ -57,8 +67,12 @@ __all__ = [
     "TRANSIT_TICKS",
     "WIRE_CREDITS",
     "WireLane",
+    "expert_home",
     "load_campaign",
+    "moe_campaign",
+    "route_tokens",
     "run_load_cell",
+    "run_moe_cell",
     "serve_selftest",
     "tenant_base_rank",
 ]

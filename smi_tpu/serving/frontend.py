@@ -119,7 +119,8 @@ class ServingFrontend:
         # backlog cap when a credit frees later (it stays parked and
         # may time out with a named shed instead)
         self.gate.admit_filter = lambda req: (
-            self._backlog(self._route_new(req.tenant, record=False))
+            self._backlog(self._route_new(req.tenant, record=False,
+                                          base=req.base_rank))
             < self.dst_cap
         )
         self.lanes = [WireLane(r) for r in range(n)]
@@ -199,24 +200,32 @@ class ServingFrontend:
 
     # -- submission -----------------------------------------------------
 
-    def submit(self, tenant: str, qos: str, chunks) -> Request:
+    def submit(self, tenant: str, qos: str, chunks,
+               base_rank: Optional[int] = None) -> Request:
         """One tenant request at the admission edge. Returns the
         :class:`Request` (admitted now, parked, or — when shed on the
         spot — raises the named
-        :class:`~smi_tpu.serving.qos.AdmissionRejected`)."""
+        :class:`~smi_tpu.serving.qos.AdmissionRejected`).
+        ``base_rank`` pins the stream's base destination (the MoE
+        expert-dispatch path); ``None`` keeps tenant-hash routing."""
         check_qos(qos)
+        if base_rank is not None and not 0 <= base_rank < self.n:
+            raise ValueError(
+                f"base_rank={base_rank} outside 0..{self.n - 1}"
+            )
         seq = self._tenant_seq.get(tenant, 0)
         self._tenant_seq[tenant] = seq + 1
         request = Request(
             tenant=tenant, qos=qos, chunks=tuple(chunks),
             arrived_at=self.clock.now(), stream_id=(tenant, seq),
+            base_rank=base_rank,
         )
         # per-destination backpressure: a route whose destination
         # already holds its stream-cap of credits (stalled consumer,
         # undetected death) sheds at the edge with a named error —
         # class-blind but destination-targeted, so one sick rank can
         # never starve admission to the healthy ones
-        dst = self._route_new(tenant, record=False)
+        dst = self._route_new(tenant, record=False, base=base_rank)
         if self._backlog(dst) >= self.dst_cap:
             raise self.gate.shed_named(
                 request, f"backpressure:rank{dst}"
@@ -224,7 +233,8 @@ class ServingFrontend:
         self.gate.offer(request, self.clock.now())
         return request
 
-    def _route_new(self, tenant: str, record: bool = True) -> int:
+    def _route_new(self, tenant: str, record: bool = True,
+                   base: Optional[int] = None) -> int:
         """Routing for a NEWLY admitted stream: the tenant's live
         owner, except that a *suspected* owner receives no new work —
         the phi-accrual two-threshold semantics (suspect = drain new
@@ -232,10 +242,12 @@ class ServingFrontend:
         divert to the heir-presumptive among unsuspected members;
         in-flight streams stay put (suspicion is reversible — flapping
         half-finished streams on a false positive would replay for
-        nothing)."""
+        nothing). ``base`` overrides the tenant hash (the explicit
+        MoE expert home); failover semantics are identical either
+        way."""
         from smi_tpu.parallel.recovery import heir_of
 
-        base = tenant_base_rank(tenant, self.n)
+        base = tenant_base_rank(tenant, self.n) if base is None else base
         owner = route_owner(self.view, base, self.n)
         if owner is None:  # pragma: no cover - last member can't die
             raise RuntimeError("no surviving rank to route to")
@@ -258,7 +270,7 @@ class ServingFrontend:
         self._stream_count += 1
         wal = ProgressLog(rank=index)
         wal.contribution = request.chunks
-        dst = self._route_new(request.tenant)
+        dst = self._route_new(request.tenant, base=request.base_rank)
         deadline = Deadline(
             float(request.deadline_ticks),
             clock=lambda: float(self.clock.now()),
@@ -378,7 +390,8 @@ class ServingFrontend:
                 # suspected, saturated rank) would abandon progress
                 # for nothing
                 continue
-            owner = self._route_new(st.request.tenant, record=False)
+            owner = self._route_new(st.request.tenant, record=False,
+                                    base=st.request.base_rank)
             # the dead consumer's partial state died with it: void
             # the stream's delivery record and replay everything
             # from the durable contribution on a fresh lane

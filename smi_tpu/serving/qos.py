@@ -135,7 +135,12 @@ class Request:
     ``stream_id`` is the tenant-scoped transient stream identity
     (tenant, per-tenant sequence number) — the serving analog of the
     reference's per-message transient channels. ``deadline_ticks``
-    defaults to the class budget.
+    defaults to the class budget. ``base_rank`` (>= 0) overrides the
+    tenant-hash routing with an explicit base destination — the MoE
+    expert-dispatch path, where the stream must reach a specific
+    expert's home rank; failover to heirs still rides
+    ``membership.route_owner`` on top of it. ``None`` keeps the hash
+    routing, byte-for-byte the pre-MoE behaviour.
     """
 
     tenant: str
@@ -144,6 +149,7 @@ class Request:
     arrived_at: int
     stream_id: Tuple[str, int] = ("", -1)
     deadline_ticks: Optional[int] = None
+    base_rank: Optional[int] = None
 
     def __post_init__(self):
         check_qos(self.qos)

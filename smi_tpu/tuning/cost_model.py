@@ -291,6 +291,154 @@ def allreduce_candidates(
     return [c for _, c in order]
 
 
+# ---------------------------------------------------------------------------
+# All-to-all algorithm costs
+# ---------------------------------------------------------------------------
+# ``payload_bytes`` is the TOTAL per-rank all-to-all payload (one
+# ``payload / n`` block per destination — the pod_wallclock pricing
+# convention). Pairwise pays n-1 alphas at block granularity; Bruck
+# pays log2(n) alphas at n/2-block aggregates (more volume, far fewer
+# launches — the latency-bound regime's winner); the two-tier form
+# crosses DCN once per destination slice with per_slice-block bundles.
+
+
+def pairwise_alltoall_us(payload_bytes: float, n: int,
+                         link: LinkModel) -> float:
+    """Pairwise exchange: ``n - 1`` steps, one block per link per
+    step."""
+    if n <= 1:
+        return 0.0
+    return link.step_us((n - 1) * payload_bytes / n, steps=n - 1)
+
+
+def bruck_alltoall_us(payload_bytes: float, n: int,
+                      link: LinkModel) -> float:
+    """Bruck log-step: ``log2 n`` rounds, each moving an ``n/2``-block
+    aggregate. Power-of-two ``n`` only — a non-power-of-two request is
+    a loud error, never a silently repriced fallback."""
+    if n < 1 or (n & (n - 1)):
+        raise ValueError(
+            f"the Bruck all-to-all needs a power-of-two rank count, "
+            f"got n={n}"
+        )
+    if n == 1:
+        return 0.0
+    rounds = n.bit_length() - 1
+    return link.step_us(rounds * payload_bytes / 2.0, steps=rounds)
+
+
+def hierarchical_alltoall_us(
+    payload_bytes: float, topo: TopologySpec,
+    ici: LinkModel, dcn: LinkModel,
+) -> float:
+    """Two-tier: in-slice exchange over ICI (``inner - 1`` steps of
+    ``outer``-block messages), then one DCN crossing per destination
+    slice (``outer - 1`` steps of ``inner``-block bundles)."""
+    ni, no = topo.inner or topo.n, topo.outer or 1
+    n = ni * no
+    block = payload_bytes / max(1, n)
+    t = 0.0
+    if ni > 1:
+        t += ici.step_us((ni - 1) * no * block, steps=ni - 1)
+    if no > 1:
+        t += dcn.step_us((no - 1) * ni * block, steps=no - 1)
+    return t
+
+
+def alltoall_advantage(
+    payload_bytes: float,
+    topo: TopologySpec,
+    link: LinkModel = LinkModel(),
+    dcn: Optional[LinkModel] = None,
+) -> float:
+    """Modeled speedup of the two-tier all-to-all over the best
+    eligible flat form (``> 1`` = two-tier wins); ``0.0`` off-pod."""
+    if not topo.hierarchical_eligible:
+        return 0.0
+    if dcn is None:
+        dcn = dcn_link_model()
+    # a flat exchange on a pod is gated by its slice-crossing steps:
+    # price the flat forms at the DCN rate (hierarchical_advantage's
+    # lockstep argument, applied to the rotating-partner schedule)
+    flat = pairwise_alltoall_us(payload_bytes, topo.n, dcn)
+    if topo.n >= 1 and not (topo.n & (topo.n - 1)):
+        flat = min(flat, bruck_alltoall_us(payload_bytes, topo.n, dcn))
+    hier = hierarchical_alltoall_us(payload_bytes, topo, link, dcn)
+    if hier <= 0.0:
+        return math.inf if flat > 0 else 0.0
+    return flat / hier
+
+
+class CandidateSet(List[Candidate]):
+    """A candidate table PLUS the candidates a structural gate
+    excluded (``excluded``) — the ``ScheduleCount`` pattern applied to
+    candidate filtering: callers keep receiving the plain ranked list,
+    and no-silent-caps consumers (``smi-tpu tune --explain``) can name
+    exactly which candidates were dropped and why instead of letting a
+    shorter table read as the whole search space."""
+
+    def __init__(self, feasible: Sequence[Candidate] = (),
+                 excluded: Sequence[Candidate] = ()):
+        super().__init__(feasible)
+        self.excluded: List[Candidate] = list(excluded)
+
+
+def alltoall_candidates(
+    payload_bytes: int,
+    topo: TopologySpec,
+    link: LinkModel = LinkModel(),
+    dcn: Optional[LinkModel] = None,
+) -> CandidateSet:
+    """Modeled candidate table for an all-to-all, best first.
+
+    Ties keep declaration order (``pairwise`` first — the fused
+    single-collective default). The Bruck variant is structurally
+    power-of-two-only: on other rank counts it lands on ``excluded``
+    with the refusal in its note, never silently missing. The
+    hierarchical variant appears only on hierarchical-eligible pods,
+    with the flat forms priced at the DCN rate there (their lockstep
+    steps are gated by slice-crossing hops).
+    """
+    if dcn is None:
+        dcn = dcn_link_model()
+    n = topo.n
+    flat_link = dcn if topo.hierarchical_eligible else link
+    flat_note = (", every step gated by DCN"
+                 if topo.hierarchical_eligible else "")
+    cands = [Candidate(
+        "pairwise", {"algorithm": "pairwise"},
+        modeled_us=pairwise_alltoall_us(payload_bytes, n, flat_link),
+        note=f"{n - 1} steps x payload/{n} per link" + flat_note,
+    )]
+    excluded = []
+    if n >= 1 and not (n & (n - 1)):
+        rounds = max(1, n.bit_length() - 1)
+        cands.append(Candidate(
+            "bruck", {"algorithm": "bruck"},
+            modeled_us=bruck_alltoall_us(payload_bytes, n, flat_link),
+            note=f"{rounds} log-steps x n/2-block aggregates"
+                 + flat_note,
+        ))
+    else:
+        excluded.append(Candidate(
+            "bruck", {"algorithm": "bruck"}, modeled_us=None,
+            note=(f"EXCLUDED: n={n} is not a power of two — the "
+                  f"Bruck schedule refuses loudly rather than pad"),
+        ))
+    if topo.hierarchical_eligible:
+        cands.append(Candidate(
+            "hierarchical", {"algorithm": "hierarchical"},
+            modeled_us=hierarchical_alltoall_us(
+                payload_bytes, topo, link, dcn
+            ),
+            note=(f"DCN crossed once per slice with "
+                  f"{topo.inner}-block bundles"),
+        ))
+    order = sorted(enumerate(cands),
+                   key=lambda ic: (ic[1].modeled_us, ic[0]))
+    return CandidateSet([c for _, c in order], excluded)
+
+
 def chunk_pipeline_us(
     payload_bytes: float, n: int, chunks: int, link: LinkModel,
     overlappable_us: float = 0.0,
@@ -338,19 +486,14 @@ def flash_fwd_vmem_bytes(bq: int, bk: int, d: int, itemsize: int) -> int:
     return tiles + scratch
 
 
-class FlashCandidates(List[Candidate]):
+class FlashCandidates(CandidateSet):
     """The feasible flash-tile candidate list, PLUS the candidates the
-    VMEM gate rejected (``excluded``) — the ``ScheduleCount`` pattern
-    applied to candidate filtering: existing callers keep receiving the
+    VMEM gate rejected (``excluded``) — :class:`CandidateSet`
+    specialized to the tile search: existing callers keep receiving the
     plain list they always did, and "no silent caps" consumers
     (``smi-tpu tune --explain``, the perf lint tier) can state exactly
     which targets were dropped and at what footprint instead of letting
     a silently shorter table read as the whole search space."""
-
-    def __init__(self, feasible: Sequence[Candidate] = (),
-                 excluded: Sequence[Candidate] = ()):
-        super().__init__(feasible)
-        self.excluded: List[Candidate] = list(excluded)
 
 
 def flash_block_candidates(

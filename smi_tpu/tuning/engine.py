@@ -67,6 +67,16 @@ RS_AG_MODEL_MARGIN = 4.0
 #: single-slice byte-identity invariant trivially intact.
 HIER_MODEL_MARGIN = 4.0
 
+#: Model-confidence margin for the all-to-all algorithm gate (same
+#: discipline): an unmeasured model ranking may pick Bruck or the
+#: two-tier form only when its modeled advantage over the pairwise
+#: default is at least this factor. Inside the band the fused
+#: ``lax.all_to_all`` compiles — at the pinned n=8 acceptance shape
+#: the pairwise/Bruck ratio is (n-1)/log2(n) ~ 2.3, inside the band,
+#: so an untuned program compiles byte-identically to the explicit
+#: pairwise form (invariant-tested).
+ALLTOALL_MODEL_MARGIN = 4.0
+
 
 def _valid_flash_block(v) -> bool:
     """A flash tile target the kernels can actually use: a positive
@@ -344,6 +354,137 @@ class PlanEngine:
             compute,
         )
 
+    def _alltoall_structural(self, algorithm: str,
+                             topo: cm.TopologySpec) -> bool:
+        """Can this shape run the algorithm at all? (Bruck needs a
+        power-of-two rank count, the two-tier form a multi-slice pod;
+        pairwise runs anywhere.)"""
+        if algorithm == "bruck":
+            return topo.n >= 1 and not (topo.n & (topo.n - 1))
+        if algorithm == "hierarchical":
+            return topo.hierarchical_eligible
+        return algorithm == "pairwise"
+
+    def use_alltoall(
+        self,
+        payload_bytes: int,
+        topo: cm.TopologySpec,
+        dtype: str = "float32",
+        algorithm: Optional[str] = None,
+        algorithm_layer: str = "env",
+    ) -> Tuple[str, str]:
+        """Trace-time algorithm gate for ``all_to_all(algorithm=None)``.
+
+        ``algorithm`` given = an explicit override (the
+        ``$SMI_TPU_ALLTOALL_ALGO`` env var) — it decides ALONE, and a
+        structurally impossible request (Bruck on a non-power-of-two
+        ring, hierarchical off-pod) is the CALLER's loud error, never
+        a silent fallback. Otherwise: per-bucket cache entry (skipped
+        with a fall-through when it names an algorithm this shape
+        cannot run — a cache written on one topology must not error a
+        trace on another), then the model where its advantage is
+        confidently (:data:`ALLTOALL_MODEL_MARGIN`) away from the
+        pairwise default, then pairwise — the fused single collective,
+        byte-for-byte what an untuned program compiles.
+        """
+        dk = self.device_kind()
+
+        def compute():
+            if algorithm is not None:
+                return algorithm, algorithm_layer
+            key = PlanKey("all_to_all", payload_bucket(payload_bytes),
+                          dtype, dk, _collective_topology(topo))
+            hit = self.cache.lookup(key)
+            if (hit is not None and "algorithm" in hit.knobs
+                    and self._alltoall_structural(
+                        str(hit.knobs["algorithm"]), topo)):
+                return str(hit.knobs["algorithm"]), "cache"
+            if topo.hierarchical_eligible:
+                advantage = cm.alltoall_advantage(
+                    payload_bytes, topo, link=self.link
+                )
+                if advantage >= ALLTOALL_MODEL_MARGIN:
+                    return "hierarchical", "model"
+            if topo.n >= 2 and not (topo.n & (topo.n - 1)):
+                # the flat-form comparison also applies ON a pod when
+                # the two-tier form did not confidently win: price the
+                # flat candidates at the tier that gates their lockstep
+                # steps there (DCN — the alltoall_candidates rule)
+                flat_link = (cm.dcn_link_model()
+                             if topo.hierarchical_eligible
+                             else self.link)
+                pairwise = cm.pairwise_alltoall_us(
+                    payload_bytes, topo.n, flat_link
+                )
+                bruck = cm.bruck_alltoall_us(
+                    payload_bytes, topo.n, flat_link
+                )
+                if bruck * ALLTOALL_MODEL_MARGIN <= pairwise:
+                    return "bruck", "model"
+            return "pairwise", "heuristic"
+
+        # exact payload, not the bucket (the use_rs_ag discipline): a
+        # bucket-wide memo would be first-call-wins across a model
+        # crossover inside one pow2 bucket
+        return self._memoized(
+            ("use_alltoall", payload_bytes, topo, dtype,
+             algorithm, algorithm_layer, dk),
+            compute,
+        )
+
+    def alltoall_plan(
+        self,
+        payload_bytes: int,
+        topo: cm.TopologySpec,
+        dtype: str = "float32",
+        device_kind: Optional[str] = None,
+    ) -> Plan:
+        """Full algorithm plan for an all-to-all — the ``tune
+        --explain all_to_all`` entry: all three candidates priced,
+        structurally excluded ones named with the reason (no silent
+        caps), the deciding layer per knob."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+        key = PlanKey("all_to_all", payload_bucket(payload_bytes),
+                      dtype, dk, _collective_topology(topo))
+        cands = cm.alltoall_candidates(payload_bytes, topo,
+                                       link=self.link)
+        knobs: Dict[str, object] = {}
+        decided: Dict[str, str] = {}
+        rationale = []
+        hit = self.cache.lookup(key)
+        if (hit is not None and "algorithm" in hit.knobs
+                and self._alltoall_structural(
+                    str(hit.knobs["algorithm"]), topo)):
+            knobs["algorithm"] = hit.knobs["algorithm"]
+            decided["algorithm"] = "cache"
+            rationale.append(
+                f"cache entry ({hit.provenance or 'measured sweep'}"
+                + (f", {hit.cost_us:.1f} us" if hit.cost_us is not None
+                   else "") + ")"
+            )
+            cands = cm.CandidateSet(
+                [Candidate(c.name, c.knobs, c.modeled_us,
+                           hit.cost_us if c.knobs.get("algorithm")
+                           == hit.knobs["algorithm"] else None, c.note)
+                 for c in cands],
+                cands.excluded,
+            )
+        else:
+            algo, layer = self.use_alltoall(payload_bytes, topo, dtype)
+            knobs["algorithm"] = algo
+            decided["algorithm"] = layer
+            rationale.append(
+                f"alpha-beta ranking (pairwise {topo.n - 1} alphas vs "
+                f"Bruck log2(n) aggregate steps; model engages only "
+                f"outside the {ALLTOALL_MODEL_MARGIN:g}x confidence "
+                f"band — inside it the fused pairwise collective "
+                f"compiles byte-identically)"
+            )
+        for dropped in cands.excluded:
+            rationale.append(f"excluded {dropped.name}: {dropped.note}")
+        return Plan(key=key, knobs=knobs, decided_by=decided,
+                    candidates=list(cands), rationale=rationale)
+
     def collective_chunks(
         self,
         family: str,
@@ -501,6 +642,28 @@ class PlanEngine:
                     self.allreduce_plan(kb * 1024, topo, dtype).explain()
                 )
             return "\n\n".join(parts)
+        if op in ("all_to_all", "alltoall"):
+            if slices is not None and slices > 1:
+                if n % slices:
+                    raise ValueError(
+                        f"n={n} ranks do not split into {slices} slices"
+                    )
+                topo = cm.TopologySpec(n=n, inner=n // slices,
+                                       outer=slices)
+                where = (f"{slices} slices x {n // slices} "
+                         f"ranks (ICI x DCN pod)")
+            else:
+                topo = cm.TopologySpec(n=n)
+                where = f"n={n} ranks"
+            parts = [
+                f"all_to_all over {where}, dtype={dtype}, device "
+                f"kind '{self.device_kind()}'"
+            ]
+            for kb in sizes_kb:
+                parts.append(
+                    self.alltoall_plan(kb * 1024, topo, dtype).explain()
+                )
+            return "\n\n".join(parts)
         if op == "flash_fwd":
             return "\n\n".join(
                 self.flash_plan(dtype=dt, windowed=w).explain()
@@ -527,7 +690,7 @@ class PlanEngine:
             )
         raise ValueError(
             f"unknown op {op!r}; explainable ops: all_reduce, "
-            f"flash_fwd, stencil_temporal, ring_all_reduce"
+            f"all_to_all, flash_fwd, stencil_temporal, ring_all_reduce"
         )
 
 
@@ -629,6 +792,33 @@ def planned_hierarchical(
         )[0]
     except Exception:
         return False if min_slices is None else outer >= min_slices
+
+
+def planned_alltoall(
+    payload_bytes: int,
+    n: int,
+    inner: int,
+    outer: int,
+    dtype: str,
+    algorithm: Optional[str] = None,
+) -> str:
+    """Trace-time all-to-all algorithm consult. ``algorithm`` carries
+    the explicit ``$SMI_TPU_ALLTOALL_ALGO`` override. Never raises; the
+    fallback is the fused pairwise collective — byte-for-byte what an
+    explicit ``algorithm='pairwise'`` call compiles."""
+    try:
+        return get_engine().use_alltoall(
+            payload_bytes,
+            cm.TopologySpec(
+                n=n,
+                inner=inner if outer and outer > 1 else None,
+                outer=outer if outer and outer > 1 else None,
+            ),
+            dtype,
+            algorithm=algorithm,
+        )[0]
+    except Exception:
+        return "pairwise" if algorithm is None else algorithm
 
 
 def planned_rs_ag(

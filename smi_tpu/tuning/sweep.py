@@ -211,6 +211,80 @@ def sweep_allreduce_hierarchical(
     return cache
 
 
+def sweep_alltoall(
+    comm,
+    sizes_kb: Sequence[int] = (64, 256, 1024, 4096),
+    runs: int = 5,
+    device_kind: Optional[str] = None,
+    verbose: bool = False,
+) -> PlanCache:
+    """Time the all-to-all candidates per payload size and persist the
+    winners as per-bucket ``algorithm`` entries — the ATLAS refinement
+    of the alpha-beta ranking. Candidates are structural: pairwise
+    always, Bruck only on power-of-two rank counts (skipped WITH a
+    printed line otherwise — never silently), hierarchical only on a
+    hybrid multi-slice communicator. Entries are keyed by the MEASURED
+    device kind and topology, so a CPU sweep can neither shadow a v5e
+    entry nor leak across pod shapes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from smi_tpu.parallel import collectives as coll
+
+    topo = cm.topology_from_comm(comm)
+    n = topo.n
+    dk = normalize_device_kind(
+        device_kind or jax.devices()[0].device_kind
+    )
+    spec = (P(tuple(comm.axis_names)) if len(comm.axis_names) > 1
+            else P(comm.axis_names[0]))
+    algos = ["pairwise"]
+    if n >= 2 and not (n & (n - 1)):
+        algos.append("bruck")
+    elif verbose:
+        print(f"  bruck: skipped (n={n} is not a power of two)")
+    if topo.hierarchical_eligible:
+        algos.append("hierarchical")
+    cache = PlanCache()
+
+    for kb in sizes_kb:
+        elems = max(n, (kb * 1024 // 4) // n * n)  # divisible by n
+        payload_bytes = elems * 4
+
+        def make(algorithm):
+            def shard_fn(x):
+                y = coll.all_to_all(x, comm, algorithm=algorithm)
+                return jnp.sum(y)[None]
+
+            fn = jax.jit(jax.shard_map(
+                shard_fn, mesh=comm.mesh, in_specs=P(),
+                out_specs=spec, check_vma=False,
+            ))
+            return lambda x: np.asarray(fn(x))
+
+        x = jnp.ones(elems, jnp.float32)
+        results = []
+        for algorithm in algos:
+            secs = _measure(make(algorithm), x, runs)
+            results.append((secs, algorithm))
+            if verbose:
+                print(
+                    f"  {kb:>7} KiB {algorithm:>12}: "
+                    f"{secs * 1e6:.1f} us"
+                )
+        secs, algorithm = min(results)
+        key = PlanKey("all_to_all", payload_bucket(payload_bytes),
+                      "float32", dk, _collective_topology(topo))
+        cache.put(key, CacheEntry(
+            {"algorithm": algorithm},
+            cost_us=secs * 1e6,
+            provenance=f"sweep:alltoall:{kb}KiB:n{n}",
+        ))
+    return cache
+
+
 def sweep_flash(
     s: int = 8192,
     d: int = 128,

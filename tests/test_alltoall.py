@@ -1,0 +1,714 @@
+"""All-to-all protocol family: transport, analysis, plan engine, CLI.
+
+The first non-ring/tree traffic shape, proven at every tier:
+
+- the three credits-simulator state machines (pairwise / Bruck /
+  two-tier pod) deliver correctly under random, adversarial, and
+  exhaustive schedules; flow control OFF admits a reachable clobber
+  (the credits' existence proof on a rotating-partner schedule);
+- the fault matrix holds: in-flight damage is a named IntegrityError
+  on framed transport and provable SilentCorruption on bare transport,
+  dropped grants deadlock, delays are tolerated, DCN cuts are named;
+- the simulated wall-clock comparisons are the acceptance numbers:
+  the two-tier variant beats flat pairwise on a 2x2 pod at >= 1 MiB
+  per-destination blocks, and Bruck beats pairwise on small blocks
+  while losing on large ones;
+- the consolidated registry (credits.all_protocol_registries) is the
+  one source of truth the fault layer re-exports and every analysis
+  tier enumerates — and the seed-pinned chaos draw set (PROTOCOLS)
+  did not grow;
+- the XLA-tier ``all_to_all`` is bit-identical across all three
+  algorithms and dtypes, resolves ``algorithm=None`` through the
+  env -> cache -> model -> heuristic ladder, and compiles untuned
+  byte-identically to the explicit pairwise form;
+- degenerate shapes hold: n=1 is the identity, empty per-destination
+  payloads survive the framing, uneven per-rank counts reassemble.
+"""
+
+import json
+import warnings
+
+import pytest
+
+from smi_tpu.parallel import credits as C
+from smi_tpu.parallel import faults as F
+from smi_tpu.parallel.routing import alltoall_pairwise_schedule
+from smi_tpu.tuning import cost_model as cm
+from smi_tpu.tuning.cache import CacheEntry, PlanCache
+from smi_tpu.tuning.engine import (
+    ALLTOALL_MODEL_MARGIN,
+    PlanEngine,
+    set_engine,
+)
+from smi_tpu.tuning.plan import PlanKey, payload_bucket
+
+pytestmark = pytest.mark.alltoall
+
+
+@pytest.fixture(autouse=True)
+def _fresh_engine():
+    yield
+    set_engine(None)
+
+
+def _engine(entries=None):
+    cache = PlanCache()
+    for key, knobs in (entries or {}).items():
+        cache.put(key, CacheEntry(knobs, cost_us=10.0,
+                                  provenance="test"))
+    return PlanEngine(cache=cache, device_kind="testdev")
+
+
+# ---------------------------------------------------------------------------
+# 1. Protocol state machines under the simulator
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 8])
+def test_pairwise_delivery_random_schedules(n):
+    for seed in range(8):
+        C.simulate_all_to_all(n, C.Strategy(seed))
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 8])
+def test_bruck_delivery_random_schedules(n):
+    for seed in range(8):
+        C.simulate_all_to_all(n, C.Strategy(seed), variant="bruck")
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (1, 3), (3, 1), (2, 2),
+                                   (2, 3), (3, 2)])
+def test_pod_delivery_random_schedules(shape):
+    slices, per_slice = shape
+    for seed in range(8):
+        C.simulate_all_to_all_pod(slices, per_slice, C.Strategy(seed))
+
+
+def test_adversarial_schedules_hold():
+    for n in (3, 4, 5):
+        for seed in range(6):
+            C.simulate_all_to_all(n, C.DelayDmaStrategy(seed))
+            C.simulate_all_to_all(n, C.FavourRankStrategy(0, seed))
+    for seed in range(6):
+        C.simulate_all_to_all(4, C.DelayDmaStrategy(seed),
+                              variant="bruck")
+        C.simulate_all_to_all_pod(2, 2, C.FavourSetStrategy({0, 1},
+                                                            seed))
+
+
+def test_exhaustive_tiny_spaces():
+    """Every schedule of the tiniest instances holds — the same
+    exhaustive bar the ring protocols clear."""
+    assert C.explore_all_schedules(
+        lambda: C.all_to_all_generators(2)
+    ) > 1
+    assert C.explore_all_schedules(
+        lambda: C.all_to_all_generators(2, "bruck")
+    ) > 1
+    assert C.explore_all_schedules(
+        lambda: C.all_to_all_pod_generators(2, 1)
+    ) > 1
+    assert C.explore_all_schedules(
+        lambda: C.all_to_all_pod_generators(1, 2)
+    ) > 1
+
+
+def test_budgeted_dfs_on_larger_spaces():
+    """Beyond-exhaustive spaces: the first N schedules in DFS order
+    hold, loudly truncated (the allow_budget honesty contract)."""
+    for make in (
+        lambda: C.all_to_all_generators(3),
+        lambda: C.all_to_all_generators(4, "bruck"),
+        lambda: C.all_to_all_pod_generators(2, 2),
+    ):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            count = C.explore_all_schedules(make, max_schedules=4000,
+                                            allow_budget=True)
+        assert count >= 4000
+
+
+def test_flow_control_off_admits_a_clobber():
+    """The credits' existence proof on the rotating-partner schedule:
+    slot reuse starts at n=4 (step 3 reuses step 1's slot), and with
+    flow control off some schedule clobbers it."""
+    with pytest.raises(C.ProtocolError):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            C.explore_all_schedules(
+                lambda: C.all_to_all_generators(4, flow_control=False),
+                max_schedules=200_000, allow_budget=True,
+            )
+
+
+def test_identity_shapes():
+    """n=1 (and the 1x1 pod) deliver the local blocks untouched."""
+    out = C.RingSimulator(C.all_to_all_generators(1),
+                          C.Strategy(0)).run()
+    assert out == [{0: "b0->0"}]
+    out = C.RingSimulator(C.all_to_all_pod_generators(1, 1),
+                          C.Strategy(0)).run()
+    assert out == [{("slice", 0): ("b0->0",)}]
+
+
+def test_empty_per_destination_payloads_survive_the_framing():
+    """A tenant routing zero tokens to an expert is an EMPTY block,
+    not a missing one: empty payloads move, verify, and deliver —
+    and in-flight damage to one is still a named IntegrityError."""
+    n = 3
+
+    def gens():
+        return [
+            C.all_to_all_rank(r, n, ["" for _ in range(n)])
+            for r in range(n)
+        ]
+
+    outputs = C.RingSimulator(
+        [C.verified_steps(g, r) for r, g in enumerate(gens())],
+        C.Strategy(0),
+    ).run()
+    for r in range(n):
+        assert outputs[r] == {src: "" for src in range(n)}
+    plan = F.FaultPlan.single(F.BitFlipPayload(0, nth=0))
+    with pytest.raises(C.IntegrityError) as err:
+        C.RingSimulator(
+            [C.verified_steps(g, r) for r, g in enumerate(gens())],
+            C.Strategy(0), faults=plan,
+        ).run()
+    assert err.value.kind == "checksum"
+
+
+def test_uneven_blocks_deliver():
+    """Uneven per-destination splits (with remainder): payload sizes
+    per (src, dst) pair differ and every one still lands at its
+    destination intact."""
+    n = 4
+
+    def block(src, dst):
+        return f"b{src}->{dst}" * ((src + dst) % 3)   # some empty
+
+    gens = [
+        C.all_to_all_rank(r, n, [block(r, d) for d in range(n)])
+        for r in range(n)
+    ]
+    outputs = C.RingSimulator(gens, C.Strategy(1)).run()
+    for r in range(n):
+        assert outputs[r] == {src: block(src, r) for src in range(n)}
+
+
+# ---------------------------------------------------------------------------
+# 2. Fault matrix
+# ---------------------------------------------------------------------------
+
+A2A = ("all_to_all", "all_to_all_bruck", "all_to_all_pod")
+
+
+@pytest.mark.parametrize("protocol", A2A)
+@pytest.mark.parametrize("fault_class", F.INTEGRITY_FAULT_CLASSES)
+def test_integrity_faults_detected_framed(protocol, fault_class):
+    for seed in range(4):
+        plan = F.FaultPlan.random(fault_class, 4, seed)
+        verdict = F.run_under_faults(protocol, 4, plan, verified=True)
+        assert verdict.detected, (protocol, fault_class, seed)
+        assert verdict.error_name == "IntegrityError"
+
+
+@pytest.mark.parametrize("protocol", A2A)
+def test_bare_transport_is_silent_corruption(protocol):
+    """The framing's existence proof, per variant: the same bit flip
+    on bare transport completes with wrong delivery."""
+    plan = F.FaultPlan.random("bit_flip_payload", 4, 3)
+    with pytest.raises(F.SilentCorruption):
+        F.run_under_faults(protocol, 4, plan, verified=False)
+
+
+def test_dropped_grant_deadlocks_the_credited_variants():
+    for protocol in ("all_to_all", "all_to_all_bruck"):
+        plan = F.FaultPlan.single(F.DroppedGrant(0, nth=0))
+        verdict = F.run_under_faults(protocol, 4, plan)
+        assert verdict.detected
+        assert verdict.error_name == "DeadlockError"
+        assert verdict.error.state is not None
+
+
+def test_delays_and_down_links():
+    for protocol in A2A:
+        v = F.run_under_faults(
+            protocol, 4,
+            F.FaultPlan.single(F.DelayedDma(1, nth=0, hold=50)),
+        )
+        assert v.tolerated, protocol
+        v = F.run_under_faults(
+            protocol, 4, F.FaultPlan.single(F.DownLink(0, 1)),
+        )
+        assert v.detected and v.error_name == "DeadlockError", protocol
+
+
+def test_dcn_faults_on_the_pod_variant():
+    """The DCN tier's characteristic faults against the two-tier
+    exchange: a severed slice pair deadlocks with a named dump, a
+    cross-slice-only delay is tolerated."""
+    v = F.run_under_faults(
+        "all_to_all_pod", 4,
+        F.FaultPlan.single(F.DcnLinkDown(0, 1, per_slice=2)),
+    )
+    assert v.detected and v.error_name == "DeadlockError"
+    v = F.run_under_faults(
+        "all_to_all_pod", 4,
+        F.FaultPlan.single(F.DcnDelay(0, nth=0, hold=60, per_slice=2)),
+    )
+    assert v.tolerated
+
+
+def test_bruck_refuses_non_power_of_two_loudly():
+    with pytest.raises(ValueError, match="power-of-two"):
+        F.run_under_faults("all_to_all_bruck", 6, None)
+    with pytest.raises(ValueError, match="power-of-two"):
+        C.all_to_all_generators(6, variant="bruck")
+    with pytest.raises(ValueError, match="power-of-two"):
+        cm.bruck_alltoall_us(1 << 20, 6, cm.LinkModel())
+
+
+# ---------------------------------------------------------------------------
+# 3. Registry consolidation
+# ---------------------------------------------------------------------------
+
+
+def test_fault_layer_reexports_the_consolidated_registry():
+    """faults.* are the SAME tuple objects credits declares — one
+    source of truth, no drift possible."""
+    assert F.PROTOCOLS is C.PROTOCOLS
+    assert F.CHUNKED_PROTOCOLS is C.CHUNKED_PROTOCOLS
+    assert F.POD_PROTOCOLS is C.POD_PROTOCOLS
+    assert F.ALLTOALL_PROTOCOLS is C.ALLTOALL_PROTOCOLS
+    flat = C.registered_protocols()
+    assert flat == (F.PROTOCOLS + F.CHUNKED_PROTOCOLS
+                    + F.POD_PROTOCOLS + F.ALLTOALL_PROTOCOLS)
+    # the seed-pinned chaos draw set did not grow
+    assert C.PROTOCOLS == ("all_gather", "all_reduce",
+                           "reduce_scatter", "neighbour_stream")
+    assert not set(C.ALLTOALL_PROTOCOLS) & set(C.PROTOCOLS)
+
+
+def test_unknown_protocol_error_names_the_registry():
+    with pytest.raises(ValueError, match="all_to_all_bruck"):
+        F.run_under_faults("ghost", 4, None)
+
+
+# ---------------------------------------------------------------------------
+# 4. Static verifier differential (mutants on the new family)
+# ---------------------------------------------------------------------------
+
+
+def test_mutants_convict_on_the_pairwise_exchange():
+    """dropped_wait starves the schedule (static AND dynamic agree);
+    reused_slot aliases the double buffer (a race the fuzzer sees as
+    a clobber)."""
+    from smi_tpu import analysis as A
+
+    rep = A.verify_generators(
+        lambda: A.mutant_generators("all_to_all", 3,
+                                    mutant="dropped_wait"),
+        protocol="all_to_all[dropped_wait]",
+    )
+    assert not rep.ok
+    # the dropped grant is both a conservation deficit (one unit short)
+    # and a guaranteed starvation — both named
+    assert "deadlock" in {f.check for f in rep.findings}
+    with pytest.raises(C.DeadlockError):
+        C.RingSimulator(
+            A.mutant_generators("all_to_all", 3, mutant="dropped_wait"),
+            C.Strategy(0), coarse=True,
+        ).run()
+
+    rep = A.verify_generators(
+        lambda: A.mutant_generators("all_to_all", 4,
+                                    mutant="reused_slot"),
+        protocol="all_to_all[reused_slot]",
+    )
+    assert not rep.ok
+    assert "slot-race" in {f.check for f in rep.findings}
+
+
+# ---------------------------------------------------------------------------
+# 5. Wall-clock acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_two_tier_beats_flat_pairwise_on_a_2x2_pod(monkeypatch):
+    """THE acceptance number: at >= 1 MiB per-destination blocks the
+    two-tier exchange beats flat pairwise on a 2x2 pod — the DCN
+    alphas drop from (n - per_slice) to (slices - 1) per rank, and
+    the slow tier is crossed with aggregated bundles."""
+    monkeypatch.delenv(cm.DCN_BETA_ENV, raising=False)
+    for block in (1 << 20, 4 << 20):
+        rep = C.alltoall_wallclock_comparison(2, 2, float(block))
+        assert rep["hierarchical_s"] < rep["pairwise_s"], rep
+    rep = C.alltoall_wallclock_comparison(2, 2, float(1 << 20))
+    assert round(rep["pairwise_s"] * 1e6, 1) == 1548.6
+    assert round(rep["hierarchical_s"] * 1e6, 1) == 957.4
+
+
+def test_bruck_beats_pairwise_small_and_loses_large(monkeypatch):
+    """The Bruck crossover the plan engine's model layer prices:
+    alpha-bound small blocks go log-step, volume-bound large blocks
+    go pairwise."""
+    monkeypatch.delenv(cm.DCN_BETA_ENV, raising=False)
+    small = C.alltoall_variant_wallclocks(8, 1024.0)
+    assert small["bruck_s"] < small["pairwise_s"], small
+    big = C.alltoall_variant_wallclocks(8, float(4 << 20))
+    assert big["pairwise_s"] < big["bruck_s"], big
+
+
+def test_wallclock_comparisons_are_deterministic(monkeypatch):
+    monkeypatch.delenv(cm.DCN_BETA_ENV, raising=False)
+    a = C.alltoall_wallclock_comparison(2, 3, float(1 << 18))
+    b = C.alltoall_wallclock_comparison(2, 3, float(1 << 18))
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# 6. The pairwise step schedule (routing/mesh exposure)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8])
+def test_pairwise_schedule_covers_every_ordered_pair_once(n):
+    steps = alltoall_pairwise_schedule(n)
+    assert len(steps) == n - 1
+    seen = set()
+    for step in steps:
+        srcs = [s for s, _ in step]
+        dsts = [d for _, d in step]
+        # within a step each rank sends once and receives once
+        assert sorted(srcs) == list(range(n))
+        assert sorted(dsts) == list(range(n))
+        seen.update(step)
+    assert seen == {(s, d) for s in range(n) for d in range(n)
+                    if s != d}
+
+
+def test_pairwise_schedule_matches_the_protocol():
+    """The exposed schedule IS the protocol's rotation: step s sends
+    to (g + s) % n."""
+    n = 5
+    steps = alltoall_pairwise_schedule(n)
+    for s, step in enumerate(steps, start=1):
+        assert step == [(g, (g + s) % n) for g in range(n)]
+
+
+def test_schedule_rejects_zero_ranks():
+    with pytest.raises(ValueError):
+        alltoall_pairwise_schedule(0)
+
+
+# ---------------------------------------------------------------------------
+# 7. Cost model + plan engine
+# ---------------------------------------------------------------------------
+
+
+def test_candidate_table_orders_by_modeled_cost():
+    link = cm.LinkModel()
+    small = cm.alltoall_candidates(4 << 10, cm.TopologySpec(n=8),
+                                   link=link)
+    assert small[0].name == "bruck"   # alpha-bound: log-step wins
+    large = cm.alltoall_candidates(64 << 20, cm.TopologySpec(n=8),
+                                   link=link)
+    assert large[0].name == "pairwise"   # volume-bound
+    assert not small.excluded and not large.excluded
+
+
+def test_candidate_table_excludes_bruck_loudly_off_pow2():
+    cands = cm.alltoall_candidates(1 << 20, cm.TopologySpec(n=6))
+    assert [c.name for c in cands] == ["pairwise"]
+    assert len(cands.excluded) == 1
+    assert cands.excluded[0].name == "bruck"
+    assert "power of two" in cands.excluded[0].note
+
+
+def test_candidate_table_prices_the_pod():
+    topo = cm.TopologySpec(n=4, inner=2, outer=2)
+    cands = cm.alltoall_candidates(4 << 20, topo)
+    names = [c.name for c in cands]
+    assert set(names) == {"pairwise", "bruck", "hierarchical"}
+    assert cands[0].name == "hierarchical"
+    assert cm.alltoall_advantage(4 << 20, topo) > 1.0
+    # off-pod: never advised
+    assert cm.alltoall_advantage(4 << 20, cm.TopologySpec(n=4)) == 0.0
+
+
+def test_engine_ladder_env_cache_model_heuristic():
+    topo8 = cm.TopologySpec(n=8)
+    eng = _engine()
+    # heuristic: inside the confidence band the fused pairwise wins
+    assert eng.use_alltoall(1 << 20, topo8) == ("pairwise", "heuristic")
+    # env override decides alone
+    assert eng.use_alltoall(1 << 20, topo8, algorithm="bruck") == (
+        "bruck", "env",
+    )
+    # model: (n-1)/log2(n) crosses the 4x margin at n=32, alpha-bound
+    topo32 = cm.TopologySpec(n=32)
+    algo, layer = eng.use_alltoall(4 << 10, topo32)
+    assert (algo, layer) == ("bruck", "model")
+    # cache outranks the model
+    key = PlanKey("all_to_all", payload_bucket(4 << 10), "float32",
+                  "testdev", "n32")
+    eng = _engine({key: {"algorithm": "pairwise"}})
+    assert eng.use_alltoall(4 << 10, topo32) == ("pairwise", "cache")
+    # the Bruck comparison also applies ON a pod when the two-tier
+    # form did not confidently win (review fix: the flat candidates
+    # are priced at the DCN tier that gates them there)
+    pod32 = cm.TopologySpec(n=32, inner=16, outer=2)
+    assert _engine().use_alltoall(4 << 10, pod32) == ("bruck", "model")
+
+
+def test_engine_cache_entry_falls_through_on_impossible_shapes():
+    """A cache entry naming an algorithm the current shape cannot run
+    (bruck on n=6) is skipped, not an error — and the fall-through
+    lands on the heuristic, never a silent bruck."""
+    key = PlanKey("all_to_all", payload_bucket(1 << 20), "float32",
+                  "testdev", "n6")
+    eng = _engine({key: {"algorithm": "bruck"}})
+    assert eng.use_alltoall(1 << 20, cm.TopologySpec(n=6)) == (
+        "pairwise", "heuristic",
+    )
+
+
+def test_alltoall_plan_names_exclusions_and_provenance():
+    eng = _engine()
+    plan = eng.alltoall_plan(1 << 20, cm.TopologySpec(n=6))
+    assert plan.knobs["algorithm"] == "pairwise"
+    assert plan.decided_by["algorithm"] == "heuristic"
+    assert any("excluded bruck" in r for r in plan.rationale)
+    key = PlanKey("all_to_all", payload_bucket(1 << 20), "float32",
+                  "testdev", "n8")
+    eng = _engine({key: {"algorithm": "bruck"}})
+    plan = eng.alltoall_plan(1 << 20, cm.TopologySpec(n=8))
+    assert plan.knobs["algorithm"] == "bruck"
+    assert plan.decided_by["algorithm"] == "cache"
+    bruck_row = next(c for c in plan.candidates if c.name == "bruck")
+    assert bruck_row.measured_us == 10.0
+
+
+def test_planned_alltoall_never_raises(monkeypatch):
+    from smi_tpu.tuning import engine as E
+
+    monkeypatch.setattr(E, "get_engine",
+                        lambda: (_ for _ in ()).throw(RuntimeError()))
+    assert E.planned_alltoall(1 << 20, 8, 8, 1, "float32") == "pairwise"
+    assert E.planned_alltoall(1 << 20, 8, 8, 1, "float32",
+                              algorithm="bruck") == "bruck"
+
+
+def test_explain_text_covers_alltoall():
+    eng = _engine()
+    text = eng.explain_text("all_to_all", n=8)
+    assert "pairwise" in text and "bruck" in text
+    assert "[heuristic]" in text
+    text = eng.explain_text("alltoall", n=6)
+    assert "excluded bruck" in text
+    text = eng.explain_text("all_to_all", n=8, slices=2)
+    assert "hierarchical" in text and "ICI x DCN pod" in text
+    with pytest.raises(ValueError, match="do not split"):
+        eng.explain_text("all_to_all", n=7, slices=2)
+
+
+# ---------------------------------------------------------------------------
+# 8. The XLA-tier collective (fake mesh, 8 CPU devices)
+# ---------------------------------------------------------------------------
+
+import jax                                                  # noqa: E402
+import jax.numpy as jnp                                     # noqa: E402
+import numpy as np                                          # noqa: E402
+from jax.sharding import PartitionSpec as P                 # noqa: E402
+
+import smi_tpu.__main__ as cli                              # noqa: E402
+from smi_tpu.parallel import collectives as coll            # noqa: E402
+from smi_tpu.parallel.mesh import (                         # noqa: E402
+    make_communicator,
+    make_hybrid_communicator,
+)
+
+DTYPES = [jnp.float32, jnp.bfloat16, jnp.int32]
+COUNTS = [1, 3, 7]   # odd per-destination counts: uneven tails
+
+
+def _run_alltoall(comm, x_host, algorithm, dtype=jnp.float32):
+    spec = (P(tuple(comm.axis_names)) if len(comm.axis_names) > 1
+            else P(comm.axis_names[0]))
+
+    def shard_fn(x):
+        return coll.all_to_all(x, comm, algorithm=algorithm)
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=comm.mesh, in_specs=spec, out_specs=spec,
+        check_vma=False,
+    ))
+    return np.asarray(fn(jnp.asarray(x_host, dtype)))
+
+
+@pytest.mark.parametrize("dtype", DTYPES,
+                         ids=[d.__name__ for d in DTYPES])
+@pytest.mark.parametrize("count", COUNTS)
+def test_xla_variants_bit_identical(dtype, count):
+    """All three algorithms are pure routing: bit-identical results
+    across dtypes and odd per-destination counts, and the delivered
+    layout is exactly 'output block s == rank s's input block r'."""
+    comm = make_communicator()
+    n = comm.size
+    x = np.arange(n * n * count * 2, dtype=np.float32).reshape(
+        n * n * count, 2
+    )
+    pair = _run_alltoall(comm, x, "pairwise", dtype)
+    bruck = _run_alltoall(comm, x, "bruck", dtype)
+    assert np.array_equal(pair, bruck)
+    pu = pair.reshape(n, n, count, 2)
+    xu = np.asarray(jnp.asarray(x, dtype)).reshape(n, n, count, 2)
+    for r in range(n):
+        for s in range(n):
+            assert np.array_equal(pu[r, s], xu[s, r]), (r, s)
+
+
+@pytest.mark.multislice
+def test_xla_hierarchical_bit_identical_on_the_pod():
+    hcomm = make_hybrid_communicator(n_slices=2)
+    n = hcomm.size
+    x = np.arange(n * n * 3, dtype=np.float32).reshape(n * n * 3, 1)
+    pair = _run_alltoall(hcomm, x, "pairwise")
+    hier = _run_alltoall(hcomm, x, "hierarchical")
+    assert np.array_equal(pair, hier)
+
+
+def test_untuned_compiles_byte_identically_to_pairwise():
+    """THE invariant: ``all_to_all(x, comm)`` with no env, no cache,
+    and the model inside its confidence band compiles the exact HLO
+    of an explicit ``algorithm='pairwise'`` call."""
+    comm = make_communicator()
+    n = comm.size
+    x = jnp.arange(n * n * 2, dtype=jnp.float32)
+
+    def lower(algorithm):
+        def shard_fn(v):
+            return coll.all_to_all(v, comm, algorithm=algorithm)
+
+        fn = jax.jit(jax.shard_map(
+            shard_fn, mesh=comm.mesh, in_specs=P(comm.axis_names[0]),
+            out_specs=P(comm.axis_names[0]), check_vma=False,
+        ))
+        return fn.lower(x).compile().as_text()
+
+    assert lower(None) == lower("pairwise")
+
+
+def test_xla_loud_errors(monkeypatch):
+    comm = make_communicator()
+    n = comm.size
+    x = jnp.arange(n * 2.0)
+    with pytest.raises(ValueError, match="ring"):
+        coll.all_to_all(x, comm, backend="ring")
+    with pytest.raises(ValueError, match="unknown all_to_all"):
+        coll.all_to_all(x, comm, algorithm="ghost")
+    with pytest.raises(ValueError, match="not\ndivisible|not divisible"):
+        coll.all_to_all(jnp.arange(float(n + 1)), comm)
+    monkeypatch.setenv(coll.ALLTOALL_ALGO_ENV, "fastest")
+    with pytest.raises(ValueError, match="SMI_TPU_ALLTOALL_ALGO"):
+        coll.all_to_all(x, comm)
+
+
+def test_env_override_is_the_operators_word(monkeypatch):
+    """$SMI_TPU_ALLTOALL_ALGO decides alone — including loudly
+    refusing a structurally impossible pin instead of silently
+    degrading to pairwise."""
+    comm = make_communicator()
+    n = comm.size
+    x = np.arange(n * n * 2, dtype=np.float32).reshape(n * n, 2)
+    monkeypatch.setenv(coll.ALLTOALL_ALGO_ENV, "bruck")
+    out = _run_alltoall(comm, x, None)
+    assert np.array_equal(out, _run_alltoall(comm, x, "bruck"))
+    # a bruck pin on a non-power-of-two comm refuses loudly at trace
+    if n == 8:
+        sub = make_communicator()   # fake 8-dev mesh: build a 6-rank
+        # check at the validation layer directly (no 6-device mesh
+        # here): the explicit-algorithm path raises before tracing
+        with pytest.raises(ValueError, match="power-of-two"):
+            coll.all_to_all(
+                jnp.arange(18.0),
+                type("FakeComm", (), {
+                    "size": 6, "axis_names": sub.axis_names,
+                    "mesh": sub.mesh,
+                })(),
+                algorithm="bruck",
+            )
+
+
+# ---------------------------------------------------------------------------
+# 9. Shrink/regrow compatibility of the step schedule
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_schedule_follows_membership_changes():
+    comm = make_communicator()
+    n = comm.size
+    assert comm.alltoall_schedule() == alltoall_pairwise_schedule(n)
+    shrunk = comm.shrink([1, 5])
+    sched = shrunk.alltoall_schedule()
+    assert sched == alltoall_pairwise_schedule(n - 2)
+    # every ordered survivor pair exactly once — the schedule follows
+    # the CURRENT size, so a regrown communicator recovers the full
+    # rotation
+    seen = {p for step in sched for p in step}
+    m = n - 2
+    assert seen == {(s, d) for s in range(m) for d in range(m)
+                    if s != d}
+    # regrow is called on the ORIGINAL communicator (the holder of the
+    # full rank order): the regrown schedule recovers the full rotation
+    regrown = comm.regrow([1, 5], [1, 5])
+    assert regrown.alltoall_schedule() == alltoall_pairwise_schedule(n)
+
+
+# ---------------------------------------------------------------------------
+# 10. CLI
+# ---------------------------------------------------------------------------
+
+
+def run_cli(*argv) -> int:
+    return cli.main(list(argv))
+
+
+def test_cli_tune_explain_alltoall(capsys):
+    assert run_cli("tune", "--explain", "all_to_all") == 0
+    out = capsys.readouterr().out
+    assert "pairwise" in out and "bruck" in out
+    assert "[heuristic]" in out or "[cache]" in out
+    assert run_cli("tune", "--explain", "alltoall", "--ranks", "6") == 0
+    assert "excluded bruck" in capsys.readouterr().out
+    assert run_cli("tune", "--explain", "all_to_all",
+                   "--slices", "2") == 0
+    assert "hierarchical" in capsys.readouterr().out
+
+
+def test_cli_tune_ops_alltoall_is_sweepable(capsys):
+    # unknown ops name the sweepable set including alltoall
+    assert run_cli("tune", "--ops", "ghost", "--cache",
+                   "/tmp/_nope.json") == 2
+    assert "alltoall" in capsys.readouterr().err
+
+
+def test_cli_lint_covers_the_family(capsys):
+    assert run_cli("lint", "--protocol", "all_to_all",
+                   "--protocol", "all_to_all_bruck",
+                   "--protocol", "all_to_all_pod", "--json") == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    names = {p["protocol"] for p in payload["protocols"]}
+    assert names == {"all_to_all", "all_to_all_bruck", "all_to_all_pod"}
+
+
+def test_cli_route_check_lint_names_bruck_shape(capsys):
+    from smi_tpu.__main__ import _check_lint
+
+    assert _check_lint(None, list(range(6))) == 0
+    out = capsys.readouterr().out
+    # the Bruck job was capped to the largest power of two and NAMED
+    assert "all_to_all_bruck[n=4]" in out
+    assert "all_to_all" in out

@@ -92,6 +92,9 @@ EXHAUSTIBLE = [
     ("neighbour_stream", {"n": 2, "chunks": 2}),
     ("neighbour_stream", {"n": 2, "chunks": 3}),
     ("all_reduce_chunked", {"n": 2, "chunks": 2}),
+    ("all_to_all", {"n": 2}),
+    ("all_to_all_bruck", {"n": 2}),
+    ("all_to_all_pod", {"n": 2, "slices": 2}),
 ]
 
 

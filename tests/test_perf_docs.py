@@ -245,15 +245,20 @@ def test_analysis_doc_quotes_the_shipped_checks():
     same drift discipline as docs/tuning.md. (Pure Python imports, no
     devices.)"""
     from smi_tpu import analysis
-    from smi_tpu.parallel import faults, traffic
+    from smi_tpu.parallel import credits, faults, traffic
 
     text = _read("docs/analysis.md")
     for check in analysis.CHECKS:
         assert f"`{check}`" in text, f"check {check} undocumented"
     for mutant in analysis.MUTANTS:
         assert f"`{mutant}`" in text, f"mutant {mutant} undocumented"
-    registered = (faults.PROTOCOLS + faults.CHUNKED_PROTOCOLS
-                  + faults.POD_PROTOCOLS)
+    # the consolidated registry is the enumeration every tier (and
+    # this doc) derives from; the fault layer's historical names must
+    # stay re-exports of the same tuples
+    registered = credits.registered_protocols()
+    assert registered == (faults.PROTOCOLS + faults.CHUNKED_PROTOCOLS
+                          + faults.POD_PROTOCOLS
+                          + faults.ALLTOALL_PROTOCOLS)
     for protocol in registered:
         assert f"`{protocol}`" in text, f"{protocol} undocumented"
     # the default shape grid covers exactly the registered protocols
@@ -455,7 +460,8 @@ def test_bench_scoreboard_baselines_pin_the_committed_artifacts():
     board = bench.scoreboard_fields()
     assert set(board) == {"stencil_gcells_per_chip",
                           "flash_train_tflops",
-                          "allreduce_payload_curve_us"}
+                          "allreduce_payload_curve_us",
+                          "alltoall_payload_curve_us"}
     for name, entry in board.items():
         assert entry["verdict"] == "pass", (name, entry)
         assert entry["measured"] is False
@@ -465,6 +471,11 @@ def test_bench_scoreboard_baselines_pin_the_committed_artifacts():
     assert curve["baseline"] == [
         ANALYTIC_EXPECTED_US[f"allreduce_n8_{kb}kib_us"]
         for kb in curve["payload_kib"]
+    ]
+    a2a = board["alltoall_payload_curve_us"]
+    assert a2a["baseline"] == [
+        ANALYTIC_EXPECTED_US[f"alltoall_n8_{kb}kib_us"]
+        for kb in a2a["payload_kib"]
     ]
     # live mode: a measured stencil run flips the verdict honestly
     live = bench.scoreboard_fields(r05["parsed"]["value"])
@@ -485,6 +496,86 @@ def test_bench_scoreboard_baselines_pin_the_committed_artifacts():
     payload["scoreboard"] = broken
     with pytest.raises(ValueError, match="verdict"):
         bench.render_line(payload)
+
+
+def test_alltoall_docs_quote_the_shipped_candidates_and_vectors(
+        monkeypatch):
+    """The r12 all-to-all sections (docs/tuning.md candidate table,
+    docs/analysis.md pricing conventions + skewed scope) must state
+    the candidates, the env override, the model margin, and the
+    simulated acceptance vectors the code ships — re-derived from the
+    deterministic simulator so the quoted numbers can never drift from
+    what tier-1 asserts. (Pure Python, no devices.)"""
+    from smi_tpu import analysis
+    from smi_tpu.parallel import collectives as coll_consts
+    from smi_tpu.parallel import credits as C
+    from smi_tpu.tuning import cost_model as cm
+    from smi_tpu.tuning.engine import ALLTOALL_MODEL_MARGIN
+
+    tuning = _read("docs/tuning.md")
+    for name in ("pairwise", "bruck", "hierarchical"):
+        assert f"`{name}`" in tuning, f"candidate {name} undocumented"
+    assert coll_consts.ALLTOALL_ALGO_ENV in tuning
+    assert f"{ALLTOALL_MODEL_MARGIN:g}x" in tuning
+    assert "power-of-two" in tuning
+    # the simulated 2x2 1 MiB-block acceptance vectors, re-derived at
+    # the published rates (no fleet $SMI_TPU_DCN_BETA leakage)
+    monkeypatch.delenv(cm.DCN_BETA_ENV, raising=False)
+    dcn = C.LinkCost(cm.DCN_ALPHA_S, cm.DCN_BETA_BYTES_PER_S)
+    rep = C.alltoall_wallclock_comparison(2, 2, float(1 << 20), dcn=dcn)
+    pair_us = f"{round(rep['pairwise_s'] * 1e6, 1):g}"
+    hier_us = f"{round(rep['hierarchical_s'] * 1e6, 1):g}"
+    for name in ("docs/tuning.md", "docs/analysis.md"):
+        text = _read(name)
+        assert pair_us in text, (
+            f"{name} does not quote the simulated flat pairwise "
+            f"wall-clock {pair_us} us — regenerate the all-to-all "
+            f"numbers"
+        )
+        assert hier_us in text, (
+            f"{name} does not quote the simulated two-tier wall-clock "
+            f"{hier_us} us — regenerate the all-to-all numbers"
+        )
+    # the committed expectations match the recomputed vectors exactly
+    assert analysis.ANALYTIC_EXPECTED_US[
+        "alltoall_pairwise_2x2_1mib_us"] == float(pair_us)
+    assert analysis.ANALYTIC_EXPECTED_US[
+        "alltoall_two_tier_2x2_1mib_us"] == float(hier_us)
+    # the skewed-routing scope is in the model grid AND documented
+    skewed = [s for s in analysis.DEFAULT_SCOPES if s.hot_rank >= 0]
+    assert skewed, "the skewed-routing scope left the default grid"
+    doc = _read("docs/analysis.md")
+    for scope in skewed:
+        assert f"hot_rank={scope.hot_rank}" in doc
+    assert "`hot_rank`" in doc
+
+
+def test_alltoall_registry_digest_is_pinned():
+    """The consolidated registry is digest-pinned: a registry edit is
+    a conscious, test-visible act — in particular the seed-pinned
+    chaos sweep's draw set (PROTOCOLS) can never grow silently."""
+    import hashlib
+
+    from smi_tpu.parallel import credits
+
+    regs = credits.all_protocol_registries()
+    assert list(regs) == ["PROTOCOLS", "CHUNKED_PROTOCOLS",
+                          "POD_PROTOCOLS", "ALLTOALL_PROTOCOLS"]
+    assert regs["PROTOCOLS"] == (
+        "all_gather", "all_reduce", "reduce_scatter",
+        "neighbour_stream",
+    )
+    digest = hashlib.sha256(repr(sorted(
+        (name, tuple(protos)) for name, protos in regs.items()
+    )).encode()).hexdigest()
+    assert digest == (
+        "e4c1b0ec1c5b858c0f5013e15f689f4b56fff45f677c55a949061b15"
+        "aaeddd5d"
+    ), (
+        f"protocol registries changed (digest {digest}) — if this is "
+        f"deliberate, update the pin AND confirm the seed-pinned "
+        f"chaos sweep (which draws from PROTOCOLS) is unaffected"
+    )
 
 
 def test_tuning_doc_quotes_the_seeded_knobs():
