@@ -914,6 +914,12 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         print(f"error: {' and '.join(picked)} are distinct campaigns; "
               f"pick one", file=sys.stderr)
         return 2
+    if getattr(args, "metrics", False) and not args.load:
+        print("error: --metrics applies only to --load (the serving "
+              "campaign is the tier with a metrics registry; the "
+              "base/--elastic/--moe campaigns report their own "
+              "gates)", file=sys.stderr)
+        return 2
     if args.load:
         return _cmd_chaos_load(args)
     if getattr(args, "moe", False):
@@ -1070,6 +1076,19 @@ def _cmd_chaos_load(args: argparse.Namespace) -> int:
             f" shed {sum(sum(s.values()) for s in cell['shed'].values())}"
             f" | interactive p99 {lat['p99']} ticks"
         )
+        if getattr(args, "metrics", False):
+            counters = cell["metrics"]["counters"]
+            obs = cell["obs"]
+            print(
+                f"{'metrics':>12}: "
+                + ", ".join(
+                    f"{k}={v}" for k, v in sorted(counters.items())
+                    if k.startswith(("admitted_total", "delivered_tot",
+                                     "shed_total", "epoch_bumps"))
+                )
+                + f" | events {obs['total_events']} "
+                f"(dropped {obs['dropped_events']})"
+            )
     print(
         f"{report['cells']} cells (seed {args.seed}), "
         f"{report['silent_corruptions']} silent corruptions, "
@@ -1184,9 +1203,22 @@ def cmd_serve(args: argparse.Namespace) -> int:
               "loop needs a mesh; only the deterministic smoke runs "
               "from the CLI)", file=sys.stderr)
         return 2
+    if args.json and getattr(args, "metrics", False):
+        print("error: --json and --metrics are exclusive output "
+              "modes (--json's full report already embeds the "
+              "metrics snapshot)", file=sys.stderr)
+        return 2
     report = serve_selftest(seed=args.seed)
     if args.json:
         print(json.dumps(report, indent=2))
+    elif getattr(args, "metrics", False):
+        # the deterministic metrics snapshot alone (scriptable): the
+        # registry's counters equal the gate's own bookkeeping
+        print(json.dumps(
+            {"metrics": report["metrics"], "obs": report["obs"],
+             "ok": report["ok"]},
+            indent=2, sort_keys=True,
+        ))
     else:
         lat = report["admission_latency"]
         print(f"selftest (seed {args.seed}): {report['verdict']}")
@@ -1213,6 +1245,78 @@ def cmd_serve(args: argparse.Namespace) -> int:
             f.write("\n")
         print(f"report -> {args.out}")
     return 0 if report["ok"] else 1
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    """``smi-tpu trace``: Perfetto/Chrome-trace export of registered
+    protocols (:mod:`smi_tpu.obs.trace`).
+
+    Runs the timestamped simulator over the selected protocols'
+    DEFAULT_SHAPES grid and writes one Chrome-trace JSON per instance
+    (open in Perfetto / ``chrome://tracing``): per-rank tracks, every
+    span attributed alpha/beta/serialization/idle by the static perf
+    decomposer, span sums asserted bit-identical to the simulator's
+    ``elapsed_seconds()``. Deterministic per ``--seed`` — same seed,
+    byte-identical files. With ``-o DIR`` one ``<name>.trace.json``
+    per instance; without, one combined JSON document on stdout.
+    """
+    from smi_tpu.analysis.verifier import DEFAULT_SHAPES
+    from smi_tpu.obs import trace as obs_trace
+
+    if args.all and args.protocols:
+        print("error: --all and --protocol are exclusive (--all "
+              "already selects every registered protocol)",
+              file=sys.stderr)
+        return 2
+    if not args.all and not args.protocols:
+        print("error: pick protocols with --protocol NAME "
+              "(repeatable) or trace every registered protocol with "
+              "--all", file=sys.stderr)
+        return 2
+    known = list(DEFAULT_SHAPES)
+    protocols = known if args.all else args.protocols
+    unknown = [p for p in protocols if p not in known]
+    if unknown:
+        print(f"error: unknown protocol(s) {unknown}; known: {known}",
+              file=sys.stderr)
+        return 2
+    if args.payload_kb is not None and args.payload_kb <= 0:
+        print(f"error: --payload-kb must be positive, got "
+              f"{args.payload_kb}", file=sys.stderr)
+        return 2
+    payload_bytes = float(
+        (args.payload_kb if args.payload_kb is not None else 4096)
+        * 1024
+    )
+    traces = obs_trace.trace_all(
+        protocols, payload_bytes=payload_bytes, seed=args.seed
+    )
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        for t in traces:
+            other = t["otherData"]
+            path = os.path.join(
+                args.out, obs_trace.trace_name(t) + ".trace.json"
+            )
+            with open(path, "wb") as f:
+                f.write(obs_trace.trace_to_json_bytes(t))
+            shape = ", ".join(
+                f"{k}={v}" for k, v in sorted(other["shape"].items())
+            )
+            print(
+                f"{other['protocol']} [{shape}]: makespan "
+                f"{other['makespan_us']:.1f} us, "
+                f"{len(t['traceEvents'])} events -> {path}"
+            )
+        print(f"{len(traces)} trace(s) (seed {args.seed}) -> "
+              f"{args.out}")
+    else:
+        sys.stdout.write(
+            obs_trace.trace_to_json_bytes(
+                {"traces": traces}
+            ).decode()
+        )
+    return 0
 
 
 def cmd_lint(args: argparse.Namespace) -> int:
@@ -2075,6 +2179,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "rank surfacing as named backpressure "
                         "(--trials/-n/--duration apply; "
                         "--protocols/--ranks/--max-faults do not)")
+    p.add_argument("--metrics", action="store_true",
+                   help="with --load: print each cell's metrics "
+                        "summary (admitted/shed/delivered counters + "
+                        "event counts) next to its verdict; the full "
+                        "deterministic snapshot always rides the "
+                        "JSON report")
     p.add_argument("--duration", type=int, default=None, metavar="TICKS",
                    help="ticks of open-loop traffic per --load/--moe "
                         "cell (defaults 240/120; --load/--moe only)")
@@ -2099,9 +2209,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "deterministic per seed)")
     p.add_argument("--json", action="store_true",
                    help="print the full cell report as JSON")
+    p.add_argument("--metrics", action="store_true",
+                   help="print only the deterministic metrics "
+                        "snapshot + event accounting as JSON (the "
+                        "scriptable surface; the full --json report "
+                        "carries it too)")
     p.add_argument("-o", "--out", default=None,
                    help="write the JSON report here")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "trace",
+        help="export Perfetto/Chrome traces of registered protocols "
+             "from the timestamped simulator (per-rank tracks, spans "
+             "attributed alpha/beta/serialization/idle, span sums "
+             "bit-identical to elapsed_seconds())",
+    )
+    p.add_argument("--protocol", action="append", default=None,
+                   dest="protocols", metavar="NAME",
+                   help="protocol to trace over its DEFAULT_SHAPES "
+                        "grid (repeatable); exclusive with --all")
+    p.add_argument("--all", action="store_true",
+                   help="trace every registered protocol")
+    p.add_argument("--seed", type=int, default=0,
+                   help="schedule seed (default 0; same seed -> "
+                        "byte-identical trace files)")
+    p.add_argument("--payload-kb", type=int, default=None,
+                   metavar="KB",
+                   help="total collective payload per instance "
+                        "(default 4096 KiB, the perf tier's "
+                        "PERF_PAYLOAD_BYTES)")
+    p.add_argument("-o", "--out", default=None, metavar="DIR",
+                   help="write one <protocol>_<shape>.trace.json per "
+                        "instance here (default: one combined JSON "
+                        "document on stdout)")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser(
         "traffic",

@@ -1,0 +1,76 @@
+"""Unified observability: flight recorder, metrics, Perfetto export.
+
+One structured event schema spans every step-clock machine in the
+stack — the credits simulator's primitives, the serving front-end's
+request lifecycle, and the membership/recovery control plane — feeding
+three consumers:
+
+- the always-on bounded **flight recorder**
+  (:class:`~smi_tpu.obs.events.FlightRecorder`), whose tail rides
+  every ``DeadlockError`` / ``WatchdogTimeout`` / ``IntegrityError`` /
+  ``AdmissionRejected`` so a failure names its causal history;
+- the **metrics registry**
+  (:class:`~smi_tpu.obs.metrics.MetricsRegistry`) with deterministic
+  JSON snapshots wired into campaign reports, ``serve --selftest
+  --metrics``, and the bench ``obs`` field — plus the
+  :class:`~smi_tpu.obs.metrics.SampleSink` timing substrate ROADMAP's
+  online-autotuning arc consumes;
+- the **Perfetto/Chrome-trace exporter**
+  (:func:`~smi_tpu.obs.trace.trace_protocol`), rendering per-rank
+  tracks from the timestamped simulator with every span attributed by
+  the PR 11 decomposer and span sums asserted bit-identical to
+  ``RingSimulator.elapsed_seconds()`` — ``smi-tpu trace`` is the CLI
+  surface.
+
+Everything is seeded-deterministic: same seed, byte-identical event
+stream, metrics snapshot, and trace file. docs/observability.md holds
+the schema table and metric catalog (drift-guarded).
+"""
+
+from smi_tpu.obs.events import (
+    DEFAULT_RECORDER_CAPACITY,
+    DEFAULT_TAIL_EVENTS,
+    EVENT_KINDS,
+    Event,
+    FlightRecorder,
+    attach_tail,
+    format_tail,
+)
+from smi_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    SampleSink,
+    payload_bucket,
+)
+from smi_tpu.obs.trace import (
+    TRACE_SCHEMA_VERSION,
+    trace_all,
+    trace_name,
+    trace_protocol,
+    trace_to_json_bytes,
+    validate_chrome_trace,
+)
+
+__all__ = [
+    "Counter",
+    "DEFAULT_RECORDER_CAPACITY",
+    "DEFAULT_TAIL_EVENTS",
+    "EVENT_KINDS",
+    "Event",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SampleSink",
+    "TRACE_SCHEMA_VERSION",
+    "attach_tail",
+    "format_tail",
+    "payload_bucket",
+    "trace_all",
+    "trace_name",
+    "trace_protocol",
+    "trace_to_json_bytes",
+    "validate_chrome_trace",
+]

@@ -226,6 +226,23 @@ def format_state_dump(state: dict) -> str:
         )
     if state.get("sems"):
         lines.append(f"  non-zero semaphores: {state['sems']}")
+    fr = state.get("flight_recorder")
+    if fr and fr.get("events"):
+        lines.append(
+            f"  flight recorder (last {len(fr['events'])} of "
+            f"{fr['total_events']} events, {fr['dropped_events']} "
+            f"dropped by the ring bound):"
+        )
+        for e in fr["events"]:
+            who = f" rank {e['rank']}" if "rank" in e else ""
+            detail = " ".join(
+                f"{k}={v}" for k, v in sorted(e.items())
+                if k not in ("seq", "tick", "plane", "kind", "rank")
+            )
+            lines.append(
+                f"    [{e['seq']}@t{e['tick']}]{who} {e['kind']}"
+                + (f" {detail}" if detail else "")
+            )
     return "\n".join(lines)
 
 
@@ -1496,16 +1513,34 @@ class RingSimulator:
       truncation, sequence swap). The simulator applies it blindly;
       detection is the verified-transport framing's job
       (:func:`verified_steps`).
+
+    ``recorder`` is an optional flight recorder (duck-typed — the
+    canonical implementation is
+    :class:`smi_tpu.obs.events.FlightRecorder`; this module never
+    imports the obs layer, the fault-plan discipline): every credit
+    grant/wait, barrier, and DMA start/landing emits a structured
+    event stamped with the scheduler's logical tick, and every
+    :class:`ProtocolError` leaving :meth:`run` (and every
+    :meth:`state_dump`) carries the recorder's bounded tail — a
+    deadlock names its causal history, not just its final state. With
+    no recorder the hot path is untouched (one ``is None`` test per
+    primitive).
     """
 
     def __init__(self, generators: Sequence[Iterator], strategy: Strategy,
                  coarse: bool = False, faults=None,
-                 costs: Optional[TierCostModel] = None):
+                 costs: Optional[TierCostModel] = None,
+                 recorder=None):
         self.gens = list(generators)
         self.n = len(self.gens)
         self.strategy = strategy
         self.coarse = coarse
         self.faults = faults
+        # structured-event hook (None = zero overhead); sim_tick is
+        # the scheduler's executed-event count — the logical clock
+        # every emitted event is stamped with
+        self.recorder = recorder
+        self.sim_tick = 0
         # wire-tier cost model: logical timestamps on every semaphore
         # increment + per-rank clocks -> simulated wall-clock
         self.costs = costs
@@ -1577,6 +1612,29 @@ class RingSimulator:
     def _link_down(self, a: int, b: int) -> bool:
         return self.faults is not None and self.faults.link_down(a, b)
 
+    # -- flight-recorder hooks (no-ops without a recorder) --
+    @staticmethod
+    def _obs_scalar(value):
+        """Semaphore indexes / slots may be tuples (phase domains,
+        per-round lanes); events carry JSON scalars."""
+        return value if isinstance(value, (int, float, str)) else str(value)
+
+    def _attach_recorder_tail(self, error: BaseException) -> None:
+        """Bounded causal history onto an escaping error — on the
+        ``recorder_tail`` attribute, and inside the structured
+        ``state`` dict when the error carries one. Never raises (the
+        tail must not mask the error it annotates)."""
+        if self.recorder is None:
+            return
+        try:
+            tail = self.recorder.tail()
+            error.recorder_tail = tail
+            state = getattr(error, "state", None)
+            if isinstance(state, dict):
+                state.setdefault("flight_recorder", tail)
+        except Exception:
+            pass
+
     # -- execution --
     def _runnable(self) -> List:
         out = []
@@ -1630,8 +1688,18 @@ class RingSimulator:
         action, _ = self.state[r]
         kind = action[0]
         self.actions_done[r] += 1
+        self.sim_tick += 1
         if kind == "wait":
             _, name, index, amount = action
+            if self.recorder is not None:
+                if name == SEM_CREDIT:
+                    self.recorder.emit(
+                        "credit.wait", self.sim_tick, rank=r,
+                        index=self._obs_scalar(index),
+                    )
+                elif name == SEM_BARRIER:
+                    self.recorder.emit("barrier.wait", self.sim_tick,
+                                       rank=r)
             self._add(r, name, index, -amount)
             if self.costs is not None:
                 self.clock[r] = max(
@@ -1651,6 +1719,17 @@ class RingSimulator:
                     )
             if name == SEM_CREDIT:
                 self.grants_done[r] += 1
+            if self.recorder is not None:
+                if name == SEM_CREDIT:
+                    extra = {} if mult == 1 else {"mult": mult}
+                    self.recorder.emit(
+                        "credit.grant", self.sim_tick, rank=r,
+                        src=r, dst=target,
+                        index=self._obs_scalar(index), **extra,
+                    )
+                elif name == SEM_BARRIER:
+                    self.recorder.emit("barrier.signal", self.sim_tick,
+                                       rank=r, src=r, dst=target)
             if mult:
                 self._add(target, name, index, inc * mult)
                 if self.costs is not None:
@@ -1674,6 +1753,11 @@ class RingSimulator:
                 tamper = getattr(self.faults, "tamper", None)
                 if tamper is not None:
                     payload = tamper(r, nth, payload)
+            if self.recorder is not None:
+                self.recorder.emit(
+                    "dma.start", self.sim_tick, rank=r,
+                    src=r, dst=target, slot=self._obs_scalar(slot),
+                )
             dma = _Dma(src=r, target=target, slot=slot, payload=payload,
                        send_index=send_index, recv_index=recv_index,
                        origin=(r, self.actions_done[r] - 1))
@@ -1728,6 +1812,13 @@ class RingSimulator:
     def _land_dma(self, i: int) -> None:
         dma = self.inflight[i]
         self.inflight[i] = None
+        self.sim_tick += 1
+        if self.recorder is not None:
+            self.recorder.emit(
+                "dma.land", self.sim_tick, rank=dma.target,
+                src=dma.src, dst=dma.target,
+                slot=self._obs_scalar(dma.slot),
+            )
         s = self._slot(dma.target, dma.slot)
         if s.full and not s.consumed:
             raise ClobberError(
@@ -1742,6 +1833,16 @@ class RingSimulator:
             )
 
     def run(self, max_steps: int = 1_000_000) -> List[Dict]:
+        try:
+            return self._run(max_steps)
+        except ProtocolError as e:
+            # a deadlock / clobber / integrity failure leaves with the
+            # recorder's bounded causal history attached (the dump in
+            # a DeadlockError.state already carries it via state_dump)
+            self._attach_recorder_tail(e)
+            raise
+
+    def _run(self, max_steps: int) -> List[Dict]:
         for _ in range(max_steps):
             if all(st is None for st in self.state) and not any(
                 d is not None for d in self.inflight
@@ -1801,6 +1902,13 @@ class RingSimulator:
             (d.src, d.target, d.slot) for d in self.undeliverable
         ]
         dump["sems"] = {k: v for k, v in self.sems.items() if v != 0}
+        if self.recorder is not None:
+            # the causal history behind the final state: bounded,
+            # dropped-event-counted (never silently truncated)
+            try:
+                dump["flight_recorder"] = self.recorder.tail()
+            except Exception:
+                pass
         return dump
 
     def _check_drained(self) -> None:
@@ -1965,13 +2073,14 @@ def _maybe_verified(gens: Sequence[Iterator], verified: bool):
 
 def simulate_all_gather(n: int, strategy: Strategy,
                         flow_control: bool = True, faults=None,
-                        verified: bool = False) -> None:
+                        verified: bool = False, recorder=None) -> None:
     gens = [
         all_gather_rank(r, n, f"chunk{r}", flow_control=flow_control)
         for r in range(n)
     ]
     outputs = RingSimulator(
-        _maybe_verified(gens, verified), strategy, faults=faults
+        _maybe_verified(gens, verified), strategy, faults=faults,
+        recorder=recorder,
     ).run()
     expected = {i: f"chunk{i}" for i in range(n)}
     for r in range(n):
@@ -1984,7 +2093,8 @@ def simulate_all_gather(n: int, strategy: Strategy,
 def simulate_all_reduce(n: int, strategy: Strategy,
                         flow_control: bool = True, faults=None,
                         verified: bool = False,
-                        costs: Optional[TierCostModel] = None) -> float:
+                        costs: Optional[TierCostModel] = None,
+                        recorder=None) -> float:
     gens = [
         all_reduce_rank(r, n, frozenset([r]), lambda a, b: a | b,
                         flow_control=flow_control)
@@ -1992,7 +2102,7 @@ def simulate_all_reduce(n: int, strategy: Strategy,
     ]
     sim = RingSimulator(
         _maybe_verified(gens, verified), strategy, faults=faults,
-        costs=costs,
+        costs=costs, recorder=recorder,
     )
     outputs = sim.run()
     want = frozenset(range(n))
@@ -2004,7 +2114,8 @@ def simulate_all_reduce(n: int, strategy: Strategy,
 
 def simulate_all_reduce_chunked(n: int, chunks: int, strategy: Strategy,
                                 flow_control: bool = True, faults=None,
-                                verified: bool = False) -> None:
+                                verified: bool = False,
+                                recorder=None) -> None:
     """Chunked pipelined all-reduce harness: rank ``r`` contributes
     ``frozenset({(r, c)})`` per chunk ``c``; every rank must finish
     holding the full per-chunk union — wrong delivery in ANY pipeline
@@ -2017,7 +2128,8 @@ def simulate_all_reduce_chunked(n: int, chunks: int, strategy: Strategy,
         for r in range(n)
     ]
     outputs = RingSimulator(
-        _maybe_verified(gens, verified), strategy, faults=faults
+        _maybe_verified(gens, verified), strategy, faults=faults,
+        recorder=recorder,
     ).run()
     want = {
         c: frozenset((src, c) for src in range(n)) for c in range(chunks)
@@ -2031,7 +2143,8 @@ def simulate_all_reduce_chunked(n: int, chunks: int, strategy: Strategy,
 
 def simulate_reduce_scatter(n: int, strategy: Strategy,
                             flow_control: bool = True,
-                            faults=None, verified: bool = False) -> None:
+                            faults=None, verified: bool = False,
+                            recorder=None) -> None:
     gens = [
         reduce_scatter_rank(
             r, n, [frozenset([(r, b)]) for b in range(n)],
@@ -2040,7 +2153,8 @@ def simulate_reduce_scatter(n: int, strategy: Strategy,
         for r in range(n)
     ]
     outputs = RingSimulator(
-        _maybe_verified(gens, verified), strategy, faults=faults
+        _maybe_verified(gens, verified), strategy, faults=faults,
+        recorder=recorder,
     ).run()
     for r in range(n):
         want = frozenset((src, r) for src in range(n))
@@ -2069,7 +2183,8 @@ def allreduce_pod_generators(slices: int, per_slice: int,
 def simulate_allreduce_pod(slices: int, per_slice: int, strategy: Strategy,
                            flow_control: bool = True, faults=None,
                            verified: bool = False,
-                           costs: Optional[TierCostModel] = None) -> float:
+                           costs: Optional[TierCostModel] = None,
+                           recorder=None) -> float:
     """Fuzz one schedule of the two-tier pod allreduce and verify that
     every rank holds the full per-block reduction — wrong delivery in
     ANY block of ANY phase is a :class:`ProtocolError`. Returns the
@@ -2080,7 +2195,7 @@ def simulate_allreduce_pod(slices: int, per_slice: int, strategy: Strategy,
             allreduce_pod_generators(slices, per_slice, flow_control),
             verified,
         ),
-        strategy, faults=faults, costs=costs,
+        strategy, faults=faults, costs=costs, recorder=recorder,
     )
     outputs = sim.run()
     want = {
@@ -2196,7 +2311,8 @@ def simulate_all_to_all(n: int, strategy: Strategy,
                         variant: str = "pairwise",
                         flow_control: bool = True, faults=None,
                         verified: bool = False,
-                        costs: Optional[TierCostModel] = None) -> float:
+                        costs: Optional[TierCostModel] = None,
+                        recorder=None) -> float:
     """Fuzz one schedule of a flat all-to-all variant and verify that
     every rank received exactly its per-source blocks — wrong delivery
     from ANY source is a :class:`ProtocolError`. Returns the simulated
@@ -2205,7 +2321,7 @@ def simulate_all_to_all(n: int, strategy: Strategy,
         _maybe_verified(
             all_to_all_generators(n, variant, flow_control), verified
         ),
-        strategy, faults=faults, costs=costs,
+        strategy, faults=faults, costs=costs, recorder=recorder,
     )
     outputs = sim.run()
     for r in range(n):
@@ -2235,7 +2351,8 @@ def simulate_all_to_all_pod(slices: int, per_slice: int,
                             strategy: Strategy,
                             flow_control: bool = True, faults=None,
                             verified: bool = False,
-                            costs: Optional[TierCostModel] = None) -> float:
+                            costs: Optional[TierCostModel] = None,
+                            recorder=None) -> float:
     """Fuzz one schedule of the two-tier pod all-to-all and verify
     delivery: every rank must hold, per source slice, the bundle of
     that slice's blocks for it (the bundles' concatenation IS the flat
@@ -2246,7 +2363,7 @@ def simulate_all_to_all_pod(slices: int, per_slice: int,
             all_to_all_pod_generators(slices, per_slice, flow_control),
             verified,
         ),
-        strategy, faults=faults, costs=costs,
+        strategy, faults=faults, costs=costs, recorder=recorder,
     )
     outputs = sim.run()
     for g in range(n):
@@ -2330,7 +2447,8 @@ def simulate_neighbour_stream(n: int, chunks: int, strategy: Strategy,
                               direction: int = 1,
                               flow_control: bool = True,
                               faults=None,
-                              verified: bool = False) -> None:
+                              verified: bool = False,
+                              recorder=None) -> None:
     gens = [
         neighbour_stream_rank(
             r, n, [(r, c) for c in range(chunks)],
@@ -2339,7 +2457,8 @@ def simulate_neighbour_stream(n: int, chunks: int, strategy: Strategy,
         for r in range(n)
     ]
     outputs = RingSimulator(
-        _maybe_verified(gens, verified), strategy, faults=faults
+        _maybe_verified(gens, verified), strategy, faults=faults,
+        recorder=recorder,
     ).run()
     for r in range(n):
         upstream = (r - direction) % n

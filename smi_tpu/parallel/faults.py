@@ -691,20 +691,25 @@ class Verdict:
 
 def _simulate(protocol: str, n: int, strategy: C.Strategy,
               plan: Optional[FaultPlan], chunks: int,
-              verified: bool = True, slices: int = 2) -> None:
+              verified: bool = True, slices: int = 2,
+              recorder=None) -> None:
     if protocol == "all_gather":
-        C.simulate_all_gather(n, strategy, faults=plan, verified=verified)
+        C.simulate_all_gather(n, strategy, faults=plan, verified=verified,
+                              recorder=recorder)
     elif protocol == "all_reduce":
-        C.simulate_all_reduce(n, strategy, faults=plan, verified=verified)
+        C.simulate_all_reduce(n, strategy, faults=plan, verified=verified,
+                              recorder=recorder)
     elif protocol == "reduce_scatter":
         C.simulate_reduce_scatter(n, strategy, faults=plan,
-                                  verified=verified)
+                                  verified=verified, recorder=recorder)
     elif protocol == "neighbour_stream":
         C.simulate_neighbour_stream(n, chunks, strategy, faults=plan,
-                                    verified=verified)
+                                    verified=verified,
+                                    recorder=recorder)
     elif protocol == "all_reduce_chunked":
         C.simulate_all_reduce_chunked(n, chunks, strategy, faults=plan,
-                                      verified=verified)
+                                      verified=verified,
+                                      recorder=recorder)
     elif protocol == "allreduce_pod":
         if n % slices:
             raise ValueError(
@@ -712,13 +717,15 @@ def _simulate(protocol: str, n: int, strategy: C.Strategy,
                 f"n={n} slices={slices}"
             )
         C.simulate_allreduce_pod(slices, n // slices, strategy,
-                                 faults=plan, verified=verified)
+                                 faults=plan, verified=verified,
+                                 recorder=recorder)
     elif protocol == "all_to_all":
         C.simulate_all_to_all(n, strategy, faults=plan,
-                              verified=verified)
+                              verified=verified, recorder=recorder)
     elif protocol == "all_to_all_bruck":
         C.simulate_all_to_all(n, strategy, variant="bruck",
-                              faults=plan, verified=verified)
+                              faults=plan, verified=verified,
+                              recorder=recorder)
     elif protocol == "all_to_all_pod":
         if n % slices:
             raise ValueError(
@@ -726,7 +733,8 @@ def _simulate(protocol: str, n: int, strategy: C.Strategy,
                 f"n={n} slices={slices}"
             )
         C.simulate_all_to_all_pod(slices, n // slices, strategy,
-                                  faults=plan, verified=verified)
+                                  faults=plan, verified=verified,
+                                  recorder=recorder)
     else:
         raise ValueError(
             f"unknown protocol {protocol!r}; known: "
@@ -742,6 +750,7 @@ def run_under_faults(
     chunks: int = 5,
     verified: bool = True,
     slices: int = 2,
+    recorder=None,
 ) -> Verdict:
     """Execute one ring protocol under a fault plan and classify.
 
@@ -757,11 +766,17 @@ def run_under_faults(
     every non-tampering fault); ``verified=False`` strips the framing,
     which is how the matrix proves the payload-tampering classes WOULD
     be silent corruption without it.
+
+    ``recorder`` (duck-typed flight recorder,
+    :class:`smi_tpu.obs.events.FlightRecorder`) threads through to the
+    simulator: a *detected* verdict's error then carries the bounded
+    event tail (``recorder_tail``) naming the causal history behind
+    the failure — what a campaign cell attaches to its evidence.
     """
     strategy = strategy if strategy is not None else C.Strategy(0)
     try:
         _simulate(protocol, n, strategy, plan, chunks, verified=verified,
-                  slices=slices)
+                  slices=slices, recorder=recorder)
     except DETECTED_ERRORS as e:
         return Verdict("detected", e)
     except C.ProtocolError as e:

@@ -309,6 +309,24 @@ class MembershipView:
     def dead(self) -> Set[int]:
         return set(range(self.n)) - self.members
 
+    def attach_recorder(self, recorder) -> "MembershipView":
+        """Attach a flight recorder (duck-typed,
+        :class:`smi_tpu.obs.events.FlightRecorder`): every epoch bump
+        — shrink or regrow — emits a ``ctl.shrink`` / ``ctl.regrow``
+        control-plane event stamped with the new epoch. Deliberately
+        an instance attribute, NOT a dataclass field: the model
+        checker fingerprints views by their fields, and an attached
+        recorder must never split behaviourally-identical states.
+        Returns ``self`` for chaining."""
+        self._recorder = recorder
+        return self
+
+    def _observe(self, kind: str, rank: int, reason: str) -> None:
+        recorder = getattr(self, "_recorder", None)
+        if recorder is not None:
+            recorder.emit(kind, self.epoch, rank=rank,
+                          epoch=self.epoch, reason=reason)
+
     def confirm_dead(self, rank: int) -> int:
         """Remove a rank under a new epoch; returns the new epoch."""
         if rank not in self.members:
@@ -320,6 +338,7 @@ class MembershipView:
         self.members.discard(rank)
         self.epoch += 1
         self.transitions.append((self.epoch, "dead", rank))
+        self._observe("ctl.shrink", rank, "confirmed-dead")
         return self.epoch
 
     def regrow(self, rank: int) -> int:
@@ -338,6 +357,7 @@ class MembershipView:
         self.incarnation[rank] += 1
         self.epoch += 1
         self.transitions.append((self.epoch, "regrow", rank))
+        self._observe("ctl.regrow", rank, "rejoin")
         return self.epoch
 
     def validate(self, rank: int, epoch: int, what: str = "message") -> None:

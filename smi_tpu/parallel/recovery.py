@@ -548,6 +548,7 @@ def run_with_recovery(
     max_attempts: int = 5,
     followup_plans: Sequence[Optional[F.FaultPlan]] = (),
     membership=None,
+    recorder=None,
 ) -> RecoveryOutcome:
     """Run one ring collective under a fault plan and heal it to
     completion.
@@ -571,6 +572,13 @@ def run_with_recovery(
     re-consults the view, unioning its dead set with whatever the
     raised error's state dump names. The error-parsing path is
     unchanged when no view is given.
+
+    ``recorder`` (duck-typed flight recorder,
+    :class:`smi_tpu.obs.events.FlightRecorder`) rides into every
+    attempt's simulator — wire-level events — and each recovery
+    transition emits a ``ctl.recover`` control-plane event (tick =
+    attempt number, reason = the attempt verdict), so a healed run's
+    history shows WHY it took the attempts it took.
     """
     inputs = canonical_inputs(protocol, n, chunks)
     expected = expected_results(protocol, n, inputs, chunks)
@@ -662,7 +670,7 @@ def run_with_recovery(
         try:
             C.RingSimulator(
                 gens, C.Strategy(strategy_seed + attempt),
-                faults=effective_plan,
+                faults=effective_plan, recorder=recorder,
             ).run()
         except F.DETECTED_ERRORS as e:
             failed = failed_ranks_of(e, ring)
@@ -684,6 +692,12 @@ def run_with_recovery(
                 failed_ranks=tuple(sorted(failed)),
                 replayed_chunks=0 if fresh else delivered,
             ))
+            if recorder is not None:
+                recorder.emit(
+                    "ctl.recover", attempt, protocol=protocol,
+                    reason=type(e).__name__,
+                    failed=str(sorted(failed)),
+                )
             if failed:
                 survivors = [r for r in survivors if r not in failed]
                 if not survivors:
@@ -705,6 +719,9 @@ def run_with_recovery(
             replayed_chunks=0 if fresh else moved,
             skipped_chunks=0 if fresh else done,
         ))
+        if recorder is not None and not fresh:
+            recorder.emit("ctl.recover", attempt, protocol=protocol,
+                          reason="resume-completed")
         break
     else:
         raise UnrecoverableError(

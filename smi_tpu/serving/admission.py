@@ -114,10 +114,27 @@ class AdmissionGate:
         tenant_burst: float = DEFAULT_TENANT_BURST,
         ceilings: Optional[Dict[str, float]] = None,
         wait_caps: Optional[Dict[str, int]] = None,
+        recorder=None,
+        metrics=None,
     ):
         if pool < 1:
             raise ValueError(f"pool must be >= 1, got {pool}")
         self.pool = pool
+        #: observability hooks (both optional, zero-cost when None):
+        #: ``recorder`` — a flight recorder
+        #: (:class:`smi_tpu.obs.events.FlightRecorder`) receiving one
+        #: ``serve.admit`` / ``serve.park`` / ``serve.shed`` event per
+        #: decision, and whose bounded tail rides every
+        #: :class:`AdmissionRejected`; ``metrics`` — a
+        #: :class:`smi_tpu.obs.metrics.MetricsRegistry` fed the
+        #: admitted/shed/parked counters, the per-(tenant, class)
+        #: admission-wait histogram, and the queue-depth gauge. The
+        #: counters are incremented at the SAME sites as the gate's
+        #: own accounting, so a metrics snapshot can never disagree
+        #: with the campaign report's bookkeeping.
+        self.recorder = recorder
+        self.metrics = metrics
+        self._now = 0
         self.tenant_rate = tenant_rate
         self.tenant_burst = tenant_burst
         self.ceilings = dict(ceilings or CLASS_POOL_CEILING)
@@ -181,6 +198,9 @@ class AdmissionGate:
                 )
         self.max_queue_depth = max(self.max_queue_depth,
                                    self.queue_depth())
+        if self.metrics is not None:
+            self.metrics.gauge("queue_depth").set(self.queue_depth())
+            self.metrics.gauge("pool_occupancy").set(occ)
 
     def _ceiling_slots(self, qos: str) -> int:
         return math.ceil(self.ceilings[qos] * self.pool)
@@ -205,6 +225,18 @@ class AdmissionGate:
             self.shed[request.qos].get(reason, 0) + 1
         )
         self.rejections.append(rejection)
+        if self.recorder is not None:
+            from smi_tpu.obs.events import attach_tail
+
+            self.recorder.emit(
+                "serve.shed", self._now, tenant=request.tenant,
+                qos=request.qos, reason=reason,
+            )
+            # a shed names its causal history, not just its reason
+            attach_tail(rejection, self.recorder)
+        if self.metrics is not None:
+            self.metrics.counter("shed_total", qos=request.qos,
+                                 reason=reason).inc()
         if self.on_shed is not None:
             self.on_shed(rejection, request)
         return rejection
@@ -214,6 +246,18 @@ class AdmissionGate:
         self.admitted[request.qos] += 1
         waited = now - request.arrived_at
         self.admission_waits[request.qos].append(waited)
+        if self.recorder is not None:
+            self.recorder.emit(
+                "serve.admit", now, tenant=request.tenant,
+                qos=request.qos, waited=waited,
+            )
+        if self.metrics is not None:
+            self.metrics.counter("admitted_total",
+                                 qos=request.qos).inc()
+            self.metrics.histogram(
+                "admission_wait_ticks", tenant=request.tenant,
+                qos=request.qos,
+            ).observe(waited)
         if self.on_admit is not None:
             self.on_admit(request, waited)
         self.assert_bounded()
@@ -229,6 +273,7 @@ class AdmissionGate:
         the spot. Deferred sheds (admission-timeout) surface through
         ``on_shed``/``rejections`` — every outcome is named either way.
         """
+        self._now = now
         bucket = self._buckets.get(request.tenant)
         if bucket is None:
             bucket = self._buckets[request.tenant] = TokenBucket(
@@ -247,6 +292,11 @@ class AdmissionGate:
         # a short burst above the ceiling parks: a credit may free
         # within the class's wait cap
         self.pending[request.qos].append(_Pending(request, now))
+        if self.recorder is not None:
+            self.recorder.emit("serve.park", now, tenant=request.tenant,
+                               qos=request.qos)
+        if self.metrics is not None:
+            self.metrics.counter("parked_total", qos=request.qos).inc()
         self.assert_bounded()
         return False
 
@@ -254,6 +304,7 @@ class AdmissionGate:
         """Drain the pending tier: shed requests that waited out their
         class cap, then admit in strict class-priority order while
         ceilings allow. Returns the newly admitted requests."""
+        self._now = now
         admitted: List[Request] = []
         for qos in QOS_CLASSES:
             queue = self.pending[qos]

@@ -191,6 +191,11 @@ def run_load_cell(
             requests_per_tick * mean_chunks, 3
         ),
         "capacity_chunks_per_tick": capacity,
+        # the deterministic metrics snapshot (smi_tpu.obs): its
+        # admitted/shed counters are incremented at the gate's own
+        # accounting sites, so they EQUAL the report's bookkeeping —
+        # tested, and the `--metrics` CLI surfaces quote it
+        "metrics": fe.metrics.snapshot(),
     })
 
     # -- gates ----------------------------------------------------------

@@ -60,12 +60,15 @@ from smi_tpu.parallel.membership import (
     SuspicionCleared,
     route_owner,
 )
+from smi_tpu.obs.events import FlightRecorder
+from smi_tpu.obs.metrics import MetricsRegistry
 from smi_tpu.parallel.credits import IntegrityError
 from smi_tpu.parallel.recovery import ProgressLog
 from smi_tpu.serving.admission import AdmissionGate, DEFAULT_POOL
 from smi_tpu.serving.qos import QOS_CLASSES, Request, check_qos
 from smi_tpu.serving.scheduler import (
     CONSUME_RATE,
+    WIRE_CREDITS,
     StreamScheduler,
     StreamState,
     WireLane,
@@ -92,17 +95,28 @@ class ServingFrontend:
         tenant_rate: float = 4.0,
         tenant_burst: float = 64.0,
         check_deadlines: bool = True,
+        recorder: Optional[FlightRecorder] = None,
+        metrics: Optional[MetricsRegistry] = None,
     ):
         if n < 2:
             raise ValueError(f"serving needs >= 2 ranks, got {n}")
         self.n = n
         self.rng = random.Random(f"serving:{n}:{seed}")
         self.clock = StepClock()
-        self.view = MembershipView(n)
+        # the observability spine is ALWAYS on (bounded ring buffer +
+        # O(label-set) registry — the recorder's tail rides every
+        # watchdog/integrity/admission error this front-end raises);
+        # callers may inject their own to aggregate across front-ends
+        self.recorder = recorder if recorder is not None \
+            else FlightRecorder()
+        self.metrics = metrics if metrics is not None \
+            else MetricsRegistry()
+        self.view = MembershipView(n).attach_recorder(self.recorder)
         self.detector = PhiAccrualDetector(self.clock, range(n))
         self.gate = AdmissionGate(
             pool=pool, tenant_rate=tenant_rate,
             tenant_burst=tenant_burst,
+            recorder=self.recorder, metrics=self.metrics,
         )
         self.gate.on_admit = self._on_admit
         #: per-destination accepted-stream cap: one saturated (or
@@ -127,6 +141,7 @@ class ServingFrontend:
         self.scheduler = StreamScheduler(
             check_deadlines=check_deadlines
         )
+        self.scheduler.on_send = self._observe_send
         self.consume_rate = consume_rate
         #: externally-killed ranks (stop heartbeating and consuming);
         #: membership catches up via phi-accrual
@@ -262,6 +277,17 @@ class ServingFrontend:
     def _backlog(self, rank: int) -> int:
         return sum(1 for st in self.active if st.dst == rank)
 
+    def _observe_send(self, stream, seq, lane, now) -> None:
+        """The scheduler's per-chunk hook: one ``serve.send`` event +
+        the sent-chunk counter, at the decision site."""
+        self.recorder.emit(
+            "serve.send", now, rank=lane.rank,
+            tenant=stream.request.tenant, qos=stream.request.qos,
+            chunk=seq, dst=lane.rank,
+        )
+        self.metrics.counter("sent_chunks_total",
+                             qos=stream.request.qos).inc()
+
     def _on_admit(self, request: Request, waited: int) -> None:
         """Acceptance: durable WAL contribution + deadline start +
         stream activation. From here on the request must be delivered
@@ -274,6 +300,7 @@ class ServingFrontend:
         deadline = Deadline(
             float(request.deadline_ticks),
             clock=lambda: float(self.clock.now()),
+            recorder=self.recorder,
         )
         self.active.append(StreamState(
             request=request, index=index, dst=dst,
@@ -315,6 +342,15 @@ class ServingFrontend:
             # gate fails the run
             self.silent_corruptions += 1
         self.delivered[st.request.qos] += 1
+        self.recorder.emit(
+            "serve.complete", st.completed_at, rank=st.dst,
+            tenant=st.request.tenant, qos=st.request.qos, dst=st.dst,
+        )
+        self.metrics.counter("delivered_total",
+                             qos=st.request.qos).inc()
+        self.metrics.histogram(
+            "stream_latency_ticks", qos=st.request.qos,
+        ).observe(st.completed_at - st.admitted_at)
         self.active.remove(st)
         self.completed.append(st)
         self.gate.release(st.request.qos, self.clock.now())
@@ -351,12 +387,15 @@ class ServingFrontend:
                         self.stale_epoch_rejections += 1
                     continue
                 try:
-                    payload = verify_chunk(lane, item)
+                    payload = verify_chunk(lane, item,
+                                           recorder=self.recorder)
                 except IntegrityError as e:
                     if e.kind == "checksum":
                         self.integrity_detections += 1
                     else:
                         self.resequenced += 1
+                    self.metrics.counter("integrity_errors_total",
+                                         kind=e.kind).inc()
                     if not st.complete and st.dst == lane.rank:
                         # replay from the receiver's expectation — the
                         # PR-2 discipline: only undelivered chunks move
@@ -366,18 +405,39 @@ class ServingFrontend:
                             self.replayed_chunks += delta
                             st.replayed_chunks += delta
                             st.next_to_send = want
+                            self._observe_replay(st, delta,
+                                                 "integrity")
                     continue
                 if st.complete or st.dst != lane.rank:
                     continue  # straggler to a failed-over route
                 st.delivered[item.seq] = payload
                 st.wal.record((st.index, item.seq), payload)
+                self.recorder.emit(
+                    "serve.consume", now, rank=lane.rank,
+                    tenant=st.request.tenant, qos=st.request.qos,
+                    chunk=item.seq, dst=lane.rank,
+                )
+                self.metrics.counter("consumed_chunks_total",
+                                     qos=st.request.qos).inc()
                 if st.complete:
                     self._complete(st)
+
+    def _observe_replay(self, st: StreamState, chunks: int,
+                        reason: str) -> None:
+        self.recorder.emit(
+            "serve.replay", self.clock.now(), rank=st.dst,
+            tenant=st.request.tenant, qos=st.request.qos,
+            chunks=chunks, reason=reason,
+        )
+        self.metrics.counter("replayed_chunks_total",
+                             reason=reason).inc(chunks)
 
     def _failover(self, dead: int) -> None:
         """Membership confirmed a death: shrink, re-route, replay."""
         old_epoch = self.view.epoch
         self.view.confirm_dead(dead)
+        self.metrics.counter("epoch_bumps_total",
+                             reason="shrink").inc()
         if self.detect_ticks is None and self._kill_tick is not None:
             self.detect_ticks = self.clock.now() - self._kill_tick
         self.lost_in_flight += self.lanes[dead].drop_all()
@@ -399,6 +459,8 @@ class ServingFrontend:
             st.delivered.clear()
             self.replayed_chunks += st.next_to_send
             st.replayed_chunks += st.next_to_send
+            if st.next_to_send:
+                self._observe_replay(st, st.next_to_send, "failover")
             st.next_to_send = 0
             st.lane_epoch = self.view.epoch
             st.dst = owner
@@ -422,10 +484,20 @@ class ServingFrontend:
         for tr in self.detector.poll():
             if isinstance(tr, SuspectRank):
                 self.suspected.append(tr.rank)
+                self.recorder.emit("ctl.suspect", now, rank=tr.rank,
+                                   reason=f"phi={tr.phi:.2f}")
+                self.metrics.counter("membership_transitions_total",
+                                     kind="suspect").inc()
             elif isinstance(tr, SuspicionCleared):
                 self.cleared.append(tr.rank)
+                self.recorder.emit("ctl.clear", now, rank=tr.rank)
+                self.metrics.counter("membership_transitions_total",
+                                     kind="clear").inc()
             elif isinstance(tr, ConfirmedDead):
                 self.confirmed.append(tr.rank)
+                self.recorder.emit("ctl.confirm", now, rank=tr.rank)
+                self.metrics.counter("membership_transitions_total",
+                                     kind="confirm").inc()
                 self._failover(tr.rank)
         self._consume()
         for lane in self.lanes:
@@ -448,6 +520,20 @@ class ServingFrontend:
             self.scheduler.schedule_lane(
                 lane, self.active, now, provider
             )
+            # wire-lane occupancy + credit stalls, AFTER scheduling:
+            # a zero-credit lane with chunks still to move is a
+            # stalled wire (the backpressure the credit chain exists
+            # to propagate) — counted per tick, per rank
+            self.metrics.gauge(
+                "wire_lane_occupancy", rank=lane.rank,
+            ).set(WIRE_CREDITS - lane.credits)
+            if lane.credits == 0 and any(
+                st.dst == lane.rank
+                and st.next_to_send < st.total_chunks
+                for st in self.active
+            ):
+                self.metrics.counter("credit_stall_ticks",
+                                     rank=lane.rank).inc()
         self.gate.pump(now)
         self.gate.assert_bounded()
 
@@ -507,5 +593,16 @@ class ServingFrontend:
             "queue_bound": gate.pool * (1 + len(QOS_CLASSES)),
             "admission_waits": {
                 c: list(gate.admission_waits[c]) for c in QOS_CLASSES
+            },
+            # the observability accounting: total/dropped event counts
+            # (dropped by the ring bound — counted, never silent) and
+            # the per-kind histogram of everything this run emitted
+            "obs": {
+                "total_events": self.recorder.total_events,
+                "dropped_events": self.recorder.dropped_events,
+                "recorder_capacity": self.recorder.capacity,
+                "event_counts": dict(sorted(
+                    self.recorder.counts.items()
+                )),
             },
         }

@@ -109,15 +109,23 @@ class AdmissionRejected(RuntimeError):
         self.qos = qos
         self.queue_depth = queue_depth
         self.reason = reason
+        #: bounded flight-recorder tail attached by the gate when a
+        #: recorder is wired (:mod:`smi_tpu.obs.events`) — the causal
+        #: history behind the shed, riding the error itself
+        self.recorder_tail: Optional[dict] = None
 
     def __reduce__(self):
         # exceptions pickle as cls(*args), but args holds the rendered
         # message, not the constructor fields — without this, a gate
         # whose rejection audit trail is copied (the model checker
         # forks worlds; campaign reports deep-copy cells) dies with a
-        # TypeError instead of round-tripping
-        return (type(self), (self.tenant, self.qos,
-                             self.queue_depth, self.reason))
+        # TypeError instead of round-tripping. The third element
+        # (state dict) keeps the flight-recorder tail on the copy.
+        return (
+            type(self),
+            (self.tenant, self.qos, self.queue_depth, self.reason),
+            {"recorder_tail": self.recorder_tail},
+        )
 
 
 def check_qos(qos: str) -> str:

@@ -180,6 +180,12 @@ class StreamScheduler:
             )
         self.check_deadlines = check_deadlines
         self.max_starve_rounds = max_starve_rounds
+        #: optional per-chunk observation hook,
+        #: ``on_send(stream, seq, lane, now)`` — called after every
+        #: issued send (the front-end wires its flight recorder /
+        #: metrics here; None = zero overhead). Observation only: the
+        #: scheduling decision is already made when it fires.
+        self.on_send: Optional[Callable] = None
 
     def _order(self, eligible: List[StreamState]) -> List[StreamState]:
         """Starved streams first (aging bound), then strict class
@@ -233,19 +239,26 @@ class StreamScheduler:
             )
             chosen.next_to_send += 1
             sent += 1
+            if self.on_send is not None:
+                self.on_send(chosen, seq, lane, now)
         return sent
 
 
-def verify_chunk(lane: WireLane, item: _InFlight) -> object:
+def verify_chunk(lane: WireLane, item: _InFlight,
+                 recorder=None) -> object:
     """Receiver-side verdict on one landed chunk: CRC, then dense
     per-lane sequence — the :func:`credits.verified_steps` discipline
     at the serving tier. Returns the payload; raises
-    :class:`~smi_tpu.parallel.credits.IntegrityError` naming the miss.
+    :class:`~smi_tpu.parallel.credits.IntegrityError` naming the miss
+    — carrying the ``recorder``'s bounded event tail
+    (``recorder_tail``) when one is wired, so a wire-damage detection
+    names the serving history that led to it.
     """
     frame = item.frame
+    error = None
     want = frame_crc(frame.src, frame.seq, frame.wire, frame.payload)
     if want != frame.crc:
-        raise IntegrityError(
+        error = IntegrityError(
             f"rank {lane.rank}: checksum mismatch on chunk "
             f"seq={frame.seq} of stream {item.stream.request.stream_id}"
             f": frame declares crc={frame.crc:#010x} but payload "
@@ -253,15 +266,22 @@ def verify_chunk(lane: WireLane, item: _InFlight) -> object:
             rank=lane.rank, src=frame.src, seq=frame.seq,
             expected=frame.crc, got=want, kind="checksum",
         )
-    key = item.stream.lane_key
-    expected = lane.next_seq.get(key, 0)
-    if frame.seq != expected:
-        raise IntegrityError(
-            f"rank {lane.rank}: out-of-sequence chunk of stream "
-            f"{item.stream.request.stream_id}: expected "
-            f"seq={expected}, got seq={frame.seq}",
-            rank=lane.rank, src=frame.src, seq=frame.seq,
-            expected=expected, got=frame.seq, kind="sequence",
-        )
-    lane.next_seq[key] = expected + 1
+    else:
+        key = item.stream.lane_key
+        expected = lane.next_seq.get(key, 0)
+        if frame.seq != expected:
+            error = IntegrityError(
+                f"rank {lane.rank}: out-of-sequence chunk of stream "
+                f"{item.stream.request.stream_id}: expected "
+                f"seq={expected}, got seq={frame.seq}",
+                rank=lane.rank, src=frame.src, seq=frame.seq,
+                expected=expected, got=frame.seq, kind="sequence",
+            )
+    if error is not None:
+        if recorder is not None:
+            from smi_tpu.obs.events import attach_tail
+
+            attach_tail(error, recorder)
+        raise error
+    lane.next_seq[item.stream.lane_key] = frame.seq + 1
     return frame.payload

@@ -61,6 +61,10 @@ def timed(
     fn: Callable[[], Any],
     deadline_s: Optional[float] = None,
     state_provider: Optional[Callable[[], str]] = None,
+    sink=None,
+    op: str = "timed",
+    payload_bytes: Optional[float] = None,
+    tenant: Optional[str] = None,
 ) -> Tuple[Any, float]:
     """Run ``fn`` and return (result, elapsed seconds).
 
@@ -76,6 +80,17 @@ def timed(
     ``state_provider``'s protocol-state dump when one is given (e.g.
     :func:`smi_tpu.parallel.faults.mirror_state_provider`) — instead of
     a stuck host. Defaults to ``$SMI_WATCHDOG_SECS`` when unset.
+
+    ``sink`` streams the measurement into the observability layer
+    without any call-site change to the timing itself: an object with
+    a ``record(op, seconds, payload_bytes=, tenant=)`` method (the
+    :class:`smi_tpu.obs.metrics.SampleSink` shape — the live-sample
+    substrate online autotuning consumes), or any plain callable taking
+    ``(op, seconds)``. ``op`` / ``payload_bytes`` / ``tenant`` label
+    the sample; with ``sink=None`` (the default) behaviour is
+    byte-for-byte the pre-hook ``timed``. A sink failure propagates —
+    a measurement pipeline that silently drops samples would corrupt
+    every decision made on them.
     """
     import numpy as np
 
@@ -96,4 +111,12 @@ def timed(
         deadline_s, state_provider=state_provider,
         context="timed() readback",
     )
-    return result, time.perf_counter() - t0
+    elapsed = time.perf_counter() - t0
+    if sink is not None:
+        record = getattr(sink, "record", None)
+        if record is not None:
+            record(op, elapsed, payload_bytes=payload_bytes,
+                   tenant=tenant)
+        else:
+            sink(op, elapsed)
+    return result, elapsed

@@ -70,6 +70,24 @@ class WatchdogTimeout(TimeoutError):
         self.state = state
 
 
+def _attach_recorder_tail(error: BaseException, recorder) -> None:
+    """Bounded flight-recorder tail onto a timeout in flight
+    (duck-typed — this module stays importable without the obs layer,
+    the protocol-mirror discipline): ``error.recorder_tail`` always,
+    and a ``flight_recorder`` entry inside the structured ``state``
+    dict when the error carries one. Never raises."""
+    if recorder is None:
+        return
+    try:
+        tail = recorder.tail()
+        error.recorder_tail = tail
+        state = getattr(error, "state", None)
+        if isinstance(state, dict):
+            state.setdefault("flight_recorder", tail)
+    except Exception:
+        pass
+
+
 class Deadline:
     """A monotonic time budget shared across the steps of one operation.
 
@@ -77,16 +95,22 @@ class Deadline:
     calls :meth:`check` (or reads :meth:`remaining` for a blocking
     wait's own timeout). ``state_provider`` is a zero-arg callable
     returning the dump to attach on expiry (e.g.
-    ``faults.mirror_state_provider("reduce", n)``).
+    ``faults.mirror_state_provider("reduce", n)``). ``recorder`` is an
+    optional flight recorder (:mod:`smi_tpu.obs.events`): an expiring
+    deadline then carries the recorder's bounded event tail next to
+    the protocol mirror — the hang's causal history, not just its
+    final state.
     """
 
     def __init__(self, seconds: Optional[float],
                  state_provider: Optional[Callable[[], str]] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 recorder=None):
         if seconds is not None and seconds < 0:
             raise ValueError(f"deadline must be >= 0, got {seconds}")
         self.budget = seconds
         self.state_provider = state_provider
+        self.recorder = recorder
         self._clock = clock
         self._start = clock()
 
@@ -125,12 +149,14 @@ class Deadline:
             return
         where = f" during {context}" if context else ""
         text, state = self._dump()
-        raise WatchdogTimeout(
+        error = WatchdogTimeout(
             f"deadline of {self.budget:.3g}s exceeded{where} "
             f"(elapsed {self.elapsed():.3g}s)",
             state_dump=text, state=state,
             elapsed=self.elapsed(), budget=self.budget,
         )
+        _attach_recorder_tail(error, self.recorder)
+        raise error
 
     def with_provider(self, state_provider: Callable[[], str]) -> "Deadline":
         """Same running clock, different dump source — lets inner layers
@@ -138,6 +164,7 @@ class Deadline:
         d = Deadline.__new__(Deadline)
         d.budget = self.budget
         d.state_provider = state_provider
+        d.recorder = self.recorder
         d._clock = self._clock
         d._start = self._start
         return d
@@ -184,6 +211,7 @@ def run_with_deadline(
     seconds: Optional[float],
     state_provider: Optional[Callable[[], str]] = None,
     context: str = "",
+    recorder=None,
 ) -> Any:
     """Run ``fn()`` with a hard time budget.
 
@@ -229,12 +257,14 @@ def run_with_deadline(
             if isinstance(dump, tuple) and len(dump) == 2:
                 dump, state = dump
         where = f" during {context}" if context else ""
-        raise WatchdogTimeout(
+        error = WatchdogTimeout(
             f"hard watchdog of {seconds:.3g}s exceeded{where} — the "
             f"device call did not complete (worker thread abandoned)",
             state_dump=dump, state=state,
             elapsed=time.monotonic() - start, budget=seconds,
-        ) from None
+        )
+        _attach_recorder_tail(error, recorder)
+        raise error from None
     if kind == "err":
         raise value
     return value

@@ -841,3 +841,99 @@ def test_lint_model_usage_errors(capsys):
     assert run_cli("lint", "--model", "--all", "--scope",
                    "tenants=2") == 2
     assert "mutually exclusive" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# trace + --metrics (the observability tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.obs
+def test_trace_writes_validated_deterministic_files(tmp_path, capsys):
+    out = tmp_path / "traces"
+    assert run_cli("trace", "--protocol", "allreduce_pod", "--seed",
+                   "7", "-o", str(out)) == 0
+    printed = capsys.readouterr().out
+    assert "3 trace(s) (seed 7)" in printed
+    files = sorted(p.name for p in out.iterdir())
+    assert files == [
+        "allreduce_pod_n4_slices2.trace.json",
+        "allreduce_pod_n6_slices2.trace.json",
+        "allreduce_pod_n6_slices3.trace.json",
+    ]
+    from smi_tpu.obs.trace import validate_chrome_trace
+
+    first = out / files[0]
+    payload = json.loads(first.read_text())
+    validate_chrome_trace(payload)
+    assert payload["otherData"]["seed"] == 7
+    # deterministic: the same invocation reproduces byte-identically
+    out2 = tmp_path / "traces2"
+    assert run_cli("trace", "--protocol", "allreduce_pod", "--seed",
+                   "7", "-o", str(out2)) == 0
+    capsys.readouterr()
+    assert first.read_bytes() == (out2 / files[0]).read_bytes()
+
+
+@pytest.mark.obs
+def test_trace_stdout_mode_is_one_json_document(capsys):
+    assert run_cli("trace", "--protocol", "all_gather") == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["traces"]) == 3  # the all_gather DEFAULT_SHAPES grid
+
+
+@pytest.mark.obs
+def test_trace_usage_error_matrix(capsys):
+    # neither --protocol nor --all
+    assert run_cli("trace") == 2
+    assert "--protocol" in capsys.readouterr().err
+    # both --protocol and --all
+    assert run_cli("trace", "--all", "--protocol", "all_reduce") == 2
+    assert "exclusive" in capsys.readouterr().err
+    # unknown protocol, naming the registry
+    assert run_cli("trace", "--protocol", "warp_drive") == 2
+    err = capsys.readouterr().err
+    assert "warp_drive" in err and "all_to_all_pod" in err
+    # malformed payload
+    assert run_cli("trace", "--protocol", "all_reduce",
+                   "--payload-kb", "0") == 2
+    assert "--payload-kb" in capsys.readouterr().err
+
+
+@pytest.mark.obs
+@pytest.mark.serving
+def test_serve_selftest_metrics_mode(capsys):
+    assert run_cli("serve", "--selftest", "--metrics") == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is True
+    counters = doc["metrics"]["counters"]
+    assert any(k.startswith("admitted_total") for k in counters)
+    assert "dropped_events" in doc["obs"]  # never silent
+
+
+@pytest.mark.obs
+@pytest.mark.serving
+def test_chaos_load_metrics_prints_cell_summaries(capsys):
+    assert run_cli("chaos", "--load", "--metrics", "--trials", "1",
+                   "--duration", "160") == 0
+    printed = capsys.readouterr().out
+    assert "metrics:" in printed
+    assert "admitted_total" in printed
+    assert "dropped" in printed
+
+
+@pytest.mark.obs
+def test_chaos_metrics_outside_load_is_usage_error(capsys):
+    assert run_cli("chaos", "--metrics") == 2
+    assert "--load" in capsys.readouterr().err
+    assert run_cli("chaos", "--elastic", "--metrics") == 2
+    assert "--load" in capsys.readouterr().err
+    assert run_cli("chaos", "--moe", "--metrics") == 2
+    assert "--load" in capsys.readouterr().err
+
+
+@pytest.mark.obs
+@pytest.mark.serving
+def test_serve_json_and_metrics_are_exclusive(capsys):
+    assert run_cli("serve", "--selftest", "--json", "--metrics") == 2
+    assert "exclusive" in capsys.readouterr().err

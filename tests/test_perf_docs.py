@@ -591,3 +591,44 @@ def test_tuning_doc_quotes_the_seeded_knobs():
     assert f"{wq} / {wk}" in text
     assert f"| {seeded.SEEDED_STENCIL_DEPTH} |" in text
     assert str(seeded.SEEDED_RS_AG_MIN_BYTES) in text
+
+
+def test_observability_doc_quotes_the_schema():
+    """docs/observability.md must render the REAL event schema, the
+    recorder bounds, the metric catalog, and the trace schema version
+    — the doc is the human-readable mirror of ``smi_tpu/obs`` and
+    must not drift from the code registry."""
+    from smi_tpu.obs import events as E
+    from smi_tpu.obs import trace as T
+
+    text = _read("docs/observability.md")
+    # every registered event kind appears in the schema table
+    for kind in E.EVENT_KINDS:
+        assert f"`{kind}`" in text, (
+            f"event kind {kind!r} missing from the schema table"
+        )
+    # a documented kind that no longer exists is equally a drift
+    import re
+
+    documented = set(re.findall(r"`((?:credit|dma|barrier|serve|ctl)"
+                                r"\.[a-z_]+)`", text))
+    assert documented == set(E.EVENT_KINDS)
+    # recorder bounds
+    assert f"**{E.DEFAULT_RECORDER_CAPACITY} events**" in text
+    assert f"**{E.DEFAULT_TAIL_EVENTS} events**" in text
+    # pinned trace schema version
+    assert f"schema version {T.TRACE_SCHEMA_VERSION}" in text
+    # the shipped metric catalog: every instrument the serving stack
+    # feeds must be documented (names as used in the registry keys)
+    for metric in (
+        "admitted_total", "parked_total", "shed_total",
+        "sent_chunks_total", "consumed_chunks_total",
+        "delivered_total", "replayed_chunks_total",
+        "integrity_errors_total", "membership_transitions_total",
+        "epoch_bumps_total", "credit_stall_ticks",
+        "wire_lane_occupancy", "queue_depth", "pool_occupancy",
+        "admission_wait_ticks", "stream_latency_ticks",
+    ):
+        assert f"`{metric}`" in text, (
+            f"metric {metric!r} missing from the catalog"
+        )
