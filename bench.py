@@ -318,6 +318,32 @@ def obs_fields() -> dict:
     }
 
 
+def retune_fields() -> dict:
+    """Additive online-retuning provenance: the seeded payload-shift
+    cell (:func:`smi_tpu.serving.campaign.run_retune_cell` — pure
+    Python, deterministic per seed, milliseconds) reporting samples
+    ingested, proposals, swaps, rollbacks, and the convergence ticks
+    from the mid-run distribution shift to the committed hot-swap —
+    the live-retuning regime this build sustains, measured next to
+    the throughput headline. The legacy metric/value/unit/vs_baseline
+    contract is untouched (schema-guarded by ``tests/test_retune.py``)."""
+    from smi_tpu.serving.campaign import run_retune_cell
+
+    rep = run_retune_cell(n=4, seed=0, duration=160)
+    rt = rep["retune"]
+    return {
+        "samples_ingested": rt["samples_ingested"],
+        "proposals": rt["proposals"],
+        "swaps": rt["swaps"],
+        "rollbacks": rt["rollbacks"],
+        "convergence_ticks": rep["convergence_ticks"],
+        "converged_algorithm": rep["converged_algorithm"],
+        "expected_algorithm": rep["expected_algorithm"],
+        "stale_plan_rejections": rt["stale_plan_rejections"],
+        "ok": rep["ok"],
+    }
+
+
 def plan_fields(depth) -> dict:
     """Additive plan-provenance evidence: which tuning layer (cache /
     model / heuristic) produced the knobs behind the headline metric
@@ -466,6 +492,12 @@ def main():
         payload["obs"] = obs_fields()
     except Exception as e:
         payload["obs"] = {"error": f"{type(e).__name__}: {e}"}
+    # additive online-retuning field (same best-effort contract): the
+    # seeded payload-shift cell's ingest/propose/swap accounting
+    try:
+        payload["retune"] = retune_fields()
+    except Exception as e:
+        payload["retune"] = {"error": f"{type(e).__name__}: {e}"}
     # additive multi-metric scoreboard (same best-effort contract):
     # the measured stencil plus the committed flash/allreduce
     # baselines, each with a pass/regress verdict

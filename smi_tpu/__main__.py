@@ -920,6 +920,12 @@ def cmd_chaos(args: argparse.Namespace) -> int:
               "base/--elastic/--moe campaigns report their own "
               "gates)", file=sys.stderr)
         return 2
+    if getattr(args, "retune", False) and not args.load:
+        print("error: --retune applies only to --load (the online "
+              "tuner rides the serving front-end; the base/--elastic/"
+              "--moe campaigns have no plan traffic to retune)",
+              file=sys.stderr)
+        return 2
     if args.load:
         return _cmd_chaos_load(args)
     if getattr(args, "moe", False):
@@ -1064,6 +1070,7 @@ def _cmd_chaos_load(args: argparse.Namespace) -> int:
             duration=(args.duration if args.duration is not None
                       else 240),
             trials=args.trials,
+            retune=getattr(args, "retune", False),
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -1076,6 +1083,16 @@ def _cmd_chaos_load(args: argparse.Namespace) -> int:
             f" shed {sum(sum(s.values()) for s in cell['shed'].values())}"
             f" | interactive p99 {lat['p99']} ticks"
         )
+        if cell["cell"] == "retune-shift":
+            rt = cell["retune"]
+            print(
+                f"{'retune':>12}: {rt['swaps']} swap(s) -> "
+                f"{cell['converged_algorithm']!r} "
+                f"(expected {cell['expected_algorithm']!r}), "
+                f"{rt['samples_ingested']} samples, "
+                f"{rt['stale_plan_rejections']} stale-plan "
+                f"straggler(s) rejected"
+            )
         if getattr(args, "metrics", False):
             counters = cell["metrics"]["counters"]
             obs = cell["obs"]
@@ -1196,7 +1213,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     admission-latency bound must hold. Nonzero exit on any gate
     failure — the CI hook for the serving layer.
     """
-    from smi_tpu.serving.campaign import serve_selftest
+    from smi_tpu.serving.campaign import retune_selftest, serve_selftest
 
     if not args.selftest:
         print("error: serve requires --selftest (the live serving "
@@ -1208,7 +1225,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
               "modes (--json's full report already embeds the "
               "metrics snapshot)", file=sys.stderr)
         return 2
-    report = serve_selftest(seed=args.seed)
+    if getattr(args, "retune", False):
+        report = retune_selftest(seed=args.seed)
+    else:
+        report = serve_selftest(seed=args.seed)
     if args.json:
         print(json.dumps(report, indent=2))
     elif getattr(args, "metrics", False):
@@ -1239,6 +1259,19 @@ def cmd_serve(args: argparse.Namespace) -> int:
             f"{report['silent_corruptions']} silent corruptions, "
             f"{report['lost_accepted']} lost accepted"
         )
+        if getattr(args, "retune", False):
+            rt = report["retune"]
+            print(
+                f"     retune: {rt['samples_ingested']} samples, "
+                f"{rt['proposals']} proposal(s), {rt['swaps']} "
+                f"swap(s), {rt['rollbacks']} rollback(s); converged "
+                f"to {report['converged_algorithm']!r} (expected "
+                f"{report['expected_algorithm']!r}) "
+                f"{report['convergence_ticks']} ticks after the "
+                f"shift; {rt['stale_plan_rejections']} stale-plan "
+                f"straggler(s) rejected, {rt['stale_plan_leaks']} "
+                f"leaked"
+            )
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2)
@@ -1478,9 +1511,10 @@ def _emit_lint_report(args: argparse.Namespace, payload: dict,
 def _cmd_lint_model(args: argparse.Namespace) -> int:
     """``smi-tpu lint --model``: the control-plane model checker.
 
-    Exhaustively verifies the five control-plane properties —
+    Exhaustively verifies the seven control-plane properties —
     queue-occupancy bound, stream-credit conservation,
-    starvation-freedom, epoch safety, no-lost-accepted — over every
+    starvation-freedom, epoch safety, no-lost-accepted, plan-epoch
+    safety, no-lost-accepted-across-swap — over every
     reachable state of each scope in the default grid (or the single
     ``--scope SPEC``), driving the REAL admission gate / scheduler /
     membership / WAL objects (:mod:`smi_tpu.analysis.model`). Exit 1
@@ -1804,6 +1838,23 @@ def cmd_tune(args: argparse.Namespace) -> int:
     from smi_tpu.tuning import PlanCache, PlanCacheError, engine
     from smi_tpu.tuning.cache import default_cache_path
 
+    if args.online:
+        conflicts = [flag for flag, val in (
+            ("--explain", args.explain), ("--ops", args.ops),
+        ) if val]
+        if conflicts:
+            print(f"error: --online replays a recorded sample sink "
+                  f"through the online tuner; {', '.join(conflicts)} "
+                  f"{'select' if len(conflicts) > 1 else 'selects'} a "
+                  f"different tune mode — drop it or run the modes "
+                  f"separately", file=sys.stderr)
+            return 2
+        return _cmd_tune_online(args)
+    if args.device_kind:
+        print("error: --device-kind applies only to --online (sweeps "
+              "and --explain key by the MEASURED local device kind)",
+              file=sys.stderr)
+        return 2
     if args.explain:
         try:
             print(engine.get_engine().explain_text(
@@ -1933,6 +1984,105 @@ def cmd_tune(args: argparse.Namespace) -> int:
           f"new/improved -> {path}")
     # the running process should trace with what it just measured
     engine.set_engine(None)
+    return 0
+
+
+def _cmd_tune_online(args: argparse.Namespace) -> int:
+    """``smi-tpu tune --online SINK.json``: offline replay of recorded
+    live samples through the online tuner (:mod:`smi_tpu.tuning.online`).
+
+    The sink is a :class:`~smi_tpu.obs.metrics.SampleSink` snapshot
+    (``{"entries": [...]}``) or a bare entries list — the vocabulary
+    ``tracing.timed(sink=)`` aggregates during a run. The tuner
+    shadow-compares every qualified cell against the cost model's
+    rival candidates and prints each propose/swap decision with its
+    evidence and per-knob provenance. Read-only: nothing is written —
+    the live path (``serve --selftest --retune`` / a retune-wired
+    front-end) is where swaps land in a running job's cache.
+    """
+    from smi_tpu.tuning import PlanCache, PlanCacheError
+    from smi_tpu.tuning import cost_model as cm
+    from smi_tpu.tuning.cache import default_cache_path
+    from smi_tpu.tuning.online import OnlineTuner
+
+    if not os.path.exists(args.online):
+        print(f"error: sample sink {args.online!r} not found",
+              file=sys.stderr)
+        return 2
+    with open(args.online) as f:
+        try:
+            payload = json.load(f)
+        except json.JSONDecodeError as e:
+            print(f"error: sample sink {args.online!r} is not valid "
+                  f"JSON: {e}", file=sys.stderr)
+            return 1
+    if args.slices and args.slices > 1:
+        if args.ranks % args.slices:
+            print(f"error: n={args.ranks} ranks do not split into "
+                  f"{args.slices} slices", file=sys.stderr)
+            return 2
+        topo = cm.TopologySpec(n=args.ranks,
+                               inner=args.ranks // args.slices,
+                               outer=args.slices)
+    else:
+        topo = cm.TopologySpec(n=args.ranks)
+    cache_path = args.cache or default_cache_path()
+    if cache_path and os.path.exists(cache_path):
+        try:
+            cache = PlanCache.load(cache_path)
+        except PlanCacheError as e:
+            print(f"error: cache at {cache_path} is unusable: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"active plans from {cache_path} "
+              f"({len(cache.entries)} entries)")
+    else:
+        cache = PlanCache()
+        print("no plan cache found: the tuner has no active entries "
+              "to retune against (pass --cache, or sweep first)")
+    tuner = OnlineTuner(
+        cache=cache, topo=topo, dtype=args.dtype,
+        device_kind=args.device_kind or "unknown",
+    )
+    try:
+        n = tuner.ingest(payload)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"{n} samples across {len(tuner.cells)} cells ingested "
+          f"(thresholds: min_samples={tuner.min_samples}, "
+          f"margin={tuner.margin:g}x)")
+    decisions = tuner.run_offline()
+    for kind, info in decisions:
+        if kind == "propose":
+            print(
+                f"propose {info['op']} bucket={info['bucket']} B"
+                + (f" tenant={info['tenant']}" if info.get("tenant")
+                   else "")
+                + f": {info['from']} measured "
+                f"{info['measured_us']:.1f} us over {info['samples']} "
+                f"samples vs {info['to']} modeled "
+                f"{info['rival_modeled_us']:.1f} us "
+                f"({info['advantage']:g}x >= margin "
+                f"{tuner.margin:g}x)"
+            )
+        else:
+            print(
+                f"swap {info['key']}: algorithm = "
+                f"{info['algorithm']!r}  [live] (revision "
+                f"{info['revision']}, plan epoch "
+                f"{info['plan_epoch']}; {info['provenance']})"
+            )
+    if not decisions:
+        print("no retune proposals: every active plan holds under "
+              "the recorded samples")
+    # cells a committed swap reset hold 0 samples — only genuinely
+    # under-sampled cells are reported as held back
+    held = sum(1 for c in tuner.cells.values()
+               if 0 < c.count < tuner.min_samples)
+    if held:
+        print(f"{held} cell(s) below the {tuner.min_samples}-sample "
+              f"threshold (noise can never flip a plan)")
     return 0
 
 
@@ -2185,6 +2335,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "event counts) next to its verdict; the full "
                         "deterministic snapshot always rides the "
                         "JSON report")
+    p.add_argument("--retune", action="store_true",
+                   help="with --load: add the seeded payload-shift "
+                        "retune cell per trial — the online tuner "
+                        "must hot-swap to the plan the offline sweep "
+                        "picks for the shifted distribution, with "
+                        "bit-identical delivery, zero lost-accepted, "
+                        "and zero stale-plan leaks (--load only)")
     p.add_argument("--duration", type=int, default=None, metavar="TICKS",
                    help="ticks of open-loop traffic per --load/--moe "
                         "cell (defaults 240/120; --load/--moe only)")
@@ -2204,6 +2361,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--selftest", action="store_true",
                    help="run the deterministic serving smoke and exit "
                         "nonzero on any gate failure")
+    p.add_argument("--retune", action="store_true",
+                   help="with --selftest: run the seeded payload-shift "
+                        "retune cell instead — the front-end serves "
+                        "with the online tuner wired "
+                        "(ServingFrontend(retune=)) and must hot-swap "
+                        "to the offline-sweep pick with bit-identical "
+                        "delivery")
     p.add_argument("--seed", type=int, default=0,
                    help="selftest seed (default 0; the report is "
                         "deterministic per seed)")
@@ -2351,7 +2515,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(all_reduce, all_to_all, flash_fwd, "
                         "stencil_temporal, ring_all_reduce) instead "
                         "of sweeping — CPU-deterministic, no hardware "
-                        "needed")
+                        "needed; an online-won entry renders as "
+                        "[live] naming its sample count and margin")
+    p.add_argument("--online", default=None, metavar="SINK_JSON",
+                   help="replay a recorded SampleSink JSON (the "
+                        "tracing.timed(sink=) aggregate) through the "
+                        "online tuner offline and print each "
+                        "propose/swap decision with its evidence and "
+                        "per-knob provenance — read-only, "
+                        "CPU-deterministic; --cache names the active "
+                        "plans to retune against")
+    p.add_argument("--device-kind", default=None, metavar="KIND",
+                   help="with --online: the device kind the recorded "
+                        "samples were measured on (keys the plan "
+                        "lookups; default 'unknown')")
     p.add_argument("--ops", nargs="+", default=None, metavar="OP",
                    help="ops to sweep (default: all_reduce; flash_fwd "
                         "needs a TPU backend; hierarchical sweeps "

@@ -66,7 +66,19 @@ The action alphabet (one BFS edge each):
   consumption; membership catches up through the real detector);
 - ``rejoin r`` — the dead rank's new incarnation first presents its
   pre-shrink epoch (which the view must reject loudly), then regrows
-  under a fresh epoch.
+  under a fresh epoch;
+- ``plan_propose`` / ``plan_quiesce`` / ``plan_swap`` /
+  ``plan_commit`` / ``plan_abort`` (``retune`` scopes only) — the r14
+  online-retuning arc driven through a REAL
+  :class:`~smi_tpu.tuning.swap.PlanSwap` over a real plan cache: the
+  swap may only install once the proposal's drain set (streams in
+  flight under the plan being retired) has completed, installing
+  bumps the plan epoch + entry revision and rejects a stale-plan
+  straggler loudly, and an abort leaves the pre-proposal entry
+  servable. Aborts are explored from the pre-swap states only — the
+  shape the serving front-end actually drives (quiesce-timeout);
+  PlanSwap's post-swap restore branch is covered by its unit tests,
+  not by this exhaustive tier.
 
 Scope: everything here is **fault-free wire, faulty control plane** —
 the wire tier's own invariants are the PR 7 verifier's job; what is
@@ -138,7 +150,13 @@ class Scope:
     matrix: one destination absorbs the whole offered load, the shape
     the MoE dispatch campaign samples and this scope checks
     exhaustively for queue-bound/starvation); ``-1`` keeps the
-    uniform modulo routing.
+    uniform modulo routing; ``retune`` (0 or 1) arms the r14 online
+    plan-swap arc — the world carries a REAL
+    :class:`~smi_tpu.tuning.swap.PlanSwap` over a real plan cache,
+    the action alphabet grows ``plan_propose`` / ``plan_quiesce`` /
+    ``plan_swap`` / ``plan_commit`` / ``plan_abort``, and the
+    ``plan-epoch-safety`` / ``swap-lost-accepted`` properties become
+    non-vacuous.
     """
 
     tenants: int = 2
@@ -151,6 +169,7 @@ class Scope:
     consume: int = 2
     starve: int = 3
     hot_rank: int = -1
+    retune: int = 0
 
     def __post_init__(self):
         for dim in ("tenants", "ranks", "chunks"):
@@ -191,6 +210,12 @@ class Scope:
             raise ValueError(
                 f"hot_rank={self.hot_rank} outside the rank range "
                 f"0..{self.ranks - 1} (-1 = uniform modulo routing)"
+            )
+        if self.retune not in (0, 1):
+            raise ValueError(
+                f"retune must be 0 or 1, got {self.retune} (one swap "
+                f"arc per scope — the machine is key-local, so one "
+                f"arc exhausts its interleavings)"
             )
 
     def describe(self) -> str:
@@ -261,6 +286,12 @@ DEFAULT_SCOPES: Tuple[Scope, ...] = (
     # exercised under maximal per-route contention (the exhaustive
     # counterpart of the MoE hot-expert campaign cell)
     Scope(tenants=3, ranks=2, chunks=2, streams=1, pool=2, hot_rank=0),
+    # the r14 plan-swap arc: propose -> quiesce -> swap ->
+    # commit/abort interleaved with admissions/sends/consumes —
+    # plan-epoch-safety and swap-lost-accepted checked on every
+    # reachable state (the exhaustive counterpart of the seeded
+    # payload-shift retune cell)
+    Scope(tenants=2, ranks=2, chunks=2, streams=1, pool=2, retune=1),
 )
 
 
@@ -331,6 +362,39 @@ class World:
         self._tenant_seq = [0] * scope.tenants
         self._epoch_watermark = 0
         self._beaten_this_period = True
+        # -- the r14 plan-swap arc (retune scopes): REAL PlanSwap /
+        # PlanCache / CacheEntry objects, driven by explicit actions
+        self.swap = None
+        self.plan_cache = None
+        self.swap_expected_entry = None
+        self.stream_plan_epoch: Dict[int, int] = {}
+        self.stale_plan_rejections = 0
+        self.stale_plan_leaks = 0
+        self._plan_epoch_watermark = 0
+        self.retunes_left = 0
+        self.plan_aborts_left = 0
+        if scope.retune:
+            from smi_tpu.tuning.cache import CacheEntry, PlanCache
+            from smi_tpu.tuning.plan import PlanKey
+            from smi_tpu.tuning.swap import PlanSwap
+
+            self.plan_cache = PlanCache()
+            key = PlanKey("all_reduce", "pow2:22", "float32", "model",
+                          f"n{scope.ranks}")
+            seed_entry = CacheEntry(
+                {"algorithm": "ring"}, cost_us=100.0,
+                provenance="sweep:model-seed",
+            )
+            self.plan_cache.put(key, seed_entry)
+            self._seed_plan_entry = seed_entry
+            self._rival_plan_entry = CacheEntry(
+                {"algorithm": "rs_ag"},
+                provenance="live:retune:model",
+            )
+            self.swap = PlanSwap(self.plan_cache, key)
+            self.swap_expected_entry = seed_entry
+            self.retunes_left = 1
+            self.plan_aborts_left = 1
         self._bootstrap()
 
     # -- mutant seams (defaults == the shipped frontend behaviour) ------
@@ -364,6 +428,21 @@ class World:
         a killed rank's silence is the detector's evidence channel."""
         return [r for r in sorted(self.view.members)
                 if r not in self.killed]
+
+    def _swap_ready(self) -> bool:
+        """May the quiescing swap install? Only when every stream in
+        the proposal's drain set — the streams in flight under the
+        plan being retired — has completed. The swap_without_quiesce
+        mutant breaks exactly this census."""
+        drain = self.swap.proposal.drain
+        return not any(st.index in drain for st in self.active)
+
+    def _rollback_swap(self, reason: str) -> None:
+        """Abort the in-flight swap through the real machine — the
+        rollback must leave the pre-proposal entry servable (zero
+        lost-accepted); the rollback_discards_entry mutant breaks
+        exactly this restore."""
+        self.swap.rollback(reason)
 
     # -- plumbing -------------------------------------------------------
 
@@ -412,6 +491,8 @@ class World:
             admitted_at=self.clock.now(),
         ))
         self.delivery_meta[index] = {}
+        if self.swap is not None:
+            self.stream_plan_epoch[index] = self.swap.plan_epoch
 
     def _complete(self, st: StreamState) -> None:
         st.completed_at = self.clock.now()
@@ -567,6 +648,58 @@ class World:
         self.zombie_beats.discard(rank)
         self.rejoin_pending.remove(rank)
 
+    # -- the plan-swap arc (retune scopes) ------------------------------
+
+    def _do_plan_propose(self) -> None:
+        """The tuner's decision point, abstracted to one action: the
+        rival entry is staged and the drain set snapshots every
+        stream currently in flight under the plan being retired."""
+        self.retunes_left -= 1
+        drain = frozenset(st.index for st in self.active)
+        self.swap.propose(
+            self._rival_plan_entry,
+            evidence={"from": "ring", "to": "rs_ag"},
+            drain=drain,
+        )
+
+    def _do_plan_swap(self) -> None:
+        old_epoch = self.swap.plan_epoch
+        installed = self.swap.swap()
+        self.swap_expected_entry = installed
+        # streams admitted AFTER the proposal are re-planned onto the
+        # new epoch at the swap site (the frontend's exact move);
+        # drain-set streams are deliberately NOT re-stamped — they
+        # were mid-delivery under the old plan, and a clean swap
+        # proved them drained before installing
+        drain = self.swap.proposal.drain
+        for st in self.active:
+            if st.index not in drain:
+                self.stream_plan_epoch[st.index] = self.swap.plan_epoch
+        # one straggler presents the retired plan epoch after the
+        # bump: reject loudly, count, never fold in
+        from smi_tpu.tuning.swap import StalePlanError
+
+        try:
+            self.swap.validate(old_epoch, what="straggler sample")
+            self.stale_plan_leaks += 1
+        except StalePlanError:
+            self.stale_plan_rejections += 1
+
+    def _do_plan_abort(self) -> None:
+        self.plan_aborts_left -= 1
+        was_swapped = self.swap.state == "swapped"
+        restored = self.swap.proposal.old
+        self._rollback_swap("model-abort")
+        # the machine's outcome after a rollback is the pre-proposal
+        # entry; a post-swap rollback additionally re-plans every
+        # in-flight stream onto its fresh epoch (defensive — the
+        # explorer currently drives aborts pre-swap only, like the
+        # serving front-end's quiesce-timeout path)
+        self.swap_expected_entry = restored
+        if was_swapped:
+            for st in self.active:
+                self.stream_plan_epoch[st.index] = self.swap.plan_epoch
+
     def apply(self, action: Tuple) -> None:
         kind = action[0]
         if kind == "tick":
@@ -583,10 +716,24 @@ class World:
             self._do_kill(action[1])
         elif kind == "rejoin":
             self._do_rejoin(action[1])
+        elif kind == "plan_propose":
+            self._do_plan_propose()
+        elif kind == "plan_quiesce":
+            self.swap.quiesce(self.clock.now())
+        elif kind == "plan_swap":
+            self._do_plan_swap()
+        elif kind == "plan_commit":
+            self.swap.commit()
+        elif kind == "plan_abort":
+            self._do_plan_abort()
         else:
             raise ValueError(f"unknown model action {action!r}")
         self._epoch_watermark = max(self._epoch_watermark,
                                     self.view.epoch)
+        if self.swap is not None:
+            self._plan_epoch_watermark = max(
+                self._plan_epoch_watermark, self.swap.plan_epoch
+            )
 
     # -- enabled actions ------------------------------------------------
 
@@ -646,6 +793,25 @@ class World:
                 out.append(("kill", victim))
         for r in self.rejoin_pending:
             out.append(("rejoin", r))
+        if self.swap is not None:
+            state = self.swap.state
+            if state == "idle" and self.retunes_left > 0:
+                out.append(("plan_propose",))
+            elif state == "proposed":
+                out.append(("plan_quiesce",))
+                if self.plan_aborts_left > 0:
+                    out.append(("plan_abort",))
+            elif state == "quiescing":
+                # enabledness goes through the mutant seam: the clean
+                # census requires the drain set empty, the
+                # swap_without_quiesce mutant lies and enables it with
+                # old-plan streams still in flight
+                if self._swap_ready():
+                    out.append(("plan_swap",))
+                if self.plan_aborts_left > 0:
+                    out.append(("plan_abort",))
+            elif state == "swapped":
+                out.append(("plan_commit",))
         return out
 
     # -- canonical fingerprint (relative time + symmetry orbits) --------
@@ -666,12 +832,17 @@ class World:
 
         def stream_key(st: StreamState) -> tuple:
             tenant = tau[int(st.request.tenant[1:])]
-            return (
+            base = (
                 order[st.index], tenant, st.request.qos,
                 rho[st.dst], st.next_to_send,
                 tuple(sorted(st.delivered)), st.skips,
                 epoch - st.lane_epoch, st.total_chunks,
             )
+            if self.swap is not None:
+                base += (self.swap.plan_epoch
+                         - self.stream_plan_epoch.get(
+                             st.index, self.swap.plan_epoch),)
+            return base
 
         streams = tuple(
             stream_key(st)
@@ -740,7 +911,7 @@ class World:
             for r in range(self.scope.ranks)
         )
 
-        return (
+        base = (
             tuple(sorted(tenants)),
             held, pending, streams,
             tuple(sorted(lanes)),
@@ -755,6 +926,18 @@ class World:
             self.kills_left, self.silence_left,
             self._beaten_this_period,
         )
+        if self.swap is not None:
+            entry = self.plan_cache.lookup(self.swap.key)
+            drain = (self.swap.proposal.drain
+                     if self.swap.proposal is not None else frozenset())
+            base += ((
+                self.swap.state, self.swap.plan_epoch,
+                self.retunes_left, self.plan_aborts_left,
+                tuple(sorted(order[i] for i in drain if i in order)),
+                (entry.knobs.get("algorithm"), entry.revision)
+                if entry is not None else None,
+            ),)
+        return base
 
     def fingerprint(self) -> tuple:
         """Orbit representative: the minimum render over every
@@ -790,7 +973,21 @@ class World:
         gate = self.gate
         accepted = sum(gate.admitted.values())
         delivered = len(self.completed)
+        retune = {}
+        if self.swap is not None:
+            entry = self.plan_cache.lookup(self.swap.key)
+            retune = {"retune": {
+                "swap_state": self.swap.state,
+                "plan_epoch": self.swap.plan_epoch,
+                "active_algorithm": (entry.knobs.get("algorithm")
+                                     if entry is not None else None),
+                "active_revision": (entry.revision
+                                    if entry is not None else None),
+                "stale_plan_rejections": self.stale_plan_rejections,
+                "stale_plan_leaks": self.stale_plan_leaks,
+            }}
         return {
+            **retune,
             "scope": self.scope.to_json(),
             "epoch": self.view.epoch,
             "members": sorted(self.view.members),

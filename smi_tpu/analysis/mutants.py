@@ -235,12 +235,50 @@ def _heartbeat_after_confirm_world():
     return _HeartbeatAfterConfirm
 
 
+def _swap_without_quiesce_world():
+    """``swap_without_quiesce``: the swap driver's drain census lies —
+    the plan installs while streams keyed to the old plan are still in
+    flight (the quiesce step is skipped). Only reachable on ``retune``
+    scopes; benign elsewhere. Conviction: ``plan-epoch-safety`` — an
+    active stream still carries the retired plan epoch after the
+    install, with the BFS-minimal trace admit -> propose -> quiesce ->
+    swap."""
+    World = _model_world_base()
+
+    class _SwapWithoutQuiesce(World):
+        def _swap_ready(self):
+            return True  # ...regardless of the drain set
+
+    return _SwapWithoutQuiesce
+
+
+def _rollback_discards_entry_world():
+    """``rollback_discards_entry``: the abort path drops the plan-cache
+    entry instead of leaving/restoring the pre-proposal plan — traffic
+    keyed to the plan has nothing to run under. Conviction:
+    ``swap-lost-accepted`` — the cache no longer holds the entry the
+    swap machine's outcome dictates."""
+    World = _model_world_base()
+
+    class _RollbackDiscardsEntry(World):
+        def _rollback_swap(self, reason):
+            self.swap.rollback(reason)
+            # ...and the entry goes with it (the defect)
+            self.plan_cache.entries.pop(
+                self.swap.key.signature(), None
+            )
+
+    return _RollbackDiscardsEntry
+
+
 #: Control-plane mutant registry: name -> World factory.
 _MODEL_MUTANT_FACTORIES = {
     "leaked_stream_credit": _leaked_stream_credit_world,
     "skipped_aging": _skipped_aging_world,
     "epoch_bump_without_void": _epoch_bump_without_void_world,
     "heartbeat_after_confirm": _heartbeat_after_confirm_world,
+    "swap_without_quiesce": _swap_without_quiesce_world,
+    "rollback_discards_entry": _rollback_discards_entry_world,
 }
 
 #: The shipped control-plane mutants, in acceptance-matrix order.
@@ -253,6 +291,8 @@ MODEL_MUTANT_PROPERTY = {
     "skipped_aging": "starvation",
     "epoch_bump_without_void": "epoch-safety",
     "heartbeat_after_confirm": "lost-accepted",
+    "swap_without_quiesce": "plan-epoch-safety",
+    "rollback_discards_entry": "swap-lost-accepted",
 }
 
 

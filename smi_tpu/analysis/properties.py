@@ -39,6 +39,19 @@ sampled assertions into exhaustively-checked invariants:
   destination the detector will never confirm dead), and at every
   terminal state zero incomplete accepted streams, zero parked
   requests, and zero held credits.
+- **plan-epoch-safety** (``retune`` scopes) — the r14 plan-swap arc
+  is exactly as safe as a membership change: the plan epoch never
+  regresses, every stale-plan presentation raised
+  :class:`~smi_tpu.tuning.swap.StalePlanError`, and no active stream
+  still carries a pre-swap plan epoch once the swap installed — the
+  quiesce (drain) step can never be skipped
+  (the ``swap_without_quiesce`` mutant's conviction).
+- **swap-lost-accepted** (``retune`` scopes) — a swap or an aborted
+  swap never loses the plan traffic is keyed to: the plan cache
+  always holds the entry the swap machine's outcome dictates
+  (pre-proposal entry until the swap, the rival after it, the
+  pre-proposal entry again after a rollback) — the
+  ``rollback_discards_entry`` mutant's conviction.
 """
 
 from __future__ import annotations
@@ -50,9 +63,12 @@ from smi_tpu.serving.scheduler import WIRE_CREDITS
 
 #: The checked properties, in reporting order. docs/analysis.md's
 #: property table must name every one (drift-guarded by
-#: tests/test_perf_docs.py).
+#: tests/test_perf_docs.py). The two ``plan-*``/``swap-*`` properties
+#: engage only on ``retune`` scopes (worlds without a swap machine
+#: satisfy them vacuously).
 PROPERTIES = ("queue-bound", "stream-credit", "starvation",
-              "epoch-safety", "lost-accepted")
+              "epoch-safety", "lost-accepted",
+              "plan-epoch-safety", "swap-lost-accepted")
 
 Violation = Tuple[str, str]
 
@@ -184,6 +200,80 @@ def check_lost_accepted(world) -> List[Violation]:
     return out
 
 
+def check_plan_epoch_safety(world) -> List[Violation]:
+    """The r14 swap arc: plan-epoch monotonicity, loud stale-plan
+    rejection, and the quiesce discipline — after a swap installs, no
+    active stream may still be keyed to the retired plan epoch.
+    Vacuous on worlds without a swap machine (non-``retune`` scopes)."""
+    swap = getattr(world, "swap", None)
+    if swap is None:
+        return []
+    out: List[Violation] = []
+    if swap.plan_epoch < world._plan_epoch_watermark:
+        out.append((
+            "plan-epoch-safety",
+            f"plan epoch regressed from "
+            f"{world._plan_epoch_watermark} to {swap.plan_epoch}",
+        ))
+    if world.stale_plan_leaks:
+        out.append((
+            "plan-epoch-safety",
+            f"{world.stale_plan_leaks} stale-plan presentation(s) "
+            f"were accepted instead of raising StalePlanError — "
+            f"traffic planned under a retired entry folded into the "
+            f"live plan",
+        ))
+    for st in world.active:
+        stamp = world.stream_plan_epoch.get(st.index, swap.plan_epoch)
+        if stamp != swap.plan_epoch:
+            out.append((
+                "plan-epoch-safety",
+                f"stream {st.request.stream_id} is still in flight "
+                f"under plan epoch {stamp} but the active plan is at "
+                f"epoch {swap.plan_epoch} — the swap installed "
+                f"without draining the streams keyed to the old plan "
+                f"(quiesce never ran)",
+            ))
+            return out
+    return out
+
+
+def check_swap_lost_accepted(world) -> List[Violation]:
+    """Zero lost-accepted ACROSS a swap or rollback: the plan cache
+    must always hold the entry the swap machine's outcome dictates —
+    a rolled-back swap that dropped (or mis-restored) the pre-proposal
+    entry leaves accepted traffic keyed to a plan that no longer
+    exists. (The explorer drives aborts from the pre-swap states only,
+    matching the front-end's quiesce-timeout path; PlanSwap's
+    post-swap restore branch is unit-tested, not exhaustively
+    explored.) Vacuous on worlds without a swap machine."""
+    swap = getattr(world, "swap", None)
+    if swap is None:
+        return []
+    expected = world.swap_expected_entry
+    got = world.plan_cache.lookup(swap.key)
+    if got is None:
+        return [(
+            "swap-lost-accepted",
+            f"the plan cache no longer holds an entry for "
+            f"{swap.key.signature()} (swap state {swap.state!r}) — a "
+            f"rolled-back swap must restore the pre-proposal plan, or "
+            f"the traffic keyed to it is lost",
+        )]
+    if expected is not None and (
+        got.knobs.get("algorithm") != expected.knobs.get("algorithm")
+    ):
+        return [(
+            "swap-lost-accepted",
+            f"the active entry for {swap.key.signature()} names "
+            f"{got.knobs.get('algorithm')!r} but the swap machine's "
+            f"outcome (state {swap.state!r}) dictates "
+            f"{expected.knobs.get('algorithm')!r} — commit/rollback "
+            f"and the cache disagree",
+        )]
+    return []
+
+
 def check_state(world) -> List[Violation]:
     """All per-state invariants, in property order."""
     out: List[Violation] = []
@@ -192,6 +282,8 @@ def check_state(world) -> List[Violation]:
     out.extend(check_starvation(world))
     out.extend(check_epoch_safety(world))
     out.extend(check_lost_accepted(world))
+    out.extend(check_plan_epoch_safety(world))
+    out.extend(check_swap_lost_accepted(world))
     return out
 
 

@@ -16,7 +16,12 @@ machines the same event vocabulary:
   reason — the admission story the campaigns gate on;
 - **control plane** — membership transitions: suspect / clear /
   confirm / shrink / regrow / epoch bump — the transitions the PR 10
-  model checker proves safe, now visible in a live run.
+  model checker proves safe, now visible in a live run;
+- **tuning plane** — the online retuner's lifecycle
+  (:mod:`smi_tpu.tuning.online`): sample ingested / swap proposed /
+  plan hot-swapped / swap rolled back, each carrying the op, the
+  payload bucket, and the evidence thresholds — the live-retuning
+  story the r14 campaign cells gate on.
 
 An :class:`Event` is causally ordered by ``seq`` (the recorder's
 monotone emission counter — emission order IS program order on the one
@@ -57,7 +62,9 @@ DEFAULT_TAIL_EVENTS = 32
 #: - ``sim``     — credits-simulator primitives (logical tick = the
 #:                 scheduler's executed-action count);
 #: - ``serving`` — request lifecycle on the front-end's StepClock;
-#: - ``control`` — membership/epoch transitions on the same clock.
+#: - ``control`` — membership/epoch transitions on the same clock;
+#: - ``tuning``  — the online retuner's sample/propose/swap/rollback
+#:                 lifecycle (same clock when front-end-hosted).
 #:
 #: docs/observability.md renders this table verbatim (drift-guarded by
 #: tests/test_perf_docs.py); extend it there and here together.
@@ -84,6 +91,13 @@ EVENT_KINDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "ctl.shrink": ("control", ("epoch",)),
     "ctl.regrow": ("control", ("epoch",)),
     "ctl.recover": ("control", ("protocol", "reason")),
+    # -- tuning plane (the online retuner's lifecycle) ------------------
+    "tune.sample": ("tuning", ("op", "bucket")),
+    "tune.propose": ("tuning", ("op", "bucket", "from_algo",
+                                "to_algo", "samples", "margin")),
+    "tune.swap": ("tuning", ("op", "bucket", "to_algo", "plan_epoch",
+                             "revision")),
+    "tune.rollback": ("tuning", ("op", "bucket", "reason")),
 }
 
 #: Envelope keys every event owns; a schema field may not shadow them

@@ -310,6 +310,7 @@ def load_campaign(
     n: int = 4,
     duration: int = 240,
     trials: int = 1,
+    retune: bool = False,
 ) -> Dict:
     """The seeded chaos-under-load campaign: one overload cell, one
     kill-one-rank cell, and one backpressure cell per trial, each
@@ -345,6 +346,14 @@ def load_campaign(
             report["cell"] = name
             report["trial"] = trial
             cells.append(report)
+        if retune:
+            # the r14 cell: the payload distribution shifts mid-run
+            # and the online tuner must hot-swap to the offline-sweep
+            # pick with bit-identical delivery
+            report = run_retune_cell(n=n, seed=base, duration=duration)
+            report["cell"] = "retune-shift"
+            report["trial"] = trial
+            cells.append(report)
     failures = [c for c in cells if not c["ok"]]
     return {
         "seed": seed,
@@ -372,6 +381,252 @@ def load_campaign(
     }
 
 
+def run_retune_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 240,
+    tenants: int = 4,
+    pool: int = DEFAULT_POOL,
+    slices: Optional[int] = None,
+    small_kb: int = 64,
+    large_kb: int = 4096,
+    kill_rank: Optional[int] = None,
+    kill_at: int = 60,
+) -> Dict:
+    """The seeded payload-shift retuning cell (ROADMAP item 3's gate).
+
+    A front-end runs with the online tuner wired
+    (``ServingFrontend(retune=)``); every admitted request stands for
+    one allreduce whose live timing is the Hockney pricing of the
+    ACTIVE plan at that payload (the credits simulator's wire tiers)
+    with seeded ±5% noise — exactly the measurement
+    ``tracing.timed(sink=tuner)`` would stream on hardware, made
+    deterministic. The tenants' payload distribution shifts mid-run
+    (``small_kb`` → ``large_kb``), invalidating a STALE offline sweep
+    entry that pinned the fused ring for the large bucket: the tuner
+    must shadow-compare, propose, quiesce (drain the proposing
+    tenant's in-flight streams), hot-swap the entry under a bumped
+    plan epoch + revision, and converge to the plan a fresh offline
+    sweep would pick for the new distribution (rs+ag flat,
+    hierarchical on a ``slices``-pod) — with bit-identical delivery
+    throughout, zero lost-accepted, zero stale-plan leaks, and zero
+    swaps before the shift (the noise-can't-flip thresholds).
+    """
+    from smi_tpu.tuning import cost_model as cm
+    from smi_tpu.tuning.cache import CacheEntry, PlanCache
+    from smi_tpu.tuning.engine import _collective_topology
+    from smi_tpu.tuning.online import OnlineTuner, priced_sample_us
+    from smi_tpu.tuning.plan import PlanKey, payload_bucket
+
+    if duration < MIN_CAMPAIGN_DURATION:
+        raise ValueError(
+            f"retune cell duration {duration} is below the "
+            f"{MIN_CAMPAIGN_DURATION}-tick minimum: the payload shift "
+            f"(mid-run) and the post-shift sample window both need "
+            f"room inside the schedule"
+        )
+    if kill_rank is not None and kill_at >= duration:
+        raise ValueError(
+            f"kill_at={kill_at} never fires inside a {duration}-tick "
+            f"schedule — raise duration past the fault tick"
+        )
+    if slices is not None:
+        if slices < 2 or 8 % slices:
+            raise ValueError(
+                f"slices={slices} does not tier an 8-rank pod "
+                f"(need a divisor >= 2)"
+            )
+        topo = cm.TopologySpec(n=8, inner=8 // slices, outer=slices)
+    else:
+        topo = cm.TopologySpec(n=8)
+    device_kind = "live-sim"
+    small_bytes, large_bytes = small_kb * 1024, large_kb * 1024
+    if payload_bucket(small_bytes) == payload_bucket(large_bytes):
+        raise ValueError(
+            f"small_kb={small_kb} and large_kb={large_kb} land in the "
+            f"same payload bucket — no distribution shift to retune on"
+        )
+
+    # the stale offline artifact: yesterday's sweep (run under the
+    # small-payload mix this tenant no longer sends) pinned the fused
+    # ring for the large bucket — the entry the live tuner must retire
+    cache = PlanCache()
+    topology = _collective_topology(topo)
+    large_key = PlanKey("all_reduce", payload_bucket(large_bytes),
+                        "float32", device_kind, topology)
+    cache.put(large_key, CacheEntry(
+        {"algorithm": "ring"},
+        cost_us=round(priced_sample_us(
+            "all_reduce", "ring", small_bytes, topo), 3),
+        provenance="sweep:stale-offline",
+    ))
+    tuner = OnlineTuner(cache=cache, topo=topo,
+                        device_kind=device_kind)
+    fe = ServingFrontend(n, seed=seed, pool=pool, retune=tuner)
+
+    # what a FRESH offline sweep would measure best for the new
+    # distribution: the model's top candidate (samples are priced by
+    # the same tables, so measurement and model agree here by
+    # construction — the deterministic analog of the ATLAS claim)
+    expected = cm.allreduce_candidates(large_bytes, topo)[0].name
+
+    shift_at = duration // 2
+    noise = random.Random(f"retune-noise:{seed}")
+    mean_chunks = (
+        sum(CLASS_MIX[c] * CLASS_CHUNKS[c] for c in QOS_CLASSES)
+        / sum(CLASS_MIX.values())
+    )
+    capacity = n * fe.consume_rate
+    requests_per_tick = capacity / mean_chunks
+    schedule = open_loop_traffic(seed, tenants, duration,
+                                 requests_per_tick)
+    tenant_seq: Dict[str, int] = {}
+    submitted = 0
+    swap_tick = None
+    early_swaps = 0
+    verdict = "ok"
+    try:
+        for tick, burst in enumerate(schedule):
+            if kill_rank is not None and tick == kill_at:
+                fe.kill(kill_rank)
+            payload = small_bytes if tick < shift_at else large_bytes
+            for tenant, qos in burst:
+                submitted += 1
+                seq = tenant_seq.get(tenant, 0)
+                tenant_seq[tenant] = seq + 1
+                chunks = tuple(
+                    _payload(tenant, seq, c)
+                    for c in range(CLASS_CHUNKS[qos])
+                )
+                try:
+                    fe.submit(tenant, qos, chunks)
+                except AdmissionRejected:
+                    # shed at the edge: the allreduce this request
+                    # stood for never ran, so there is no timing to
+                    # record — a rejected request must not inflate a
+                    # cell's sample count toward the min_samples gate
+                    continue
+                # the live timing of the allreduce this request
+                # drives, under whatever plan is ACTIVE right now
+                entry = tuner.active_entry(
+                    tuner.plan_key("all_reduce", payload)
+                )
+                algorithm = (
+                    str(entry.knobs["algorithm"]) if entry is not None
+                    else cm.allreduce_candidates(payload, topo)[0].name
+                )
+                us = priced_sample_us(
+                    "all_reduce", algorithm, payload, topo
+                ) * (1.0 + (noise.random() - 0.5) * 0.1)
+                tuner.record("all_reduce", us * 1e-6,
+                             payload_bytes=payload, tenant=tenant)
+            fe.step()
+            if tuner.swaps and swap_tick is None:
+                swap_tick = tick
+                if tick < shift_at:
+                    early_swaps += 1
+        fe.drain()
+    except Exception as e:  # a watchdog/assert firing IS the verdict
+        verdict = f"{type(e).__name__}: {e}"
+
+    report = fe.report()
+    final = tuner.active_entry(large_key)
+    converged_algorithm = (
+        str(final.knobs["algorithm"]) if final is not None else None
+    )
+    report.update({
+        "seed": seed,
+        "duration": duration,
+        "shift_at": shift_at,
+        "small_kb": small_kb,
+        "large_kb": large_kb,
+        "slices": slices,
+        "kill_rank": kill_rank,
+        "submitted_total": submitted,
+        "expected_algorithm": expected,
+        "converged_algorithm": converged_algorithm,
+        "converged_revision": final.revision if final else None,
+        "swap_tick": swap_tick,
+        "convergence_ticks": (swap_tick - shift_at
+                              if swap_tick is not None else None),
+        "metrics": fe.metrics.snapshot(),
+    })
+
+    # -- gates ----------------------------------------------------------
+    problems: List[str] = []
+    if verdict != "ok":
+        problems.append(verdict)
+    if report["silent_corruptions"]:
+        problems.append(
+            f"silent corruption: {report['silent_corruptions']} "
+            f"stream(s) delivered wrong bits"
+        )
+    if report["lost_accepted"]:
+        problems.append(
+            f"lost accepted: {report['lost_accepted']} admitted "
+            f"stream(s) never delivered"
+        )
+    if report["stale_epoch_leaks"]:
+        problems.append("stale-epoch traffic accepted")
+    rt = report["retune"]
+    if rt["stale_plan_leaks"]:
+        problems.append("stale-plan traffic accepted")
+    if report["max_queue_depth"] > report["queue_bound"]:
+        problems.append(
+            f"queue occupancy {report['max_queue_depth']} exceeded "
+            f"bound {report['queue_bound']}"
+        )
+    if early_swaps:
+        problems.append(
+            f"{early_swaps} swap(s) fired BEFORE the payload shift — "
+            f"noise flipped a plan the thresholds should hold"
+        )
+    if rt["swaps"] < 1:
+        problems.append(
+            "the tuner never swapped: the stale offline entry "
+            "survived the shifted distribution"
+        )
+    elif converged_algorithm != expected:
+        problems.append(
+            f"converged to {converged_algorithm!r} but a fresh "
+            f"offline sweep of the shifted distribution picks "
+            f"{expected!r}"
+        )
+    if rt["swaps"] >= 1 and not rt["stale_plan_rejections"]:
+        problems.append(
+            "post-swap straggler was never presented/rejected"
+        )
+    if rt["rollbacks"]:
+        problems.append(
+            f"{rt['rollbacks']} rollback(s) in the seeded cell — "
+            f"quiesce did not drain inside its window"
+        )
+    if kill_rank is not None and report["confirmed"] != [kill_rank]:
+        problems.append(
+            f"kill of rank {kill_rank} not confirmed "
+            f"(confirmed: {report['confirmed']})"
+        )
+    waits = report["admission_waits"]
+    report["admission_latency"] = {
+        c: {
+            "p50": percentile(waits[c], 0.50),
+            "p99": percentile(waits[c], 0.99),
+        }
+        for c in QOS_CLASSES
+    }
+    del report["admission_waits"]
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    return report
+
+
+def retune_selftest(seed: int = 0) -> Dict:
+    """The ``smi-tpu serve --selftest --retune`` smoke: the seeded
+    payload-shift cell at a fast shape — the tuner must converge to
+    the offline-sweep pick with bit-identical delivery."""
+    return run_retune_cell(n=4, seed=seed, duration=160)
+
+
 #: Model-checker property -> the campaign gate it instantiates. The
 #: model tier (:mod:`smi_tpu.analysis.model`) checks these same gates
 #: exhaustively at small scope; a counterexample trace replayed here
@@ -383,6 +638,8 @@ MODEL_GATES = {
     "starvation": "ready stream starved past the aging bound",
     "epoch-safety": "stale-epoch traffic accepted",
     "lost-accepted": "lost accepted",
+    "plan-epoch-safety": "stale-plan traffic accepted",
+    "swap-lost-accepted": "plan swap lost the active plan",
 }
 
 

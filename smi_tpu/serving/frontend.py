@@ -74,6 +74,7 @@ from smi_tpu.serving.scheduler import (
     WireLane,
     verify_chunk,
 )
+from smi_tpu.tuning.swap import StalePlanError
 from smi_tpu.utils.watchdog import Deadline
 
 
@@ -97,6 +98,7 @@ class ServingFrontend:
         check_deadlines: bool = True,
         recorder: Optional[FlightRecorder] = None,
         metrics: Optional[MetricsRegistry] = None,
+        retune: Optional[object] = None,
     ):
         if n < 2:
             raise ValueError(f"serving needs >= 2 ranks, got {n}")
@@ -137,6 +139,27 @@ class ServingFrontend:
                                           base=req.base_rank))
             < self.dst_cap
         )
+        #: the online retuner (:class:`smi_tpu.tuning.online.OnlineTuner`)
+        #: — None = retuning off, byte-for-byte the pre-r14 loop. When
+        #: wired, the front-end drives its PlanSwap machines one
+        #: transition per tick (propose -> quiesce -> swap -> commit)
+        #: with THIS loop's in-flight census as the drain set, and
+        #: injects the stale-plan straggler check at every swap (the
+        #: _failover discipline applied to plan epochs).
+        self.tuner = retune
+        if self.tuner is not None:
+            if getattr(self.tuner, "recorder", None) is None:
+                self.tuner.recorder = self.recorder
+            if getattr(self.tuner, "metrics", None) is None:
+                self.tuner.metrics = self.metrics
+            self.tuner.clock = self.clock.now
+        #: stream index -> plan epoch at admission (retune bookkeeping;
+        #: streams admitted between propose and swap are re-planned —
+        #: re-stamped — at the swap site)
+        self.plan_stamp: Dict[int, int] = {}
+        self.replanned_streams = 0
+        self.stale_plan_rejections = 0
+        self.stale_plan_leaks = 0
         self.lanes = [WireLane(r) for r in range(n)]
         self.scheduler = StreamScheduler(
             check_deadlines=check_deadlines
@@ -308,6 +331,10 @@ class ServingFrontend:
             lane_epoch=self.view.epoch,
             admitted_at=self.clock.now(),
         ))
+        if self.tuner is not None:
+            # the plan world this stream was admitted under; a swap
+            # completing while it is in flight re-plans (re-stamps) it
+            self.plan_stamp[index] = self.tuner.total_plan_epoch()
 
     # -- the serving loop -----------------------------------------------
 
@@ -353,6 +380,7 @@ class ServingFrontend:
         ).observe(st.completed_at - st.admitted_at)
         self.active.remove(st)
         self.completed.append(st)
+        self.plan_stamp.pop(st.index, None)
         self.gate.release(st.request.qos, self.clock.now())
 
     def _consume(self) -> None:
@@ -535,7 +563,66 @@ class ServingFrontend:
                 self.metrics.counter("credit_stall_ticks",
                                      rank=lane.rank).inc()
         self.gate.pump(now)
+        if self.tuner is not None:
+            self._drive_retune(now)
         self.gate.assert_bounded()
+
+    # -- online retuning (r14) ------------------------------------------
+
+    def _retune_drain_census(self, evidence) -> frozenset:
+        """The in-flight streams keyed to the plan a proposal wants to
+        retire: the proposing tenant's active streams (per-tenant
+        specialization is the point of online retuning), or every
+        active stream for a tenant-less cell."""
+        tenant = evidence.get("tenant")
+        return frozenset(
+            st.index for st in self.active
+            if tenant is None or st.request.tenant == tenant
+        )
+
+    def _drive_retune(self, now: int) -> None:
+        """One swap-machine transition per tick per plan key: propose
+        -> quiesce -> (drain) -> swap -> commit, with quiesce-timeout
+        rollback. At every swap the old plan epoch is presented once
+        as a straggler and must be rejected loudly
+        (:class:`~smi_tpu.tuning.swap.StalePlanError` — counted,
+        never folded in), and every still-active stream NOT in the
+        drain set is re-planned onto the new epoch."""
+        tuner = self.tuner
+        tuner.maybe_propose(now, drain_census=self._retune_drain_census)
+        for swap in tuner.pending_swaps():
+            if swap.state == "proposed":
+                tuner.start_quiesce(swap, now)
+            elif swap.state == "quiescing":
+                drain = swap.proposal.drain
+                still = [st for st in self.active
+                         if st.index in drain]
+                if not still:
+                    old_epoch = swap.plan_epoch
+                    tuner.execute_swap(swap)
+                    total = tuner.total_plan_epoch()
+                    tenant = swap.proposal.evidence.get("tenant")
+                    for st in self.active:
+                        if self.plan_stamp.get(st.index) != total:
+                            self.plan_stamp[st.index] = total
+                            if (tenant is None
+                                    or st.request.tenant == tenant):
+                                self.replanned_streams += 1
+                    # the straggler: one sample/chunk planned under
+                    # the retired entry presents its old plan epoch
+                    # after the bump — reject, count, never fold in
+                    try:
+                        swap.validate(old_epoch,
+                                      what="post-swap straggler")
+                        self.stale_plan_leaks += 1
+                    except StalePlanError:
+                        self.stale_plan_rejections += 1
+                elif (swap.quiesce_started is not None
+                      and now - swap.quiesce_started
+                      > tuner.quiesce_timeout):
+                    tuner.rollback(swap, "quiesce-timeout", now)
+            elif swap.state == "swapped":
+                tuner.commit(swap)
 
     def drain(self, max_ticks: int = 5000) -> None:
         """Run the loop until every accepted stream completes. A
@@ -605,4 +692,10 @@ class ServingFrontend:
                     self.recorder.counts.items()
                 )),
             },
+            **({"retune": {
+                **self.tuner.summary(),
+                "replanned_streams": self.replanned_streams,
+                "stale_plan_rejections": self.stale_plan_rejections,
+                "stale_plan_leaks": self.stale_plan_leaks,
+            }} if self.tuner is not None else {}),
         }

@@ -31,21 +31,41 @@ from smi_tpu.tuning.cache import (
 )
 from smi_tpu.tuning.cost_model import LinkModel, TopologySpec
 from smi_tpu.tuning.engine import PlanEngine, get_engine, set_engine
+from smi_tpu.tuning.online import (
+    OnlineTuner,
+    online_retune_enabled,
+    retune_margin,
+    retune_min_samples,
+)
 from smi_tpu.tuning.plan import Candidate, Plan, PlanKey
 from smi_tpu.tuning.seeded import seeded_cache
+from smi_tpu.tuning.swap import (
+    PlanSwap,
+    PlanSwapError,
+    StalePlanError,
+    SwapProposal,
+)
 
 __all__ = [
     "CacheEntry",
     "Candidate",
     "LinkModel",
+    "OnlineTuner",
     "Plan",
     "PlanCache",
     "PlanCacheError",
     "PlanEngine",
     "PlanKey",
+    "PlanSwap",
+    "PlanSwapError",
+    "StalePlanError",
+    "SwapProposal",
     "TopologySpec",
     "default_cache_path",
     "get_engine",
+    "online_retune_enabled",
+    "retune_margin",
+    "retune_min_samples",
     "seeded_cache",
     "set_engine",
 ]

@@ -46,11 +46,27 @@ class CacheEntry:
     knobs: Dict[str, object]
     cost_us: Optional[float] = None     # lower is better; None = seeded
     provenance: str = ""                # e.g. "sweep:2026-08-03" or
-    #                                     "seeded:PERF.json:<metric>"
+    #                                     "seeded:PERF.json:<metric>" or
+    #                                     "live:retune:samples=N:..."
+    #: Monotonic staleness counter, bumped on every online swap
+    #: install (:meth:`smi_tpu.tuning.swap.PlanSwap.swap`). A higher
+    #: revision ALWAYS wins a merge regardless of measured cost: a
+    #: late-arriving offline sweep (revision 0, possibly with a
+    #: better-looking ``cost_us`` measured under yesterday's traffic)
+    #: can no longer silently resurrect a plan the live tuner just
+    #: retired. Revision-0 vs revision-0 keeps the original
+    #: best-measured-cost merge rules byte-for-byte.
+    revision: int = 0
 
     def better_than(self, other: Optional["CacheEntry"]) -> bool:
         if other is None:
             return True
+        if self.revision != other.revision:
+            # staleness outranks cost: the live tuner's bumped
+            # revision reflects the CURRENT traffic; the older
+            # revision's measurement, however good, priced a
+            # distribution that no longer exists
+            return self.revision > other.revision
         if self.cost_us is None:
             # unmeasured never displaces measured; vs unmeasured the
             # incoming entry wins (merge order: other.merge(self))
@@ -65,6 +81,9 @@ class CacheEntry:
             out["cost_us"] = self.cost_us
         if self.provenance:
             out["provenance"] = self.provenance
+        if self.revision:
+            # absent when 0: pre-revision cache files stay byte-stable
+            out["revision"] = self.revision
         return out
 
     @staticmethod
@@ -82,10 +101,18 @@ class CacheEntry:
                 f"plan-cache entry {sig!r} has non-numeric cost_us "
                 f"{cost!r}"
             )
+        revision = payload.get("revision", 0)
+        if (not isinstance(revision, int) or isinstance(revision, bool)
+                or revision < 0):
+            raise PlanCacheError(
+                f"plan-cache entry {sig!r} has a malformed revision "
+                f"{revision!r} (want an integer >= 0)"
+            )
         return CacheEntry(
             knobs=dict(payload["knobs"]),
             cost_us=None if cost is None else float(cost),
             provenance=str(payload.get("provenance", "")),
+            revision=revision,
         )
 
 

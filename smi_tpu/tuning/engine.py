@@ -95,6 +95,32 @@ def _collective_topology(topo: cm.TopologySpec) -> str:
     return f"n{topo.n}"
 
 
+def cache_entry_layer(entry) -> str:
+    """The explain-surface layer of a cache hit: ``"live"`` when the
+    entry was written by the online retuner (its ``live:`` provenance
+    names the sample count and win margin — the env -> cache -> live
+    -> model -> heuristic ladder), else ``"cache"``."""
+    provenance = str(getattr(entry, "provenance", "") or "")
+    return "live" if provenance.startswith("live:") else "cache"
+
+
+def _cache_hit_rationale(hit) -> Tuple[str, str]:
+    """(layer, rationale line) for one algorithm cache hit — the ONE
+    rendering both collective plan surfaces share, so the live-tier
+    presentation cannot drift between them."""
+    layer = cache_entry_layer(hit)
+    if layer == "live":
+        # an online-won entry names its sample count and win margin
+        # (the provenance the retuner stamped at swap)
+        return layer, (f"live retune entry ({hit.provenance}, "
+                       f"revision {hit.revision})")
+    return layer, (
+        f"cache entry ({hit.provenance or 'measured sweep'}"
+        + (f", {hit.cost_us:.1f} us" if hit.cost_us is not None
+           else "") + ")"
+    )
+
+
 class PlanEngine:
     def __init__(
         self,
@@ -153,13 +179,10 @@ class PlanEngine:
         rationale = []
         hit = self.cache.lookup(key)
         if hit is not None and "algorithm" in hit.knobs:
+            layer, why = _cache_hit_rationale(hit)
             knobs["algorithm"] = hit.knobs["algorithm"]
-            decided["algorithm"] = "cache"
-            rationale.append(
-                f"cache entry ({hit.provenance or 'measured sweep'}"
-                + (f", {hit.cost_us:.1f} us" if hit.cost_us is not None
-                   else "") + ")"
-            )
+            decided["algorithm"] = layer
+            rationale.append(why)
             cands = [
                 Candidate(c.name, c.knobs, c.modeled_us,
                           hit.cost_us if c.knobs.get("algorithm")
@@ -455,13 +478,10 @@ class PlanEngine:
         if (hit is not None and "algorithm" in hit.knobs
                 and self._alltoall_structural(
                     str(hit.knobs["algorithm"]), topo)):
+            layer, why = _cache_hit_rationale(hit)
             knobs["algorithm"] = hit.knobs["algorithm"]
-            decided["algorithm"] = "cache"
-            rationale.append(
-                f"cache entry ({hit.provenance or 'measured sweep'}"
-                + (f", {hit.cost_us:.1f} us" if hit.cost_us is not None
-                   else "") + ")"
-            )
+            decided["algorithm"] = layer
+            rationale.append(why)
             cands = cm.CandidateSet(
                 [Candidate(c.name, c.knobs, c.modeled_us,
                            hit.cost_us if c.knobs.get("algorithm")

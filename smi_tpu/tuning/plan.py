@@ -29,8 +29,12 @@ import dataclasses
 import re
 from typing import Dict, List, Optional
 
-#: the three decision layers, in consultation order
-LAYERS = ("cache", "model", "heuristic")
+#: the decision layers, in consultation order. ``live`` is the online
+#: retuner's tier (:mod:`smi_tpu.tuning.online`): an entry the live
+#: tuner hot-swapped in renders as ``[live]`` — same cache storage,
+#: its provenance names the sample count and win margin — so the
+#: resolution ladder reads env -> cache -> live -> model -> heuristic.
+LAYERS = ("cache", "live", "model", "heuristic")
 
 
 def normalize_device_kind(kind: Optional[str]) -> str:

@@ -430,6 +430,10 @@ MODEL_MUTANT_SCOPE = {
     "skipped_aging": A.DEFAULT_SCOPES[1],
     "epoch_bump_without_void": A.DEFAULT_SCOPES[3],
     "heartbeat_after_confirm": A.DEFAULT_SCOPES[3],
+    # the r14 plan-swap mutants need the retune scope (the swap
+    # machine is inert everywhere else — benign by construction)
+    "swap_without_quiesce": A.DEFAULT_SCOPES[5],
+    "rollback_discards_entry": A.DEFAULT_SCOPES[5],
 }
 
 

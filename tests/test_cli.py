@@ -937,3 +937,145 @@ def test_chaos_metrics_outside_load_is_usage_error(capsys):
 def test_serve_json_and_metrics_are_exclusive(capsys):
     assert run_cli("serve", "--selftest", "--json", "--metrics") == 2
     assert "exclusive" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the online retuner CLI (r14): tune --online, serve/chaos --retune
+# ---------------------------------------------------------------------------
+
+
+def _sink_and_cache(tmp_path):
+    """A recorded SampleSink JSON (20 stale-ring timings at 4 MiB)
+    plus a plan cache whose active entry the replay must retire."""
+    from smi_tpu.obs.metrics import SampleSink
+    from smi_tpu.tuning import cost_model as cm
+    from smi_tpu.tuning.cache import CacheEntry, PlanCache
+    from smi_tpu.tuning.engine import _collective_topology
+    from smi_tpu.tuning.online import priced_sample_us
+    from smi_tpu.tuning.plan import PlanKey, payload_bucket
+
+    topo = cm.TopologySpec(n=8)
+    sink = SampleSink()
+    us = priced_sample_us("all_reduce", "ring", 4 << 20, topo)
+    for _ in range(20):
+        sink.record("all_reduce", us * 1e-6, payload_bytes=4 << 20,
+                    tenant="t3")
+    sink_path = tmp_path / "sink.json"
+    sink_path.write_text(json.dumps(sink.snapshot()))
+    cache = PlanCache()
+    cache.put(
+        PlanKey("all_reduce", payload_bucket(4 << 20), "float32",
+                "live-sim", _collective_topology(topo)),
+        CacheEntry({"algorithm": "ring"}, cost_us=700.0,
+                   provenance="sweep:stale"),
+    )
+    cache_path = tmp_path / "plans.json"
+    cache.save(str(cache_path))
+    return str(sink_path), str(cache_path)
+
+
+@pytest.mark.retune
+def test_tune_online_replays_and_prints_decisions(tmp_path, capsys):
+    sink, cache = _sink_and_cache(tmp_path)
+    assert run_cli("tune", "--online", sink, "--cache", cache,
+                   "--device-kind", "live-sim") == 0
+    out = capsys.readouterr().out
+    assert "propose all_reduce" in out
+    assert "ring measured" in out and "rs_ag modeled" in out
+    assert "[live]" in out and "revision 1" in out
+    assert "live:retune:samples=20" in out
+    # read-only: the on-disk cache still holds the stale entry
+    payload = json.loads(open(cache).read())
+    (entry,) = payload["entries"].values()
+    assert entry["knobs"]["algorithm"] == "ring"
+
+
+@pytest.mark.retune
+def test_tune_online_without_active_plans_holds(tmp_path, capsys):
+    sink, _ = _sink_and_cache(tmp_path)
+    empty = tmp_path / "empty.json"
+    from smi_tpu.tuning.cache import PlanCache
+
+    PlanCache().save(str(empty))
+    assert run_cli("tune", "--online", sink, "--cache",
+                   str(empty)) == 0
+    out = capsys.readouterr().out
+    assert "no retune proposals" in out
+
+
+@pytest.mark.retune
+def test_tune_online_usage_error_matrix(tmp_path, capsys):
+    sink, cache = _sink_and_cache(tmp_path)
+    # mode conflicts
+    assert run_cli("tune", "--online", sink, "--explain",
+                   "all_reduce") == 2
+    assert "--explain" in capsys.readouterr().err
+    assert run_cli("tune", "--online", sink, "--ops",
+                   "all_reduce") == 2
+    assert "--ops" in capsys.readouterr().err
+    # --device-kind is --online-scoped
+    assert run_cli("tune", "--device-kind", "v5e") == 2
+    assert "--online" in capsys.readouterr().err
+    # missing sink
+    assert run_cli("tune", "--online", str(tmp_path / "nope.json")) == 2
+    assert "not found" in capsys.readouterr().err
+    # malformed sink JSON is a content error, not a crash
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert run_cli("tune", "--online", str(bad)) == 1
+    assert "not valid JSON" in capsys.readouterr().err
+    # a sink that is not the SampleSink vocabulary
+    junk = tmp_path / "junk.json"
+    junk.write_text(json.dumps({"entries": [{"cost_us": 1.0}]}))
+    assert run_cli("tune", "--online", str(junk), "--cache",
+                   cache) == 1
+    assert "vocabulary" in capsys.readouterr().err
+    # an unsplittable pod shape
+    assert run_cli("tune", "--online", sink, "--slices", "3") == 2
+    assert "slices" in capsys.readouterr().err
+
+
+@pytest.mark.retune
+@pytest.mark.serving
+def test_serve_selftest_retune_gate_and_report(tmp_path, capsys):
+    out_path = tmp_path / "retune.json"
+    assert run_cli("serve", "--selftest", "--retune", "--seed", "3",
+                   "-o", str(out_path)) == 0
+    printed = capsys.readouterr().out
+    assert "retune:" in printed
+    assert "swap(s)" in printed
+    assert "converged to 'rs_ag'" in printed
+    report = json.loads(out_path.read_text())
+    assert report["ok"] is True
+    assert report["retune"]["swaps"] >= 1
+    assert report["retune"]["stale_plan_leaks"] == 0
+    assert report["converged_algorithm"] == "rs_ag"
+    # deterministic per seed
+    out2 = tmp_path / "retune2.json"
+    assert run_cli("serve", "--selftest", "--retune", "--seed", "3",
+                   "-o", str(out2)) == 0
+    capsys.readouterr()
+    assert out_path.read_text() == out2.read_text()
+
+
+@pytest.mark.retune
+@pytest.mark.serving
+def test_chaos_load_retune_adds_the_shift_cell(tmp_path, capsys):
+    out_path = tmp_path / "load.json"
+    assert run_cli("chaos", "--load", "--retune", "--seed", "1729",
+                   "--trials", "1", "--duration", "160",
+                   "-o", str(out_path)) == 0
+    printed = capsys.readouterr().out
+    assert "retune-shift" in printed
+    assert "swap(s) -> 'rs_ag'" in printed
+    report = json.loads(out_path.read_text())
+    assert report["ok"] and report["cells"] == 4
+    assert report["outcomes"]["retune-shift"] == "ok"
+
+
+@pytest.mark.retune
+def test_chaos_retune_requires_load(capsys):
+    assert run_cli("chaos", "--retune") == 2
+    assert "--load" in capsys.readouterr().err
+    assert run_cli("chaos", "--elastic", "--retune") == 2
+    assert "--load" in capsys.readouterr().err

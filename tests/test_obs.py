@@ -66,7 +66,7 @@ def _grid_id(case):
 class TestFlightRecorder:
     def test_schema_is_well_formed(self):
         for kind, (plane, fields) in EVENT_KINDS.items():
-            assert plane in ("sim", "serving", "control")
+            assert plane in ("sim", "serving", "control", "tuning")
             assert isinstance(fields, tuple)
 
     def test_unknown_kind_is_loud(self):

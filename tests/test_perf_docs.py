@@ -610,8 +610,8 @@ def test_observability_doc_quotes_the_schema():
     # a documented kind that no longer exists is equally a drift
     import re
 
-    documented = set(re.findall(r"`((?:credit|dma|barrier|serve|ctl)"
-                                r"\.[a-z_]+)`", text))
+    documented = set(re.findall(r"`((?:credit|dma|barrier|serve|ctl|"
+                                r"tune)\.[a-z_]+)`", text))
     assert documented == set(E.EVENT_KINDS)
     # recorder bounds
     assert f"**{E.DEFAULT_RECORDER_CAPACITY} events**" in text
@@ -628,7 +628,41 @@ def test_observability_doc_quotes_the_schema():
         "epoch_bumps_total", "credit_stall_ticks",
         "wire_lane_occupancy", "queue_depth", "pool_occupancy",
         "admission_wait_ticks", "stream_latency_ticks",
+        "tune_samples_total", "tune_proposals_total",
+        "tune_swaps_total", "tune_rollbacks_total",
     ):
         assert f"`{metric}`" in text, (
             f"metric {metric!r} missing from the catalog"
         )
+
+
+def test_tuning_doc_quotes_the_online_retuner():
+    """docs/tuning.md's "Online retuning (r14)" section must quote the
+    shipped thresholds, env knobs, swap states, model-checker
+    properties, and the convicted mutant — the doc is the
+    human-readable mirror of ``smi_tpu/tuning/online.py`` +
+    ``swap.py`` and must not drift from the code. (Pure Python
+    imports, no devices.)"""
+    from smi_tpu.tuning import online, swap as S
+
+    text = _read("docs/tuning.md")
+    assert "Online retuning (r14)" in text
+    section = text.split("Online retuning (r14)", 1)[1]
+    # thresholds + env knobs
+    assert str(online.DEFAULT_RETUNE_MIN_SAMPLES) in section
+    assert f"{online.DEFAULT_RETUNE_MARGIN:g}x" in section
+    assert str(online.QUIESCE_TIMEOUT_TICKS) in section
+    for env in (online.ONLINE_RETUNE_ENV, online.MIN_SAMPLES_ENV,
+                online.MARGIN_ENV):
+        assert env in section, f"env knob {env} undocumented"
+    # every swap state appears in the state diagram
+    for state in S.SWAP_STATES:
+        assert f"`{state}`" in section, f"state {state} undocumented"
+    # the model-checker story: both properties, the headline mutant,
+    # and the honesty clause
+    assert "`plan-epoch-safety`" in section
+    assert "`swap-lost-accepted`" in section
+    assert "`swap_without_quiesce`" in section
+    assert "does not prove" in section
+    # the resolution ladder names the live tier
+    assert "live" in section and "tune --online" in section

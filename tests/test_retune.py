@@ -1,0 +1,828 @@
+"""Online autotuner tests: shadow comparison, PlanSwap, convergence.
+
+The r14 subsystem end to end: env-knob discipline, the PlanSwap
+state machine and its stale-plan gate, the cache-revision staleness
+rule (a late offline sweep can never resurrect a retired plan), the
+OnlineTuner's noise-proof thresholds, SampleSink behaviour under
+retuner load (bucket edges, tenant churn, snapshot-vs-bookkeeping
+equality of the tune.* counters), the engine's ``live`` provenance
+tier, the seeded payload-shift campaign cells (flat -> rs_ag, pod ->
+hierarchical, kill-during-shift), and the retune model-checker scope
+with its two mutants.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from smi_tpu.obs.events import FlightRecorder
+from smi_tpu.obs.metrics import MetricsRegistry, SampleSink
+from smi_tpu.tuning import cost_model as cm
+from smi_tpu.tuning.cache import CacheEntry, PlanCache, PlanCacheError
+from smi_tpu.tuning.engine import (
+    PlanEngine,
+    _collective_topology,
+    cache_entry_layer,
+)
+from smi_tpu.tuning.online import (
+    DEFAULT_RETUNE_MARGIN,
+    DEFAULT_RETUNE_MIN_SAMPLES,
+    MARGIN_ENV,
+    MIN_SAMPLES_ENV,
+    ONLINE_RETUNE_ENV,
+    OnlineTuner,
+    online_retune_enabled,
+    op_candidates,
+    priced_sample_us,
+    retune_margin,
+    retune_min_samples,
+    sample_bucket_bytes,
+)
+from smi_tpu.tuning.plan import LAYERS, PlanKey, payload_bucket
+from smi_tpu.tuning.swap import (
+    SWAP_STATES,
+    PlanSwap,
+    PlanSwapError,
+    StalePlanError,
+)
+
+pytestmark = pytest.mark.retune
+
+TOPO8 = cm.TopologySpec(n=8)
+POD = cm.TopologySpec(n=8, inner=4, outer=2)
+LARGE = 4 << 20
+SMALL = 64 << 10
+
+
+def large_key(topo=TOPO8, device_kind="live-sim"):
+    return PlanKey("all_reduce", payload_bucket(LARGE), "float32",
+                   device_kind, _collective_topology(topo))
+
+
+def stale_ring_cache(topo=TOPO8, device_kind="live-sim"):
+    cache = PlanCache()
+    cache.put(large_key(topo, device_kind), CacheEntry(
+        {"algorithm": "ring"}, cost_us=700.0,
+        provenance="sweep:stale-offline",
+    ))
+    return cache
+
+
+def fed_tuner(samples=DEFAULT_RETUNE_MIN_SAMPLES, tenant="t0",
+              payload=LARGE, algorithm="ring", **kwargs):
+    """A tuner over the stale-ring cache, fed ``samples`` live
+    timings of ``algorithm`` at ``payload``."""
+    kwargs.setdefault("cache", stale_ring_cache())
+    kwargs.setdefault("topo", TOPO8)
+    kwargs.setdefault("device_kind", "live-sim")
+    tuner = OnlineTuner(**kwargs)
+    us = priced_sample_us("all_reduce", algorithm, payload, TOPO8)
+    for _ in range(samples):
+        tuner.record("all_reduce", us * 1e-6, payload_bytes=payload,
+                     tenant=tenant)
+    return tuner
+
+
+# ---------------------------------------------------------------------------
+# Env knobs: the default_deadline discipline
+# ---------------------------------------------------------------------------
+
+
+class TestEnvKnobs:
+    def test_unset_means_off_and_builtin_defaults(self, monkeypatch):
+        for env in (ONLINE_RETUNE_ENV, MIN_SAMPLES_ENV, MARGIN_ENV):
+            monkeypatch.delenv(env, raising=False)
+        assert online_retune_enabled() is False
+        assert retune_min_samples() == DEFAULT_RETUNE_MIN_SAMPLES
+        assert retune_margin() == DEFAULT_RETUNE_MARGIN
+
+    @pytest.mark.parametrize("value,expected", [
+        ("1", True), ("true", True), ("YES", True), ("on", True),
+        ("0", False), ("false", False), ("No", False), ("off", False),
+        ("", False),
+    ])
+    def test_switch_vocabulary(self, monkeypatch, value, expected):
+        monkeypatch.setenv(ONLINE_RETUNE_ENV, value)
+        assert online_retune_enabled() is expected
+
+    def test_malformed_switch_is_loud(self, monkeypatch):
+        monkeypatch.setenv(ONLINE_RETUNE_ENV, "maybe")
+        with pytest.raises(ValueError, match=ONLINE_RETUNE_ENV):
+            online_retune_enabled()
+
+    def test_min_samples_override_outranks_builtin(self, monkeypatch):
+        monkeypatch.setenv(MIN_SAMPLES_ENV, "24")
+        assert retune_min_samples() == 24
+        assert OnlineTuner().min_samples == 24
+
+    @pytest.mark.parametrize("value", ["0", "-3", "2.5", "lots"])
+    def test_malformed_min_samples_is_loud(self, monkeypatch, value):
+        monkeypatch.setenv(MIN_SAMPLES_ENV, value)
+        with pytest.raises(ValueError, match=MIN_SAMPLES_ENV):
+            retune_min_samples()
+
+    def test_margin_override_outranks_builtin(self, monkeypatch):
+        monkeypatch.setenv(MARGIN_ENV, "2.25")
+        assert retune_margin() == 2.25
+        assert OnlineTuner().margin == 2.25
+
+    @pytest.mark.parametrize("value", ["1.0", "0.9", "nan", "inf", "x"])
+    def test_malformed_margin_is_loud(self, monkeypatch, value):
+        monkeypatch.setenv(MARGIN_ENV, value)
+        with pytest.raises(ValueError, match=MARGIN_ENV):
+            retune_margin()
+
+    def test_explicit_argument_outranks_env(self, monkeypatch):
+        monkeypatch.setenv(MIN_SAMPLES_ENV, "24")
+        monkeypatch.setenv(MARGIN_ENV, "2.25")
+        tuner = OnlineTuner(min_samples=5, margin=3.0)
+        assert tuner.min_samples == 5 and tuner.margin == 3.0
+
+
+# ---------------------------------------------------------------------------
+# PlanSwap: the epoch-guarded state machine
+# ---------------------------------------------------------------------------
+
+
+class TestPlanSwap:
+    def make(self):
+        cache = stale_ring_cache()
+        return cache, PlanSwap(cache, large_key())
+
+    def rival(self):
+        return CacheEntry({"algorithm": "rs_ag"},
+                          provenance="live:retune:test")
+
+    def test_happy_arc_installs_with_bumped_revision_and_epoch(self):
+        cache, swap = self.make()
+        assert swap.state == "idle" and swap.plan_epoch == 0
+        swap.propose(self.rival(), evidence={"why": "test"})
+        assert swap.state == "proposed"
+        swap.quiesce(now=7)
+        assert swap.state == "quiescing" and swap.quiesce_started == 7
+        installed = swap.swap()
+        assert swap.state == "swapped" and swap.plan_epoch == 1
+        assert installed.revision == 1
+        assert cache.lookup(large_key()).knobs["algorithm"] == "rs_ag"
+        swap.commit()
+        assert swap.state == "committed"
+        assert swap.committed_swaps == 1
+
+    def test_every_state_is_in_the_registry(self):
+        cache, swap = self.make()
+        seen = {swap.state}
+        swap.propose(self.rival())
+        seen.add(swap.state)
+        swap.quiesce()
+        seen.add(swap.state)
+        swap.swap()
+        seen.add(swap.state)
+        swap.commit()
+        seen.add(swap.state)
+        swap.propose(self.rival())
+        swap.rollback("test")
+        seen.add(swap.state)
+        assert seen == set(SWAP_STATES)
+
+    def test_illegal_transitions_are_loud(self):
+        cache, swap = self.make()
+        with pytest.raises(PlanSwapError, match="requires"):
+            swap.swap()            # idle -> swap
+        with pytest.raises(PlanSwapError, match="requires"):
+            swap.commit()          # idle -> commit
+        with pytest.raises(PlanSwapError, match="requires"):
+            swap.rollback()        # nothing in flight
+        swap.propose(self.rival())
+        with pytest.raises(PlanSwapError, match="requires"):
+            swap.swap()            # proposed -> swap (quiesce skipped!)
+        with pytest.raises(PlanSwapError, match="requires"):
+            swap.propose(self.rival())   # already in flight
+
+    def test_pre_swap_rollback_leaves_entry_and_epoch_untouched(self):
+        cache, swap = self.make()
+        swap.propose(self.rival())
+        swap.rollback("changed my mind")
+        assert swap.state == "rolled_back" and swap.plan_epoch == 0
+        assert cache.lookup(large_key()).knobs["algorithm"] == "ring"
+        assert swap.last_rollback_reason == "changed my mind"
+
+    def test_post_swap_rollback_restores_under_a_further_bump(self):
+        cache, swap = self.make()
+        swap.propose(self.rival())
+        swap.quiesce()
+        swap.swap()
+        assert swap.plan_epoch == 1
+        swap.rollback("validation failed")
+        # monotone: the restore is itself a plan change
+        assert swap.plan_epoch == 2
+        assert cache.lookup(large_key()).knobs["algorithm"] == "ring"
+
+    def test_stale_plan_gate_names_key_stale_and_current(self):
+        cache, swap = self.make()
+        swap.propose(self.rival())
+        swap.quiesce()
+        swap.swap()
+        swap.validate(1)  # current: fine
+        with pytest.raises(StalePlanError) as e:
+            swap.validate(0, what="straggler chunk")
+        assert e.value.stale == 0 and e.value.current == 1
+        assert e.value.key == large_key().signature()
+        assert "straggler chunk" in str(e.value)
+        assert "never folded in" in str(e.value)
+
+    def test_revision_is_monotone_across_swaps(self):
+        cache, swap = self.make()
+        for expect in (1, 2):
+            swap.propose(self.rival())
+            swap.quiesce()
+            assert swap.swap().revision == expect
+            swap.commit()
+
+
+# ---------------------------------------------------------------------------
+# CacheEntry.revision: the staleness satellite
+# ---------------------------------------------------------------------------
+
+
+class TestCacheRevision:
+    def test_default_revision_zero_keeps_json_byte_stable(self):
+        e = CacheEntry({"algorithm": "ring"}, cost_us=1.0)
+        assert e.revision == 0
+        assert "revision" not in e.to_json()
+        e2 = dataclasses.replace(e, revision=3)
+        assert e2.to_json()["revision"] == 3
+        back = CacheEntry.from_json("sig", e2.to_json())
+        assert back.revision == 3
+
+    @pytest.mark.parametrize("junk", ["1", 1.5, -1, True])
+    def test_malformed_revision_is_loud(self, junk):
+        with pytest.raises(PlanCacheError, match="revision"):
+            CacheEntry.from_json("sig", {"knobs": {}, "revision": junk})
+
+    def test_late_offline_sweep_cannot_resurrect_a_retired_plan(self):
+        """THE interleaving regression: the live tuner retires ring
+        (revision 1); a late-arriving offline sweep merge carries a
+        better-measured ring entry at revision 0 — it must lose."""
+        cache = stale_ring_cache()
+        swap = PlanSwap(cache, large_key())
+        swap.propose(CacheEntry({"algorithm": "rs_ag"},
+                                provenance="live:retune:test"))
+        swap.quiesce()
+        swap.swap()
+        swap.commit()
+        # yesterday's sweep finishes late and merges in: measured ring
+        # "better" than the live entry's (unmeasured) cost
+        late_sweep = PlanCache()
+        late_sweep.put(large_key(), CacheEntry(
+            {"algorithm": "ring"}, cost_us=1.0,
+            provenance="sweep:late",
+        ))
+        cache.merge(late_sweep)
+        survivor = cache.lookup(large_key())
+        assert survivor.knobs["algorithm"] == "rs_ag"
+        assert survivor.revision == 1
+        # ...and a LATER live revision displaces the earlier one
+        newer = PlanCache()
+        newer.put(large_key(), CacheEntry(
+            {"algorithm": "hierarchical"}, revision=2,
+            provenance="live:retune:newer",
+        ))
+        cache.merge(newer)
+        assert cache.lookup(large_key()).knobs["algorithm"] \
+            == "hierarchical"
+
+    def test_revision_zero_pairs_keep_the_original_merge_rules(self):
+        a = CacheEntry({"x": 1}, cost_us=5.0)
+        b = CacheEntry({"x": 2}, cost_us=3.0)
+        unmeasured = CacheEntry({"x": 3})
+        assert b.better_than(a) and not a.better_than(b)
+        assert not unmeasured.better_than(a)
+        assert a.better_than(unmeasured)
+        assert unmeasured.better_than(CacheEntry({"x": 4}))
+
+
+# ---------------------------------------------------------------------------
+# OnlineTuner: thresholds, proposals, observability
+# ---------------------------------------------------------------------------
+
+
+class TestOnlineTuner:
+    def test_negative_sample_is_loud(self):
+        with pytest.raises(ValueError, match="negative sample"):
+            OnlineTuner().record("all_reduce", -1.0)
+
+    def test_below_min_samples_never_proposes(self):
+        tuner = fed_tuner(samples=DEFAULT_RETUNE_MIN_SAMPLES - 1)
+        assert tuner.maybe_propose() == []
+        tuner.record("all_reduce",
+                     priced_sample_us("all_reduce", "ring", LARGE,
+                                      TOPO8) * 1e-6,
+                     payload_bytes=LARGE, tenant="t0")
+        assert len(tuner.maybe_propose()) == 1
+
+    def test_inside_the_margin_band_never_proposes(self):
+        """Noise can't flip: measured just UNDER margin*rival holds
+        the plan; just over proposes."""
+        rival_us = priced_sample_us("all_reduce", "rs_ag", LARGE, TOPO8)
+        for factor, expect in ((0.98, 0), (1.02, 1)):
+            cache = stale_ring_cache()
+            tuner = OnlineTuner(cache=cache, topo=TOPO8,
+                                device_kind="live-sim")
+            us = rival_us * tuner.margin * factor
+            for _ in range(tuner.min_samples):
+                tuner.record("all_reduce", us * 1e-6,
+                             payload_bytes=LARGE, tenant="t0")
+            assert len(tuner.maybe_propose()) == expect, factor
+
+    def test_no_active_entry_means_nothing_to_retune(self):
+        tuner = fed_tuner(cache=PlanCache())
+        assert tuner.maybe_propose() == []
+
+    def test_small_payload_with_good_plan_never_proposes(self):
+        """At 64 KiB the ring IS the best candidate: even a stale
+        entry naming it holds (the rival rs_ag models slower)."""
+        cache = PlanCache()
+        key = PlanKey("all_reduce", payload_bucket(SMALL), "float32",
+                      "live-sim", _collective_topology(TOPO8))
+        cache.put(key, CacheEntry({"algorithm": "ring"}, cost_us=130.0,
+                                  provenance="sweep:fine"))
+        tuner = fed_tuner(cache=cache, payload=SMALL)
+        assert tuner.maybe_propose() == []
+
+    def test_full_arc_installs_live_entry_and_resets_cells(self):
+        tuner = fed_tuner(samples=20, tenant="t3")
+        (swap,) = tuner.maybe_propose()
+        ev = swap.proposal.evidence
+        assert ev["from"] == "ring" and ev["to"] == "rs_ag"
+        assert ev["samples"] == 20
+        tuner.start_quiesce(swap)
+        installed = tuner.execute_swap(swap)
+        tuner.commit(swap)
+        assert installed.provenance.startswith("live:retune:")
+        assert "samples=20" in installed.provenance
+        assert "margin=" in installed.provenance
+        assert "tenant=t3" in installed.provenance
+        assert installed.revision == 1
+        assert tuner.swaps == 1 and tuner.proposals == 1
+        # the cell reset: fresh window measures the NEW plan, so the
+        # committed swap cannot immediately re-propose itself away
+        rs_ag_us = priced_sample_us("all_reduce", "rs_ag", LARGE, TOPO8)
+        for _ in range(tuner.min_samples):
+            tuner.record("all_reduce", rs_ag_us * 1e-6,
+                         payload_bytes=LARGE, tenant="t3")
+        assert tuner.maybe_propose() == []
+
+    def test_rollback_counts_and_emits(self):
+        rec = FlightRecorder()
+        tuner = fed_tuner(recorder=rec)
+        (swap,) = tuner.maybe_propose()
+        tuner.rollback(swap, "quiesce-timeout")
+        assert tuner.rollbacks == 1
+        assert rec.counts.get("tune.rollback") == 1
+        assert tuner.cache.lookup(large_key()).knobs["algorithm"] \
+            == "ring"
+
+    def test_timed_sink_plumbing(self):
+        """``tracing.timed(sink=tuner)`` streams a wall-clock sample
+        into the tuner with no adapter (the SampleSink shape)."""
+        from smi_tpu.utils.tracing import timed
+
+        tuner = OnlineTuner()
+        result, elapsed = timed(lambda: 41 + 1, sink=tuner,
+                                op="all_reduce", payload_bytes=LARGE,
+                                tenant="t9")
+        assert result == 42
+        assert tuner.samples_ingested == 1
+        key = ("all_reduce", sample_bucket_bytes(LARGE), "t9")
+        assert tuner.cells[key].count == 1
+
+    def test_metrics_snapshot_equals_bookkeeping(self):
+        """Satellite: the tune.* counters are incremented at the
+        tuner's own accounting sites — snapshot == bookkeeping."""
+        metrics = MetricsRegistry()
+        rec = FlightRecorder()
+        tuner = fed_tuner(samples=20, metrics=metrics, recorder=rec)
+        for swap in tuner.maybe_propose():
+            tuner.start_quiesce(swap)
+            tuner.execute_swap(swap)
+            tuner.commit(swap)
+        # one more cell that rolls back
+        sm = PlanKey("all_reduce", payload_bucket(SMALL), "float32",
+                     "live-sim", _collective_topology(TOPO8))
+        tuner.cache.put(sm, CacheEntry({"algorithm": "rs_ag"},
+                                       provenance="sweep:bad"))
+        ring_small = priced_sample_us("all_reduce", "rs_ag", SMALL,
+                                      TOPO8) * tuner.margin * 1.1
+        for _ in range(tuner.min_samples):
+            tuner.record("all_reduce", ring_small * 1e-6,
+                         payload_bytes=SMALL, tenant="t0")
+        (swap2,) = tuner.maybe_propose()
+        tuner.rollback(swap2, "test")
+        counters = metrics.snapshot()["counters"]
+        assert sum(v for k, v in counters.items()
+                   if k.startswith("tune_samples_total")) \
+            == tuner.samples_ingested
+        assert sum(v for k, v in counters.items()
+                   if k.startswith("tune_proposals_total")) \
+            == tuner.proposals == 2
+        assert sum(v for k, v in counters.items()
+                   if k.startswith("tune_swaps_total")) \
+            == tuner.swaps == 1
+        assert sum(v for k, v in counters.items()
+                   if k.startswith("tune_rollbacks_total")) \
+            == tuner.rollbacks == 1
+        # ...and the event stream agrees
+        assert rec.counts["tune.sample"] == tuner.samples_ingested
+        assert rec.counts["tune.propose"] == 2
+        assert rec.counts["tune.swap"] == 1
+        assert rec.counts["tune.rollback"] == 1
+
+    def test_sample_event_schema_is_valid(self):
+        rec = FlightRecorder()
+        tuner = OnlineTuner(recorder=rec)
+        tuner.record("all_reduce", 1e-3, payload_bytes=LARGE,
+                     tenant="t0")
+        (event,) = rec.events()
+        assert event.plane == "tuning" and event.kind == "tune.sample"
+        payload = event.to_json()
+        assert payload["op"] == "all_reduce"
+        assert payload["bucket"] == sample_bucket_bytes(LARGE)
+
+    def test_ingest_sample_sink_round_trip(self):
+        sink = SampleSink()
+        us = priced_sample_us("all_reduce", "ring", LARGE, TOPO8)
+        for _ in range(20):
+            sink.record("all_reduce", us * 1e-6, payload_bytes=LARGE,
+                        tenant="t1")
+        for form in (sink, sink.snapshot(), sink.entries()):
+            tuner = OnlineTuner(cache=stale_ring_cache(), topo=TOPO8,
+                                device_kind="live-sim")
+            assert tuner.ingest(form) == 20
+            assert len(tuner.maybe_propose()) == 1, type(form)
+
+    @pytest.mark.parametrize("junk", [
+        42, [{"cost_us": 1.0}], [{"knobs": {}, "cost_us": 1.0}],
+        [{"knobs": {"op": "x", "samples": 0}, "cost_us": 1.0}],
+    ])
+    def test_ingest_junk_is_loud(self, junk):
+        with pytest.raises(ValueError):
+            OnlineTuner().ingest(junk)
+
+
+# ---------------------------------------------------------------------------
+# SampleSink under retuner load: bucket edges + vocabulary agreement
+# ---------------------------------------------------------------------------
+
+
+class TestBucketBoundaries:
+    def test_exact_pow2_edge_payloads_bucket_consistently(self):
+        """A payload exactly at a pow2 edge lands in the plan bucket
+        that covers [2^k, 2^(k+1)) — and 2^(k+1) starts a new cell —
+        in BOTH the tuner's vocabulary and the plan cache's."""
+        k = 20
+        edge, above, top = 1 << k, (1 << k) + 1, (1 << (k + 1)) - 1
+        nxt = 1 << (k + 1)
+        assert sample_bucket_bytes(edge) == edge
+        assert sample_bucket_bytes(above) == edge
+        assert sample_bucket_bytes(top) == edge
+        assert sample_bucket_bytes(nxt) == nxt
+        assert payload_bucket(edge) == payload_bucket(top) == f"pow2:{k}"
+        assert payload_bucket(nxt) == f"pow2:{k + 1}"
+        tuner = OnlineTuner()
+        for p in (edge, above, top):
+            tuner.record("all_reduce", 1e-3, payload_bytes=p)
+        tuner.record("all_reduce", 1e-3, payload_bytes=nxt)
+        assert tuner.cells[("all_reduce", edge, None)].count == 3
+        assert tuner.cells[("all_reduce", nxt, None)].count == 1
+
+    def test_swapped_entry_is_what_the_engine_consults(self):
+        """The entry a swap installs for a bucket is exactly the one
+        the plan engine resolves for any payload in that bucket —
+        edges included — and renders as the ``live`` layer."""
+        tuner = fed_tuner(samples=20)
+        for swap in tuner.maybe_propose():
+            tuner.start_quiesce(swap)
+            tuner.execute_swap(swap)
+            tuner.commit(swap)
+        engine = PlanEngine(cache=tuner.cache, device_kind="live-sim")
+        for payload in (LARGE, LARGE + 1, (LARGE << 1) - 1):
+            plan = engine.allreduce_plan(payload, TOPO8)
+            assert plan.knobs["algorithm"] == "rs_ag", payload
+            assert plan.decided_by["algorithm"] == "live", payload
+
+    def test_sample_sink_edge_vocabulary_is_upper_bound(self):
+        """The metrics-side SampleSink keeps its documented
+        upper-bound grid: exactly-at-edge stays, one-over moves up —
+        pinned so the tuner's deliberate divergence (plan-vocabulary
+        lower bounds) stays a visible, tested decision."""
+        from smi_tpu.obs.metrics import payload_bucket as sink_bucket
+
+        assert sink_bucket(1024) == 1024
+        assert sink_bucket(1025) == 2048
+
+    def test_ingest_representative_is_the_sink_bound(self):
+        """The documented ingest caveat, pinned: a recorded sink
+        bucket maps through its bound, so replaying EXACT-pow2
+        traffic lands on the same cell the live record() path uses —
+        while interior payloads (lossy by the sink's own grid) land
+        one bucket high and must prefer the live path."""
+        sink = SampleSink()
+        sink.record("all_reduce", 1e-3, payload_bytes=LARGE)      # 4 MiB
+        sink.record("all_reduce", 1e-3, payload_bytes=LARGE - 8)  # interior
+        offline = OnlineTuner()
+        offline.ingest(sink)
+        live = OnlineTuner()
+        live.record("all_reduce", 1e-3, payload_bytes=LARGE)
+        live.record("all_reduce", 1e-3, payload_bytes=LARGE - 8)
+        # exact-pow2: offline cell == live cell (both at the 4 MiB key)
+        assert ("all_reduce", LARGE, None) in offline.cells
+        assert ("all_reduce", LARGE, None) in live.cells
+        # interior: the sink already merged it into its 4 MiB bucket,
+        # so offline sees ONE cell where live keeps two — the lossy
+        # half of the caveat, held visible here
+        assert offline.cells[("all_reduce", LARGE, None)].count == 2
+        assert live.cells[("all_reduce", LARGE >> 1, None)].count == 1
+
+
+# ---------------------------------------------------------------------------
+# The engine's live tier
+# ---------------------------------------------------------------------------
+
+
+class TestLiveTier:
+    def test_layers_ladder_names_live_after_cache(self):
+        assert LAYERS == ("cache", "live", "model", "heuristic")
+
+    def test_cache_entry_layer_discriminates_on_provenance(self):
+        live = CacheEntry({"algorithm": "rs_ag"},
+                          provenance="live:retune:samples=16:margin=2x")
+        swept = CacheEntry({"algorithm": "rs_ag"},
+                           provenance="sweep:allreduce:4096KiB:n8")
+        assert cache_entry_layer(live) == "live"
+        assert cache_entry_layer(swept) == "cache"
+
+    def test_plan_source_ranks_live_between_cache_and_model(self):
+        from smi_tpu.tuning.plan import Plan
+
+        plan = Plan(key=large_key(), knobs={"algorithm": "rs_ag"},
+                    decided_by={"algorithm": "live"})
+        assert plan.source == "live"
+
+    def test_explain_names_samples_and_margin(self):
+        cache = PlanCache()
+        cache.put(large_key(), CacheEntry(
+            {"algorithm": "rs_ag"}, revision=1,
+            provenance="live:retune:samples=48:margin=1.90x:tenant=t3",
+        ))
+        engine = PlanEngine(cache=cache, device_kind="live-sim")
+        text = engine.allreduce_plan(LARGE, TOPO8).explain()
+        assert "[live]" in text
+        assert "samples=48" in text and "margin=1.90x" in text
+        assert "revision 1" in text
+
+    def test_sweep_entries_still_render_as_cache(self):
+        engine = PlanEngine(cache=stale_ring_cache(),
+                            device_kind="live-sim")
+        plan = engine.allreduce_plan(LARGE, TOPO8)
+        assert plan.decided_by["algorithm"] == "cache"
+
+    def test_alltoall_live_tier(self):
+        cache = PlanCache()
+        key = PlanKey("all_to_all", payload_bucket(LARGE), "float32",
+                      "live-sim", _collective_topology(TOPO8))
+        cache.put(key, CacheEntry(
+            {"algorithm": "bruck"},
+            provenance="live:retune:samples=20:margin=4.10x",
+        ))
+        engine = PlanEngine(cache=cache, device_kind="live-sim")
+        plan = engine.alltoall_plan(LARGE, TOPO8)
+        assert plan.decided_by["algorithm"] == "live"
+
+
+# ---------------------------------------------------------------------------
+# The seeded payload-shift campaign cells
+# ---------------------------------------------------------------------------
+
+
+class TestRetuneCell:
+    def test_flat_cell_converges_to_rs_ag(self):
+        from smi_tpu.serving.campaign import run_retune_cell
+
+        rep = run_retune_cell(n=4, seed=0, duration=160)
+        assert rep["ok"], rep["verdict"]
+        rt = rep["retune"]
+        assert rt["swaps"] >= 1 and rt["rollbacks"] == 0
+        assert rep["converged_algorithm"] == "rs_ag"
+        assert rep["converged_algorithm"] == rep["expected_algorithm"]
+        assert rep["converged_revision"] == 1
+        assert rep["convergence_ticks"] is not None
+        assert rep["swap_tick"] >= rep["shift_at"]
+        assert rep["silent_corruptions"] == 0
+        assert rep["lost_accepted"] == 0
+        assert rep["stale_epoch_leaks"] == 0
+        assert rt["stale_plan_leaks"] == 0
+        assert rt["stale_plan_rejections"] >= 1
+
+    def test_pod_cell_converges_to_hierarchical(self):
+        from smi_tpu.serving.campaign import run_retune_cell
+
+        rep = run_retune_cell(n=4, seed=1, duration=160, slices=2)
+        assert rep["ok"], rep["verdict"]
+        assert rep["converged_algorithm"] == "hierarchical"
+
+    def test_tenant_churn_failover_during_the_window(self):
+        """Satellite: samples keep flowing from a tenant whose
+        destination failed over mid-window — the cells stay separate,
+        the failover completes, and the tuner still converges."""
+        from smi_tpu.serving.campaign import run_retune_cell
+
+        rep = run_retune_cell(n=4, seed=3, duration=240, kill_rank=1)
+        assert rep["ok"], rep["verdict"]
+        assert rep["confirmed"] == [1]
+        assert rep["converged_algorithm"] == "rs_ag"
+        assert rep["replayed_chunks"] >= 0
+
+    def test_cell_is_deterministic_per_seed(self):
+        from smi_tpu.serving.campaign import run_retune_cell
+
+        a = run_retune_cell(n=4, seed=7, duration=160)
+        b = run_retune_cell(n=4, seed=7, duration=160)
+        assert json.dumps(a, sort_keys=True) \
+            == json.dumps(b, sort_keys=True)
+
+    def test_degenerate_shapes_are_loud(self):
+        from smi_tpu.serving.campaign import run_retune_cell
+
+        with pytest.raises(ValueError, match="minimum"):
+            run_retune_cell(duration=60)
+        with pytest.raises(ValueError, match="same payload bucket"):
+            run_retune_cell(small_kb=64, large_kb=100)
+        with pytest.raises(ValueError, match="slices"):
+            run_retune_cell(slices=3)
+        with pytest.raises(ValueError, match="never fires"):
+            run_retune_cell(duration=160, kill_rank=0, kill_at=200)
+
+    def test_frontend_replans_streams_admitted_during_quiesce(self):
+        from smi_tpu.serving.campaign import run_retune_cell
+
+        rep = run_retune_cell(n=4, seed=0, duration=160)
+        # the report carries the re-plan bookkeeping (>= 0; the drain
+        # discipline means the count is exactly the proposing tenant's
+        # streams admitted between propose and swap)
+        assert rep["retune"]["replanned_streams"] >= 0
+
+    @pytest.mark.slow
+    def test_long_drift_soak(self):
+        """The long soak: more seeds, longer schedules, both
+        topologies — every cell green."""
+        from smi_tpu.serving.campaign import run_retune_cell
+
+        for seed in range(4):
+            for slices in (None, 2):
+                rep = run_retune_cell(n=4, seed=seed, duration=480,
+                                      slices=slices)
+                assert rep["ok"], (seed, slices, rep["verdict"])
+
+
+# ---------------------------------------------------------------------------
+# The model-checker scope + mutants (the acceptance matrix)
+# ---------------------------------------------------------------------------
+
+
+class TestModelRetune:
+    def scope(self):
+        from smi_tpu import analysis as A
+
+        (scope,) = [s for s in A.DEFAULT_SCOPES if s.retune]
+        return scope
+
+    def test_clean_retune_scope_exhausts_ok(self):
+        from smi_tpu import analysis as A
+
+        report = A.check_scope(self.scope())
+        assert report.ok, report.describe()
+        assert not report.truncated
+        assert "plan-epoch-safety" in report.properties
+        assert "swap-lost-accepted" in report.properties
+
+    def test_swap_without_quiesce_minimal_trace(self):
+        """THE acceptance criterion: convicted by exactly
+        plan-epoch-safety, with the BFS-minimal 4-step trace
+        admit -> propose -> quiesce -> swap, replayable as a failing
+        campaign cell."""
+        from smi_tpu import analysis as A
+        from smi_tpu.serving.campaign import (
+            MODEL_GATES,
+            replay_model_trace,
+        )
+
+        report = A.check_scope(
+            self.scope(),
+            world_factory=A.model_mutant_world("swap_without_quiesce"),
+            mutant="swap_without_quiesce",
+        )
+        assert not report.ok
+        assert {f.property for f in report.findings} \
+            == {"plan-epoch-safety"}
+        finding = report.findings[0]
+        kinds = [a[0] for a in finding.trace]
+        assert kinds == ["admit", "plan_propose", "plan_quiesce",
+                         "plan_swap"]
+        cell = replay_model_trace(self.scope(), finding.trace,
+                                  mutant="swap_without_quiesce")
+        assert not cell["ok"]
+        assert MODEL_GATES["plan-epoch-safety"] in cell["verdict"]
+
+    def test_rollback_discards_entry_conviction(self):
+        from smi_tpu import analysis as A
+        from smi_tpu.serving.campaign import (
+            MODEL_GATES,
+            replay_model_trace,
+        )
+
+        report = A.check_scope(
+            self.scope(),
+            world_factory=A.model_mutant_world(
+                "rollback_discards_entry"),
+            mutant="rollback_discards_entry",
+        )
+        assert not report.ok
+        assert {f.property for f in report.findings} \
+            == {"swap-lost-accepted"}
+        finding = report.findings[0]
+        assert [a[0] for a in finding.trace] \
+            == ["plan_propose", "plan_abort"]
+        cell = replay_model_trace(self.scope(), finding.trace,
+                                  mutant="rollback_discards_entry")
+        assert not cell["ok"]
+        assert MODEL_GATES["swap-lost-accepted"] in cell["verdict"]
+
+    def test_retune_mutants_benign_on_non_retune_scopes(self):
+        """The swap seams are inert without a swap machine: both
+        mutants are clean on every scope with retune=0."""
+        from smi_tpu import analysis as A
+
+        scope = A.DEFAULT_SCOPES[0]
+        for mutant in ("swap_without_quiesce",
+                       "rollback_discards_entry"):
+            report = A.check_scope(
+                scope, world_factory=A.model_mutant_world(mutant),
+                mutant=mutant,
+            )
+            assert report.ok, mutant
+
+    def test_scope_validation(self):
+        from smi_tpu import analysis as A
+
+        with pytest.raises(ValueError, match="retune"):
+            A.Scope(retune=2)
+        parsed = A.parse_scope("tenants=2,ranks=2,retune=1")
+        assert parsed.retune == 1
+
+    def test_clean_world_report_carries_the_retune_block(self):
+        from smi_tpu import analysis as A
+
+        world = A.World(self.scope())
+        for action in ((("admit", 0)), ("plan_propose",),
+                       ("plan_quiesce",)):
+            world.apply(tuple(action))
+        rep = world.report()
+        assert rep["retune"]["swap_state"] == "quiescing"
+        assert rep["retune"]["active_algorithm"] == "ring"
+
+
+# ---------------------------------------------------------------------------
+# bench.py: the additive retune field
+# ---------------------------------------------------------------------------
+
+
+class TestBenchRetuneField:
+    def test_retune_fields_shape_and_gates(self):
+        import bench
+
+        fields = bench.retune_fields()
+        assert fields["ok"] is True
+        assert fields["swaps"] >= 1
+        assert fields["rollbacks"] == 0
+        assert fields["converged_algorithm"] \
+            == fields["expected_algorithm"] == "rs_ag"
+        assert fields["convergence_ticks"] is not None
+        assert fields["samples_ingested"] > 0
+
+    def test_render_line_keeps_the_legacy_contract(self):
+        """The retune field is ADDITIVE: the one-line schema
+        (metric/value/unit/vs_baseline) renders unchanged with it
+        present."""
+        import bench
+
+        payload = {
+            "metric": "stencil_throughput", "value": 1.0,
+            "unit": "Gcell/s", "vs_baseline": 1.0,
+            "retune": {"swaps": 1, "ok": True},
+        }
+        line = bench.render_line(payload)
+        parsed = json.loads(line)
+        for key in ("metric", "value", "unit", "vs_baseline"):
+            assert key in parsed
+        assert parsed["retune"]["swaps"] == 1
