@@ -318,6 +318,50 @@ def obs_fields() -> dict:
     }
 
 
+def slo_fields() -> dict:
+    """Additive SLO provenance: the burn-rate health and p99 blame of
+    a deterministic fair-weather serving smoke (pure Python,
+    milliseconds, fixed seed, 0.5x load — the regime where zero
+    alarms is the contract). Reports the per-class worst burn rate
+    observed (the noise floor — should be 0.0 in fair weather), total
+    breaches, and the slow-decile blame component shares — so the one
+    JSON line records not just throughput but how close the serving
+    tier sat to its error budgets while sustaining it. The legacy
+    metric/value/unit/vs_baseline contract is untouched
+    (schema-guarded by ``tests/test_slo.py``)."""
+    from smi_tpu.serving.campaign import run_load_cell
+
+    rep = run_load_cell(n=4, seed=0, duration=160, overload=0.5)
+    health = rep["health"]
+    blame = rep["blame"]
+    binding = blame["binding"]
+    return {
+        "cell": "fair-weather 0.5x",
+        "fair_weather_burn": {
+            qos: c["worst_burn"]
+            for qos, c in health["classes"].items()
+        },
+        "breaches": health["breaches_total"],
+        "p99_blame": {
+            qos: {
+                "p99_ticks": row["p99"],
+                "binding": row["binding"],
+                "resource": row["resource"],
+                "shares": row["shares"],
+            }
+            for qos, row in blame["by_qos"].items()
+            if row is not None
+        },
+        "binding": {
+            "component": binding["component"],
+            "resource": binding["resource"],
+            "share": binding["share"],
+        },
+        "span_exact": rep["span_exact"],
+        "ok": rep["ok"],
+    }
+
+
 def retune_fields() -> dict:
     """Additive online-retuning provenance: the seeded payload-shift
     cell (:func:`smi_tpu.serving.campaign.run_retune_cell` — pure
@@ -498,6 +542,13 @@ def main():
         payload["retune"] = retune_fields()
     except Exception as e:
         payload["retune"] = {"error": f"{type(e).__name__}: {e}"}
+    # additive SLO field (same best-effort contract): fair-weather
+    # burn rates + p99 blame component shares from the deterministic
+    # serving smoke
+    try:
+        payload["slo"] = slo_fields()
+    except Exception as e:
+        payload["slo"] = {"error": f"{type(e).__name__}: {e}"}
     # additive multi-metric scoreboard (same best-effort contract):
     # the measured stencil plus the committed flash/allreduce
     # baselines, each with a pass/regress verdict

@@ -1259,6 +1259,29 @@ def cmd_serve(args: argparse.Namespace) -> int:
             f"{report['silent_corruptions']} silent corruptions, "
             f"{report['lost_accepted']} lost accepted"
         )
+        # the r15 health line: burn-rate state + the blame verdict
+        health = report.get("health")
+        if health is not None:
+            breached = [
+                q for q, c in health["classes"].items()
+                if c["breaches"]
+            ]
+            print(
+                f"     health: "
+                + ("ok" if not health["breaches_total"] else
+                   f"{health['breaches_total']} SLO breach(es) "
+                   f"[{', '.join(breached)}]")
+                + f"; span exactness "
+                + ("held" if report.get("span_exact") else "FAILED")
+            )
+        blame = report.get("blame")
+        if blame is not None:
+            b = blame["binding"]
+            print(
+                f"      blame: tail bound by {b['component']} -> "
+                f"{b['resource']} ({b['share']:.0%} of the slow "
+                f"decile)"
+            )
         if getattr(args, "retune", False):
             rt = report["retune"]
             print(
@@ -1296,6 +1319,18 @@ def cmd_trace(args: argparse.Namespace) -> int:
     from smi_tpu.analysis.verifier import DEFAULT_SHAPES
     from smi_tpu.obs import trace as obs_trace
 
+    if getattr(args, "serve", False):
+        if args.all or args.protocols:
+            print("error: --serve and --protocol/--all are exclusive "
+                  "(--serve traces the seeded serving selftest, not "
+                  "a simulator protocol)", file=sys.stderr)
+            return 2
+        if args.payload_kb is not None:
+            print("error: --payload-kb only applies to protocol "
+                  "traces (--serve's payloads are the selftest's own "
+                  "chunk streams)", file=sys.stderr)
+            return 2
+        return _cmd_trace_serve(args)
     if args.all and args.protocols:
         print("error: --all and --protocol are exclusive (--all "
               "already selects every registered protocol)",
@@ -1303,8 +1338,9 @@ def cmd_trace(args: argparse.Namespace) -> int:
         return 2
     if not args.all and not args.protocols:
         print("error: pick protocols with --protocol NAME "
-              "(repeatable) or trace every registered protocol with "
-              "--all", file=sys.stderr)
+              "(repeatable), trace every registered protocol with "
+              "--all, or export a serving run with --serve",
+              file=sys.stderr)
         return 2
     known = list(DEFAULT_SHAPES)
     protocols = known if args.all else args.protocols
@@ -1350,6 +1386,139 @@ def cmd_trace(args: argparse.Namespace) -> int:
             ).decode()
         )
     return 0
+
+
+def _cmd_trace_serve(args: argparse.Namespace) -> int:
+    """``smi-tpu trace --serve``: export a seeded ``serve --selftest``
+    run as a Chrome trace — per-tenant track groups, one thread per
+    request, spans from the r15 span builder (components + parks/
+    sheds/retune-quiesce annotations). Deterministic per ``--seed``:
+    same seed, byte-identical file; schema-validated before writing.
+    """
+    from smi_tpu.obs import trace as obs_trace
+    from smi_tpu.obs.spans import frontend_spans
+    from smi_tpu.serving.campaign import serve_selftest
+
+    report, fe = serve_selftest(seed=args.seed, return_frontend=True)
+    payload = obs_trace.trace_serving(
+        frontend_spans(fe), seed=args.seed, label="selftest"
+    )
+    obs_trace.validate_chrome_trace(payload)
+    data = obs_trace.trace_to_json_bytes(payload)
+    other = payload["otherData"]
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        path = os.path.join(
+            args.out, obs_trace.trace_name(payload) + ".trace.json"
+        )
+        with open(path, "wb") as f:
+            f.write(data)
+        print(
+            f"serving selftest (seed {args.seed}): "
+            f"{other['requests']} request(s) across "
+            f"{other['tenants']} tenant(s), "
+            f"{other['delivered']} delivered / {other['shed']} shed, "
+            f"makespan {other['makespan_ticks']} ticks -> {path}"
+        )
+    else:
+        sys.stdout.write(data.decode())
+    return 0 if report["ok"] else 1
+
+
+def cmd_health(args: argparse.Namespace) -> int:
+    """``smi-tpu health``: render span / SLO / blame state from a
+    recorded run (a ``serve --selftest -o`` / ``chaos --load -o``
+    report JSON) or from a fresh seeded selftest (``--selftest``).
+
+    Text output: per cell, the burn-rate health table, the
+    tail-latency blame verdict, and the span digest. ``--json``
+    prints the extracted state. Exit 1 when any rendered cell failed
+    its gates (breaches alone are health *observations*, not
+    failures); 2 on usage errors.
+    """
+    from smi_tpu.obs.slo import format_health
+    from smi_tpu.obs.spans import format_blame
+
+    if args.selftest and args.report:
+        print("error: pass a recorded REPORT.json or --selftest, "
+              "not both", file=sys.stderr)
+        return 2
+    if args.report and args.seed is not None:
+        print("error: --seed only applies to --selftest (a recorded "
+              "report carries its own seed)", file=sys.stderr)
+        return 2
+    if not args.selftest and not args.report:
+        print("error: pass a recorded REPORT.json (serve --selftest "
+              "-o / chaos --load -o) or run a fresh one with "
+              "--selftest", file=sys.stderr)
+        return 2
+    if args.selftest:
+        from smi_tpu.serving.campaign import serve_selftest
+
+        seed = args.seed if args.seed is not None else 0
+        payload = serve_selftest(seed=seed)
+        source = f"selftest (seed {seed})"
+    else:
+        try:
+            with open(args.report) as f:
+                payload = json.load(f)
+        except OSError as e:
+            print(f"error: cannot read {args.report}: {e}",
+                  file=sys.stderr)
+            return 2
+        except json.JSONDecodeError as e:
+            print(f"error: {args.report} is not JSON: {e}",
+                  file=sys.stderr)
+            return 1
+        source = args.report
+    cells = payload.get("reports") if isinstance(payload, dict) \
+        else None
+    if cells is None:
+        cells = [payload]
+    missing = [i for i, c in enumerate(cells)
+               if not isinstance(c, dict) or "health" not in c]
+    if missing:
+        print(
+            f"error: {source} carries no health state (cell(s) "
+            f"{missing} lack the r15 'health' field — re-record with "
+            f"this build)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({
+            "source": source,
+            "cells": [{
+                "cell": c.get("cell", "selftest"),
+                "ok": c.get("ok"),
+                "verdict": c.get("verdict"),
+                "health": c["health"],
+                "blame": c.get("blame"),
+                "spans": c.get("spans"),
+                "span_exact": c.get("span_exact"),
+            } for c in cells],
+        }, indent=2, sort_keys=True))
+    else:
+        print(f"health: {source} ({len(cells)} cell(s))")
+        for c in cells:
+            name = c.get("cell", "selftest")
+            print(f"\n[{name}] verdict: {c.get('verdict', '?')}")
+            for line in format_health(c["health"]):
+                print(line)
+            for line in format_blame(c.get("blame")):
+                print(line)
+            spans = c.get("spans") or {}
+            if "error" in spans:
+                print(f"  spans: {spans['error']}")
+            elif spans:
+                comps = ", ".join(
+                    f"{k}={v}" for k, v in
+                    spans.get("components_ticks", {}).items()
+                )
+                print(
+                    f"  spans: {spans.get('requests', 0)} request(s) "
+                    f"{spans.get('outcomes', {})}, exact="
+                    f"{c.get('span_exact')} [{comps}]"
+                )
+    return 0 if all(c.get("ok") for c in cells) else 1
 
 
 def cmd_lint(args: argparse.Namespace) -> int:
@@ -2395,6 +2564,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "grid (repeatable); exclusive with --all")
     p.add_argument("--all", action="store_true",
                    help="trace every registered protocol")
+    p.add_argument("--serve", action="store_true",
+                   help="export a seeded serve --selftest run "
+                        "instead: per-tenant track groups, one "
+                        "thread per request, spans from the r15 "
+                        "span builder (components + annotations); "
+                        "exclusive with --protocol/--all/"
+                        "--payload-kb")
     p.add_argument("--seed", type=int, default=0,
                    help="schedule seed (default 0; same seed -> "
                         "byte-identical trace files)")
@@ -2408,6 +2584,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "instance here (default: one combined JSON "
                         "document on stdout)")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "health",
+        help="render span / SLO / blame state from a recorded "
+             "serving run (serve --selftest -o / chaos --load -o "
+             "report JSON) or a fresh seeded selftest: per-class "
+             "burn rates and breaches, the tail-latency blame "
+             "verdict, and the span digest",
+    )
+    p.add_argument("report", nargs="?", default=None,
+                   help="recorded report JSON to render (exclusive "
+                        "with --selftest)")
+    p.add_argument("--selftest", action="store_true",
+                   help="run the seeded serving selftest and render "
+                        "its health state instead of reading a file")
+    p.add_argument("--seed", type=int, default=None,
+                   help="with --selftest: the selftest seed "
+                        "(default 0); a usage error with a recorded "
+                        "report, which carries its own seed")
+    p.add_argument("--json", action="store_true",
+                   help="print the extracted health/blame/span "
+                        "state as JSON instead of text")
+    p.set_defaults(fn=cmd_health)
 
     p = sub.add_parser(
         "traffic",

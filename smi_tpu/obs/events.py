@@ -40,6 +40,7 @@ rides every snapshot (the ScheduleCount no-silent-caps discipline).
 from __future__ import annotations
 
 import dataclasses
+import os
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
@@ -48,6 +49,38 @@ from typing import Dict, List, Optional, Tuple
 #: enough that a hang's tail spans several serving ticks or simulator
 #: laps. docs/observability.md quotes this (drift-guarded).
 DEFAULT_RECORDER_CAPACITY = 512
+
+#: Environment knob: override the default flight-recorder capacity.
+#: A long serving campaign emits far more than 512 events — without
+#: the override the ring wraps and the early life of long streams is
+#: gone from every tail and span build. Unset/empty keeps the 512
+#: default; a malformed or non-positive value is a LOUD ValueError
+#: naming knob and value (the ``$SMI_WATCHDOG_SECS`` discipline — a
+#: typo must never silently shrink the operator's history).
+OBS_RING_ENV = "SMI_TPU_OBS_RING"
+
+
+def ring_capacity(default: int = DEFAULT_RECORDER_CAPACITY) -> int:
+    """Resolve the flight-recorder capacity: ``$SMI_TPU_OBS_RING``
+    when set (the operator's word — outranks any caller default),
+    else ``default``. Loud on malformed/non-positive values."""
+    raw = os.environ.get(OBS_RING_ENV, "").strip()
+    if not raw:
+        return default
+    try:
+        capacity = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"${OBS_RING_ENV} must be an integer event capacity "
+            f"(flight-recorder ring bound), got {raw!r}"
+        ) from None
+    if capacity < 1:
+        raise ValueError(
+            f"${OBS_RING_ENV} must be >= 1 (the recorder is "
+            f"always-on; unset the variable for the "
+            f"{DEFAULT_RECORDER_CAPACITY}-event default), got {raw!r}"
+        )
+    return capacity
 
 #: How many tail events an error dump attaches
 #: (:func:`FlightRecorder.tail`'s default) — bounded so a state dump
@@ -64,7 +97,9 @@ DEFAULT_TAIL_EVENTS = 32
 #: - ``serving`` — request lifecycle on the front-end's StepClock;
 #: - ``control`` — membership/epoch transitions on the same clock;
 #: - ``tuning``  — the online retuner's sample/propose/swap/rollback
-#:                 lifecycle (same clock when front-end-hosted).
+#:                 lifecycle (same clock when front-end-hosted);
+#: - ``slo``     — the burn-rate health engine's transitions (warn /
+#:                 breach / recover), evaluated once per step tick.
 #:
 #: docs/observability.md renders this table verbatim (drift-guarded by
 #: tests/test_perf_docs.py); extend it there and here together.
@@ -84,6 +119,8 @@ EVENT_KINDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "serve.consume": ("serving", ("tenant", "qos", "chunk", "dst")),
     "serve.replay": ("serving", ("tenant", "qos", "chunks", "reason")),
     "serve.complete": ("serving", ("tenant", "qos", "dst")),
+    "serve.stall": ("serving", ("dst",)),
+    "serve.reroute": ("serving", ("tenant", "qos", "src", "dst")),
     # -- control plane --------------------------------------------------
     "ctl.suspect": ("control", ("reason",)),
     "ctl.clear": ("control", ()),
@@ -98,6 +135,10 @@ EVENT_KINDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "tune.swap": ("tuning", ("op", "bucket", "to_algo", "plan_epoch",
                              "revision")),
     "tune.rollback": ("tuning", ("op", "bucket", "reason")),
+    # -- slo plane (the burn-rate health engine, r15) --------------------
+    "slo.burn": ("slo", ("qos", "window", "rate")),
+    "slo.breach": ("slo", ("qos", "window", "rate", "budget")),
+    "slo.recover": ("slo", ("qos", "breached_ticks")),
 }
 
 #: Envelope keys every event owns; a schema field may not shadow them
@@ -155,7 +196,11 @@ class FlightRecorder:
     front-end); cross-machine merging is a consumer concern.
     """
 
-    def __init__(self, capacity: int = DEFAULT_RECORDER_CAPACITY):
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            # $SMI_TPU_OBS_RING outranks the 512 default (loud on
+            # malformed); an explicit capacity= is the caller's word
+            capacity = ring_capacity()
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity

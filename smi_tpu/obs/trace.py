@@ -43,8 +43,10 @@ from smi_tpu.parallel import credits as C
 
 #: Pinned Chrome-trace schema version for this exporter's payloads —
 #: bumped on any shape change; :func:`validate_chrome_trace` and the
-#: tests check it.
-TRACE_SCHEMA_VERSION = 1
+#: tests check it. v2 (r15): payloads carry ``trace_kind`` —
+#: ``protocol`` (the simulator decomposition, unchanged) or
+#: ``serving`` (request span trees on per-tenant track groups).
+TRACE_SCHEMA_VERSION = 2
 
 #: Chronological order of a jump's components inside its wait window:
 #: idle is time before the producer even issued, then the latency
@@ -210,6 +212,7 @@ def trace_protocol(
         "traceEvents": events,
         "otherData": {
             "schema_version": TRACE_SCHEMA_VERSION,
+            "trace_kind": "protocol",
             "protocol": protocol,
             "shape": dict(shape),
             "ranks": replay.n,
@@ -252,14 +255,103 @@ def trace_all(
 
 def trace_name(payload: dict) -> str:
     """Deterministic file stem for one trace payload:
-    ``<protocol>_n<k>[_chunks<c>][_slices<s>]``."""
+    ``<protocol>_n<k>[_chunks<c>][_slices<s>]`` for protocol traces,
+    ``serve_<label>_seed<s>`` for serving traces."""
     other = payload["otherData"]
+    if other.get("trace_kind") == "serving":
+        return f"serve_{other['label']}_seed{other['seed']}"
     shape = other["shape"]
     stem = f"{other['protocol']}_n{shape['n']}"
     for key in ("chunks", "slices"):
         if key in shape:
             stem += f"_{key}{shape[key]}"
     return stem
+
+
+def trace_serving(span_report, seed: int = 0,
+                  label: str = "selftest") -> dict:
+    """Render a serving run's request span trees as a Chrome trace.
+
+    Per-tenant track groups: each tenant is one Chrome-trace
+    *process* (``pid``), each of its requests one *thread* (``tid`` =
+    the per-tenant stream sequence), so Perfetto renders a serving
+    run as grouped request spans rather than simulator primitives.
+    Component spans carry their component as ``cat``; annotation
+    spans (parks, sheds, retune-quiesce windows) carry
+    ``annotation``. Timestamps are step-clock ticks rendered as
+    microseconds — a logical clock, honestly labeled in ``otherData``.
+    Deterministic: same seed, byte-identical file through
+    :func:`trace_to_json_bytes`.
+    """
+    from smi_tpu.obs.spans import COMPONENTS, SpanReport
+
+    if not isinstance(span_report, SpanReport):
+        raise TypeError(
+            f"trace_serving takes a SpanReport (build_spans' "
+            f"output), got {type(span_report).__name__}"
+        )
+    tenants = sorted({t.tenant for t in span_report.requests.values()})
+    pid_of = {tenant: i for i, tenant in enumerate(tenants)}
+    events: List[dict] = []
+    for tenant in tenants:
+        events.append({
+            "ph": "M", "pid": pid_of[tenant], "tid": 0,
+            "name": "process_name",
+            "args": {"name": f"tenant {tenant}"},
+        })
+    components_ticks = {c: 0 for c in COMPONENTS}
+    makespan = 0
+    delivered = shed = 0
+    for key in sorted(span_report.requests):
+        tree = span_report.requests[key]
+        pid = pid_of[tree.tenant]
+        if tree.completed is not None:
+            delivered += 1
+        elif tree.shed_reason is not None:
+            shed += 1
+        events.append({
+            "ph": "M", "pid": pid, "tid": tree.seq,
+            "name": "thread_name",
+            "args": {"name": f"s{tree.seq} ({tree.qos}) "
+                             f"{tree.outcome}"},
+        })
+        for span in tree.spans:
+            cat = (span.component if span.kind == "component"
+                   else "annotation")
+            if span.kind == "component":
+                components_ticks[span.component] += span.duration
+            args = {"tenant": tree.tenant, "seq": tree.seq,
+                    "qos": tree.qos, "kind": span.kind}
+            args.update(span.detail)
+            events.append({
+                "ph": "X", "pid": pid, "tid": tree.seq,
+                "name": span.component, "cat": cat,
+                "ts": float(span.t0), "dur": float(span.duration),
+                "args": args,
+            })
+            makespan = max(makespan, span.t1)
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "otherData": {
+            "schema_version": TRACE_SCHEMA_VERSION,
+            "trace_kind": "serving",
+            "label": label,
+            "seed": seed,
+            "time_unit": "step-clock ticks (rendered as us)",
+            "tenants": len(tenants),
+            "requests": len(span_report.requests),
+            "delivered": delivered,
+            "shed": shed,
+            "makespan_ticks": makespan,
+            "components_ticks": {
+                c: components_ticks[c] for c in COMPONENTS
+                if components_ticks[c]
+            },
+            "total_events": span_report.total_events,
+            "dropped_events": span_report.dropped_events,
+        },
+    }
 
 
 def trace_to_json_bytes(payload: dict) -> bytes:
@@ -288,10 +380,24 @@ def validate_chrome_trace(payload: dict) -> None:
             f"trace schema_version {other.get('schema_version')!r} != "
             f"pinned {TRACE_SCHEMA_VERSION}"
         )
-    for key in ("protocol", "shape", "ranks", "seed", "makespan_us",
-                "span_makespan_us", "per_rank"):
-        if key not in other:
-            raise ValueError(f"otherData missing {key!r}")
+    kind = other.get("trace_kind", "protocol")
+    if kind == "serving":
+        from smi_tpu.obs.spans import COMPONENTS
+
+        for key in ("label", "seed", "tenants", "requests",
+                    "makespan_ticks", "components_ticks",
+                    "dropped_events"):
+            if key not in other:
+                raise ValueError(f"otherData missing {key!r}")
+        allowed_cats = tuple(COMPONENTS) + ("annotation",)
+    elif kind == "protocol":
+        for key in ("protocol", "shape", "ranks", "seed",
+                    "makespan_us", "span_makespan_us", "per_rank"):
+            if key not in other:
+                raise ValueError(f"otherData missing {key!r}")
+        allowed_cats = ("alpha", "beta", "serialization", "idle")
+    else:
+        raise ValueError(f"unknown trace_kind {kind!r}")
     events = payload["traceEvents"]
     if not isinstance(events, list) or not events:
         raise ValueError("traceEvents must be a non-empty list")
@@ -311,8 +417,7 @@ def validate_chrome_trace(payload: dict) -> None:
                     )
             if e["dur"] < 0:
                 raise ValueError(f"traceEvents[{i}] has negative dur")
-            if e["cat"] not in ("alpha", "beta", "serialization",
-                                "idle"):
+            if e["cat"] not in allowed_cats:
                 raise ValueError(
                     f"traceEvents[{i}] has unknown component "
                     f"{e['cat']!r}"

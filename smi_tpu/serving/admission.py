@@ -231,6 +231,7 @@ class AdmissionGate:
             self.recorder.emit(
                 "serve.shed", self._now, tenant=request.tenant,
                 qos=request.qos, reason=reason,
+                stream_seq=request.stream_id[1],
             )
             # a shed names its causal history, not just its reason
             attach_tail(rejection, self.recorder)
@@ -250,6 +251,7 @@ class AdmissionGate:
             self.recorder.emit(
                 "serve.admit", now, tenant=request.tenant,
                 qos=request.qos, waited=waited,
+                stream_seq=request.stream_id[1],
             )
         if self.metrics is not None:
             self.metrics.counter("admitted_total",
@@ -294,7 +296,8 @@ class AdmissionGate:
         self.pending[request.qos].append(_Pending(request, now))
         if self.recorder is not None:
             self.recorder.emit("serve.park", now, tenant=request.tenant,
-                               qos=request.qos)
+                               qos=request.qos,
+                               stream_seq=request.stream_id[1])
         if self.metrics is not None:
             self.metrics.counter("parked_total", qos=request.qos).inc()
         self.assert_bounded()

@@ -67,6 +67,31 @@ def _payload(tenant: str, stream_seq: int, chunk: int) -> str:
     return f"{tenant}/s{stream_seq}/c{chunk}"
 
 
+def campaign_recorder(duration: int, n: int):
+    """A flight recorder sized to retain a WHOLE cell's event stream
+    (the r15 span builder refuses a wrapped ring): generous per-tick
+    estimate times the schedule, plus a drain cushion.
+    ``$SMI_TPU_OBS_RING`` outranks the estimate — the operator's word
+    stands, and an undersized override surfaces as a named
+    span-exactness problem, never a silent truncation."""
+    from smi_tpu.obs.events import FlightRecorder, ring_capacity
+
+    estimate = duration * (n * 8 + 24) + 8192
+    return FlightRecorder(capacity=ring_capacity(default=estimate))
+
+
+def span_fields(fe, report: Dict, problems: List[str]) -> None:
+    """Fold the span/blame payload into a cell report and extend the
+    gate problems with any span-exactness failure (the bit-identity
+    criterion: event-stream component sums == the front-end's own
+    measured admission-to-delivery latencies)."""
+    from smi_tpu.obs.spans import campaign_fields
+
+    fields, span_problems = campaign_fields(fe)
+    report.update(fields)
+    problems.extend(span_problems)
+
+
 def open_loop_traffic(
     seed: int,
     tenants: int,
@@ -105,7 +130,8 @@ def run_load_cell(
     tenants: int = 6,
     pool: int = DEFAULT_POOL,
     plan: Optional[F.FaultPlan] = None,
-) -> Dict:
+    return_frontend: bool = False,
+):
     """One chaos-under-load cell: open-loop traffic, optional fault,
     full drain, gates evaluated. Deterministic per (shape, seed).
 
@@ -115,8 +141,11 @@ def run_load_cell(
     :class:`~smi_tpu.parallel.faults.SlowConsumer` maps onto a
     consumer stall in ticks (the seeded draw
     ``FaultPlan.random("slow_consumer", n, seed)`` is how the campaign
-    sweeps the class)."""
-    fe = ServingFrontend(n, seed=seed, pool=pool)
+    sweeps the class). ``return_frontend=True`` returns
+    ``(report, frontend)`` — the span/trace consumers need the live
+    recorder, not just the report."""
+    fe = ServingFrontend(n, seed=seed, pool=pool,
+                         recorder=campaign_recorder(duration, n))
     if plan is not None:
         if plan.slow_consumers and stall_rank is not None:
             raise ValueError(
@@ -297,11 +326,17 @@ def run_load_cell(
                 "consumer stall never propagated to the admission "
                 "edge (zero backpressure sheds)"
             )
+    # the r15 span layer: per-request span trees from the event
+    # stream, the tail-latency blame verdict, and the bit-identity
+    # exactness gate (span-component sums == measured latencies)
+    span_fields(fe, report, problems)
     # drop the unhashed per-request wait lists from the shipped report
     # (the percentiles above carry the evidence)
     del report["admission_waits"]
     report["verdict"] = "; ".join(problems) if problems else "ok"
     report["ok"] = not problems
+    if return_frontend:
+        return report, fe
     return report
 
 
@@ -392,7 +427,8 @@ def run_retune_cell(
     large_kb: int = 4096,
     kill_rank: Optional[int] = None,
     kill_at: int = 60,
-) -> Dict:
+    return_frontend: bool = False,
+):
     """The seeded payload-shift retuning cell (ROADMAP item 3's gate).
 
     A front-end runs with the online tuner wired
@@ -462,7 +498,8 @@ def run_retune_cell(
     ))
     tuner = OnlineTuner(cache=cache, topo=topo,
                         device_kind=device_kind)
-    fe = ServingFrontend(n, seed=seed, pool=pool, retune=tuner)
+    fe = ServingFrontend(n, seed=seed, pool=pool, retune=tuner,
+                         recorder=campaign_recorder(duration, n))
 
     # what a FRESH offline sweep would measure best for the new
     # distribution: the model's top candidate (samples are priced by
@@ -614,9 +651,12 @@ def run_retune_cell(
         }
         for c in QOS_CLASSES
     }
+    span_fields(fe, report, problems)
     del report["admission_waits"]
     report["verdict"] = "; ".join(problems) if problems else "ok"
     report["ok"] = not problems
+    if return_frontend:
+        return report, fe
     return report
 
 
@@ -696,13 +736,17 @@ def replay_model_trace(scope, trace, mutant: Optional[str] = None) -> Dict:
     return report
 
 
-def serve_selftest(seed: int = 0) -> Dict:
+def serve_selftest(seed: int = 0, return_frontend: bool = False):
     """The ``smi-tpu serve --selftest`` smoke: a deterministic CPU
     admit -> stream -> shed -> drain pass (overload cell at a fast
-    shape) whose gates must all hold. Returns the cell report;
-    ``ok=False`` on any gate failure."""
+    shape) whose gates must all hold. Returns the cell report
+    (``ok=False`` on any gate failure); ``return_frontend=True``
+    returns ``(report, frontend)`` — the ONE selftest shape, shared
+    with ``trace --serve`` so the exported trace can never drift from
+    the run the selftest gates."""
     return run_load_cell(
-        n=4, seed=seed, duration=160, overload=2.0
+        n=4, seed=seed, duration=160, overload=2.0,
+        return_frontend=return_frontend,
     )
 
 

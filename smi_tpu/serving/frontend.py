@@ -62,6 +62,7 @@ from smi_tpu.parallel.membership import (
 )
 from smi_tpu.obs.events import FlightRecorder
 from smi_tpu.obs.metrics import MetricsRegistry
+from smi_tpu.obs.slo import SloEngine
 from smi_tpu.parallel.credits import IntegrityError
 from smi_tpu.parallel.recovery import ProgressLog
 from smi_tpu.serving.admission import AdmissionGate, DEFAULT_POOL
@@ -121,6 +122,15 @@ class ServingFrontend:
             recorder=self.recorder, metrics=self.metrics,
         )
         self.gate.on_admit = self._on_admit
+        #: the burn-rate health engine (always-on, like the recorder):
+        #: deliveries and service-caused sheds burn per-class error
+        #: budgets, evaluated once per tick — the continuous health
+        #: signal ROADMAP item 4's autoscaling consumes. Consumers
+        #: that chain their own on_shed (the MoE dispatcher) wrap
+        #: this one.
+        self.slo = SloEngine(recorder=self.recorder,
+                             metrics=self.metrics)
+        self.gate.on_shed = self._on_shed
         #: per-destination accepted-stream cap: one saturated (or
         #: silently dead) destination may hold at most twice its fair
         #: share of the pool — and never more than pool minus one fair
@@ -307,9 +317,16 @@ class ServingFrontend:
             "serve.send", now, rank=lane.rank,
             tenant=stream.request.tenant, qos=stream.request.qos,
             chunk=seq, dst=lane.rank,
+            stream_seq=stream.request.stream_id[1],
         )
         self.metrics.counter("sent_chunks_total",
                              qos=stream.request.qos).inc()
+
+    def _on_shed(self, rejection, request: Request) -> None:
+        """Every named shed burns the class's SLO error budget
+        (tenant-rate excluded inside the engine — client-caused)."""
+        self.slo.observe_shed(request.qos, rejection.reason,
+                              self.clock.now())
 
     def _on_admit(self, request: Request, waited: int) -> None:
         """Acceptance: durable WAL contribution + deadline start +
@@ -372,12 +389,17 @@ class ServingFrontend:
         self.recorder.emit(
             "serve.complete", st.completed_at, rank=st.dst,
             tenant=st.request.tenant, qos=st.request.qos, dst=st.dst,
+            stream_seq=st.request.stream_id[1],
         )
         self.metrics.counter("delivered_total",
                              qos=st.request.qos).inc()
         self.metrics.histogram(
             "stream_latency_ticks", qos=st.request.qos,
         ).observe(st.completed_at - st.admitted_at)
+        self.slo.observe_delivery(
+            st.request.qos, st.completed_at - st.admitted_at,
+            st.completed_at,
+        )
         self.active.remove(st)
         self.completed.append(st)
         self.plan_stamp.pop(st.index, None)
@@ -444,6 +466,7 @@ class ServingFrontend:
                     "serve.consume", now, rank=lane.rank,
                     tenant=st.request.tenant, qos=st.request.qos,
                     chunk=item.seq, dst=lane.rank,
+                    stream_seq=st.request.stream_id[1],
                 )
                 self.metrics.counter("consumed_chunks_total",
                                      qos=st.request.qos).inc()
@@ -456,6 +479,7 @@ class ServingFrontend:
             "serve.replay", self.clock.now(), rank=st.dst,
             tenant=st.request.tenant, qos=st.request.qos,
             chunks=chunks, reason=reason,
+            stream_seq=st.request.stream_id[1],
         )
         self.metrics.counter("replayed_chunks_total",
                              reason=reason).inc(chunks)
@@ -480,6 +504,18 @@ class ServingFrontend:
                 continue
             owner = self._route_new(st.request.tenant, record=False,
                                     base=st.request.base_rank)
+            # the reroute is an event of its own (distinct from the
+            # replay below, which only fires when chunks actually
+            # move): the span builder charges the stream's blackout
+            # wait to the DEAD destination, not to the heir it lands
+            # on afterwards — a queued-never-sent stream still spent
+            # its time waiting on the rank that died
+            self.recorder.emit(
+                "serve.reroute", self.clock.now(), rank=dead,
+                tenant=st.request.tenant, qos=st.request.qos,
+                src=dead, dst=owner,
+                stream_seq=st.request.stream_id[1],
+            )
             # the dead consumer's partial state died with it: void
             # the stream's delivery record and replay everything
             # from the durable contribution on a fresh lane
@@ -562,7 +598,14 @@ class ServingFrontend:
             ):
                 self.metrics.counter("credit_stall_ticks",
                                      rank=lane.rank).inc()
+                # the span builder's credit-stall sub-span record:
+                # one event per (tick, lane) AT the stall, same site
+                # as the counter — the wire's zero-credit ticks are
+                # carved out of the affected streams' queue spans
+                self.recorder.emit("serve.stall", now, rank=lane.rank,
+                                   dst=lane.rank)
         self.gate.pump(now)
+        self.slo.evaluate(now)
         if self.tuner is not None:
             self._drive_retune(now)
         self.gate.assert_bounded()
@@ -692,6 +735,9 @@ class ServingFrontend:
                     self.recorder.counts.items()
                 )),
             },
+            # the burn-rate health snapshot (r15): per-class SLO
+            # state, riding every campaign report and selftest
+            "health": self.slo.health(),
             **({"retune": {
                 **self.tuner.summary(),
                 "replanned_streams": self.replanned_streams,

@@ -295,7 +295,10 @@ def run_moe_cell(
             f"{MIN_MOE_DURATION}-tick minimum (a hot-expert backlog "
             f"needs the schedule to reach the admission edge)"
         )
-    fe = ServingFrontend(n, seed=seed, pool=pool)
+    from smi_tpu.serving.campaign import campaign_recorder
+
+    fe = ServingFrontend(n, seed=seed, pool=pool,
+                         recorder=campaign_recorder(duration, n))
     dispatcher = MoeDispatcher(
         fe, experts, hot_expert=hot_expert, hot_factor=hot_factor,
         seed=seed,
@@ -408,6 +411,12 @@ def run_moe_cell(
                 f"hot-expert saturation confirmed a death: "
                 f"{report['confirmed']} (skew mistaken for failure)"
             )
+    # the r15 span layer: expert-dispatch streams get the same span
+    # trees, blame verdict, and bit-identity exactness gate as every
+    # other serving cell
+    from smi_tpu.serving.campaign import span_fields
+
+    span_fields(fe, report, problems)
     report["verdict"] = "; ".join(problems) if problems else "ok"
     report["ok"] = not problems
     return report

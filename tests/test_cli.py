@@ -1079,3 +1079,90 @@ def test_chaos_retune_requires_load(capsys):
     assert "--load" in capsys.readouterr().err
     assert run_cli("chaos", "--elastic", "--retune") == 2
     assert "--load" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# r15: trace --serve + the health subcommand
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slo
+def test_trace_serve_writes_validated_deterministic_file(tmp_path,
+                                                         capsys):
+    out = tmp_path / "traces"
+    assert run_cli("trace", "--serve", "--seed", "3",
+                   "-o", str(out)) == 0
+    printed = capsys.readouterr().out
+    assert "serving selftest (seed 3)" in printed
+    [path] = sorted(out.iterdir())
+    assert path.name == "serve_selftest_seed3.trace.json"
+    from smi_tpu.obs.trace import validate_chrome_trace
+
+    payload = json.loads(path.read_text())
+    validate_chrome_trace(payload)
+    assert payload["otherData"]["trace_kind"] == "serving"
+    assert payload["otherData"]["seed"] == 3
+    # same seed, byte-identical file
+    out2 = tmp_path / "traces2"
+    assert run_cli("trace", "--serve", "--seed", "3",
+                   "-o", str(out2)) == 0
+    capsys.readouterr()
+    assert path.read_bytes() == (out2 / path.name).read_bytes()
+
+
+@pytest.mark.slo
+def test_trace_serve_usage_error_matrix(capsys):
+    assert run_cli("trace", "--serve", "--all") == 2
+    assert "exclusive" in capsys.readouterr().err
+    assert run_cli("trace", "--serve", "--protocol",
+                   "all_reduce") == 2
+    assert "exclusive" in capsys.readouterr().err
+    assert run_cli("trace", "--serve", "--payload-kb", "64") == 2
+    assert "--payload-kb" in capsys.readouterr().err
+
+
+@pytest.mark.slo
+def test_health_selftest_renders_burn_blame_and_spans(capsys):
+    assert run_cli("health", "--selftest", "--seed", "2") == 0
+    printed = capsys.readouterr().out
+    assert "SLO health" in printed
+    assert "tail blame" in printed
+    assert "spans:" in printed
+    for qos in ("interactive", "batch", "best_effort"):
+        assert qos in printed
+
+
+@pytest.mark.slo
+def test_health_renders_a_recorded_report(tmp_path, capsys):
+    out = tmp_path / "serve.json"
+    assert run_cli("serve", "--selftest", "-o", str(out)) == 0
+    capsys.readouterr()
+    assert run_cli("health", str(out)) == 0
+    printed = capsys.readouterr().out
+    assert "SLO health" in printed and "tail blame" in printed
+    # --json extracts the structured state
+    assert run_cli("health", str(out), "--json") == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["cells"][0]["span_exact"] is True
+    assert "classes" in doc["cells"][0]["health"]
+
+
+@pytest.mark.slo
+def test_health_usage_error_matrix(tmp_path, capsys):
+    # neither a report nor --selftest
+    assert run_cli("health") == 2
+    assert "--selftest" in capsys.readouterr().err
+    # both at once
+    assert run_cli("health", "x.json", "--selftest") == 2
+    assert "not both" in capsys.readouterr().err
+    # --seed against a recorded report (which carries its own seed)
+    assert run_cli("health", "x.json", "--seed", "0") == 2
+    assert "--selftest" in capsys.readouterr().err
+    # missing file
+    assert run_cli("health", str(tmp_path / "nope.json")) == 2
+    assert "cannot read" in capsys.readouterr().err
+    # a JSON without the r15 health field
+    legacy = tmp_path / "legacy.json"
+    legacy.write_text(json.dumps({"ok": True}))
+    assert run_cli("health", str(legacy)) == 1
+    assert "no health state" in capsys.readouterr().err

@@ -66,7 +66,8 @@ def _grid_id(case):
 class TestFlightRecorder:
     def test_schema_is_well_formed(self):
         for kind, (plane, fields) in EVENT_KINDS.items():
-            assert plane in ("sim", "serving", "control", "tuning")
+            assert plane in ("sim", "serving", "control", "tuning",
+                             "slo")
             assert isinstance(fields, tuple)
 
     def test_unknown_kind_is_loud(self):

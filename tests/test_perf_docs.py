@@ -610,8 +610,13 @@ def test_observability_doc_quotes_the_schema():
     # a documented kind that no longer exists is equally a drift
     import re
 
+    # scan the schema TABLE only (the r15 span taxonomy legitimately
+    # names dotted components like `credit.stall` further down)
+    schema_section = text.split("## Event schema", 1)[1].split(
+        "\n## ", 1)[0]
     documented = set(re.findall(r"`((?:credit|dma|barrier|serve|ctl|"
-                                r"tune)\.[a-z_]+)`", text))
+                                r"tune|slo)\.[a-z_]+)`",
+                                schema_section))
     assert documented == set(E.EVENT_KINDS)
     # recorder bounds
     assert f"**{E.DEFAULT_RECORDER_CAPACITY} events**" in text
@@ -630,10 +635,47 @@ def test_observability_doc_quotes_the_schema():
         "admission_wait_ticks", "stream_latency_ticks",
         "tune_samples_total", "tune_proposals_total",
         "tune_swaps_total", "tune_rollbacks_total",
+        "slo_burn_warnings_total", "slo_breaches_total",
+        "slo_recoveries_total",
     ):
         assert f"`{metric}`" in text, (
             f"metric {metric!r} missing from the catalog"
         )
+
+
+def test_observability_doc_quotes_the_span_slo_tier():
+    """The "Spans, blame, and SLOs (r15)" section must quote the REAL
+    span taxonomy, the shipped SLO table, the burn windows/floor, and
+    the env knob — the doc is the human-readable mirror of
+    ``smi_tpu/obs/spans.py`` + ``slo.py`` and must not drift."""
+    from smi_tpu.obs import slo as S
+    from smi_tpu.obs import spans as SP
+    from smi_tpu.obs.events import OBS_RING_ENV
+
+    text = _read("docs/observability.md")
+    assert "Spans, blame, and SLOs (r15)" in text
+    section = text.split("Spans, blame, and SLOs (r15)", 1)[1]
+    # every span component appears in the taxonomy table
+    for component in SP.COMPONENTS:
+        assert f"`{component}`" in section, (
+            f"span component {component!r} missing from the taxonomy"
+        )
+    # the shipped SLO table, value for value
+    for qos, spec in S.DEFAULT_SLOS.items():
+        assert f"`{qos}` | {spec.latency_target_ticks} | " \
+               f"{spec.error_budget}" in section, (
+            f"SLO row for {qos} drifted from DEFAULT_SLOS"
+        )
+    # burn windows, evidence floor, decile, env knob
+    assert (f"({S.SLO_WINDOWS[0]} / {S.SLO_WINDOWS[1]} ticks)"
+            in section)
+    assert f"**{S.MIN_WINDOW_EVENTS} events**" in section
+    assert f"{SP.BLAME_DECILE:.0%}" in section
+    assert f"${OBS_RING_ENV}" in section
+    # the honesty clauses
+    assert "health observation, not a campaign gate" in section
+    assert "does not claim" in text.split(
+        "Spans, blame, and SLOs (r15)", 1)[1]
 
 
 def test_tuning_doc_quotes_the_online_retuner():

@@ -1,0 +1,666 @@
+"""Request-level tracing + SLO engine (r15): spans, blame, burn rates.
+
+The acceptance criteria, each pinned:
+
+- **span exactness** — every request's span-component sum is
+  bit-identical to the front-end's own measured admission-to-delivery
+  latency, across the FULL seeded campaign matrix
+  (overload / kill / stall / moe / retune cells);
+- **blame ground truth** — the tail-latency blame verdict names the
+  injected binding resource in every fault cell: the killed rank
+  (``failover:rank<k>``), the stalled rank, the hot wire lane, the
+  browned-out class;
+- **breach determinism** — the seeded overload (brownout) campaign
+  fires ``slo.breach`` deterministically; the fair-weather cell
+  (0.5x load) fires ZERO alarms;
+- **no silent truncation** — the span builder refuses a wrapped ring
+  loudly, naming ``$SMI_TPU_OBS_RING``.
+"""
+
+import json
+import math
+
+import pytest
+
+from smi_tpu.obs.events import (
+    DEFAULT_RECORDER_CAPACITY,
+    OBS_RING_ENV,
+    FlightRecorder,
+    ring_capacity,
+)
+from smi_tpu.obs.slo import (
+    BREACH_BURN,
+    DEFAULT_SLOS,
+    MIN_WINDOW_EVENTS,
+    SLO_WINDOWS,
+    SloEngine,
+    SloSpec,
+    format_health,
+)
+from smi_tpu.obs.spans import (
+    COMPONENTS,
+    DELIVERY_COMPONENTS,
+    SpanError,
+    blame_report,
+    build_spans,
+    exactness_problems,
+    format_blame,
+    frontend_spans,
+)
+from smi_tpu.serving.campaign import run_load_cell, run_retune_cell
+from smi_tpu.serving.frontend import ServingFrontend
+from smi_tpu.serving.moe import expert_home, run_moe_cell
+from smi_tpu.serving.qos import QOS_CLASSES
+
+pytestmark = pytest.mark.slo
+
+
+# ---------------------------------------------------------------------------
+# $SMI_TPU_OBS_RING: the recorder-capacity env override
+# ---------------------------------------------------------------------------
+
+
+class TestRingEnvOverride:
+    def test_default_unchanged_when_unset(self, monkeypatch):
+        monkeypatch.delenv(OBS_RING_ENV, raising=False)
+        assert ring_capacity() == DEFAULT_RECORDER_CAPACITY
+        assert FlightRecorder().capacity == DEFAULT_RECORDER_CAPACITY
+
+    def test_env_overrides_the_default(self, monkeypatch):
+        monkeypatch.setenv(OBS_RING_ENV, "2048")
+        assert FlightRecorder().capacity == 2048
+        # ... and a caller-supplied default too (campaigns pass their
+        # schedule estimate; the operator's word outranks it)
+        assert ring_capacity(default=99_999) == 2048
+
+    def test_explicit_capacity_outranks_the_env(self, monkeypatch):
+        monkeypatch.setenv(OBS_RING_ENV, "2048")
+        assert FlightRecorder(capacity=4).capacity == 4
+
+    @pytest.mark.parametrize("junk", ["abc", "1.5", "0", "-3", "nan"])
+    def test_malformed_is_loud_naming_the_knob(self, monkeypatch, junk):
+        monkeypatch.setenv(OBS_RING_ENV, junk)
+        with pytest.raises(ValueError, match="SMI_TPU_OBS_RING"):
+            ring_capacity()
+
+    def test_empty_means_unset(self, monkeypatch):
+        monkeypatch.setenv(OBS_RING_ENV, "  ")
+        assert ring_capacity() == DEFAULT_RECORDER_CAPACITY
+
+
+# ---------------------------------------------------------------------------
+# SLO engine unit behaviour
+# ---------------------------------------------------------------------------
+
+
+class TestSloEngine:
+    def test_spec_validation_is_loud(self):
+        with pytest.raises(ValueError, match="error_budget"):
+            SloSpec("interactive", 10, 1.5)
+        with pytest.raises(ValueError, match="latency_target"):
+            SloSpec("interactive", 0, 0.1)
+
+    def test_missing_class_is_loud(self):
+        with pytest.raises(ValueError, match="missing QoS class"):
+            SloEngine(specs={"interactive": DEFAULT_SLOS["interactive"]})
+
+    def test_unknown_class_is_loud(self):
+        with pytest.raises(ValueError, match="unknown QoS class"):
+            SloEngine(specs={
+                **DEFAULT_SLOS,
+                "premium": SloSpec("premium", 100, 0.05),
+            })
+
+    def test_window_validation_is_loud(self):
+        with pytest.raises(ValueError, match="short < long"):
+            SloEngine(windows=(64, 32))
+
+    def test_below_the_evidence_floor_burn_reads_zero(self):
+        engine = SloEngine()
+        # a handful of errors, but fewer than MIN_WINDOW_EVENTS
+        # events total: one unlucky shed must not page
+        for tick in range(1, 6):
+            engine.observe_shed("interactive", "brownout:interactive",
+                                tick)
+            engine.evaluate(tick)
+        health = engine.health()
+        cls = health["classes"]["interactive"]
+        assert cls["errors"] == 5
+        assert cls["burn"]["short"] == 0.0
+        assert cls["breaches"] == 0
+
+    def test_sustained_errors_breach_then_recover(self):
+        rec = FlightRecorder(capacity=4096)
+        engine = SloEngine(recorder=rec)
+        tick = 0
+        # sustained outage: every interactive request shed, enough
+        # volume to clear the floor in BOTH windows
+        for _ in range(SLO_WINDOWS[1]):
+            tick += 1
+            for _ in range(2):
+                engine.observe_shed("interactive",
+                                    "backpressure:rank0", tick)
+            engine.evaluate(tick)
+        health = engine.health()
+        cls = health["classes"]["interactive"]
+        assert cls["breached"] is True
+        assert cls["breaches"] == 1
+        assert health["breached"] is True
+        # recovery: healthy traffic until both windows drain
+        for _ in range(SLO_WINDOWS[1] + 1):
+            tick += 1
+            for _ in range(2):
+                engine.observe_delivery("interactive", 1, tick)
+            engine.evaluate(tick)
+        health = engine.health()
+        cls = health["classes"]["interactive"]
+        assert cls["breached"] is False
+        assert cls["recoveries"] == 1
+        kinds = [e.kind for e in rec.events()
+                 if e.kind.startswith("slo.")]
+        assert "slo.breach" in kinds and "slo.recover" in kinds
+        # the recover event carries the breach duration
+        recover = next(e for e in rec.events()
+                       if e.kind == "slo.recover")
+        assert dict(recover.fields)["breached_ticks"] > 0
+
+    def test_short_burst_warns_but_does_not_breach(self):
+        rec = FlightRecorder(capacity=4096)
+        engine = SloEngine(recorder=rec)
+        tick = 0
+        # a long healthy prefix fills the LONG window with good events
+        for _ in range(SLO_WINDOWS[1]):
+            tick += 1
+            for _ in range(3):
+                engine.observe_delivery("batch", 1, tick)
+            engine.evaluate(tick)
+        # then one short burst of errors: the 32-tick window burns,
+        # the 128-tick window (mostly healthy) does not agree
+        for _ in range(8):
+            tick += 1
+            for _ in range(3):
+                engine.observe_shed("batch", "brownout:batch", tick)
+            engine.evaluate(tick)
+        health = engine.health()
+        cls = health["classes"]["batch"]
+        assert cls["burn_warnings"] >= 1
+        assert cls["breaches"] == 0
+        kinds = [e.kind for e in rec.events()]
+        assert "slo.burn" in kinds and "slo.breach" not in kinds
+
+    def test_tenant_rate_sheds_are_not_slo_errors(self):
+        engine = SloEngine()
+        engine.observe_shed("batch", "tenant-rate", 1)
+        engine.evaluate(1)
+        assert engine.health()["classes"]["batch"]["errors"] == 0
+
+    def test_late_delivery_is_a_latency_error(self):
+        engine = SloEngine()
+        target = DEFAULT_SLOS["batch"].latency_target_ticks
+        engine.observe_delivery("batch", target + 1, 1)
+        engine.observe_delivery("batch", target, 1)
+        engine.evaluate(1)
+        cls = engine.health()["classes"]["batch"]
+        assert cls["errors"] == 1 and cls["good"] == 1
+        assert cls["errors_by_reason"] == {"latency": 1}
+
+    def test_health_snapshot_is_deterministic(self):
+        def build():
+            engine = SloEngine()
+            for tick in range(1, 40):
+                engine.observe_delivery("interactive", 2, tick)
+                if tick % 3 == 0:
+                    engine.observe_shed("best_effort",
+                                        "brownout:best_effort", tick)
+                engine.evaluate(tick)
+            return json.dumps(engine.health(), sort_keys=True)
+
+        assert build() == build()
+
+    def test_format_health_renders_every_class(self):
+        engine = SloEngine()
+        engine.evaluate(1)
+        text = "\n".join(format_health(engine.health()))
+        for qos in QOS_CLASSES:
+            assert qos in text
+
+
+# ---------------------------------------------------------------------------
+# Span builder: refusal, walk correctness, stall carving
+# ---------------------------------------------------------------------------
+
+
+class TestSpanBuilder:
+    def test_truncated_stream_is_refused_naming_the_knob(self):
+        fe = ServingFrontend(2, seed=0,
+                             recorder=FlightRecorder(capacity=8))
+        fe.submit("t0", "batch",
+                  tuple(f"c{i}" for i in range(8)))
+        fe.drain()
+        assert fe.recorder.dropped_events > 0
+        with pytest.raises(SpanError, match="SMI_TPU_OBS_RING"):
+            build_spans(fe.recorder)
+        # the opt-in best-effort path still builds the retained window
+        report = build_spans(fe.recorder, allow_partial=True)
+        assert report.dropped_events > 0
+
+    def test_single_stream_partition_is_exact(self):
+        fe = ServingFrontend(2, seed=0)
+        fe.submit("t0", "batch", ("c0", "c1", "c2"))
+        fe.drain()
+        report = frontend_spans(fe)
+        assert exactness_problems(report, fe) == []
+        [st] = fe.completed
+        tree = report.requests[st.request.stream_id]
+        assert tree.latency == st.completed_at - st.admitted_at
+        assert tree.delivery_sum() == tree.latency
+        # component spans tile: sorted by t0, each starts where the
+        # previous ended, from admission to completion
+        comp = [s for s in tree.spans if s.kind == "component"
+                and s.component != "admit.wait"]
+        t = tree.admitted
+        for span in comp:
+            assert span.t0 == t
+            t = span.t1
+        assert t == tree.completed
+
+    def test_snapshot_roundtrip_builds_identical_trees(self):
+        rep, fe = run_load_cell(n=4, seed=3, duration=120,
+                                overload=1.0, return_frontend=True)
+        live = frontend_spans(fe)
+        recorded = build_spans(fe.recorder.snapshot())
+        assert live.requests.keys() == recorded.requests.keys()
+        for key in live.requests:
+            assert (live.requests[key].to_json()
+                    == recorded.requests[key].to_json())
+
+    def test_shed_requests_get_terminal_trees(self):
+        rep, fe = run_load_cell(n=4, seed=0, duration=160,
+                                overload=2.0, return_frontend=True)
+        report = frontend_spans(fe)
+        shed = [t for t in report.requests.values()
+                if t.shed_reason is not None]
+        assert shed, "a 2x overload cell must shed"
+        for tree in shed:
+            assert tree.outcome.startswith("shed:")
+            assert tree.completed is None
+        digest = report.digest()
+        assert digest["outcomes"]["shed"] == len(shed)
+
+    def test_stall_cell_carves_credit_stall_subspans(self):
+        rep, fe = run_load_cell(
+            n=4, seed=1, duration=240, overload=1.0, stall_rank=1,
+            stall_at=40, stall_ticks=60, return_frontend=True,
+        )
+        report = frontend_spans(fe)
+        stall_ticks = sum(
+            t.by_dst.get(("credit.stall", 1), 0)
+            for t in report.requests.values()
+        )
+        assert stall_ticks > 0, (
+            "a 60-tick consumer stall never surfaced as credit.stall "
+            "time on the stalled lane"
+        )
+        # the carving is a sub-partition: queue + credit.stall spans
+        # never overlap within a tree (every component span tiles)
+        for tree in report.delivered():
+            assert tree.delivery_sum() == tree.latency
+
+    def test_inconsistent_stream_is_loud(self):
+        events = [
+            {"kind": "serve.admit", "tick": 5, "tenant": "t0",
+             "qos": "batch", "waited": 0, "stream_seq": 0},
+            # a consume with no matching send: causally impossible
+            {"kind": "serve.consume", "tick": 9, "tenant": "t0",
+             "qos": "batch", "chunk": 0, "dst": 1, "stream_seq": 0},
+        ]
+        with pytest.raises(SpanError, match="no matching send"):
+            build_spans(events)
+
+    def test_walk_complete_mismatch_is_loud(self):
+        events = [
+            {"kind": "serve.admit", "tick": 5, "tenant": "t0",
+             "qos": "batch", "waited": 0, "stream_seq": 0},
+            {"kind": "serve.send", "tick": 5, "tenant": "t0",
+             "qos": "batch", "chunk": 0, "dst": 1, "stream_seq": 0},
+            {"kind": "serve.consume", "tick": 7, "tenant": "t0",
+             "qos": "batch", "chunk": 0, "dst": 1, "stream_seq": 0},
+            {"kind": "serve.complete", "tick": 11, "tenant": "t0",
+             "qos": "batch", "dst": 1, "stream_seq": 0},
+        ]
+        with pytest.raises(SpanError, match="disagree"):
+            build_spans(events)
+
+
+# ---------------------------------------------------------------------------
+# Span exactness across the seeded campaign matrix (the acceptance)
+# ---------------------------------------------------------------------------
+
+
+MATRIX_SEEDS = (0, 7, 23)
+
+
+class TestSpanExactnessMatrix:
+    @pytest.mark.parametrize("seed", MATRIX_SEEDS)
+    @pytest.mark.parametrize("shape", [
+        ("overload", dict(overload=2.0, duration=240)),
+        ("kill", dict(overload=1.0, duration=240, kill_rank=2,
+                      kill_at=60)),
+        ("stall", dict(overload=1.0, duration=240, stall_rank=1,
+                       stall_at=40, stall_ticks=60)),
+    ], ids=lambda s: s[0])
+    def test_load_cells_are_bit_exact(self, shape, seed):
+        """Every request's span-component sum == the front-end's own
+        measured admission-to-delivery latency, bit-identically — the
+        cell's own gate AND an independent re-derivation here."""
+        name, kwargs = shape
+        rep, fe = run_load_cell(n=4, seed=seed, return_frontend=True,
+                                **kwargs)
+        assert rep["ok"], rep["verdict"]
+        assert rep["span_exact"] is True
+        report = frontend_spans(fe)
+        assert exactness_problems(report, fe) == []
+        # belt and braces: compare stream by stream, == not approx
+        for st in fe.completed:
+            tree = report.requests[st.request.stream_id]
+            assert tree.delivery_sum() == \
+                st.completed_at - st.admitted_at
+
+    @pytest.mark.parametrize("seed", MATRIX_SEEDS)
+    @pytest.mark.parametrize("hot", [None, 1], ids=["uniform", "hot"])
+    def test_moe_cells_are_bit_exact(self, seed, hot):
+        rep = run_moe_cell(n=4, seed=seed, duration=120,
+                           hot_expert=hot,
+                           batches_per_tick=0.75 if hot else 0.5)
+        assert rep["ok"], rep["verdict"]
+        assert rep["span_exact"] is True
+
+    @pytest.mark.parametrize("seed", MATRIX_SEEDS[:2])
+    def test_retune_cell_is_bit_exact(self, seed):
+        rep = run_retune_cell(n=4, seed=seed, duration=160)
+        assert rep["ok"], rep["verdict"]
+        assert rep["span_exact"] is True
+
+    def test_exactness_detects_a_lying_frontend(self):
+        """The gate is a real comparison: perturb the front-end's
+        bookkeeping after the run and the check must fail."""
+        rep, fe = run_load_cell(n=4, seed=0, duration=120,
+                                overload=1.0, return_frontend=True)
+        report = frontend_spans(fe)
+        assert exactness_problems(report, fe) == []
+        fe.completed[0].completed_at += 1
+        problems = exactness_problems(report, fe)
+        assert problems and "span exactness" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# Tail-latency blame vs injected ground truth
+# ---------------------------------------------------------------------------
+
+
+class TestBlame:
+    @pytest.mark.parametrize("seed,kill", [(0, 2), (3, 0), (8, 2),
+                                           (11, 3)])
+    def test_kill_cell_blames_the_dead_rank(self, seed, kill):
+        rep = run_load_cell(n=4, seed=seed, duration=240,
+                            overload=1.0, kill_rank=kill, kill_at=60)
+        assert rep["ok"], rep["verdict"]
+        binding = rep["blame"]["binding"]
+        assert binding["component"] == "failover"
+        assert binding["resource"] == f"failover:rank{kill}"
+
+    def test_kill_with_nothing_in_flight_blames_the_heirs_wire(self):
+        """A kill that caught zero in-flight streams (suspicion
+        drained everything first) has no failover time to blame — the
+        binding falls to the heir's wire, which is where the diverted
+        load actually bound. Seed pinned from the seeded sweep."""
+        rep = run_load_cell(n=4, seed=4, duration=240, overload=1.0,
+                            kill_rank=1, kill_at=60)
+        assert rep["ok"], rep["verdict"]
+        assert "failover" not in rep["spans"]["components_ticks"]
+        binding = rep["blame"]["binding"]
+        assert binding["resource"].startswith("wire:rank")
+
+    @pytest.mark.parametrize("seed,stall", [(0, 3), (2, 1), (6, 1),
+                                            (9, 2)])
+    def test_stall_cell_blames_the_stalled_rank(self, seed, stall):
+        rep = run_load_cell(n=4, seed=seed, duration=240,
+                            overload=1.0, stall_rank=stall,
+                            stall_at=40, stall_ticks=60)
+        assert rep["ok"], rep["verdict"]
+        binding = rep["blame"]["binding"]
+        assert binding["resource"].endswith(f"rank{stall}"), binding
+
+    @pytest.mark.parametrize("seed", (0, 7, 11))
+    def test_overload_cell_blames_wire_and_brownout_class(self, seed):
+        rep = run_load_cell(n=4, seed=seed, duration=240,
+                            overload=2.0)
+        assert rep["ok"], rep["verdict"]
+        binding = rep["blame"]["binding"]
+        # the tail of DELIVERED requests bound on the saturated wire;
+        # the shed pressure names the browned-out class
+        assert binding["resource"].startswith("wire:rank")
+        admission = rep["blame"]["admission"]
+        assert admission["brownout_class"] == "best_effort"
+        assert admission["brownout_sheds"] > 0
+
+    @pytest.mark.parametrize("seed,hot", [(0, 1), (5, 3)])
+    def test_moe_hot_expert_blames_its_home_rank(self, seed, hot):
+        rep = run_moe_cell(n=4, seed=seed, duration=120,
+                           hot_expert=hot, batches_per_tick=0.75)
+        assert rep["ok"], rep["verdict"]
+        home = expert_home(hot, 4)
+        binding = rep["blame"]["binding"]
+        assert binding["resource"].endswith(f"rank{home}"), binding
+
+    def test_blame_rows_decompose_p99_into_shares(self):
+        rep = run_load_cell(n=4, seed=0, duration=240, overload=2.0)
+        for qos, row in rep["blame"]["by_qos"].items():
+            if row is None:
+                continue
+            assert row["p99"] >= row["p50"]
+            assert set(row["shares"]) <= set(DELIVERY_COMPONENTS)
+            if row["shares"]:
+                assert abs(sum(row["shares"].values()) - 1.0) < 0.01
+            assert row["decile_count"] == max(
+                1, math.ceil(0.1 * row["count"])
+            )
+
+    def test_bad_decile_is_loud(self):
+        rep, fe = run_load_cell(n=4, seed=0, duration=120,
+                                overload=1.0, return_frontend=True)
+        with pytest.raises(ValueError, match="decile"):
+            blame_report(frontend_spans(fe), decile=0.0)
+
+    def test_format_blame_renders_the_verdict(self):
+        rep = run_load_cell(n=4, seed=0, duration=240, overload=2.0)
+        text = "\n".join(format_blame(rep["blame"]))
+        assert "binding" in text and "brownout class best_effort" \
+            in text
+
+
+# ---------------------------------------------------------------------------
+# SLO breaches in the seeded campaigns: deterministic, no false alarms
+# ---------------------------------------------------------------------------
+
+
+class TestSloCampaign:
+    @pytest.mark.parametrize("seed", (0, 7, 11, 23))
+    def test_brownout_campaign_breaches_deterministically(self, seed):
+        """The 2x overload (brownout) cell must fire slo.breach on
+        best_effort — the class the ceilings shed first — and the
+        breach must be in the event stream, not just the snapshot."""
+        rep = run_load_cell(n=4, seed=seed, duration=240,
+                            overload=2.0)
+        assert rep["ok"], rep["verdict"]
+        cls = rep["health"]["classes"]["best_effort"]
+        assert cls["breaches"] >= 1
+        assert rep["obs"]["event_counts"].get("slo.breach", 0) >= 1
+        # brownout is the dominant error reason for the class
+        assert cls["errors_by_reason"].get("brownout", 0) > 0
+        # and the counters agree with the engine's own bookkeeping
+        counters = rep["metrics"]["counters"]
+        assert counters.get(
+            "slo_breaches_total{qos=best_effort}", 0
+        ) == cls["breaches"]
+
+    @pytest.mark.parametrize("seed", (0, 7, 11, 23))
+    def test_fair_weather_fires_zero_alarms(self, seed):
+        """0.5x load: zero breaches AND zero burn warnings, any seed —
+        the noise floor of the signal."""
+        rep = run_load_cell(n=4, seed=seed, duration=240,
+                            overload=0.5)
+        assert rep["ok"], rep["verdict"]
+        health = rep["health"]
+        assert health["breaches_total"] == 0
+        assert all(c["burn_warnings"] == 0
+                   for c in health["classes"].values())
+        assert rep["obs"]["event_counts"].get("slo.breach", 0) == 0
+        assert rep["obs"]["event_counts"].get("slo.burn", 0) == 0
+
+    def test_health_rides_every_cell_report(self):
+        for rep in (
+            run_load_cell(n=4, seed=0, duration=160, overload=2.0),
+            run_moe_cell(n=4, seed=0, duration=120),
+            run_retune_cell(n=4, seed=0, duration=160),
+        ):
+            health = rep["health"]
+            assert health["windows"] == list(SLO_WINDOWS)
+            assert set(health["classes"]) == set(QOS_CLASSES)
+
+    def test_health_is_deterministic_per_seed(self):
+        a = run_load_cell(n=4, seed=5, duration=160, overload=2.0)
+        b = run_load_cell(n=4, seed=5, duration=160, overload=2.0)
+        assert json.dumps(a["health"], sort_keys=True) == \
+            json.dumps(b["health"], sort_keys=True)
+        assert json.dumps(a["blame"], sort_keys=True) == \
+            json.dumps(b["blame"], sort_keys=True)
+
+    def test_breach_is_observation_not_gate(self):
+        """An overload cell breaches AND passes its gates: health is
+        a signal for the control loop, never a campaign verdict."""
+        rep = run_load_cell(n=4, seed=0, duration=240, overload=2.0)
+        assert rep["health"]["breaches_total"] > 0
+        assert rep["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# Serving trace export (per-tenant track groups)
+# ---------------------------------------------------------------------------
+
+
+class TestServingTrace:
+    def _trace(self, seed=5):
+        from smi_tpu.obs.trace import trace_serving
+
+        rep, fe = run_load_cell(n=4, seed=seed, duration=160,
+                                overload=2.0, return_frontend=True)
+        return trace_serving(frontend_spans(fe), seed=seed)
+
+    def test_same_seed_byte_identical_file(self):
+        from smi_tpu.obs.trace import trace_to_json_bytes
+
+        assert trace_to_json_bytes(self._trace(5)) == \
+            trace_to_json_bytes(self._trace(5))
+
+    def test_validates_and_groups_by_tenant(self):
+        from smi_tpu.obs.trace import validate_chrome_trace
+
+        payload = self._trace()
+        validate_chrome_trace(payload)
+        other = payload["otherData"]
+        assert other["trace_kind"] == "serving"
+        # one process per tenant, named
+        processes = [e for e in payload["traceEvents"]
+                     if e.get("name") == "process_name"]
+        assert len(processes) == other["tenants"]
+        assert all(e["args"]["name"].startswith("tenant ")
+                   for e in processes)
+        # spans carry component cats from the span taxonomy
+        cats = {e["cat"] for e in payload["traceEvents"]
+                if e["ph"] == "X"}
+        assert cats <= set(COMPONENTS) | {"annotation"}
+        assert "credit.stall" in cats  # the overload signature
+
+    def test_components_ticks_match_the_span_digest(self):
+        rep, fe = run_load_cell(n=4, seed=3, duration=160,
+                                overload=2.0, return_frontend=True)
+        from smi_tpu.obs.trace import trace_serving
+
+        spans = frontend_spans(fe)
+        payload = trace_serving(spans, seed=3)
+        digest = spans.digest()
+        traced = payload["otherData"]["components_ticks"]
+        for c, v in digest["components_ticks"].items():
+            assert traced.get(c, 0) == v
+
+    def test_protocol_traces_still_validate_at_v2(self):
+        from smi_tpu.obs.trace import (
+            trace_protocol,
+            validate_chrome_trace,
+        )
+
+        payload = trace_protocol("all_reduce", 3)
+        assert payload["otherData"]["trace_kind"] == "protocol"
+        validate_chrome_trace(payload)
+
+    def test_trace_serving_rejects_non_span_input(self):
+        from smi_tpu.obs.trace import trace_serving
+
+        with pytest.raises(TypeError, match="SpanReport"):
+            trace_serving({"not": "a span report"})
+
+
+# ---------------------------------------------------------------------------
+# bench.py additive slo field
+# ---------------------------------------------------------------------------
+
+
+def test_bench_slo_field_schema_and_legacy_contract():
+    import bench
+
+    fields = bench.slo_fields()
+    assert set(fields) == {
+        "cell", "fair_weather_burn", "breaches", "p99_blame",
+        "binding", "span_exact", "ok",
+    }
+    # fair weather: zero breaches, zero burn — the noise floor
+    assert fields["breaches"] == 0
+    assert all(v == 0.0 for v in fields["fair_weather_burn"].values())
+    assert fields["span_exact"] is True and fields["ok"] is True
+    for qos, row in fields["p99_blame"].items():
+        assert qos in QOS_CLASSES
+        assert set(row) == {"p99_ticks", "binding", "resource",
+                            "shares"}
+    # additive: the legacy single-line contract is untouched
+    line = bench.render_line({
+        "metric": "m", "value": 1, "unit": "u", "vs_baseline": 1.0,
+        "slo": fields,
+    })
+    assert json.loads(line)["slo"] == fields
+
+
+# ---------------------------------------------------------------------------
+# Wide sweeps behind slow
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(12))
+def test_wide_matrix_exactness_and_blame(seed):
+    import random
+
+    kill = random.Random(f"k{seed}").randrange(4)
+    rep = run_load_cell(n=4, seed=seed, duration=240, overload=1.0,
+                        kill_rank=kill, kill_at=60)
+    assert rep["ok"] and rep["span_exact"], rep["verdict"]
+    binding = rep["blame"]["binding"]
+    if "failover" in rep["spans"]["components_ticks"]:
+        assert binding["resource"] == f"failover:rank{kill}"
+    stall = random.Random(f"s{seed}").randrange(4)
+    rep = run_load_cell(n=4, seed=seed, duration=240, overload=1.0,
+                        stall_rank=stall, stall_at=40, stall_ticks=60)
+    assert rep["ok"] and rep["span_exact"], rep["verdict"]
+    assert rep["blame"]["binding"]["resource"].endswith(
+        f"rank{stall}"
+    )
