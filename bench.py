@@ -388,6 +388,34 @@ def retune_fields() -> dict:
     }
 
 
+def elasticity_fields() -> dict:
+    """Additive demand-elasticity provenance: the seeded flash-crowd
+    cell (:func:`smi_tpu.serving.campaign.run_flash_crowd_cell` —
+    pure Python, deterministic per seed, sub-second) reporting the
+    scale-out/scale-in arc, the blame-driven live migration, and the
+    loss accounting — the elasticity regime this build sustains,
+    measured next to the throughput headline. The legacy
+    metric/value/unit/vs_baseline contract is untouched."""
+    from smi_tpu.serving.campaign import run_flash_crowd_cell
+
+    rep = run_flash_crowd_cell(n=4, seed=0)
+    el = rep["elasticity"]
+    migs = el["migrations"]
+    return {
+        "scale_outs": el["scale_outs"],
+        "scale_ins": el["scale_ins"],
+        "parked": el["parked"],
+        "migrations": len(migs),
+        "migrations_committed": sum(
+            1 for m in migs if m["state"] == "committed"
+        ),
+        "migrated_streams": el["migrated_streams"],
+        "stale_epoch_rejections": rep["stale_epoch_rejections"],
+        "lost_accepted": rep["lost_accepted"],
+        "ok": rep["ok"],
+    }
+
+
 def plan_fields(depth) -> dict:
     """Additive plan-provenance evidence: which tuning layer (cache /
     model / heuristic) produced the knobs behind the headline metric
@@ -542,6 +570,12 @@ def main():
         payload["retune"] = retune_fields()
     except Exception as e:
         payload["retune"] = {"error": f"{type(e).__name__}: {e}"}
+    # additive demand-elasticity field (same best-effort contract):
+    # the seeded flash-crowd cell's scale/migration accounting
+    try:
+        payload["elasticity"] = elasticity_fields()
+    except Exception as e:
+        payload["elasticity"] = {"error": f"{type(e).__name__}: {e}"}
     # additive SLO field (same best-effort contract): fair-weather
     # burn rates + p99 blame component shares from the deterministic
     # serving smoke

@@ -926,6 +926,12 @@ def cmd_chaos(args: argparse.Namespace) -> int:
               "--moe campaigns have no plan traffic to retune)",
               file=sys.stderr)
         return 2
+    if getattr(args, "flash_crowd", False) and not args.load:
+        print("error: --flash-crowd applies only to --load (the "
+              "demand-elasticity cell rides the serving front-end; "
+              "the base/--elastic/--moe campaigns have no "
+              "autoscaler)", file=sys.stderr)
+        return 2
     if args.load:
         return _cmd_chaos_load(args)
     if getattr(args, "moe", False):
@@ -1071,6 +1077,7 @@ def _cmd_chaos_load(args: argparse.Namespace) -> int:
                       else 240),
             trials=args.trials,
             retune=getattr(args, "retune", False),
+            flash_crowd=getattr(args, "flash_crowd", False),
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -1092,6 +1099,18 @@ def _cmd_chaos_load(args: argparse.Namespace) -> int:
                 f"{rt['samples_ingested']} samples, "
                 f"{rt['stale_plan_rejections']} stale-plan "
                 f"straggler(s) rejected"
+            )
+        if cell["cell"] == "flash-crowd":
+            el = cell["elasticity"]
+            migs = el["migrations"]
+            committed = sum(
+                1 for m in migs if m["state"] == "committed"
+            )
+            print(
+                f"{'elastic':>12}: {el['scale_outs']} scale-out(s), "
+                f"{el['scale_ins']} scale-in(s), "
+                f"parked {el['parked']}, "
+                f"{len(migs)} migration(s) ({committed} committed)"
             )
         if getattr(args, "metrics", False):
             counters = cell["metrics"]["counters"]
@@ -1213,7 +1232,11 @@ def cmd_serve(args: argparse.Namespace) -> int:
     admission-latency bound must hold. Nonzero exit on any gate
     failure — the CI hook for the serving layer.
     """
-    from smi_tpu.serving.campaign import retune_selftest, serve_selftest
+    from smi_tpu.serving.campaign import (
+        autoscale_selftest,
+        retune_selftest,
+        serve_selftest,
+    )
 
     if not args.selftest:
         print("error: serve requires --selftest (the live serving "
@@ -1225,8 +1248,15 @@ def cmd_serve(args: argparse.Namespace) -> int:
               "modes (--json's full report already embeds the "
               "metrics snapshot)", file=sys.stderr)
         return 2
+    if (getattr(args, "retune", False)
+            and getattr(args, "autoscale", False)):
+        print("error: --retune and --autoscale are distinct "
+              "selftests; pick one", file=sys.stderr)
+        return 2
     if getattr(args, "retune", False):
         report = retune_selftest(seed=args.seed)
+    elif getattr(args, "autoscale", False):
+        report = autoscale_selftest(seed=args.seed)
     else:
         report = serve_selftest(seed=args.seed)
     if args.json:
@@ -1294,6 +1324,20 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 f"shift; {rt['stale_plan_rejections']} stale-plan "
                 f"straggler(s) rejected, {rt['stale_plan_leaks']} "
                 f"leaked"
+            )
+        if getattr(args, "autoscale", False):
+            el = report["elasticity"]
+            migs = el["migrations"]
+            committed = sum(
+                1 for m in migs if m["state"] == "committed"
+            )
+            print(
+                f"    elastic: {el['scale_outs']} scale-out(s), "
+                f"{el['scale_ins']} scale-in(s), "
+                f"parked {el['parked']}, {len(migs)} migration(s) "
+                f"({committed} committed), "
+                f"crowd window {report['crowd_window']} at "
+                f"{report['crowd_factor']}x"
             )
     if args.out:
         with open(args.out, "w") as f:
@@ -2511,6 +2555,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "picks for the shifted distribution, with "
                         "bit-identical delivery, zero lost-accepted, "
                         "and zero stale-plan leaks (--load only)")
+    p.add_argument("--flash-crowd", action="store_true",
+                   dest="flash_crowd",
+                   help="with --load: add the seeded flash-crowd "
+                        "demand-elasticity cell per trial — one "
+                        "tenant 10x's its rate mid-run and capacity "
+                        "must FOLLOW the load: scale-out under the "
+                        "crowd, a blame-driven live migration when "
+                        "the tail convicts the hot rank, scale-in "
+                        "after it drains, loss-free throughout "
+                        "(--load only)")
     p.add_argument("--duration", type=int, default=None, metavar="TICKS",
                    help="ticks of open-loop traffic per --load/--moe "
                         "cell (defaults 240/120; --load/--moe only)")
@@ -2537,6 +2591,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ServingFrontend(retune=)) and must hot-swap "
                         "to the offline-sweep pick with bit-identical "
                         "delivery")
+    p.add_argument("--autoscale", action="store_true",
+                   help="with --selftest: run the seeded flash-crowd "
+                        "cell instead — the elasticity controller "
+                        "must scale out under the crowd, migrate the "
+                        "hot tenant off its convicted rank, and "
+                        "scale back in after the drain, loss-free")
     p.add_argument("--seed", type=int, default=0,
                    help="selftest seed (default 0; the report is "
                         "deterministic per seed)")

@@ -78,7 +78,25 @@ The action alphabet (one BFS edge each):
   servable. Aborts are explored from the pre-swap states only — the
   shape the serving front-end actually drives (quiesce-timeout);
   PlanSwap's post-swap restore branch is covered by its unit tests,
-  not by this exhaustive tier.
+  not by this exhaustive tier;
+- ``mig_propose`` / ``mig_handoff`` / ``mig_cutover`` /
+  ``mig_commit`` / ``mig_abort`` (``migrate`` scopes only) — the r16
+  live-tenant-migration arc: the source lane's frozen streams drain,
+  their delivered state crosses as a REAL CRC-framed checkpoint shard
+  (:func:`~smi_tpu.parallel.checkpoint.pack_shard`), the cutover
+  bumps the membership epoch
+  (:meth:`~smi_tpu.parallel.membership.MembershipView.migrate_cutover`)
+  and rejects a straggler from the old route loudly, and an abort
+  before cutover leaves every stream where it was — zero
+  lost-accepted either way (the ``migration-lost-accepted`` /
+  ``placement-epoch-safety`` properties);
+- ``scale_in`` / ``scale_out`` (``migrate`` scopes only) — the
+  demand-elasticity capacity arc through the real actuators
+  (:func:`~smi_tpu.parallel.membership.shrink_pod` /
+  :func:`~smi_tpu.parallel.membership.regrow_pod`): scale-in parks a
+  member only when it holds zero residents and an empty lane (the
+  ``_scale_in_ok`` seam the ``scale_in_with_residents`` mutant
+  breaks); scale-out re-admits it under a fresh incarnation.
 
 Scope: everything here is **fault-free wire, faulty control plane** —
 the wire tier's own invariants are the PR 7 verifier's job; what is
@@ -110,6 +128,7 @@ from smi_tpu.parallel.membership import (
     plan_regrow_ring,
     route_owner,
 )
+from smi_tpu.parallel.checkpoint import pack_shard, unpack_shard
 from smi_tpu.parallel.credits import IntegrityError
 from smi_tpu.parallel.recovery import ProgressLog
 from smi_tpu.serving.admission import AdmissionGate
@@ -156,7 +175,12 @@ class Scope:
     the action alphabet grows ``plan_propose`` / ``plan_quiesce`` /
     ``plan_swap`` / ``plan_commit`` / ``plan_abort``, and the
     ``plan-epoch-safety`` / ``swap-lost-accepted`` properties become
-    non-vacuous.
+    non-vacuous; ``migrate`` (0 or 1) arms the r16 demand-elasticity
+    arc — live tenant migration (drain -> handoff -> cutover ->
+    commit, checkpoint-shard transport, epoch-bumped cutover) plus
+    one scale-in/scale-out round trip through the real membership
+    actuators, and the ``migration-lost-accepted`` /
+    ``placement-epoch-safety`` properties become non-vacuous.
     """
 
     tenants: int = 2
@@ -170,6 +194,7 @@ class Scope:
     starve: int = 3
     hot_rank: int = -1
     retune: int = 0
+    migrate: int = 0
 
     def __post_init__(self):
         for dim in ("tenants", "ranks", "chunks"):
@@ -216,6 +241,18 @@ class Scope:
                 f"retune must be 0 or 1, got {self.retune} (one swap "
                 f"arc per scope — the machine is key-local, so one "
                 f"arc exhausts its interleavings)"
+            )
+        if self.migrate not in (0, 1):
+            raise ValueError(
+                f"migrate must be 0 or 1, got {self.migrate} (one "
+                f"migration arc per scope — the front-end drives one "
+                f"migration at a time, so one arc exhausts its "
+                f"interleavings)"
+            )
+        if self.migrate and self.ranks < 2:
+            raise ValueError(
+                "migrate=1 needs ranks >= 2 (a migration needs a "
+                "source and a distinct destination)"
             )
 
     def describe(self) -> str:
@@ -292,6 +329,15 @@ DEFAULT_SCOPES: Tuple[Scope, ...] = (
     # reachable state (the exhaustive counterpart of the seeded
     # payload-shift retune cell)
     Scope(tenants=2, ranks=2, chunks=2, streams=1, pool=2, retune=1),
+    # the r16 demand-elasticity arc: drain -> handoff -> cutover ->
+    # commit/abort interleaved with admissions/sends/consumes, plus
+    # one scale-in/scale-out round trip — migration-lost-accepted and
+    # placement-epoch-safety checked on every reachable state (the
+    # exhaustive counterpart of the seeded flash-crowd / migration
+    # campaign cells; consume=1 keeps partially-delivered streams
+    # reachable mid-arc, the states where a lost handoff would hide)
+    Scope(tenants=2, ranks=2, chunks=2, streams=1, pool=2, consume=1,
+          migrate=1),
 )
 
 
@@ -395,6 +441,21 @@ class World:
             self.swap_expected_entry = seed_entry
             self.retunes_left = 1
             self.plan_aborts_left = 1
+        # -- the r16 migration/scale arc (migrate scopes): live tenant
+        # migration over a REAL checkpoint shard + one capacity round
+        # trip through the real membership actuators
+        self.migration: Optional[Dict] = None
+        self.migrations_left = 0
+        self.mig_aborts_left = 0
+        self.scale_ins_left = 0
+        self.parked: set = set()
+        #: delivered state lost across a cutover (a handoff that never
+        #: happened) — the migration-lost-accepted property's evidence
+        self.mig_lost = 0
+        if scope.migrate:
+            self.migrations_left = 1
+            self.mig_aborts_left = 1
+            self.scale_ins_left = 1
         self._bootstrap()
 
     # -- mutant seams (defaults == the shipped frontend behaviour) ------
@@ -443,6 +504,34 @@ class World:
         lost-accepted); the rollback_discards_entry mutant breaks
         exactly this restore."""
         self.swap.rollback(reason)
+
+    def _handoff_ready(self) -> bool:
+        """May the draining migration pack its shard? Only when no
+        frozen stream has a frame on the source wire — sends are
+        frozen, so the census is monotone."""
+        mig = self.migration
+        lane = self.lanes[mig["src"]]
+        frozen = mig["streams"]
+        return not any(
+            item.stream.index in frozen
+            for queue in (lane.in_flight, lane.landed)
+            for item in queue
+        )
+
+    def _cutover_ready(self) -> bool:
+        """May the migration cut over? Only once the handoff shard is
+        packed. The cutover_without_handoff mutant lies and cuts over
+        straight from the drain — the delivered state never crosses."""
+        return self.migration["state"] == "handoff"
+
+    def _scale_in_ok(self, rank: int) -> bool:
+        """May this rank be scaled in? Only with zero residents (no
+        active stream destined to it) and an empty wire lane — the
+        scale_in_with_residents mutant breaks exactly this census."""
+        if any(st.dst == rank for st in self.active):
+            return False
+        lane = self.lanes[rank]
+        return not (lane.in_flight or lane.landed)
 
     # -- plumbing -------------------------------------------------------
 
@@ -580,9 +669,22 @@ class World:
         except AdmissionRejected:
             pass  # named + recorded by the real gate
 
+    def _sendable(self) -> List[StreamState]:
+        """The streams the scheduler may issue sends for: everything
+        active, minus a draining migration's frozen streams (delivery
+        continues — that IS the drain — but no new frames enter the
+        source wire until the cutover re-routes them)."""
+        if (self.migration is not None
+                and self.migration["state"] in
+                ("draining", "handoff", "cutover")):
+            frozen = self.migration["streams"]
+            return [st for st in self.active
+                    if st.index not in frozen]
+        return self.active
+
     def _do_send(self, rank: int) -> None:
         self.scheduler.schedule_lane(
-            self.lanes[rank], self.active, self.clock.now()
+            self.lanes[rank], self._sendable(), self.clock.now()
         )
 
     def _do_consume(self, rank: int) -> None:
@@ -700,6 +802,118 @@ class World:
             for st in self.active:
                 self.stream_plan_epoch[st.index] = self.swap.plan_epoch
 
+    # -- the migration/scale arc (migrate scopes) -----------------------
+
+    def _do_mig_propose(self) -> None:
+        """Start the one migration arc: the source is the destination
+        of the lowest-index active stream (a deterministic 'hot' pick
+        the symmetry reduction can reason about), the destination its
+        successor among the members, and the frozen set every active
+        stream currently routed to the source."""
+        self.migrations_left -= 1
+        src = min(self.active, key=lambda s: s.index).dst
+        members = sorted(self.view.members)
+        dst = members[(members.index(src) + 1) % len(members)]
+        self.migration = {
+            "state": "draining", "src": src, "dst": dst,
+            "streams": frozenset(st.index for st in self.active
+                                 if st.dst == src),
+            "blob": None, "handed": {},
+        }
+
+    def _do_mig_handoff(self) -> None:
+        """Pack the drained streams' delivered state into a REAL
+        checkpoint shard (CRC + framing) — the in-memory transport the
+        serving front-end uses, byte for byte."""
+        mig = self.migration
+        snapshot = sorted(
+            (st.index, (dict(sorted(st.delivered.items())),
+                        st.next_to_send))
+            for st in self.active if st.index in mig["streams"]
+        )
+        payload = pickle.dumps(snapshot, protocol=4)
+        blob, _crc = pack_shard(mig["src"], self.view.epoch, payload)
+        mig["blob"] = blob
+        # render-only summary (the blob's bytes are identity-variant,
+        # the fingerprint must not see them)
+        mig["handed"] = {idx: len(d) for idx, (d, _n) in snapshot}
+        mig["state"] = "handoff"
+
+    def _do_mig_cutover(self) -> None:
+        """Epoch-bumped cutover: restore each frozen stream's state
+        FROM the shard (the blob is load-bearing — a cutover without a
+        handoff has nothing to restore and the delivered state is
+        lost, the migration-lost-accepted conviction), re-route onto
+        the destination's fresh epoch-keyed lane, and reject one
+        straggler from the old route loudly."""
+        mig = self.migration
+        restored: Dict = {}
+        if mig["blob"] is not None:
+            _r, _s, payload, _c = unpack_shard(mig["blob"])
+            restored = dict(pickle.loads(payload))
+        old_epoch = self.view.epoch
+        new_epoch = self.view.migrate_cutover(mig["src"], mig["dst"])
+        dst_lane = self.lanes[mig["dst"]]
+        for st in self.active:
+            if st.index not in mig["streams"]:
+                continue
+            handed = restored.get(st.index)
+            if handed is None:
+                # no shard: the delivered state did not cross
+                self.mig_lost += len(st.delivered)
+                st.delivered.clear()
+                self.delivery_meta[st.index] = {}
+                st.next_to_send = 0
+                dst_lane.next_seq[(st.index, new_epoch)] = 0
+            else:
+                delivered, next_to_send = handed
+                st.delivered = dict(delivered)
+                st.next_to_send = next_to_send
+                # the destination's dense-seq expectation continues
+                # where the source's left off
+                dst_lane.next_seq[(st.index, new_epoch)] = next_to_send
+                self.delivery_meta[st.index] = {
+                    seq: (mig["dst"], new_epoch)
+                    for seq in st.delivered
+                }
+            st.dst = mig["dst"]
+            st.lane_epoch = new_epoch
+        try:
+            self.view.validate(mig["src"], old_epoch,
+                               what="post-migration straggler")
+            self.stale_leaks += 1
+        except StaleEpochError:
+            self.stale_rejections += 1
+        mig["state"] = "cutover"
+
+    def _do_mig_commit(self) -> None:
+        self.migration["state"] = "committed"
+
+    def _do_mig_abort(self) -> None:
+        """Abort before cutover: unfreeze, nothing moved, nothing
+        lost — the streams resume on the source exactly as they were."""
+        self.mig_aborts_left -= 1
+        self.migration["state"] = "aborted"
+
+    def _do_scale_in(self) -> None:
+        """Park the highest member through the real actuator (epoch
+        bump + ring re-plan + detector forget) — demand-driven, loudly
+        distinct from a death."""
+        from smi_tpu.parallel.membership import shrink_pod
+
+        self.scale_ins_left -= 1
+        rank = max(self.view.members)
+        shrink_pod(self.view, self.detector, rank, reason="demand")
+        self.parked.add(rank)
+
+    def _do_scale_out(self) -> None:
+        """Re-admit the parked rank under a fresh incarnation."""
+        from smi_tpu.parallel.membership import regrow_pod
+
+        rank = min(self.parked)
+        regrow_pod(self.view, self.detector, rank, reason="demand")
+        self.parked.discard(rank)
+
     def apply(self, action: Tuple) -> None:
         kind = action[0]
         if kind == "tick":
@@ -726,6 +940,20 @@ class World:
             self.swap.commit()
         elif kind == "plan_abort":
             self._do_plan_abort()
+        elif kind == "mig_propose":
+            self._do_mig_propose()
+        elif kind == "mig_handoff":
+            self._do_mig_handoff()
+        elif kind == "mig_cutover":
+            self._do_mig_cutover()
+        elif kind == "mig_commit":
+            self._do_mig_commit()
+        elif kind == "mig_abort":
+            self._do_mig_abort()
+        elif kind == "scale_in":
+            self._do_scale_in()
+        elif kind == "scale_out":
+            self._do_scale_out()
         else:
             raise ValueError(f"unknown model action {action!r}")
         self._epoch_watermark = max(self._epoch_watermark,
@@ -765,13 +993,14 @@ class World:
         for t in range(self.scope.tenants):
             if self.submissions_left[t] > 0:
                 out.append(("admit", t))
+        sendable = self._sendable()
         for lane in self.lanes:
             if lane.rank in self.killed:
                 continue
             if lane.can_send() and any(
                 st.dst == lane.rank
                 and st.next_to_send < st.total_chunks
-                for st in self.active
+                for st in sendable
             ):
                 out.append(("send", lane.rank))
         now = self.clock.now()
@@ -812,6 +1041,40 @@ class World:
                     out.append(("plan_abort",))
             elif state == "swapped":
                 out.append(("plan_commit",))
+        if self.scope.migrate:
+            mig = self.migration
+            if (mig is None and self.migrations_left > 0
+                    and len(self.view.members) >= 2 and self.active):
+                out.append(("mig_propose",))
+            elif mig is not None:
+                state = mig["state"]
+                if state == "draining":
+                    if self._handoff_ready():
+                        out.append(("mig_handoff",))
+                    # enabledness goes through the mutant seam: the
+                    # clean census requires the shard packed, the
+                    # cutover_without_handoff mutant lies and cuts
+                    # over straight from the drain
+                    if self._cutover_ready():
+                        out.append(("mig_cutover",))
+                    if self.mig_aborts_left > 0:
+                        out.append(("mig_abort",))
+                elif state == "handoff":
+                    if self._cutover_ready():
+                        out.append(("mig_cutover",))
+                    if self.mig_aborts_left > 0:
+                        out.append(("mig_abort",))
+                elif state == "cutover":
+                    out.append(("mig_commit",))
+            if ((mig is None
+                    or mig["state"] in ("committed", "aborted"))
+                    and self.scale_ins_left > 0
+                    and len(self.view.members) > 1):
+                victim = max(self.view.members)
+                if self._scale_in_ok(victim):
+                    out.append(("scale_in",))
+            if self.parked:
+                out.append(("scale_out",))
         return out
 
     # -- canonical fingerprint (relative time + symmetry orbits) --------
@@ -937,6 +1200,30 @@ class World:
                 (entry.knobs.get("algorithm"), entry.revision)
                 if entry is not None else None,
             ),)
+        if self.scope.migrate:
+            mig = self.migration
+            mig_t = None
+            if mig is not None:
+                # the blob's raw bytes are identity-variant (they
+                # embed absolute stream indices/payload labels) — the
+                # fingerprint sees its PRESENCE plus the order-mapped
+                # handed summary, never the bytes
+                mig_t = (
+                    mig["state"], rho[mig["src"]], rho[mig["dst"]],
+                    tuple(sorted(order[i] for i in mig["streams"]
+                                 if i in order)),
+                    tuple(sorted(
+                        (order[i], count)
+                        for i, count in mig["handed"].items()
+                        if i in order
+                    )),
+                    mig["blob"] is not None,
+                )
+            base += ((
+                mig_t, self.migrations_left, self.mig_aborts_left,
+                self.scale_ins_left, self.mig_lost,
+                tuple(sorted(rho[r] for r in self.parked)),
+            ),)
         return base
 
     def fingerprint(self) -> tuple:
@@ -986,8 +1273,19 @@ class World:
                 "stale_plan_rejections": self.stale_plan_rejections,
                 "stale_plan_leaks": self.stale_plan_leaks,
             }}
+        migrate = {}
+        if self.scope.migrate:
+            migrate = {"migrate": {
+                "state": (self.migration["state"]
+                          if self.migration is not None else None),
+                "migrations_left": self.migrations_left,
+                "mig_lost": self.mig_lost,
+                "scale_ins_left": self.scale_ins_left,
+                "parked": sorted(self.parked),
+            }}
         return {
             **retune,
+            **migrate,
             "scope": self.scope.to_json(),
             "epoch": self.view.epoch,
             "members": sorted(self.view.members),

@@ -271,6 +271,40 @@ def _rollback_discards_entry_world():
     return _RollbackDiscardsEntry
 
 
+def _cutover_without_handoff_world():
+    """``cutover_without_handoff``: the migration driver's readiness
+    check lies — the cutover fires straight from ``draining`` without
+    waiting for the handoff shard, so the destination restores from
+    nothing and every chunk the source had already delivered is gone.
+    Only reachable on ``migrate`` scopes; benign elsewhere.
+    Conviction: ``migration-lost-accepted`` — ``mig_lost`` counts the
+    delivered state that never crossed."""
+    World = _model_world_base()
+
+    class _CutoverWithoutHandoff(World):
+        def _cutover_ready(self):
+            # ...whether or not the shard was ever packed (the defect)
+            return self.migration["state"] in ("draining", "handoff")
+
+    return _CutoverWithoutHandoff
+
+
+def _scale_in_with_residents_world():
+    """``scale_in_with_residents``: the scale-in victim census lies —
+    the controller parks a rank without checking for resident streams
+    or in-flight frames, stranding accepted work on a non-member.
+    Only reachable on ``migrate`` scopes; benign elsewhere.
+    Conviction: ``placement-epoch-safety`` — an active stream's
+    destination is no longer a member."""
+    World = _model_world_base()
+
+    class _ScaleInWithResidents(World):
+        def _scale_in_ok(self, rank):
+            return True  # ...residents or not (the defect)
+
+    return _ScaleInWithResidents
+
+
 #: Control-plane mutant registry: name -> World factory.
 _MODEL_MUTANT_FACTORIES = {
     "leaked_stream_credit": _leaked_stream_credit_world,
@@ -279,6 +313,8 @@ _MODEL_MUTANT_FACTORIES = {
     "heartbeat_after_confirm": _heartbeat_after_confirm_world,
     "swap_without_quiesce": _swap_without_quiesce_world,
     "rollback_discards_entry": _rollback_discards_entry_world,
+    "cutover_without_handoff": _cutover_without_handoff_world,
+    "scale_in_with_residents": _scale_in_with_residents_world,
 }
 
 #: The shipped control-plane mutants, in acceptance-matrix order.
@@ -293,6 +329,8 @@ MODEL_MUTANT_PROPERTY = {
     "heartbeat_after_confirm": "lost-accepted",
     "swap_without_quiesce": "plan-epoch-safety",
     "rollback_discards_entry": "swap-lost-accepted",
+    "cutover_without_handoff": "migration-lost-accepted",
+    "scale_in_with_residents": "placement-epoch-safety",
 }
 
 

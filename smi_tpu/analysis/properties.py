@@ -52,6 +52,16 @@ sampled assertions into exhaustively-checked invariants:
   (pre-proposal entry until the swap, the rival after it, the
   pre-proposal entry again after a rollback) — the
   ``rollback_discards_entry`` mutant's conviction.
+- **migration-lost-accepted** (``migrate`` scopes) — a live tenant
+  migration never loses delivered state: the cutover restores every
+  frozen stream's progress from the checkpoint shard packed at
+  handoff, so ``mig_lost`` (delivered chunks that did not cross) is
+  always zero — the ``cutover_without_handoff`` mutant's conviction.
+- **placement-epoch-safety** (``migrate`` scopes) — capacity changes
+  never strand accepted work: every active stream's destination is a
+  current member (a scale-in with residents would park the rank their
+  frames route to, unreachable under the new epoch) — the
+  ``scale_in_with_residents`` mutant's conviction.
 """
 
 from __future__ import annotations
@@ -68,7 +78,8 @@ from smi_tpu.serving.scheduler import WIRE_CREDITS
 #: satisfy them vacuously).
 PROPERTIES = ("queue-bound", "stream-credit", "starvation",
               "epoch-safety", "lost-accepted",
-              "plan-epoch-safety", "swap-lost-accepted")
+              "plan-epoch-safety", "swap-lost-accepted",
+              "migration-lost-accepted", "placement-epoch-safety")
 
 Violation = Tuple[str, str]
 
@@ -274,6 +285,49 @@ def check_swap_lost_accepted(world) -> List[Violation]:
     return []
 
 
+def check_migration_lost_accepted(world) -> List[Violation]:
+    """The r16 migration arc: delivered state always crosses the
+    cutover — ``mig_lost`` counts chunks whose delivery record did not
+    come back out of the handoff shard. Vacuous on non-``migrate``
+    scopes (the counter only moves inside the migration arc)."""
+    scope = getattr(world, "scope", None)
+    if scope is None or not getattr(scope, "migrate", 0):
+        return []
+    if world.mig_lost:
+        return [(
+            "migration-lost-accepted",
+            f"{world.mig_lost} delivered chunk(s) were lost across "
+            f"the migration cutover — the handoff shard was never "
+            f"packed (or never restored), so the destination restarts "
+            f"the stream(s) from nothing and 'accepted' silently "
+            f"stopped being durable",
+        )]
+    return []
+
+
+def check_placement_epoch_safety(world) -> List[Violation]:
+    """The r16 capacity arc: a scale-in may only park a rank with
+    zero residents — every active stream's destination must be a
+    current member. Vacuous on non-``migrate`` scopes (kill scopes
+    reroute inside the same failover action, so only the elasticity
+    actuators can strand a destination)."""
+    scope = getattr(world, "scope", None)
+    if scope is None or not getattr(scope, "migrate", 0):
+        return []
+    for st in world.active:
+        if st.dst not in world.view.members:
+            return [(
+                "placement-epoch-safety",
+                f"active stream {st.request.stream_id} is destined to "
+                f"rank {st.dst}, which is not a member (members: "
+                f"{sorted(world.view.members)}) — a capacity change "
+                f"parked a rank that still holds residents, so their "
+                f"frames route to a destination the new epoch cannot "
+                f"reach",
+            )]
+    return []
+
+
 def check_state(world) -> List[Violation]:
     """All per-state invariants, in property order."""
     out: List[Violation] = []
@@ -284,6 +338,8 @@ def check_state(world) -> List[Violation]:
     out.extend(check_lost_accepted(world))
     out.extend(check_plan_epoch_safety(world))
     out.extend(check_swap_lost_accepted(world))
+    out.extend(check_migration_lost_accepted(world))
+    out.extend(check_placement_epoch_safety(world))
     return out
 
 

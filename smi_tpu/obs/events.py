@@ -128,6 +128,8 @@ EVENT_KINDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "ctl.shrink": ("control", ("epoch",)),
     "ctl.regrow": ("control", ("epoch",)),
     "ctl.recover": ("control", ("protocol", "reason")),
+    "ctl.scale": ("control", ("epoch", "direction")),
+    "ctl.migrate": ("control", ("src", "dst", "state")),
     # -- tuning plane (the online retuner's lifecycle) ------------------
     "tune.sample": ("tuning", ("op", "bucket")),
     "tune.propose": ("tuning", ("op", "bucket", "from_algo",

@@ -827,6 +827,129 @@ def campaign_fields(fe) -> Tuple[dict, List[str]]:
     }, problems)
 
 
+#: The blame resource *kinds* — the head of every resource string the
+#: binding vocabulary produces (``wire:rank3`` → ``wire``). ``replay``
+#: and ``none`` never carry a rank; the other three may.
+BLAME_KINDS = ("none", "wire", "consumer", "failover", "replay")
+
+#: Kinds that may name a binding rank (``<kind>:rank<r>``).
+_RANKED_BLAME_KINDS = ("wire", "consumer", "failover")
+
+
+@dataclasses.dataclass(frozen=True)
+class BlameVerdict:
+    """A structured tail-latency blame verdict.
+
+    The machine-consumable form of a binding's ``resource`` string:
+    ``kind`` is the resource family (:data:`BLAME_KINDS`), ``rank`` the
+    binding rank when the verdict names one (else ``None``),
+    ``component`` the dominant delivery component that bound, and
+    ``share`` its fraction of the tail. Campaign code and the
+    elasticity controller consume THIS — pattern-matching the rendered
+    ``"wire:rank<r>"`` string was the r15 shape and is now a bug:
+    a vocabulary change would silently stop matching.
+    """
+
+    kind: str
+    rank: Optional[int]
+    component: str = "none"
+    share: float = 0.0
+
+    @property
+    def resource(self) -> str:
+        """The rendered resource string (round-trips through
+        :func:`parse_blame_resource`)."""
+        if self.rank is None:
+            return self.kind
+        return f"{self.kind}:rank{self.rank}"
+
+    def __str__(self) -> str:
+        return (f"{self.component} -> {self.resource} "
+                f"({self.share:.0%} of the tail)")
+
+
+def parse_blame_resource(resource: str, component: str = "none",
+                         share: float = 0.0) -> BlameVerdict:
+    """Parse a binding ``resource`` string into a :class:`BlameVerdict`.
+
+    A malformed string is a LOUD ``ValueError`` naming the string: the
+    verdict vocabulary is an API (campaign gates and the elasticity
+    controller act on it), and a silent ``None`` on a typo would turn
+    a migration trigger into a no-op without a trace.
+    """
+    if not isinstance(resource, str):
+        raise ValueError(
+            f"blame resource must be a string, got "
+            f"{type(resource).__name__}: {resource!r}"
+        )
+    kind, sep, tail = resource.partition(":")
+    if kind not in BLAME_KINDS:
+        raise ValueError(
+            f"malformed blame resource {resource!r}: kind {kind!r} is "
+            f"not one of {BLAME_KINDS}"
+        )
+    rank: Optional[int] = None
+    if sep:
+        if kind not in _RANKED_BLAME_KINDS:
+            raise ValueError(
+                f"malformed blame resource {resource!r}: {kind!r} "
+                f"never names a rank"
+            )
+        if not tail.startswith("rank"):
+            raise ValueError(
+                f"malformed blame resource {resource!r}: expected "
+                f"{kind}:rank<r>"
+            )
+        try:
+            rank = int(tail[len("rank"):])
+        except ValueError:
+            raise ValueError(
+                f"malformed blame resource {resource!r}: "
+                f"{tail[len('rank'):]!r} is not a rank"
+            ) from None
+        if rank < 0:
+            raise ValueError(
+                f"malformed blame resource {resource!r}: rank must be "
+                f">= 0"
+            )
+    return BlameVerdict(kind=kind, rank=rank, component=component,
+                        share=share)
+
+
+def blame_verdict(blame: dict) -> BlameVerdict:
+    """The :class:`BlameVerdict` of a blame report (or of one of its
+    rows). Accepts the :func:`blame_report` dict itself (reads its
+    cell-level ``binding``), the binding dict, or a per-class row —
+    anything carrying a ``resource`` string. Malformed input is loud.
+    """
+    if not isinstance(blame, dict):
+        raise ValueError(
+            f"blame verdict needs a blame dict, got "
+            f"{type(blame).__name__}"
+        )
+    node = blame
+    if isinstance(node.get("binding"), dict):
+        node = node["binding"]  # the full blame_report was passed
+    if "resource" not in node:
+        raise ValueError(
+            f"blame verdict: no 'resource' in {sorted(node)!r} — pass "
+            f"a blame report, its binding, or a per-class row"
+        )
+    component = node.get("component")
+    if component is None:
+        # per-class rows carry the component under "binding"
+        component = node.get("binding", "none")
+    if not isinstance(component, str):
+        raise ValueError(
+            f"blame verdict: component must be a string, got "
+            f"{component!r}"
+        )
+    return parse_blame_resource(
+        node["resource"], component=component,
+        share=float(node.get("share", 0.0)),
+    )
+
+
 def format_blame(blame: Optional[dict]) -> List[str]:
     """Render a blame report as text lines (the ``smi-tpu health``
     surface)."""

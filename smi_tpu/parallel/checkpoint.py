@@ -170,18 +170,72 @@ def shard_name(rank: int, step: int) -> str:
     return f"shard-step{step:08d}-rank{rank}.bin"
 
 
-def write_shard(directory: str, rank: int, step: int,
-                payload) -> Tuple[str, int]:
-    """Write one CRC-framed shard atomically; returns its filename and
-    the framed CRC (so the manifest can quote it without re-encoding
-    the payload)."""
+def pack_shard(rank: int, step: int, payload) -> Tuple[bytes, int]:
+    """Frame one shard in memory; returns ``(blob, crc)``.
+
+    The exact bytes :func:`write_shard` puts on disk — a JSON header
+    line framing the payload's length and CRC, then the payload. Split
+    out so the framing is usable as a *transport*: a live-migration
+    handoff ships a tenant's in-flight stream state through this
+    discipline (pack → move → :func:`unpack_shard`) without touching a
+    filesystem, and torn or bit-flipped state is rejected exactly like
+    a damaged checkpoint at rest.
+    """
     data, meta = _encode_payload(payload)
     crc = zlib.crc32(data) & 0xFFFFFFFF
     header = dict(
         meta, rank=rank, step=step, nbytes=len(data), crc=crc,
         schema_version=SCHEMA_VERSION,
     )
-    blob = json.dumps(header, sort_keys=True).encode() + b"\n" + data
+    return json.dumps(header, sort_keys=True).encode() + b"\n" + data, crc
+
+
+def unpack_shard(blob: bytes, origin: str = "<memory>"):
+    """Verify + decode a framed shard blob; returns
+    ``(rank, step, payload, crc)``. ``origin`` names the blob's source
+    in errors (a file path, a migration handoff, ...).
+
+    Raises :class:`CheckpointIntegrityError` on a CRC or length
+    mismatch — a damaged shard names itself instead of deserializing.
+    """
+    nl = blob.find(b"\n")
+    if nl < 0:
+        raise CheckpointIntegrityError(
+            f"shard {origin!r} has no header line (torn or foreign file)"
+        )
+    try:
+        header = json.loads(blob[:nl].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CheckpointIntegrityError(
+            f"shard {origin!r} header is not JSON: {e}"
+        ) from e
+    data = blob[nl + 1:]
+    rank, step = header.get("rank"), header.get("step")
+    if len(data) != header.get("nbytes"):
+        raise CheckpointIntegrityError(
+            f"shard {origin!r} (rank {rank}, step {step}) payload is "
+            f"{len(data)} bytes but the header framed "
+            f"{header.get('nbytes')} (torn write)",
+            rank=rank, step=step,
+            expected=header.get("nbytes"), got=len(data),
+        )
+    crc = zlib.crc32(data) & 0xFFFFFFFF
+    if crc != header.get("crc"):
+        raise CheckpointIntegrityError(
+            f"shard {origin!r} (rank {rank}, step {step}): payload "
+            f"hashes to {crc:#010x} but the header framed "
+            f"{header.get('crc'):#010x} (corrupted at rest)",
+            rank=rank, step=step, expected=header.get("crc"), got=crc,
+        )
+    return rank, step, _decode_payload(data, header), crc
+
+
+def write_shard(directory: str, rank: int, step: int,
+                payload) -> Tuple[str, int]:
+    """Write one CRC-framed shard atomically; returns its filename and
+    the framed CRC (so the manifest can quote it without re-encoding
+    the payload)."""
+    blob, crc = pack_shard(rank, step, payload)
     name = shard_name(rank, step)
     write_atomic(os.path.join(directory, name), blob)
     return name, crc
@@ -197,36 +251,7 @@ def read_shard(path: str):
     """
     with open(path, "rb") as f:
         blob = f.read()
-    nl = blob.find(b"\n")
-    if nl < 0:
-        raise CheckpointIntegrityError(
-            f"shard {path!r} has no header line (torn or foreign file)"
-        )
-    try:
-        header = json.loads(blob[:nl].decode())
-    except (UnicodeDecodeError, json.JSONDecodeError) as e:
-        raise CheckpointIntegrityError(
-            f"shard {path!r} header is not JSON: {e}"
-        ) from e
-    data = blob[nl + 1:]
-    rank, step = header.get("rank"), header.get("step")
-    if len(data) != header.get("nbytes"):
-        raise CheckpointIntegrityError(
-            f"shard {path!r} (rank {rank}, step {step}) payload is "
-            f"{len(data)} bytes but the header framed "
-            f"{header.get('nbytes')} (torn write)",
-            rank=rank, step=step,
-            expected=header.get("nbytes"), got=len(data),
-        )
-    crc = zlib.crc32(data) & 0xFFFFFFFF
-    if crc != header.get("crc"):
-        raise CheckpointIntegrityError(
-            f"shard {path!r} (rank {rank}, step {step}): payload "
-            f"hashes to {crc:#010x} but the header framed "
-            f"{header.get('crc'):#010x} (corrupted at rest)",
-            rank=rank, step=step, expected=header.get("crc"), got=crc,
-        )
-    return rank, step, _decode_payload(data, header), crc
+    return unpack_shard(blob, origin=path)
 
 
 # ---------------------------------------------------------------------------

@@ -360,6 +360,78 @@ class MembershipView:
         self._observe("ctl.regrow", rank, "rejoin")
         return self.epoch
 
+    def scale_in(self, rank: int, reason: str = "demand") -> int:
+        """Park a healthy rank under a new epoch — capacity scale-in.
+
+        Same composition change as :meth:`confirm_dead` (the epoch is
+        the safety rail either way) but booked as a ``scale-in``
+        transition and observed as a ``ctl.scale`` event: an operator
+        reading the audit trail must be able to tell a deliberate
+        capacity decision from a death. Returns the new epoch.
+        """
+        if rank not in self.members:
+            raise ValueError(f"rank {rank} is not a member")
+        if len(self.members) == 1:
+            raise ValueError(
+                f"cannot scale in rank {rank}: it is the last member"
+            )
+        self.members.discard(rank)
+        self.epoch += 1
+        self.transitions.append((self.epoch, "scale-in", rank))
+        recorder = getattr(self, "_recorder", None)
+        if recorder is not None:
+            recorder.emit("ctl.scale", self.epoch, rank=rank,
+                          epoch=self.epoch, direction="in", reason=reason)
+        return self.epoch
+
+    def scale_out(self, rank: int, reason: str = "demand") -> int:
+        """Re-admit a parked rank under a new epoch + incarnation —
+        capacity scale-out, the inverse of :meth:`scale_in`. Booked as
+        a ``scale-out`` transition / ``ctl.scale`` event so demand
+        actuation and failure recovery stay distinguishable in the
+        audit trail. Returns the new epoch."""
+        if rank in self.members:
+            raise ValueError(f"rank {rank} is already a member")
+        if not 0 <= rank < self.n:
+            raise ValueError(f"rank {rank} out of range for n={self.n}")
+        self.members.add(rank)
+        self.incarnation[rank] += 1
+        self.epoch += 1
+        self.transitions.append((self.epoch, "scale-out", rank))
+        recorder = getattr(self, "_recorder", None)
+        if recorder is not None:
+            recorder.emit("ctl.scale", self.epoch, rank=rank,
+                          epoch=self.epoch, direction="out", reason=reason)
+        return self.epoch
+
+    def migrate_cutover(self, src: int, dst: int,
+                        tenant: str = "") -> int:
+        """Bump the epoch for a live-migration lane switch.
+
+        Membership does not change — both ranks stay members — but the
+        epoch must move so stragglers still addressed to the source
+        lane are rejected as :class:`StaleEpochError` instead of being
+        folded into the destination silently (the same rail a failover
+        uses, chosen on purpose). Returns the new epoch.
+        """
+        for r, role in ((src, "source"), (dst, "destination")):
+            if r not in self.members:
+                raise ValueError(
+                    f"migration {role} rank {r} is not a member"
+                )
+        if src == dst:
+            raise ValueError(
+                f"migration source and destination are both rank {src}"
+            )
+        self.epoch += 1
+        self.transitions.append((self.epoch, "migrate", dst))
+        recorder = getattr(self, "_recorder", None)
+        if recorder is not None:
+            recorder.emit("ctl.migrate", self.epoch, rank=dst,
+                          epoch=self.epoch, src=src, dst=dst,
+                          state="cutover", tenant=tenant)
+        return self.epoch
+
     def validate(self, rank: int, epoch: int, what: str = "message") -> None:
         """Reject traffic from a mismatched epoch or a non-member (the
         error's wording distinguishes stale sender from split view)."""
@@ -431,6 +503,38 @@ def plan_regrow_ring(view: MembershipView,
     ctx = build_routing_context(topo, excluded=cut)
     check_all_pairs_routable(ctx, [topo.devices[r] for r in order])
     return order
+
+
+def shrink_pod(view: MembershipView, detector, rank: int,
+               reason: str = "demand") -> int:
+    """Capacity scale-in actuator: park ``rank`` out of the serving
+    pod. The step-clock analog of ``Communicator.shrink_pod``, driven
+    by *demand* instead of death: the epoch bumps (``scale-in``
+    transition + ``ctl.scale`` event), the post-shrink ring is
+    validated routable (:func:`plan_regrow_ring` — a scale-in that
+    would strand a member raises instead of landing), and the phi
+    detector forgets the rank so a deliberately-parked rank can never
+    accrue suspicion while silent. Returns the new epoch."""
+    epoch = view.scale_in(rank, reason=reason)
+    plan_regrow_ring(view)
+    if detector is not None:
+        detector.forget(rank)
+    return epoch
+
+
+def regrow_pod(view: MembershipView, detector, rank: int,
+               reason: str = "demand") -> int:
+    """Capacity scale-out actuator: re-admit a parked rank (the
+    inverse of :func:`shrink_pod`). Epoch bumps under a ``scale-out``
+    transition, the grown ring is validated routable, and the detector
+    forgets the rank so the fresh incarnation bootstraps its heartbeat
+    history clean (the :meth:`MembershipView.regrow` discipline).
+    Returns the new epoch."""
+    epoch = view.scale_out(rank, reason=reason)
+    plan_regrow_ring(view)
+    if detector is not None:
+        detector.forget(rank)
+    return epoch
 
 
 # ---------------------------------------------------------------------------

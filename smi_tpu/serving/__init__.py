@@ -13,11 +13,28 @@ runtime's discipline) — ``smi-tpu serve --selftest`` and
 
 from smi_tpu.serving.admission import AdmissionGate, TokenBucket
 from smi_tpu.serving.campaign import (
+    autoscale_selftest,
     load_campaign,
+    run_flash_crowd_cell,
     run_load_cell,
+    run_migrate_under_kill_cell,
+    run_migration_cell,
     serve_selftest,
 )
+from smi_tpu.serving.elasticity import (
+    MIN_SERVING_RANKS,
+    SCALE_BURN_THRESHOLD,
+    SCALE_COOLDOWN_TICKS,
+    SCALE_IN_BURN_FRACTION,
+    SCALE_IN_SUSTAIN_TICKS,
+    SCALE_OUT_SUSTAIN_TICKS,
+    ElasticityController,
+    autoscale_enabled,
+    scale_burn_threshold,
+    scale_cooldown_ticks,
+)
 from smi_tpu.serving.frontend import ServingFrontend, tenant_base_rank
+from smi_tpu.serving.placement import PlacementMap
 from smi_tpu.serving.moe import (
     HOT_FACTOR,
     MoeDispatcher,
@@ -54,12 +71,20 @@ __all__ = [
     "CLASS_POOL_CEILING",
     "CLASS_PRIORITY",
     "CONSUME_RATE",
+    "ElasticityController",
     "HOT_FACTOR",
     "INTERACTIVE_P99_TICKS",
+    "MIN_SERVING_RANKS",
     "MoeDispatcher",
     "MAX_STARVE_ROUNDS",
+    "PlacementMap",
     "QOS_CLASSES",
     "Request",
+    "SCALE_BURN_THRESHOLD",
+    "SCALE_COOLDOWN_TICKS",
+    "SCALE_IN_BURN_FRACTION",
+    "SCALE_IN_SUSTAIN_TICKS",
+    "SCALE_OUT_SUSTAIN_TICKS",
     "ServingFrontend",
     "StreamScheduler",
     "StreamState",
@@ -67,12 +92,19 @@ __all__ = [
     "TRANSIT_TICKS",
     "WIRE_CREDITS",
     "WireLane",
+    "autoscale_enabled",
+    "autoscale_selftest",
     "expert_home",
     "load_campaign",
     "moe_campaign",
     "route_tokens",
+    "run_flash_crowd_cell",
     "run_load_cell",
+    "run_migrate_under_kill_cell",
+    "run_migration_cell",
     "run_moe_cell",
+    "scale_burn_threshold",
+    "scale_cooldown_ticks",
     "serve_selftest",
     "tenant_base_rank",
 ]

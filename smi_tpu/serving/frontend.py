@@ -45,8 +45,8 @@ shedding, and bounded interactive admission latency.
 
 from __future__ import annotations
 
+import pickle
 import random
-import zlib
 from typing import Dict, List, Optional
 
 from smi_tpu.parallel.membership import (
@@ -63,9 +63,11 @@ from smi_tpu.parallel.membership import (
 from smi_tpu.obs.events import FlightRecorder
 from smi_tpu.obs.metrics import MetricsRegistry
 from smi_tpu.obs.slo import SloEngine
+from smi_tpu.parallel.checkpoint import pack_shard, unpack_shard
 from smi_tpu.parallel.credits import IntegrityError
 from smi_tpu.parallel.recovery import ProgressLog
 from smi_tpu.serving.admission import AdmissionGate, DEFAULT_POOL
+from smi_tpu.serving.placement import PlacementMap, tenant_base_rank
 from smi_tpu.serving.qos import QOS_CLASSES, Request, check_qos
 from smi_tpu.serving.scheduler import (
     CONSUME_RATE,
@@ -79,10 +81,7 @@ from smi_tpu.tuning.swap import StalePlanError
 from smi_tpu.utils.watchdog import Deadline
 
 
-def tenant_base_rank(tenant: str, n: int) -> int:
-    """Deterministic tenant -> base rank map (stable across runs and
-    processes; failover rides :func:`membership.route_owner`)."""
-    return zlib.crc32(f"tenant:{tenant}".encode()) % n
+__all__ = ["ServingFrontend", "tenant_base_rank"]
 
 
 class ServingFrontend:
@@ -100,6 +99,7 @@ class ServingFrontend:
         recorder: Optional[FlightRecorder] = None,
         metrics: Optional[MetricsRegistry] = None,
         retune: Optional[object] = None,
+        elasticity: Optional[object] = None,
     ):
         if n < 2:
             raise ValueError(f"serving needs >= 2 ranks, got {n}")
@@ -170,6 +170,20 @@ class ServingFrontend:
         self.replanned_streams = 0
         self.stale_plan_rejections = 0
         self.stale_plan_leaks = 0
+        #: sticky tenant placement (r16): unarmed = byte-identical to
+        #: the crc32 rule; the elasticity controller arms it at bind
+        self.placement = PlacementMap(n)
+        #: the in-flight live migration, or None — one at a time, a
+        #: dict {tenant, src, dst, state, streams, blob, reason, ...}
+        #: driven one state transition per tick by _drive_migration
+        self._migration: Optional[Dict] = None
+        #: completed/aborted migration audit trail (report material)
+        self.migrations: List[Dict] = []
+        self.migrated_streams = 0
+        #: per-rank decayed credit-stall window (halved every tick,
+        #: +1 per stalled tick) — with the occupancy gauge, the load
+        #: signal placement and migration targeting read
+        self._recent_stalls: Dict[int, int] = {r: 0 for r in range(n)}
         self.lanes = [WireLane(r) for r in range(n)]
         self.scheduler = StreamScheduler(
             check_deadlines=check_deadlines
@@ -200,6 +214,14 @@ class ServingFrontend:
         self._kill_tick: Optional[int] = None
         self._next_beat = 0
         self._bootstrap()
+        #: the demand-elasticity controller
+        #: (:class:`smi_tpu.serving.elasticity.ElasticityController`)
+        #: — None = elasticity off, byte-for-byte the pre-r16 loop.
+        #: Bound AFTER bootstrap: parking the spare ranks is a real
+        #: scale-in (epoch bump + ctl.scale), loud from tick zero.
+        self.elasticity = elasticity
+        if self.elasticity is not None:
+            self.elasticity.bind(self)
 
     # -- clock & membership plumbing ------------------------------------
 
@@ -295,7 +317,10 @@ class ServingFrontend:
         way."""
         from smi_tpu.parallel.recovery import heir_of
 
-        base = tenant_base_rank(tenant, self.n) if base is None else base
+        if base is None:
+            base = self.placement.place(
+                tenant, self.view.members, self._rank_load
+            )
         owner = route_owner(self.view, base, self.n)
         if owner is None:  # pragma: no cover - last member can't die
             raise RuntimeError("no surviving rank to route to")
@@ -309,6 +334,17 @@ class ServingFrontend:
 
     def _backlog(self, rank: int) -> int:
         return sum(1 for st in self.active if st.dst == rank)
+
+    def _rank_load(self, rank: int) -> float:
+        """The measured per-rank load placement and migration
+        targeting read: wire-lane occupancy (the shipped gauge) plus
+        the decayed credit-stall window — both maintained in
+        :meth:`step`'s lane loop, so the signal is exactly what the
+        blame engine convicts with."""
+        occupancy = self.metrics.gauge(
+            "wire_lane_occupancy", rank=rank,
+        ).value
+        return float(occupancy + self._recent_stalls.get(rank, 0))
 
     def _observe_send(self, stream, seq, lane, now) -> None:
         """The scheduler's per-chunk hook: one ``serve.send`` event +
@@ -580,9 +616,17 @@ class ServingFrontend:
                     f"{st.dst} ({len(st.delivered)}/"
                     f"{st.total_chunks} delivered)"
                 )
+        # a draining migration freezes its streams' sends (delivery
+        # continues — that IS the drain); everything else schedules
+        # exactly as before
+        schedulable = self.active
+        if self._migration is not None:
+            frozen = self._migration["streams"]
+            schedulable = [st for st in self.active
+                           if st.index not in frozen]
         for lane in self.lanes:
             self.scheduler.schedule_lane(
-                lane, self.active, now, provider
+                lane, schedulable, now, provider
             )
             # wire-lane occupancy + credit stalls, AFTER scheduling:
             # a zero-credit lane with chunks still to move is a
@@ -591,11 +635,13 @@ class ServingFrontend:
             self.metrics.gauge(
                 "wire_lane_occupancy", rank=lane.rank,
             ).set(WIRE_CREDITS - lane.credits)
+            self._recent_stalls[lane.rank] //= 2
             if lane.credits == 0 and any(
                 st.dst == lane.rank
                 and st.next_to_send < st.total_chunks
                 for st in self.active
             ):
+                self._recent_stalls[lane.rank] += 2
                 self.metrics.counter("credit_stall_ticks",
                                      rank=lane.rank).inc()
                 # the span builder's credit-stall sub-span record:
@@ -608,6 +654,10 @@ class ServingFrontend:
         self.slo.evaluate(now)
         if self.tuner is not None:
             self._drive_retune(now)
+        if self._migration is not None:
+            self._drive_migration(now)
+        if self.elasticity is not None:
+            self.elasticity.step(now)
         self.gate.assert_bounded()
 
     # -- online retuning (r14) ------------------------------------------
@@ -666,6 +716,182 @@ class ServingFrontend:
                     tuner.rollback(swap, "quiesce-timeout", now)
             elif swap.state == "swapped":
                 tuner.commit(swap)
+
+    # -- live tenant migration (r16) ------------------------------------
+
+    def request_migration(self, tenant: str, dst: int,
+                          reason: str = "demand") -> None:
+        """Start a live migration of ``tenant`` onto member ``dst``:
+        drain -> handoff -> cutover -> commit, one state per tick,
+        every transition a ``ctl.migrate`` event. The tenant's
+        in-flight streams freeze their sends, the wire drains, the
+        delivered state crosses as a CRC-framed checkpoint shard
+        (:func:`~smi_tpu.parallel.checkpoint.pack_shard`), and the
+        cutover bumps the membership epoch so stragglers from the old
+        route are rejected as :class:`StaleEpochError` — never folded
+        in. Zero lost-accepted by construction: nothing is dropped,
+        voided, or replayed on the happy path."""
+        if self._migration is not None:
+            raise RuntimeError(
+                f"migration already in flight for tenant "
+                f"{self._migration['tenant']!r} "
+                f"({self._migration['state']})"
+            )
+        if dst not in self.view.members:
+            raise ValueError(
+                f"migration destination rank {dst} is not a member "
+                f"(members: {sorted(self.view.members)})"
+            )
+        src = self._route_new(tenant, record=False)
+        if src == dst:
+            raise ValueError(
+                f"tenant {tenant!r} is already served by rank {dst}"
+            )
+        streams = frozenset(
+            st.index for st in self.active
+            if st.request.tenant == tenant and st.dst == src
+        )
+        self._migration = {
+            "tenant": tenant, "src": src, "dst": dst,
+            "state": "draining", "streams": streams, "blob": None,
+            "reason": reason, "requested_at": self.clock.now(),
+        }
+        self._emit_migrate("draining")
+
+    def _emit_migrate(self, state: str) -> None:
+        mig = self._migration
+        self.recorder.emit(
+            "ctl.migrate", self.clock.now(), rank=mig["src"],
+            src=mig["src"], dst=mig["dst"], state=state,
+            tenant=mig["tenant"],
+        )
+        self.metrics.counter("migration_transitions_total",
+                             state=state).inc()
+
+    def _migration_drained(self) -> bool:
+        """True once no frozen stream has a frame on the source wire
+        (in flight or landed-unconsumed) — sends are frozen, so this
+        is monotone while the consumer lives."""
+        mig = self._migration
+        lane = self.lanes[mig["src"]]
+        frozen = mig["streams"]
+        return not any(
+            item.stream.index in frozen
+            for queue in (lane.in_flight, lane.landed)
+            for item in queue
+        )
+
+    def _drive_migration(self, now: int) -> None:
+        """One migration state transition per tick. A membership
+        change touching either party aborts loudly first: after a
+        failover has rerouted (voided, replayed) the frozen streams,
+        restoring the handoff snapshot would resurrect stale state."""
+        mig = self._migration
+        if (mig["src"] not in self.view.members
+                or mig["dst"] not in self.view.members):
+            self._abort_migration("membership-change")
+            return
+        if mig["state"] == "draining":
+            if self._migration_drained():
+                self._migration_handoff(now)
+        elif mig["state"] == "handoff":
+            self._migration_cutover(now)
+        elif mig["state"] == "cutover":
+            self._migration_commit(now)
+
+    def _migration_handoff(self, now: int) -> None:
+        """Pack the drained streams' delivered state into a checkpoint
+        shard — the same CRC+seq framing the elastic soak writes to
+        disk, here as the in-memory handoff transport. After a full
+        drain every sent chunk was consumed, so delivered state and
+        send cursor agree; the cutover restores BOTH from the shard
+        (the blob is load-bearing, not ceremonial)."""
+        mig = self._migration
+        snapshot = sorted(
+            (st.index, (dict(sorted(st.delivered.items())),
+                        st.next_to_send))
+            for st in self.active if st.index in mig["streams"]
+        )
+        payload = pickle.dumps(snapshot)
+        blob, _crc = pack_shard(mig["src"], self.view.epoch, payload)
+        mig["blob"] = blob
+        mig["state"] = "handoff"
+        self._emit_migrate("handoff")
+
+    def _migration_cutover(self, now: int) -> None:
+        mig = self._migration
+        _rank, _step, payload, _crc = unpack_shard(
+            mig["blob"], origin=f"migration:{mig['tenant']}",
+        )
+        restored = dict(pickle.loads(payload))
+        old_epoch = self.view.epoch
+        new_epoch = self.view.migrate_cutover(
+            mig["src"], mig["dst"], tenant=mig["tenant"],
+        )
+        self.metrics.counter("epoch_bumps_total",
+                             reason="migrate").inc()
+        dst_lane = self.lanes[mig["dst"]]
+        for st in self.active:
+            if st.index not in mig["streams"]:
+                continue
+            handed = restored.get(st.index)
+            if handed is None:
+                # the forbidden outcome: an accepted stream's state
+                # missing from the shard packed at handoff
+                raise RuntimeError(
+                    f"migration handoff lost stream "
+                    f"{st.request.stream_id}: not in the shard "
+                    f"packed at handoff"
+                )
+            delivered, next_to_send = handed
+            st.delivered = dict(delivered)
+            st.next_to_send = next_to_send
+            st.dst = mig["dst"]
+            st.lane_epoch = new_epoch
+            # the destination's dense-sequence expectation continues
+            # where the source's left off — remaining chunks arrive
+            # as seq next_to_send, next_to_send+1, ... on the fresh
+            # (index, epoch) lane
+            dst_lane.next_seq[(st.index, new_epoch)] = next_to_send
+            self.migrated_streams += 1
+        # one straggler from the old route presents the pre-cutover
+        # epoch: it must be rejected by epoch, never folded in
+        try:
+            self.view.validate(mig["src"], old_epoch,
+                               what="post-migration straggler")
+            self.stale_epoch_leaks += 1
+        except StaleEpochError:
+            self.stale_epoch_rejections += 1
+        self.placement.pin(mig["tenant"], mig["dst"],
+                           reason="migrate")
+        mig["state"] = "cutover"
+        # the ctl.migrate cutover event itself is emitted by
+        # MembershipView.migrate_cutover, at the epoch-bump site
+
+    def _migration_commit(self, now: int) -> None:
+        mig = self._migration
+        mig["state"] = "committed"
+        self._emit_migrate("committed")
+        self.migrations.append({
+            "tenant": mig["tenant"], "src": mig["src"],
+            "dst": mig["dst"], "state": "committed",
+            "reason": mig["reason"], "streams": len(mig["streams"]),
+            "requested_at": mig["requested_at"], "committed_at": now,
+        })
+        self._migration = None
+
+    def _abort_migration(self, why: str) -> None:
+        mig = self._migration
+        self._emit_migrate("aborted")
+        self.migrations.append({
+            "tenant": mig["tenant"], "src": mig["src"],
+            "dst": mig["dst"], "state": "aborted",
+            "reason": mig["reason"], "abort_reason": why,
+            "streams": len(mig["streams"]),
+            "requested_at": mig["requested_at"],
+            "aborted_at": self.clock.now(),
+        })
+        self._migration = None
 
     def drain(self, max_ticks: int = 5000) -> None:
         """Run the loop until every accepted stream completes. A
@@ -744,4 +970,13 @@ class ServingFrontend:
                 "stale_plan_rejections": self.stale_plan_rejections,
                 "stale_plan_leaks": self.stale_plan_leaks,
             }} if self.tuner is not None else {}),
+            # the demand-elasticity snapshot (r16): controller state,
+            # placement audit, migration trail — None = key absent,
+            # byte-for-byte the pre-r16 report
+            **({"elasticity": {
+                **self.elasticity.report(),
+                "placement": self.placement.report(),
+                "migrations": list(self.migrations),
+                "migrated_streams": self.migrated_streams,
+            }} if self.elasticity is not None else {}),
         }

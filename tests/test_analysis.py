@@ -434,6 +434,10 @@ MODEL_MUTANT_SCOPE = {
     # machine is inert everywhere else — benign by construction)
     "swap_without_quiesce": A.DEFAULT_SCOPES[5],
     "rollback_discards_entry": A.DEFAULT_SCOPES[5],
+    # the r16 elasticity mutants need the migrate scope (the
+    # migration arc and scale actuators are inert everywhere else)
+    "cutover_without_handoff": A.DEFAULT_SCOPES[6],
+    "scale_in_with_residents": A.DEFAULT_SCOPES[6],
 }
 
 
@@ -503,6 +507,30 @@ def test_model_counterexample_is_minimal():
     assert len(report.findings[0].trace) == 3
     kinds = [a[0] for a in report.findings[0].trace]
     assert kinds == ["admit", "kill", "heartbeat"]
+
+
+@pytest.mark.model
+def test_model_migration_counterexamples_are_minimal():
+    """The r16 convictions are BFS-minimal too: losing delivered state
+    across a premature cutover needs a delivery first (admit -> send ->
+    heartbeat -> consume) then the two-step arc; stranding residents
+    needs only an admit before the bad scale-in."""
+    report = A.check_scope(
+        MODEL_MUTANT_SCOPE["cutover_without_handoff"],
+        world_factory=A.model_mutant_world("cutover_without_handoff"),
+        mutant="cutover_without_handoff",
+    )
+    kinds = [a[0] for a in report.findings[0].trace]
+    assert kinds == ["admit", "send", "heartbeat", "consume",
+                     "mig_propose", "mig_cutover"]
+
+    report = A.check_scope(
+        MODEL_MUTANT_SCOPE["scale_in_with_residents"],
+        world_factory=A.model_mutant_world("scale_in_with_residents"),
+        mutant="scale_in_with_residents",
+    )
+    kinds = [a[0] for a in report.findings[0].trace]
+    assert kinds == ["admit", "scale_in"]
 
 
 @pytest.mark.model

@@ -1166,3 +1166,65 @@ def test_health_usage_error_matrix(tmp_path, capsys):
     legacy.write_text(json.dumps({"ok": True}))
     assert run_cli("health", str(legacy)) == 1
     assert "no health state" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the demand-elasticity CLI (r16): serve --autoscale, chaos --flash-crowd
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.elasticity
+@pytest.mark.serving
+def test_serve_selftest_autoscale_gate_and_report(tmp_path, capsys):
+    out_path = tmp_path / "autoscale.json"
+    assert run_cli("serve", "--selftest", "--autoscale",
+                   "-o", str(out_path)) == 0
+    printed = capsys.readouterr().out
+    assert "elastic:" in printed
+    assert "scale-out(s)" in printed
+    report = json.loads(out_path.read_text())
+    assert report["ok"] is True
+    el = report["elasticity"]
+    assert el["scale_outs"] >= 1 and el["scale_ins"] >= 1
+    assert report["lost_accepted"] == 0
+    # deterministic per seed
+    out2 = tmp_path / "autoscale2.json"
+    assert run_cli("serve", "--selftest", "--autoscale",
+                   "-o", str(out2)) == 0
+    capsys.readouterr()
+    assert out_path.read_text() == out2.read_text()
+
+
+@pytest.mark.elasticity
+def test_serve_autoscale_usage_errors(capsys):
+    # --autoscale without --selftest: the serve usage gate
+    assert run_cli("serve", "--autoscale") == 2
+    assert "--selftest" in capsys.readouterr().err
+    # --autoscale and --retune are distinct selftests
+    assert run_cli("serve", "--selftest", "--autoscale",
+                   "--retune") == 2
+    assert "pick one" in capsys.readouterr().err
+
+
+@pytest.mark.elasticity
+@pytest.mark.serving
+def test_chaos_load_flash_crowd_adds_the_cell(tmp_path, capsys):
+    out_path = tmp_path / "flash.json"
+    assert run_cli("chaos", "--load", "--flash-crowd", "--seed",
+                   "1729", "--trials", "1", "-o", str(out_path)) == 0
+    printed = capsys.readouterr().out
+    assert "flash-crowd" in printed
+    assert "scale-out(s)" in printed
+    report = json.loads(out_path.read_text())
+    assert report["ok"] and report["cells"] == 4
+    assert report["outcomes"]["flash-crowd"] == "ok"
+
+
+@pytest.mark.elasticity
+def test_chaos_flash_crowd_requires_load(capsys):
+    assert run_cli("chaos", "--flash-crowd") == 2
+    assert "--load" in capsys.readouterr().err
+    assert run_cli("chaos", "--elastic", "--flash-crowd") == 2
+    assert "--load" in capsys.readouterr().err
+    assert run_cli("chaos", "--moe", "--flash-crowd") == 2
+    assert "--load" in capsys.readouterr().err

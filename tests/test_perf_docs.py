@@ -185,6 +185,56 @@ def test_serving_doc_quotes_the_shipped_constants():
     assert "SERVING_FAULT_CLASSES" in text
 
 
+def test_elasticity_doc_quotes_the_shipped_constants():
+    """docs/robustness.md's "Demand elasticity" section must state
+    the burn threshold, both sustain windows, the hysteresis
+    fraction, the cooldown, the floor, and the env knob names the
+    elasticity code ships, plus the migration state machine, the
+    migrate-scope properties, and the CLI surfaces — the same drift
+    discipline as the serving section. (Pure Python imports, no
+    devices.)"""
+    from smi_tpu import analysis
+    from smi_tpu.serving import elasticity as E
+
+    text = _read("docs/robustness.md")
+    assert "Demand elasticity" in text
+    for const in ("SCALE_BURN_THRESHOLD", "SCALE_OUT_SUSTAIN_TICKS",
+                  "SCALE_IN_BURN_FRACTION", "SCALE_IN_SUSTAIN_TICKS",
+                  "SCALE_COOLDOWN_TICKS", "MIN_SERVING_RANKS"):
+        value = getattr(E, const)
+        assert f"| `{const}` | {value} |" in text, (
+            f"{const}={value} missing from the hysteresis table"
+        )
+    for env in (E.AUTOSCALE_ENV, E.SCALE_COOLDOWN_ENV,
+                E.SCALE_BURN_ENV):
+        assert f"${env}" in text, f"env knob ${env} undocumented"
+    # the migration state machine, every state by name
+    for state in ("draining", "handoff", "cutover", "committed",
+                  "aborted"):
+        assert state in text
+    assert "`membership-change`" in text
+    # the model tier's migrate-scope properties + both mutants
+    for name in ("migration-lost-accepted", "placement-epoch-safety",
+                 "cutover_without_handoff",
+                 "scale_in_with_residents"):
+        assert f"`{name}`" in text, f"{name} undocumented"
+    migrate_scope = next(
+        s for s in analysis.DEFAULT_SCOPES if s.migrate
+    )
+    assert (f"tenants={migrate_scope.tenants} "
+            f"ranks={migrate_scope.ranks} "
+            f"chunks={migrate_scope.chunks} "
+            f"streams={migrate_scope.streams} "
+            f"pool={migrate_scope.pool} "
+            f"consume={migrate_scope.consume} "
+            f"migrate={migrate_scope.migrate}" in text), (
+        "the migrate scope drifted from DEFAULT_SCOPES"
+    )
+    # the CLI surfaces
+    assert "chaos --load --flash-crowd" in text
+    assert "serve --selftest --autoscale" in text
+
+
 def test_two_tier_docs_quote_the_shipped_rates_and_gates():
     """The r6 two-tier sections (docs/tuning.md decision table,
     docs/perf_notes.md "Two-tier collectives (r6)") must state the

@@ -40,12 +40,15 @@ from smi_tpu.obs.slo import (
 from smi_tpu.obs.spans import (
     COMPONENTS,
     DELIVERY_COMPONENTS,
+    BlameVerdict,
     SpanError,
     blame_report,
+    blame_verdict,
     build_spans,
     exactness_problems,
     format_blame,
     frontend_spans,
+    parse_blame_resource,
 )
 from smi_tpu.serving.campaign import run_load_cell, run_retune_cell
 from smi_tpu.serving.frontend import ServingFrontend
@@ -405,9 +408,9 @@ class TestBlame:
         rep = run_load_cell(n=4, seed=seed, duration=240,
                             overload=1.0, kill_rank=kill, kill_at=60)
         assert rep["ok"], rep["verdict"]
-        binding = rep["blame"]["binding"]
-        assert binding["component"] == "failover"
-        assert binding["resource"] == f"failover:rank{kill}"
+        verdict = blame_verdict(rep["blame"])
+        assert verdict.component == "failover"
+        assert verdict.kind == "failover" and verdict.rank == kill
 
     def test_kill_with_nothing_in_flight_blames_the_heirs_wire(self):
         """A kill that caught zero in-flight streams (suspicion
@@ -418,8 +421,8 @@ class TestBlame:
                             kill_rank=1, kill_at=60)
         assert rep["ok"], rep["verdict"]
         assert "failover" not in rep["spans"]["components_ticks"]
-        binding = rep["blame"]["binding"]
-        assert binding["resource"].startswith("wire:rank")
+        verdict = blame_verdict(rep["blame"])
+        assert verdict.kind == "wire" and verdict.rank is not None
 
     @pytest.mark.parametrize("seed,stall", [(0, 3), (2, 1), (6, 1),
                                             (9, 2)])
@@ -428,18 +431,18 @@ class TestBlame:
                             overload=1.0, stall_rank=stall,
                             stall_at=40, stall_ticks=60)
         assert rep["ok"], rep["verdict"]
-        binding = rep["blame"]["binding"]
-        assert binding["resource"].endswith(f"rank{stall}"), binding
+        verdict = blame_verdict(rep["blame"])
+        assert verdict.rank == stall, verdict
 
     @pytest.mark.parametrize("seed", (0, 7, 11))
     def test_overload_cell_blames_wire_and_brownout_class(self, seed):
         rep = run_load_cell(n=4, seed=seed, duration=240,
                             overload=2.0)
         assert rep["ok"], rep["verdict"]
-        binding = rep["blame"]["binding"]
+        verdict = blame_verdict(rep["blame"])
         # the tail of DELIVERED requests bound on the saturated wire;
         # the shed pressure names the browned-out class
-        assert binding["resource"].startswith("wire:rank")
+        assert verdict.kind == "wire" and verdict.rank is not None
         admission = rep["blame"]["admission"]
         assert admission["brownout_class"] == "best_effort"
         assert admission["brownout_sheds"] > 0
@@ -450,8 +453,8 @@ class TestBlame:
                            hot_expert=hot, batches_per_tick=0.75)
         assert rep["ok"], rep["verdict"]
         home = expert_home(hot, 4)
-        binding = rep["blame"]["binding"]
-        assert binding["resource"].endswith(f"rank{home}"), binding
+        verdict = blame_verdict(rep["blame"])
+        assert verdict.rank == home, verdict
 
     def test_blame_rows_decompose_p99_into_shares(self):
         rep = run_load_cell(n=4, seed=0, duration=240, overload=2.0)
@@ -641,6 +644,57 @@ def test_bench_slo_field_schema_and_legacy_contract():
 
 
 # ---------------------------------------------------------------------------
+# BlameVerdict: the structured verdict accessor (r16)
+# ---------------------------------------------------------------------------
+
+
+class TestBlameVerdict:
+    @pytest.mark.parametrize("resource,kind,rank", [
+        ("none", "none", None),
+        ("wire", "wire", None),
+        ("consumer", "consumer", None),
+        ("replay", "replay", None),
+        ("failover", "failover", None),
+        ("wire:rank3", "wire", 3),
+        ("consumer:rank0", "consumer", 0),
+        ("failover:rank11", "failover", 11),
+    ])
+    def test_parse_round_trips_the_vocabulary(self, resource, kind,
+                                              rank):
+        v = parse_blame_resource(resource)
+        assert (v.kind, v.rank) == (kind, rank)
+        assert v.resource == resource
+
+    @pytest.mark.parametrize("bad", [
+        "wires", "wire:", "wire:rank", "wire:rankX", "wire:3",
+        "replay:rank1", "none:rank0", "wire:rank-2", "", "rank3",
+    ])
+    def test_malformed_resource_is_loud(self, bad):
+        with pytest.raises(ValueError) as e:
+            parse_blame_resource(bad)
+        assert repr(bad) in str(e.value)
+
+    def test_accessor_reads_report_binding_and_rows(self):
+        rep = run_load_cell(n=4, seed=0, duration=240, overload=2.0)
+        top = blame_verdict(rep["blame"])
+        assert top == blame_verdict(rep["blame"]["binding"])
+        assert isinstance(top, BlameVerdict)
+        assert top.resource == rep["blame"]["binding"]["resource"]
+        for row in rep["blame"]["by_qos"].values():
+            if row is None:
+                continue
+            v = blame_verdict(row)
+            assert v.resource == row["resource"]
+            assert v.component == row["binding"]
+
+    def test_accessor_rejects_non_blame_dicts(self):
+        with pytest.raises(ValueError):
+            blame_verdict({"verdict": "wire:rank1"})
+        with pytest.raises(ValueError):
+            blame_verdict("wire:rank1")  # type: ignore[arg-type]
+
+
+# ---------------------------------------------------------------------------
 # Wide sweeps behind slow
 # ---------------------------------------------------------------------------
 
@@ -654,13 +708,11 @@ def test_wide_matrix_exactness_and_blame(seed):
     rep = run_load_cell(n=4, seed=seed, duration=240, overload=1.0,
                         kill_rank=kill, kill_at=60)
     assert rep["ok"] and rep["span_exact"], rep["verdict"]
-    binding = rep["blame"]["binding"]
     if "failover" in rep["spans"]["components_ticks"]:
-        assert binding["resource"] == f"failover:rank{kill}"
+        verdict = blame_verdict(rep["blame"])
+        assert verdict.kind == "failover" and verdict.rank == kill
     stall = random.Random(f"s{seed}").randrange(4)
     rep = run_load_cell(n=4, seed=seed, duration=240, overload=1.0,
                         stall_rank=stall, stall_at=40, stall_ticks=60)
     assert rep["ok"] and rep["span_exact"], rep["verdict"]
-    assert rep["blame"]["binding"]["resource"].endswith(
-        f"rank{stall}"
-    )
+    assert blame_verdict(rep["blame"]).rank == stall
