@@ -416,6 +416,32 @@ def elasticity_fields() -> dict:
     }
 
 
+def partition_fields() -> dict:
+    """Additive partition-tolerance provenance: the seeded clean
+    partition/heal cell (:func:`smi_tpu.serving.campaign.
+    run_partition_cell` — pure Python, deterministic per seed,
+    seconds) reporting the park / loud-refusal / heal-rejoin arc,
+    the split-brain count the fence holds at zero, and the A/B
+    bit-identity against the no-partition control — the partition
+    regime this build sustains, measured next to the throughput
+    headline. The legacy metric/value/unit/vs_baseline contract is
+    untouched."""
+    from smi_tpu.serving.campaign import run_partition_cell
+
+    rep = run_partition_cell(n=4, seed=0)
+    part = rep["partition"]
+    return {
+        "quorum_losses": part["quorum_losses"],
+        "quorum_rejections": part["quorum_rejections"],
+        "heal_rejoins": part["heal_rejoins"],
+        "split_brain_incidents": part["split_brain_incidents"],
+        "stale_epoch_rejections": rep["stale_epoch_rejections"],
+        "lost_accepted": rep["lost_accepted"],
+        "digest_match": rep["digest_match"],
+        "ok": rep["ok"],
+    }
+
+
 def plan_fields(depth) -> dict:
     """Additive plan-provenance evidence: which tuning layer (cache /
     model / heuristic) produced the knobs behind the headline metric
@@ -576,6 +602,12 @@ def main():
         payload["elasticity"] = elasticity_fields()
     except Exception as e:
         payload["elasticity"] = {"error": f"{type(e).__name__}: {e}"}
+    # additive partition-tolerance field (same best-effort contract):
+    # the seeded clean-cut cell's park/refuse/rejoin accounting
+    try:
+        payload["partition"] = partition_fields()
+    except Exception as e:
+        payload["partition"] = {"error": f"{type(e).__name__}: {e}"}
     # additive SLO field (same best-effort contract): fair-weather
     # burn rates + p99 blame component shares from the deterministic
     # serving smoke

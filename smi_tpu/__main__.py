@@ -908,11 +908,27 @@ def cmd_chaos(args: argparse.Namespace) -> int:
 
     picked = [f for f, v in (("--elastic", args.elastic),
                              ("--load", args.load),
-                             ("--moe", getattr(args, "moe", False)))
+                             ("--moe", getattr(args, "moe", False)),
+                             ("--partition",
+                              getattr(args, "partition", False)))
               if v]
     if len(picked) > 1:
         print(f"error: {' and '.join(picked)} are distinct campaigns; "
               f"pick one", file=sys.stderr)
+        return 2
+    for flag, value in (("--asymmetric",
+                         getattr(args, "asymmetric", False)),
+                        ("--flap", getattr(args, "flap", False))):
+        if value and not getattr(args, "partition", False):
+            print(f"error: {flag} applies only to --partition (it "
+                  f"narrows the partition-tolerance campaign to one "
+                  f"cell)", file=sys.stderr)
+            return 2
+    if (getattr(args, "asymmetric", False)
+            and getattr(args, "flap", False)):
+        print("error: --asymmetric and --flap are distinct "
+              "partition cells; pick one (or neither, for the full "
+              "campaign)", file=sys.stderr)
         return 2
     if getattr(args, "metrics", False) and not args.load:
         print("error: --metrics applies only to --load (the serving "
@@ -936,9 +952,12 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         return _cmd_chaos_load(args)
     if getattr(args, "moe", False):
         return _cmd_chaos_moe(args)
+    if getattr(args, "partition", False):
+        return _cmd_chaos_partition(args)
     if args.duration is not None or args.n_ranks is not None:
-        print("error: --duration/-n apply only to --load/--moe (the "
-              "base and --elastic campaigns sweep --ranks/--trials)",
+        print("error: --duration/-n apply only to "
+              "--load/--moe/--partition (the base and --elastic "
+              "campaigns sweep --ranks/--trials)",
               file=sys.stderr)
         return 2
     if args.elastic:
@@ -1221,6 +1240,111 @@ def _cmd_chaos_moe(args: argparse.Namespace) -> int:
     return 0 if report["ok"] else 1
 
 
+def _cmd_chaos_partition(args: argparse.Namespace) -> int:
+    """``chaos --partition``: the partition-tolerance campaign
+    (:mod:`smi_tpu.serving.campaign`).
+
+    Per trial: a clean symmetric cut/heal A/B (the minority's quorum
+    lease lapses and it parks, every stream homed there is refused
+    LOUDLY, the quorate majority fails over under a fenced epoch
+    bump, and the heal's delivery is bit-identical to the
+    no-partition control), an asymmetric cut during a live migration
+    (the one-way link loss only round-trip lease evidence can see;
+    the migration must abort loudly, loss-free), and a flapping-link
+    soak (suspect/clear hysteresis — zero membership transitions).
+    Exit gate: zero split-brain incidents, zero lost-accepted, zero
+    silent corruption, zero stale-epoch leaks.
+    """
+    from smi_tpu.serving.campaign import partition_campaign
+
+    if args.protocols:
+        print("error: --protocols does not apply to --partition "
+              "(the campaign cuts the serving front-end's control "
+              "plane, not a ring protocol)", file=sys.stderr)
+        return 2
+    if args.max_faults is not None:
+        print("error: --max-faults does not apply to --partition "
+              "(each cell injects exactly one partition-class "
+              "fault; sweep more cells with --trials)",
+              file=sys.stderr)
+        return 2
+    if args.ranks is not None:
+        print("error: --ranks does not apply to --partition (one "
+              "rank count per campaign; use -n/--n instead)",
+              file=sys.stderr)
+        return 2
+    only = None
+    if getattr(args, "asymmetric", False):
+        only = "partition-migration-abort"
+    elif getattr(args, "flap", False):
+        only = "flapping-link"
+    try:
+        report = partition_campaign(
+            seed=args.seed,
+            n=args.n_ranks if args.n_ranks is not None else 4,
+            duration=(args.duration if args.duration is not None
+                      else 240),
+            trials=args.trials,
+            only=only,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for cell in report["reports"]:
+        part = cell.get("partition") or {}
+        line = f"{cell['cell']:>25}: {cell['verdict']}"
+        if cell["cell"] == "partition-heal":
+            line += (
+                f" | park {part.get('quorum_losses', 0)}, "
+                f"refused loudly "
+                f"{part.get('quorum_rejections', 0)}, "
+                f"rejoined {part.get('heal_rejoins', 0)}, "
+                f"split-brain "
+                f"{part.get('split_brain_incidents', 0)}, "
+                f"{cell['digest_common']} streams bit-identical "
+                f"to control"
+            )
+        elif cell["cell"] == "partition-migration-abort":
+            migs = cell.get("elasticity", {}).get("migrations", ())
+            reasons = [m.get("abort_reason") for m in migs
+                       if m.get("state") == "aborted"]
+            line += (
+                f" | {len(list(migs))} migration(s), aborted: "
+                f"{reasons}, rejoined "
+                f"{part.get('heal_rejoins', 0)}"
+            )
+        elif cell["cell"] == "flapping-link":
+            line += (
+                f" | {len(cell['suspected'])} suspect/clear "
+                f"cycle(s), epoch {cell['epoch']}, "
+                f"{len(cell['discarded_vectors'])} vector(s) "
+                f"discarded"
+            )
+        print(line)
+    print(
+        f"{report['cells']} cells (seed {args.seed}), "
+        f"{report['split_brain_incidents']} split-brain incidents, "
+        f"{report['silent_corruptions']} silent corruptions, "
+        f"{report['lost_accepted']} lost accepted, "
+        f"{report['stale_epoch_leaks']} stale-epoch leaks"
+    )
+    for failure in report["failures"]:
+        print(
+            f"FAILURE {failure['cell']} trial {failure['trial']}: "
+            f"{failure['verdict']}"
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"report -> {args.out}")
+    if report["ok"]:
+        print("partition campaign ok: the minority parked loudly, "
+              "the majority stayed fenced, heals rejoined, and no "
+              "tenant ever had two primaries")
+    return 0 if report["ok"] else 1
+
+
 def cmd_serve(args: argparse.Namespace) -> int:
     """``serve --selftest``: the deterministic serving smoke.
 
@@ -1234,6 +1358,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     """
     from smi_tpu.serving.campaign import (
         autoscale_selftest,
+        partition_selftest,
         retune_selftest,
         serve_selftest,
     )
@@ -1248,15 +1373,23 @@ def cmd_serve(args: argparse.Namespace) -> int:
               "modes (--json's full report already embeds the "
               "metrics snapshot)", file=sys.stderr)
         return 2
-    if (getattr(args, "retune", False)
-            and getattr(args, "autoscale", False)):
-        print("error: --retune and --autoscale are distinct "
-              "selftests; pick one", file=sys.stderr)
+    picked = [f for f, v in (("--retune",
+                              getattr(args, "retune", False)),
+                             ("--autoscale",
+                              getattr(args, "autoscale", False)),
+                             ("--partition",
+                              getattr(args, "partition", False)))
+              if v]
+    if len(picked) > 1:
+        print(f"error: {' and '.join(picked)} are distinct "
+              f"selftests; pick one", file=sys.stderr)
         return 2
     if getattr(args, "retune", False):
         report = retune_selftest(seed=args.seed)
     elif getattr(args, "autoscale", False):
         report = autoscale_selftest(seed=args.seed)
+    elif getattr(args, "partition", False):
+        report = partition_selftest(seed=args.seed)
     else:
         report = serve_selftest(seed=args.seed)
     if args.json:
@@ -1338,6 +1471,19 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 f"({committed} committed), "
                 f"crowd window {report['crowd_window']} at "
                 f"{report['crowd_factor']}x"
+            )
+        if getattr(args, "partition", False):
+            part = report["partition"]
+            print(
+                f"  partition: rank {report['victim_rank']} cut for "
+                f"{report['window']} ticks; parked "
+                f"{part['quorum_losses']}, refused loudly "
+                f"{part['quorum_rejections']}, rejoined "
+                f"{part['heal_rejoins']}, split-brain "
+                f"{part['split_brain_incidents']}; "
+                f"{report['digest_common']} streams bit-identical "
+                f"to the no-partition control "
+                f"({report['digest_divergent']} divergent)"
             )
     if args.out:
         with open(args.out, "w") as f:
@@ -2542,6 +2688,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "rank surfacing as named backpressure "
                         "(--trials/-n/--duration apply; "
                         "--protocols/--ranks/--max-faults do not)")
+    p.add_argument("--partition", action="store_true",
+                   help="run the partition-tolerance campaign "
+                        "instead: a clean symmetric cut/heal A/B "
+                        "(minority parks loudly, majority fails over "
+                        "fenced, heal delivery bit-identical to the "
+                        "no-partition control), an asymmetric cut "
+                        "during a live migration (loud loss-free "
+                        "abort), and a flapping-link soak (no "
+                        "membership oscillation) per trial "
+                        "(--trials/-n/--duration apply; "
+                        "--protocols/--ranks/--max-faults do not)")
+    p.add_argument("--asymmetric", action="store_true",
+                   help="with --partition: run only the "
+                        "asymmetric-cut-during-migration cell (the "
+                        "one-way link loss only round-trip lease "
+                        "evidence can see)")
+    p.add_argument("--flap", action="store_true",
+                   help="with --partition: run only the "
+                        "flapping-link soak (suspect/clear "
+                        "hysteresis, zero membership transitions)")
     p.add_argument("--metrics", action="store_true",
                    help="with --load: print each cell's metrics "
                         "summary (admitted/shed/delivered counters + "
@@ -2597,6 +2763,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "must scale out under the crowd, migrate the "
                         "hot tenant off its convicted rank, and "
                         "scale back in after the drain, loss-free")
+    p.add_argument("--partition", action="store_true",
+                   help="with --selftest: run the seeded clean "
+                        "partition/heal cell instead — the minority "
+                        "parks and refuses loudly, the quorate "
+                        "majority fails over fenced, the heal "
+                        "rejoins, and delivery is bit-identical to "
+                        "the no-partition control")
     p.add_argument("--seed", type=int, default=0,
                    help="selftest seed (default 0; the report is "
                         "deterministic per seed)")

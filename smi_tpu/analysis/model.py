@@ -96,7 +96,19 @@ The action alphabet (one BFS edge each):
   :func:`~smi_tpu.parallel.membership.regrow_pod`): scale-in parks a
   member only when it holds zero residents and an empty lane (the
   ``_scale_in_ok`` seam the ``scale_in_with_residents`` mutant
-  breaks); scale-out re-admits it under a fresh incarnation.
+  breaks); scale-out re-admits it under a fresh incarnation;
+- ``partition_start`` / ``partition_failover`` /
+  ``minority_accept t`` / ``partition_heal`` (``partition`` scopes
+  only) — the r17 partition-tolerance arc: a cut isolates one rank
+  (the minority parks the moment its quorum lease lapses), the
+  majority side may fail it over only when its reachable census is a
+  majority quorum (the ``_quorum_ok`` seam the
+  ``actuate_without_quorum`` mutant breaks), the stale side may never
+  accept a new stream while parked (the ``_accept_ok`` seam the
+  ``accept_in_minority`` mutant breaks — its stale claim colliding
+  with the majority's heir is the ``no-split-brain`` conviction), and
+  the heal rejoins a failed-over rank through the straggler rail +
+  the real regrow actuators.
 
 Scope: everything here is **fault-free wire, faulty control plane** —
 the wire tier's own invariants are the PR 7 verifier's job; what is
@@ -195,6 +207,7 @@ class Scope:
     hot_rank: int = -1
     retune: int = 0
     migrate: int = 0
+    partition: int = 0
 
     def __post_init__(self):
         for dim in ("tenants", "ranks", "chunks"):
@@ -253,6 +266,17 @@ class Scope:
             raise ValueError(
                 "migrate=1 needs ranks >= 2 (a migration needs a "
                 "source and a distinct destination)"
+            )
+        if self.partition not in (0, 1):
+            raise ValueError(
+                f"partition must be 0 or 1, got {self.partition} (one "
+                f"partition arc per scope — cut, explore, heal — "
+                f"exhausts its interleavings)"
+            )
+        if self.partition and self.ranks < 2:
+            raise ValueError(
+                "partition=1 needs ranks >= 2 (a partition needs two "
+                "sides)"
             )
 
     def describe(self) -> str:
@@ -338,6 +362,21 @@ DEFAULT_SCOPES: Tuple[Scope, ...] = (
     # reachable mid-arc, the states where a lost handoff would hide)
     Scope(tenants=2, ranks=2, chunks=2, streams=1, pool=2, consume=1,
           migrate=1),
+    # the r17 partition arc, both-sides-minority shape: at n=2 NEITHER
+    # side of a cut can muster a majority quorum, so the honest world
+    # parks every epoch-advancing actuation until the heal — the scope
+    # where actuate_without_quorum is convicted (its lying census
+    # fails over with 1 of the 2 needed reachable)
+    Scope(tenants=2, ranks=2, chunks=2, streams=1, pool=2,
+          partition=1),
+    # the r17 partition arc, majority-failover shape: at n=3 the
+    # reachable side IS a quorum, the cut rank's tenants legitimately
+    # fail over to heirs under a fresh epoch, and the parked minority
+    # must not accept — the scope where accept_in_minority is
+    # convicted (its stale claim collides with the heir: two primaries
+    # for one tenant in one epoch)
+    Scope(tenants=2, ranks=3, chunks=2, streams=1, pool=2, consume=1,
+          partition=1),
 )
 
 
@@ -456,6 +495,24 @@ class World:
             self.migrations_left = 1
             self.mig_aborts_left = 1
             self.scale_ins_left = 1
+        # -- the r17 partition arc (partition scopes): one cut/heal
+        # round trip; the quorum census and the minority's accept
+        # discipline go through mutant seams (_quorum_ok / _accept_ok)
+        self.partitioned: Optional[int] = None
+        self.partitions_left = 0
+        self.partition_epoch = -1
+        self.q_parked: set = set()
+        self.minority_accepts_left = 0
+        #: tenant -> rank claiming primaryship from the stale side —
+        #: the no-split-brain property's evidence
+        self.minority_claims: Dict[int, int] = {}
+        #: (what, reachable, members) censused at every
+        #: epoch-advancing actuation under the arc — the
+        #: fenced-actuation property's evidence
+        self.actuations: List[Tuple[str, int, int]] = []
+        if scope.partition:
+            self.partitions_left = 1
+            self.minority_accepts_left = 1
         self._bootstrap()
 
     # -- mutant seams (defaults == the shipped frontend behaviour) ------
@@ -532,6 +589,24 @@ class World:
             return False
         lane = self.lanes[rank]
         return not (lane.in_flight or lane.landed)
+
+    def _quorum_ok(self) -> bool:
+        """May the control plane fail the partitioned rank over? Only
+        when the side it can still reach is a majority quorum of the
+        current membership — the actuate_without_quorum mutant lies
+        and fails over from a minority census."""
+        from smi_tpu.parallel.membership import quorum_size
+
+        members = self.view.members
+        reachable = set(members) - {self.partitioned}
+        return len(reachable) >= quorum_size(len(members))
+
+    def _accept_ok(self) -> bool:
+        """May the partitioned rank accept a new stream? Never — it
+        parked the moment its quorum lease lapsed. The
+        accept_in_minority mutant lies and keeps accepting on the
+        stale side."""
+        return self.partitioned not in self.q_parked
 
     # -- plumbing -------------------------------------------------------
 
@@ -914,6 +989,82 @@ class World:
         regrow_pod(self.view, self.detector, rank, reason="demand")
         self.parked.discard(rank)
 
+    # -- the partition arc (partition scopes) ---------------------------
+
+    def _partition_victim(self) -> int:
+        """The rank the cut isolates: deterministically, the highest
+        member that is some tenant's base but not the control-plane
+        home (the lowest member) — the shape where the majority's
+        failover and the minority's stale claim can collide. Falls
+        back to the highest member when every base IS the home (the
+        hot-rank scopes). Deterministic in exactly the state the
+        symmetry reduction permutes, so victim choice commutes with
+        rank relabelling."""
+        bases = {self._base_rank(t) for t in range(self.scope.tenants)}
+        home = min(self.view.members)
+        cands = sorted((bases & self.view.members) - {home})
+        return cands[-1] if cands else max(self.view.members)
+
+    def _record_actuation(self, what: str) -> None:
+        """Census one epoch-advancing actuation under the partition
+        arc: how many members the control plane could reach when it
+        pulled the trigger, out of how many there were."""
+        members = len(self.view.members)
+        cut = {self.partitioned} if self.partitioned is not None else set()
+        reachable = len(set(self.view.members) - cut)
+        self.actuations.append((what, reachable, members))
+
+    def _do_partition_start(self) -> None:
+        self.partitions_left -= 1
+        r = self._partition_victim()
+        self.partitioned = r
+        self.partition_epoch = self.view.epoch
+        # the cut rank's quorum lease lapses: the honest minority
+        # parks itself (evidence state — the _accept_ok seam decides
+        # whether the park is respected)
+        self.q_parked.add(r)
+
+    def _do_partition_failover(self) -> None:
+        """The majority side confirms the unreachable rank dead and
+        fails it over — gated (via enabledness) on the _quorum_ok
+        census. The detector is told to forget the rank first: its
+        silence was the partition's, not a death's, and the failover
+        decision here is the quorum census's, not phi's."""
+        r = self.partitioned
+        self._record_actuation("partition-failover")
+        self.detector.forget(r)
+        self._failover(r)
+
+    def _do_minority_accept(self, tenant: int) -> None:
+        """The stale side accepts a new stream for a tenant it still
+        believes it owns — only a lying _accept_ok enables this; the
+        claim is the no-split-brain property's witness."""
+        self.minority_accepts_left -= 1
+        self.minority_claims[tenant] = self.partitioned
+
+    def _do_partition_heal(self) -> None:
+        """The cut heals. A rank that was failed over during the cut
+        presents its stale epoch once (the straggler rail), then
+        rejoins through the real actuators under a fresh incarnation;
+        a rank that was merely parked just unparks. Either way the
+        stale side's claims die with the park."""
+        r = self.partitioned
+        self.partitioned = None  # the cut is gone before any actuation
+        self.q_parked.discard(r)
+        self.minority_claims.clear()
+        if r not in self.view.members:
+            try:
+                self.view.validate(r, self.partition_epoch,
+                                   what="parked-rank straggler")
+                self.stale_leaks += 1
+            except StaleEpochError:
+                self.stale_rejections += 1
+            self._record_actuation("heal-rejoin")
+            self.view.regrow(r)
+            plan_regrow_ring(self.view)
+            self.detector.forget(r)
+        self.partition_epoch = -1
+
     def apply(self, action: Tuple) -> None:
         kind = action[0]
         if kind == "tick":
@@ -954,6 +1105,14 @@ class World:
             self._do_scale_in()
         elif kind == "scale_out":
             self._do_scale_out()
+        elif kind == "partition_start":
+            self._do_partition_start()
+        elif kind == "partition_failover":
+            self._do_partition_failover()
+        elif kind == "minority_accept":
+            self._do_minority_accept(action[1])
+        elif kind == "partition_heal":
+            self._do_partition_heal()
         else:
             raise ValueError(f"unknown model action {action!r}")
         self._epoch_watermark = max(self._epoch_watermark,
@@ -1075,6 +1234,23 @@ class World:
                     out.append(("scale_in",))
             if self.parked:
                 out.append(("scale_out",))
+        if self.scope.partition:
+            if (self.partitioned is None and self.partitions_left > 0
+                    and len(self.view.members) >= 2):
+                out.append(("partition_start",))
+            elif self.partitioned is not None:
+                r = self.partitioned
+                # enabledness goes through the mutant seams: the clean
+                # quorum census blocks the failover when the reachable
+                # side is a minority, and the clean park blocks every
+                # stale-side accept
+                if r in self.view.members and self._quorum_ok():
+                    out.append(("partition_failover",))
+                if self.minority_accepts_left > 0 and self._accept_ok():
+                    for t in range(self.scope.tenants):
+                        if self._base_rank(t) == r:
+                            out.append(("minority_accept", t))
+                out.append(("partition_heal",))
         return out
 
     # -- canonical fingerprint (relative time + symmetry orbits) --------
@@ -1224,6 +1400,18 @@ class World:
                 self.scale_ins_left, self.mig_lost,
                 tuple(sorted(rho[r] for r in self.parked)),
             ),)
+        if self.scope.partition:
+            base += ((
+                rho[self.partitioned] if self.partitioned is not None
+                else -1,
+                self.partitions_left, self.minority_accepts_left,
+                (epoch - self.partition_epoch
+                 if self.partitioned is not None else -1),
+                tuple(sorted(rho[r] for r in self.q_parked)),
+                tuple(sorted((tau[t], rho[r])
+                             for t, r in self.minority_claims.items())),
+                tuple(self.actuations),
+            ),)
         return base
 
     def fingerprint(self) -> tuple:
@@ -1283,9 +1471,22 @@ class World:
                 "scale_ins_left": self.scale_ins_left,
                 "parked": sorted(self.parked),
             }}
+        partition = {}
+        if self.scope.partition:
+            partition = {"partition": {
+                "partitioned": self.partitioned,
+                "partitions_left": self.partitions_left,
+                "parked": sorted(self.q_parked),
+                "minority_claims": {
+                    f"t{t}": r
+                    for t, r in sorted(self.minority_claims.items())
+                },
+                "actuations": [list(a) for a in self.actuations],
+            }}
         return {
             **retune,
             **migrate,
+            **partition,
             "scope": self.scope.to_json(),
             "epoch": self.view.epoch,
             "members": sorted(self.view.members),

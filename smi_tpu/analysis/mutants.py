@@ -305,6 +305,43 @@ def _scale_in_with_residents_world():
     return _ScaleInWithResidents
 
 
+def _actuate_without_quorum_world():
+    """``actuate_without_quorum``: the failover driver's quorum census
+    lies — the partitioned rank is failed over even when the side the
+    control plane can reach is a minority (the both-sides-minority
+    n=2 cut, where the honest world parks every actuator until the
+    heal). Only reachable on ``partition`` scopes; benign elsewhere,
+    and benign on partition scopes whose reachable side genuinely IS
+    a quorum (the lie then agrees with the truth). Conviction:
+    ``fenced-actuation`` — the actuation log records a trigger pulled
+    with fewer reachable members than ``quorum_size(members)``."""
+    World = _model_world_base()
+
+    class _ActuateWithoutQuorum(World):
+        def _quorum_ok(self):
+            return True  # ...majority reachable or not (the defect)
+
+    return _ActuateWithoutQuorum
+
+
+def _accept_in_minority_world():
+    """``accept_in_minority``: the cut rank ignores its lapsed quorum
+    lease and keeps accepting new streams on the stale side. Only
+    reachable on ``partition`` scopes; benign on the n=2 cut (no
+    quorate majority exists to fail the rank over, so the stale claim
+    never collides with an heir). Conviction: ``no-split-brain`` — on
+    the n=3 scope the majority legitimately fails the cut rank over,
+    and the stale claim plus the heir are two primaries for one
+    tenant in one epoch."""
+    World = _model_world_base()
+
+    class _AcceptInMinority(World):
+        def _accept_ok(self):
+            return True  # ...parked or not (the defect)
+
+    return _AcceptInMinority
+
+
 #: Control-plane mutant registry: name -> World factory.
 _MODEL_MUTANT_FACTORIES = {
     "leaked_stream_credit": _leaked_stream_credit_world,
@@ -315,6 +352,8 @@ _MODEL_MUTANT_FACTORIES = {
     "rollback_discards_entry": _rollback_discards_entry_world,
     "cutover_without_handoff": _cutover_without_handoff_world,
     "scale_in_with_residents": _scale_in_with_residents_world,
+    "actuate_without_quorum": _actuate_without_quorum_world,
+    "accept_in_minority": _accept_in_minority_world,
 }
 
 #: The shipped control-plane mutants, in acceptance-matrix order.
@@ -331,6 +370,8 @@ MODEL_MUTANT_PROPERTY = {
     "rollback_discards_entry": "swap-lost-accepted",
     "cutover_without_handoff": "migration-lost-accepted",
     "scale_in_with_residents": "placement-epoch-safety",
+    "actuate_without_quorum": "fenced-actuation",
+    "accept_in_minority": "no-split-brain",
 }
 
 

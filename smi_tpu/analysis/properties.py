@@ -1,4 +1,4 @@
-"""The five model-checked control-plane properties.
+"""The model-checked control-plane properties.
 
 Each check is a pure predicate over a :class:`~smi_tpu.analysis.model.World`
 state — it reads the REAL objects (the gate's occupancy, the lanes'
@@ -62,6 +62,18 @@ sampled assertions into exhaustively-checked invariants:
   current member (a scale-in with residents would park the rank their
   frames route to, unreachable under the new epoch) — the
   ``scale_in_with_residents`` mutant's conviction.
+- **no-split-brain** (``partition`` scopes) — never two primaries for
+  one tenant in one epoch: while a cut is in flight, the isolated
+  side's stale claim to a tenant must never coexist with a different
+  rank currently owning that tenant's route — the
+  ``accept_in_minority`` mutant's conviction (its stale-side accept
+  collides with the majority's post-failover heir).
+- **fenced-actuation** (``partition`` scopes) — no epoch-advancing
+  actuator fires without a majority quorum: every actuation recorded
+  under the partition arc must have censused at least
+  ``quorum_size(members)`` reachable members when it pulled the
+  trigger — the ``actuate_without_quorum`` mutant's conviction (it
+  fails a rank over from a minority census).
 """
 
 from __future__ import annotations
@@ -79,7 +91,8 @@ from smi_tpu.serving.scheduler import WIRE_CREDITS
 PROPERTIES = ("queue-bound", "stream-credit", "starvation",
               "epoch-safety", "lost-accepted",
               "plan-epoch-safety", "swap-lost-accepted",
-              "migration-lost-accepted", "placement-epoch-safety")
+              "migration-lost-accepted", "placement-epoch-safety",
+              "no-split-brain", "fenced-actuation")
 
 Violation = Tuple[str, str]
 
@@ -328,6 +341,57 @@ def check_placement_epoch_safety(world) -> List[Violation]:
     return []
 
 
+def check_no_split_brain(world) -> List[Violation]:
+    """The r17 partition arc: never two primaries for one tenant in
+    one epoch. The isolated side's stale claim (a ``minority_accept``
+    only a lying ``_accept_ok`` enables) must never coexist with a
+    DIFFERENT rank currently owning the tenant's route — once the
+    majority fails the cut rank over, the heir and the stale claimant
+    would both be accepting the same tenant's streams. Vacuous on
+    non-``partition`` scopes (the claims map only moves inside the
+    partition arc)."""
+    scope = getattr(world, "scope", None)
+    if scope is None or not getattr(scope, "partition", 0):
+        return []
+    for tenant, claimed in sorted(world.minority_claims.items()):
+        owner = world._route(tenant)
+        if owner != claimed:
+            return [(
+                "no-split-brain",
+                f"tenant t{tenant} has two primaries in epoch "
+                f"{world.view.epoch}: rank {claimed} (the partitioned "
+                f"side's stale claim) and rank {owner} (the current "
+                f"route owner) — the minority accepted a new stream "
+                f"while cut off, so both sides are serving the same "
+                f"tenant",
+            )]
+    return []
+
+
+def check_fenced_actuation(world) -> List[Violation]:
+    """The r17 partition arc: no epoch-advancing actuator fires
+    without a majority quorum. Every actuation censused under the arc
+    must have reached at least ``quorum_size(members)`` members when
+    it pulled the trigger. Vacuous on non-``partition`` scopes (the
+    actuation log only moves inside the partition arc)."""
+    scope = getattr(world, "scope", None)
+    if scope is None or not getattr(scope, "partition", 0):
+        return []
+    from smi_tpu.parallel.membership import quorum_size
+
+    for what, reachable, members in world.actuations:
+        needed = quorum_size(members)
+        if reachable < needed:
+            return [(
+                "fenced-actuation",
+                f"actuation {what!r} fired with only {reachable} of "
+                f"{members} member(s) reachable — a majority quorum "
+                f"needs {needed}, so a minority-side census mutated "
+                f"membership state it had no mandate over",
+            )]
+    return []
+
+
 def check_state(world) -> List[Violation]:
     """All per-state invariants, in property order."""
     out: List[Violation] = []
@@ -340,6 +404,8 @@ def check_state(world) -> List[Violation]:
     out.extend(check_swap_lost_accepted(world))
     out.extend(check_migration_lost_accepted(world))
     out.extend(check_placement_epoch_safety(world))
+    out.extend(check_no_split_brain(world))
+    out.extend(check_fenced_actuation(world))
     return out
 
 

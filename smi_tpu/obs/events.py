@@ -130,6 +130,7 @@ EVENT_KINDS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "ctl.recover": ("control", ("protocol", "reason")),
     "ctl.scale": ("control", ("epoch", "direction")),
     "ctl.migrate": ("control", ("src", "dst", "state")),
+    "ctl.quorum": ("control", ("epoch", "quorum", "verdict")),
     # -- tuning plane (the online retuner's lifecycle) ------------------
     "tune.sample": ("tuning", ("op", "bucket")),
     "tune.propose": ("tuning", ("op", "bucket", "from_algo",

@@ -1507,7 +1507,12 @@ class RingSimulator:
     - ``stall_after(rank) -> Optional[int]`` — crash-stop ``rank``
       after that many executed actions (None = healthy);
     - ``link_down(a, b) -> bool`` — all traffic between global ranks
-      ``a`` and ``b`` (signals and DMAs, both directions) is lost;
+      ``a`` and ``b`` (signals and DMAs, both directions) is lost; a
+      plan may instead provide ``link_blocked(src, dst, tick)``
+      (preferred when present) — tick-aware and DIRECTIONAL, which is
+      how windowed partitions, asymmetric cuts (A hears B while B
+      stops hearing A), and seeded flapping links are expressed;
+      the tick is the scheduler's ``sim_tick`` logical clock;
     - ``tamper(src, nth, payload) -> payload`` (optional) — damage the
       ``nth`` DMA payload started by ``src`` in flight (bit flip,
       truncation, sequence swap). The simulator applies it blindly;
@@ -1610,7 +1615,16 @@ class RingSimulator:
         return after is not None and self.actions_done[r] >= after
 
     def _link_down(self, a: int, b: int) -> bool:
-        return self.faults is not None and self.faults.link_down(a, b)
+        if self.faults is None:
+            return False
+        # tick-aware directional hook preferred when the plan has one
+        # (windowed partitions / asymmetric cuts / flapping links heal
+        # mid-run, so the answer depends on WHEN and WHICH WAY); plans
+        # without it keep the static symmetric semantics bit-for-bit
+        blocked = getattr(self.faults, "link_blocked", None)
+        if blocked is not None:
+            return blocked(a, b, self.sim_tick)
+        return self.faults.link_down(a, b)
 
     # -- flight-recorder hooks (no-ops without a recorder) --
     @staticmethod

@@ -133,6 +133,23 @@ SERVING_FAULT_CLASSES = ("slow_consumer",)
 #: an ICI tamper is).
 DCN_FAULT_CLASSES = ("dcn_link_down", "dcn_delay")
 
+#: Partition fault classes, deliberately NOT in :data:`FAULT_CLASSES`
+#: (same seed-pinning rule as every post-seed registry). Unlike every
+#: class above, these are *windowed and directional*: a link is cut
+#: for a tick interval and then HEALS, possibly in one direction only
+#: (A hears B while B stops hearing A — the asymmetric regime that
+#: makes heartbeat evidence diverge between the two sides), or flaps
+#: on a seeded duty cycle. Both sides stay alive throughout, which is
+#: exactly what crash-stop faults (:class:`StalledRank`,
+#: :class:`DownLink`) can never model — each side can declare the
+#: other dead and keep actuating, the split-brain hazard quorum
+#: fencing (:mod:`smi_tpu.parallel.membership`) exists to close.
+#: Consulted by the simulator through the tick-aware
+#: ``link_blocked(src, dst, tick)`` hook; ``smi-tpu chaos
+#: --partition`` sweeps them.
+PARTITION_FAULT_CLASSES = ("partition", "asymmetric_link",
+                           "flapping_link")
+
 #: Named invariant violations that count as *detection*. A bare
 #: ProtocolError (wrong delivery) is NOT in this set — that is silent
 #: corruption and fails the matrix.
@@ -345,6 +362,136 @@ class StalledHeartbeat:
     silent_for: int = 20
 
 
+@dataclasses.dataclass(frozen=True)
+class PartitionFault:
+    """Cut every wire between the ``minority`` rank set and the rest
+    of the ring, BOTH directions, for ticks ``[from_tick, until_tick)``
+    — then heal.
+
+    The clean network partition: both sides stay alive and keep
+    heartbeating *within* their side, but no signal, DMA, or heartbeat
+    crosses the cut while the window is open. Each side's phi-accrual
+    evidence therefore says the other side died — without quorum
+    fencing, each side shrinks the other and keeps actuating, and on
+    heal the two histories collide silently. The windowed analog of
+    :class:`DownLink` (which never heals) at rank-set granularity.
+    """
+
+    minority: FrozenSet[int]
+    from_tick: int = 40
+    until_tick: int = 120
+
+    def __post_init__(self):
+        if not self.minority:
+            raise ValueError("PartitionFault needs a non-empty minority "
+                             "rank set (an empty cut partitions nothing)")
+        if self.until_tick <= self.from_tick:
+            raise ValueError(
+                f"PartitionFault window is empty: from_tick="
+                f"{self.from_tick}, until_tick={self.until_tick} "
+                f"(must heal strictly after it cuts)"
+            )
+
+    def blocks(self, src: int, dst: int, tick: int) -> bool:
+        if not (self.from_tick <= tick < self.until_tick):
+            return False
+        return (src in self.minority) != (dst in self.minority)
+
+
+@dataclasses.dataclass(frozen=True)
+class AsymmetricLinkFault:
+    """Traffic FROM ``src`` TO ``dst`` is lost for ticks
+    ``[from_tick, until_tick)``; the ``dst``->``src`` direction keeps
+    flowing — then heal.
+
+    The asymmetric partition: ``src`` still hears ``dst`` (so from
+    ``src``'s side the world looks healthy) while ``dst`` stops
+    hearing ``src`` (so ``dst``'s detector watches ``src``'s phi climb
+    toward dead). Heartbeat evidence DIVERGES between the two sides —
+    the regime where one side confirms a death the other side never
+    suspected, which symmetric cuts cannot produce.
+    """
+
+    src: int
+    dst: int
+    from_tick: int = 40
+    until_tick: int = 120
+
+    def __post_init__(self):
+        if self.src == self.dst:
+            raise ValueError(
+                f"an asymmetric link connects two DISTINCT ranks, got "
+                f"{self.src} twice"
+            )
+        if self.until_tick <= self.from_tick:
+            raise ValueError(
+                f"AsymmetricLinkFault window is empty: from_tick="
+                f"{self.from_tick}, until_tick={self.until_tick}"
+            )
+
+    def blocks(self, src: int, dst: int, tick: int) -> bool:
+        if not (self.from_tick <= tick < self.until_tick):
+            return False
+        return src == self.src and dst == self.dst
+
+
+@dataclasses.dataclass(frozen=True)
+class FlappingLink:
+    """The ``a``<->``b`` wire flaps on a seeded duty cycle: within
+    each ``period``-tick window of ``[from_tick, until_tick)`` the
+    link is down (both directions) for ``down_ticks`` consecutive
+    ticks at a seeded offset, up otherwise.
+
+    The fault two-threshold detection exists for, exercised at the
+    *link* rather than the rank: beats are lost in bursts but always
+    resume within the confirmation grace, so the detector must ride
+    suspect/clear cycles WITHOUT ever confirming a death — a
+    membership transition (or a park/rejoin oscillation) on a merely
+    flapping wire is the failure mode the hysteresis gate counts.
+    Deterministic per ``(a, b, seed)``: the same fault always flaps
+    the same ticks.
+    """
+
+    a: int
+    b: int
+    from_tick: int = 40
+    until_tick: int = 160
+    period: int = 8
+    down_ticks: int = 3
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.a == self.b:
+            raise ValueError(
+                f"a flapping link connects two DISTINCT ranks, got "
+                f"{self.a} twice"
+            )
+        if self.until_tick <= self.from_tick:
+            raise ValueError(
+                f"FlappingLink window is empty: from_tick="
+                f"{self.from_tick}, until_tick={self.until_tick}"
+            )
+        if self.period < 1:
+            raise ValueError(f"period must be >= 1, got {self.period}")
+        if not (1 <= self.down_ticks <= self.period):
+            raise ValueError(
+                f"down_ticks must be in 1..period={self.period}, got "
+                f"{self.down_ticks} (a full-period outage is a "
+                f"PartitionFault, not a flap)"
+            )
+
+    def blocks(self, src: int, dst: int, tick: int) -> bool:
+        if {src, dst} != {self.a, self.b}:
+            return False
+        if not (self.from_tick <= tick < self.until_tick):
+            return False
+        window, offset = divmod(tick - self.from_tick, self.period)
+        start = random.Random(
+            f"flap:{self.a}:{self.b}:{self.seed}:{window}"
+        ).randrange(self.period - self.down_ticks + 1)
+        return start <= offset < start + self.down_ticks
+
+
 def _corrupt_value(inner, truncate: bool):
     """Type-preserving in-flight damage: on hardware a flipped or
     truncated buffer still has the buffer's type — the reduction
@@ -401,8 +548,8 @@ class FaultPlan:
 
     Implements the hook interface :class:`credits.RingSimulator`
     consults (``grant_multiplier`` / ``dma_hold`` / ``stall_after`` /
-    ``link_down``). An empty plan is behaviourally identical to
-    ``faults=None`` — the healthy fuzzer.
+    ``link_down`` / the tick-aware ``link_blocked``). An empty plan is
+    behaviourally identical to ``faults=None`` — the healthy fuzzer.
     """
 
     dropped_grants: Tuple[DroppedGrant, ...] = ()
@@ -424,6 +571,13 @@ class FaultPlan:
     #: holds) — consulted through the same hooks, slice-resolved.
     dcn_link_downs: Tuple[DcnLinkDown, ...] = ()
     dcn_delays: Tuple[DcnDelay, ...] = ()
+    #: Partition-tier faults: windowed, possibly one-directional,
+    #: possibly flapping link cuts that HEAL — consulted through the
+    #: tick-aware ``link_blocked(src, dst, tick)`` hook (the simulator
+    #: prefers it over plain ``link_down`` when present).
+    partitions: Tuple[PartitionFault, ...] = ()
+    asymmetric_links: Tuple[AsymmetricLinkFault, ...] = ()
+    flapping_links: Tuple[FlappingLink, ...] = ()
 
     # -- hook interface (credits.RingSimulator) ------------------------
     def grant_multiplier(self, rank: int, nth: int) -> int:
@@ -466,6 +620,30 @@ class FaultPlan:
             return True
         return any(f.severs(a, b) for f in self.dcn_link_downs)
 
+    def link_blocked(self, src: int, dst: int, tick: int) -> bool:
+        """Tick-aware, DIRECTIONAL link state — the hook the simulator
+        prefers over :meth:`link_down` when present. Subsumes the
+        static cuts (a permanently-down link is blocked at every tick)
+        and adds the windowed partition classes: a symmetric cut
+        blocks both directions across the minority boundary inside its
+        window, an asymmetric cut blocks exactly its ``src``->``dst``
+        direction, a flapping link blocks its seeded down-ticks.
+        Healing is the whole point: past ``until_tick`` the wire
+        carries traffic again and the two sides must reconcile.
+        """
+        if self.link_down(src, dst):
+            return True
+        for f in self.partitions:
+            if f.blocks(src, dst, tick):
+                return True
+        for f in self.asymmetric_links:
+            if f.blocks(src, dst, tick):
+                return True
+        for f in self.flapping_links:
+            if f.blocks(src, dst, tick):
+                return True
+        return False
+
     def tamper(self, src: int, nth: int, payload):
         """Damage the ``nth`` DMA payload of ``src`` in flight.
 
@@ -504,6 +682,8 @@ class FaultPlan:
             or self.flapping_ranks or self.stalled_heartbeats
             or self.slow_consumers
             or self.dcn_link_downs or self.dcn_delays
+            or self.partitions or self.asymmetric_links
+            or self.flapping_links
         )
 
     def faults(self) -> Tuple:
@@ -517,6 +697,8 @@ class FaultPlan:
             + self.flapping_ranks + self.stalled_heartbeats
             + self.slow_consumers
             + self.dcn_link_downs + self.dcn_delays
+            + self.partitions + self.asymmetric_links
+            + self.flapping_links
         )
 
     def describe(self) -> List[str]:
@@ -556,6 +738,12 @@ class FaultPlan:
             return cls(dcn_link_downs=(fault,))
         if isinstance(fault, DcnDelay):
             return cls(dcn_delays=(fault,))
+        if isinstance(fault, PartitionFault):
+            return cls(partitions=(fault,))
+        if isinstance(fault, AsymmetricLinkFault):
+            return cls(asymmetric_links=(fault,))
+        if isinstance(fault, FlappingLink):
+            return cls(flapping_links=(fault,))
         raise TypeError(f"unknown fault {fault!r}")
 
     @classmethod
@@ -584,6 +772,11 @@ class FaultPlan:
                 dcn_link_downs=(plan.dcn_link_downs
                                 + single.dcn_link_downs),
                 dcn_delays=plan.dcn_delays + single.dcn_delays,
+                partitions=plan.partitions + single.partitions,
+                asymmetric_links=(plan.asymmetric_links
+                                  + single.asymmetric_links),
+                flapping_links=(plan.flapping_links
+                                + single.flapping_links),
             )
         return plan
 
@@ -658,9 +851,35 @@ class FaultPlan:
                 rank, nth=rng.randrange(3), hold=rng.randrange(8, 120),
                 per_slice=per_slice,
             ))
+        if fault_class in PARTITION_FAULT_CLASSES:
+            if n < 2:
+                raise ValueError(
+                    f"partition fault draws need n >= 2 (a one-rank "
+                    f"ring has no wire to cut), got n={n}"
+                )
+            start = 40 + rng.randrange(20)
+            if fault_class == "partition":
+                # a strict minority: never more than (n-1)//2 ranks on
+                # the cut side, so the other side always keeps quorum
+                size = 1 + rng.randrange(max(1, (n - 1) // 2))
+                ranks = rng.sample(range(n), size)
+                return cls.single(PartitionFault(
+                    frozenset(ranks), from_tick=start,
+                    until_tick=start + 60 + rng.randrange(40),
+                ))
+            if fault_class == "asymmetric_link":
+                return cls.single(AsymmetricLinkFault(
+                    rank, (rank + 1) % n, from_tick=start,
+                    until_tick=start + 60 + rng.randrange(40),
+                ))
+            return cls.single(FlappingLink(
+                rank, (rank + 1) % n, from_tick=start,
+                until_tick=start + 80 + rng.randrange(40),
+                period=8, down_ticks=2 + rng.randrange(2), seed=seed,
+            ))
         raise ValueError(
             f"unknown fault class {fault_class!r}; "
-            f"known: {FAULT_CLASSES + ELASTIC_FAULT_CLASSES + SERVING_FAULT_CLASSES + DCN_FAULT_CLASSES}"
+            f"known: {FAULT_CLASSES + ELASTIC_FAULT_CLASSES + SERVING_FAULT_CLASSES + DCN_FAULT_CLASSES + PARTITION_FAULT_CLASSES}"
         )
 
 

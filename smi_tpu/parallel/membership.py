@@ -45,8 +45,9 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import random
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 from smi_tpu.parallel import faults as F
 
@@ -119,6 +120,206 @@ class StaleEpochError(RuntimeError):
         self.rank = rank
         self.stale = stale
         self.current = current
+
+
+#: Environment knob for the quorum fraction (the ``default_deadline``
+#: discipline: explicit argument outranks the environment, the
+#: environment outranks the built-in, malformed values raise loudly).
+#: A fraction ``f`` means an actuation needs strictly MORE than ``f``
+#: of the members reachable — ``floor(f*n) + 1`` ranks — so the
+#: built-in 0.5 is the strict majority and no two disjoint quorums can
+#: ever coexist (any valid f >= 0.5 keeps that intersection property,
+#: which is the whole point: two sides of a partition can never both
+#: fence an actuation in the same epoch).
+QUORUM_FRACTION_ENV = "SMI_TPU_QUORUM_FRACTION"
+
+#: Built-in quorum fraction: strict majority.
+DEFAULT_QUORUM_FRACTION = 0.5
+
+
+def quorum_fraction(explicit: Optional[float] = None) -> float:
+    """Resolve the quorum fraction: explicit argument over
+    ``$SMI_TPU_QUORUM_FRACTION`` over the built-in strict majority.
+    Malformed or out-of-range values raise ``ValueError`` loudly —
+    a silently-defaulted quorum is a silently-broken safety rail."""
+    raw: object = explicit
+    source = "quorum fraction"
+    if raw is None:
+        env = os.environ.get(QUORUM_FRACTION_ENV, "").strip()
+        if not env:
+            return DEFAULT_QUORUM_FRACTION
+        raw = env
+        source = f"${QUORUM_FRACTION_ENV}"
+    try:
+        value = float(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{source} must be a number in [0.5, 1.0), got {raw!r}"
+        ) from None
+    if not math.isfinite(value):
+        raise ValueError(
+            f"{source} must be finite, got {value!r}"
+        )
+    if not 0.5 <= value < 1.0:
+        raise ValueError(
+            f"{source} must be in [0.5, 1.0) (below 0.5 two disjoint "
+            f"quorums could coexist — the split-brain the fence "
+            f"exists to prevent; 1.0 would need n+1 of n ranks), "
+            f"got {value!r}"
+        )
+    return value
+
+
+def quorum_size(n: int, fraction: Optional[float] = None) -> int:
+    """Ranks needed to fence an actuation over an ``n``-member view:
+    strictly more than the resolved fraction of the members."""
+    if n < 1:
+        raise ValueError(f"quorum over an empty view is meaningless, n={n}")
+    return int(math.floor(quorum_fraction(fraction) * n)) + 1
+
+
+class QuorumLostError(RuntimeError):
+    """An actuation was attempted from a side of the view that cannot
+    reach a quorum of the members — the minority side of a partition.
+
+    Raised loudly at the fencing point, never deferred: the minority
+    must PARK (stop accepting new streams, stop mutating shared state)
+    and rejoin via the :class:`StaleEpochError` straggler rail once
+    the partition heals. Carries the acting ``rank`` (or -1 for the
+    control plane itself), the ``reachable`` member set the actor
+    could muster, and the ``needed`` quorum size.
+    """
+
+    def __init__(self, rank: int, reachable, needed: int,
+                 what: str = "actuation"):
+        reachable = frozenset(reachable)
+        super().__init__(
+            f"quorum lost for {what}: rank {rank} reaches only "
+            f"{sorted(reachable)} ({len(reachable)} of the {needed} "
+            f"needed) — minority side of a partition must park, not "
+            f"actuate"
+        )
+        self.rank = rank
+        self.reachable = reachable
+        self.needed = needed
+
+
+@dataclasses.dataclass(frozen=True)
+class FencingToken:
+    """Proof-of-quorum an actuator must present before mutating shared
+    state (epoch bumps, scale in/out, migration cutover, placement
+    writes).
+
+    Minted by :func:`mint_fencing_token` only when the minter reaches
+    a quorum of the current members, and pinned to the epoch it was
+    minted under: a token outlives its epoch the moment membership
+    moves, so a partitioned minority holding a stale token is rejected
+    on the SAME :class:`StaleEpochError` rail a superseded incarnation
+    is — fencing is epoch discipline, not a second mechanism.
+    """
+
+    epoch: int
+    quorum_set: FrozenSet[int]
+
+
+@dataclasses.dataclass(frozen=True)
+class QuorumDecision:
+    """Structured record of one fencing decision — the ``ctl.quorum``
+    event's payload (epoch, quorum set, verdict), so Perfetto traces
+    and the flight recorder show WHY an actuation was allowed or
+    refused next to the blame verdicts that motivated it."""
+
+    epoch: int
+    quorum: Tuple[int, ...]
+    verdict: str  # "minted" | "granted" | "denied" | "stale"
+
+    def as_fields(self) -> Dict[str, object]:
+        return {
+            "epoch": self.epoch,
+            "quorum": ",".join(str(r) for r in self.quorum),
+            "verdict": self.verdict,
+        }
+
+
+def _observe_quorum(view: "MembershipView", decision: QuorumDecision,
+                    rank: int) -> None:
+    recorder = getattr(view, "_recorder", None)
+    if recorder is not None:
+        recorder.emit("ctl.quorum", view.epoch, rank=rank,
+                      **decision.as_fields())
+
+
+def mint_fencing_token(view: "MembershipView",
+                       reachable: Optional[Sequence[int]] = None,
+                       fraction: Optional[float] = None,
+                       rank: int = -1,
+                       what: str = "actuation") -> FencingToken:
+    """Mint a :class:`FencingToken` for the current epoch, or raise
+    :class:`QuorumLostError` if ``reachable`` (the members the minter
+    can currently hear; default: all of them — the healthy fast path)
+    falls short of the quorum. Every decision — grant or denial — is
+    observed as a ``ctl.quorum`` event when the view has a recorder.
+    """
+    members = frozenset(view.members)
+    if reachable is None:
+        quorum = members
+    else:
+        quorum = frozenset(reachable) & members
+    needed = quorum_size(len(members), fraction)
+    if len(quorum) < needed:
+        _observe_quorum(
+            view, QuorumDecision(view.epoch, tuple(sorted(quorum)),
+                                 "denied"), rank,
+        )
+        raise QuorumLostError(rank, quorum, needed, what=what)
+    token = FencingToken(epoch=view.epoch, quorum_set=quorum)
+    _observe_quorum(
+        view, QuorumDecision(view.epoch, tuple(sorted(quorum)),
+                             "minted"), rank,
+    )
+    return token
+
+
+def check_fencing_token(view: "MembershipView",
+                        token: Optional[FencingToken],
+                        rank: int = -1,
+                        fraction: Optional[float] = None,
+                        what: str = "actuation") -> FencingToken:
+    """Validate (or mint, when ``token`` is None — the backward-
+    compatible healthy path, trivially quorate over the full member
+    set) the fencing token guarding an actuation.
+
+    A token from an older epoch is a straggler from before a
+    membership change and is rejected as :class:`StaleEpochError` —
+    the same rail, deliberately. A current-epoch token whose quorum
+    set no longer covers a quorum of the members (possible only if
+    the caller forged or filtered it) raises
+    :class:`QuorumLostError`. Returns the validated token."""
+    if token is None:
+        return mint_fencing_token(view, fraction=fraction, rank=rank,
+                                  what=what)
+    if token.epoch != view.epoch:
+        _observe_quorum(
+            view, QuorumDecision(token.epoch,
+                                 tuple(sorted(token.quorum_set)),
+                                 "stale"), rank,
+        )
+        raise StaleEpochError(rank, token.epoch, view.epoch,
+                              what=f"fencing token for {what}")
+    members = frozenset(view.members)
+    quorum = frozenset(token.quorum_set) & members
+    needed = quorum_size(len(members), fraction)
+    if len(quorum) < needed:
+        _observe_quorum(
+            view, QuorumDecision(token.epoch, tuple(sorted(quorum)),
+                                 "denied"), rank,
+        )
+        raise QuorumLostError(rank, quorum, needed, what=what)
+    _observe_quorum(
+        view, QuorumDecision(token.epoch, tuple(sorted(quorum)),
+                             "granted"), rank,
+    )
+    return token
 
 
 @dataclasses.dataclass(frozen=True)
@@ -405,15 +606,22 @@ class MembershipView:
         return self.epoch
 
     def migrate_cutover(self, src: int, dst: int,
-                        tenant: str = "") -> int:
+                        tenant: str = "",
+                        token: Optional[FencingToken] = None) -> int:
         """Bump the epoch for a live-migration lane switch.
 
         Membership does not change — both ranks stay members — but the
         epoch must move so stragglers still addressed to the source
         lane are rejected as :class:`StaleEpochError` instead of being
         folded into the destination silently (the same rail a failover
-        uses, chosen on purpose). Returns the new epoch.
+        uses, chosen on purpose). The cutover is a fenced actuation:
+        ``token`` (minted trivially from the full member set when
+        None) must prove quorum under the CURRENT epoch or the switch
+        refuses — a partitioned minority can never cut a migration
+        over both ways. Returns the new epoch.
         """
+        check_fencing_token(self, token, rank=dst,
+                            what=f"migration cutover {src}->{dst}")
         for r, role in ((src, "source"), (dst, "destination")):
             if r not in self.members:
                 raise ValueError(
@@ -506,7 +714,8 @@ def plan_regrow_ring(view: MembershipView,
 
 
 def shrink_pod(view: MembershipView, detector, rank: int,
-               reason: str = "demand") -> int:
+               reason: str = "demand",
+               token: Optional[FencingToken] = None) -> int:
     """Capacity scale-in actuator: park ``rank`` out of the serving
     pod. The step-clock analog of ``Communicator.shrink_pod``, driven
     by *demand* instead of death: the epoch bumps (``scale-in``
@@ -514,7 +723,12 @@ def shrink_pod(view: MembershipView, detector, rank: int,
     validated routable (:func:`plan_regrow_ring` — a scale-in that
     would strand a member raises instead of landing), and the phi
     detector forgets the rank so a deliberately-parked rank can never
-    accrue suspicion while silent. Returns the new epoch."""
+    accrue suspicion while silent. A fenced actuation: ``token``
+    (minted trivially from the full member set when None) must prove
+    quorum under the current epoch (:func:`check_fencing_token`) or
+    the scale-in refuses loudly. Returns the new epoch."""
+    check_fencing_token(view, token, rank=rank,
+                        what=f"scale-in of rank {rank}")
     epoch = view.scale_in(rank, reason=reason)
     plan_regrow_ring(view)
     if detector is not None:
@@ -523,13 +737,17 @@ def shrink_pod(view: MembershipView, detector, rank: int,
 
 
 def regrow_pod(view: MembershipView, detector, rank: int,
-               reason: str = "demand") -> int:
+               reason: str = "demand",
+               token: Optional[FencingToken] = None) -> int:
     """Capacity scale-out actuator: re-admit a parked rank (the
     inverse of :func:`shrink_pod`). Epoch bumps under a ``scale-out``
     transition, the grown ring is validated routable, and the detector
     forgets the rank so the fresh incarnation bootstraps its heartbeat
     history clean (the :meth:`MembershipView.regrow` discipline).
-    Returns the new epoch."""
+    Fenced exactly like :func:`shrink_pod`: no quorum token, no
+    capacity change. Returns the new epoch."""
+    check_fencing_token(view, token, rank=rank,
+                        what=f"scale-out of rank {rank}")
     epoch = view.scale_out(rank, reason=reason)
     plan_regrow_ring(view)
     if detector is not None:

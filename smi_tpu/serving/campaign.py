@@ -37,7 +37,7 @@ import random
 from typing import Dict, List, Optional, Tuple
 
 from smi_tpu.parallel import faults as F
-from smi_tpu.parallel.membership import WATCHDOG_TICKS
+from smi_tpu.parallel.membership import WATCHDOG_TICKS, QuorumLostError
 from smi_tpu.serving.admission import DEFAULT_POOL
 from smi_tpu.serving.frontend import ServingFrontend
 from smi_tpu.serving.qos import (
@@ -1390,6 +1390,720 @@ def autoscale_selftest(seed: int = 0) -> Dict:
                                 duration=MIN_FLASH_CROWD_DURATION)
 
 
+# -- the r17 partition-tolerance cells ----------------------------------
+
+#: Minimum ticks a cut must stay open: the quorum lease (phi evidence
+#: on the ack round trip, ConfirmedDead at a 2x-heartbeat grace) needs
+#: several missed beat periods to lapse before the heal arrives.
+MIN_PARTITION_WINDOW = 60
+
+
+def _partition_victim(n: int, tenants: int):
+    """The cell's tenant names plus the cut victim: the first tenant
+    whose crc32 home is NOT the control-plane home (the lowest rank —
+    cutting the sink itself would cut everyone and prove nothing
+    about minority fencing). Returns ``(names, victim_tenant,
+    victim_rank)``."""
+    from smi_tpu.serving.placement import tenant_base_rank
+
+    names = _distinct_home_tenants(n, tenants)
+    for name in names:
+        home = tenant_base_rank(name, n)
+        if home != 0:
+            return names, name, home
+    raise RuntimeError(  # pragma: no cover — homes are distinct
+        "every distinct-home tenant landed on rank 0"
+    )
+
+
+def _run_partition_traffic(
+    n: int,
+    seed: int,
+    duration: int,
+    tenants: int,
+    pool: int,
+    fenced: bool,
+    fault_kind: Optional[str],
+    partition_at: int,
+    window: int,
+    flap_seed: Optional[int] = None,
+):
+    """One arm of the partition A/B: identical seeded traffic, with or
+    without a control-plane cut injected at ``partition_at``. Returns
+    ``(frontend, victim_tenant, victim_rank, quorum_rejected)`` —
+    the last is the count of submits the caller saw refused LOUDLY
+    (:class:`~smi_tpu.parallel.membership.QuorumLostError`), which
+    must match the front-end's own census."""
+    names, victim_tenant, victim = _partition_victim(n, tenants)
+    remap = {f"t{j}": names[j] for j in range(tenants)}
+    fe = ServingFrontend(n, seed=seed, pool=pool,
+                         quorum_fencing=fenced,
+                         recorder=campaign_recorder(duration, n))
+    mean_chunks = (
+        sum(CLASS_MIX[c] * CLASS_CHUNKS[c] for c in QOS_CLASSES)
+        / sum(CLASS_MIX.values())
+    )
+    capacity = n * fe.consume_rate
+    requests_per_tick = 0.35 * capacity / mean_chunks
+    schedule = open_loop_traffic(seed, tenants, duration,
+                                 requests_per_tick)
+    tenant_seq: Dict[str, int] = {}
+    quorum_rejected = 0
+    for tick, burst in enumerate(schedule):
+        if fault_kind is not None and tick == partition_at:
+            now = fe.clock.now()
+            if fault_kind == "partition":
+                fault = F.PartitionFault(
+                    minority=frozenset({victim}),
+                    from_tick=now, until_tick=now + window,
+                )
+            elif fault_kind == "asymmetric":
+                # the victim's OUTBOUND dies; it still hears the
+                # majority — exactly the cut one-way beat evidence
+                # cannot see, and the round-trip lease must
+                fault = F.AsymmetricLinkFault(
+                    src=victim, dst=0,
+                    from_tick=now, until_tick=now + window,
+                )
+            else:
+                fault = F.FlappingLink(
+                    a=0, b=victim,
+                    from_tick=now, until_tick=now + window,
+                    seed=seed if flap_seed is None else flap_seed,
+                )
+            fe.inject_partition(fault)
+        for tenant, qos in burst:
+            tenant = remap[tenant]
+            seq = tenant_seq.get(tenant, 0)
+            tenant_seq[tenant] = seq + 1
+            chunks = tuple(
+                _payload(tenant, seq, c)
+                for c in range(CLASS_CHUNKS[qos])
+            )
+            try:
+                fe.submit(tenant, qos, chunks)
+            except QuorumLostError:
+                quorum_rejected += 1  # the loud minority-park refusal
+            except AdmissionRejected:
+                pass
+        fe.step()
+    fe.drain()
+    return fe, victim_tenant, victim, quorum_rejected
+
+
+def run_partition_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 240,
+    tenants: Optional[int] = None,
+    pool: int = DEFAULT_POOL,
+    partition_at: int = 60,
+    window: int = 100,
+    return_frontend: bool = False,
+):
+    """The clean partition/heal cell: a symmetric cut isolates one
+    rank mid-traffic, run as an A/B against its own no-partition
+    control.
+
+    The minority rank's quorum lease lapses (phi evidence on the ack
+    round trip), it parks, and every new stream homed there is
+    refused LOUDLY (``QuorumLostError``, counted — the caller-visible
+    count must match the front-end's census). The majority — a
+    quorate side — confirms the unreachable rank and fails its
+    tenants over under a fenced epoch bump; at the heal the parked
+    rank presents its stale epoch once (rejected, counted) and
+    rejoins through the real regrow actuator. Gates: zero
+    lost-accepted, zero split-brain incidents, zero corruption,
+    membership restored to full strength, and every stream BOTH arms
+    completed delivered bit-identical to the control."""
+    if duration < MIN_CAMPAIGN_DURATION:
+        raise ValueError(
+            f"partition cell duration {duration} is below the "
+            f"{MIN_CAMPAIGN_DURATION}-tick minimum"
+        )
+    if window < MIN_PARTITION_WINDOW:
+        raise ValueError(
+            f"partition window {window} is below the "
+            f"{MIN_PARTITION_WINDOW}-tick minimum: the quorum lease "
+            f"cannot lapse before the heal"
+        )
+    if duration - (partition_at + window) < 40:
+        raise ValueError(
+            f"partition cell needs >= 40 post-heal ticks "
+            f"(partition_at={partition_at} + window={window} vs "
+            f"duration={duration}) for the rejoin to prove itself"
+        )
+    if tenants is None:
+        tenants = max(2, n - 1)
+    fe, victim_tenant, victim, quorum_rejected = (
+        _run_partition_traffic(n, seed, duration, tenants, pool,
+                               fenced=True, fault_kind="partition",
+                               partition_at=partition_at,
+                               window=window))
+    control, _, _, _ = _run_partition_traffic(
+        n, seed, duration, tenants, pool,
+        fenced=True, fault_kind=None,
+        partition_at=partition_at, window=window)
+
+    report = fe.report()
+    control_report = control.report()
+    digest = _delivery_digest(fe)
+    control_digest = _delivery_digest(control)
+    common = sorted(set(digest) & set(control_digest))
+    divergent = [k for k in common if digest[k] != control_digest[k]]
+    report.update({
+        "seed": seed,
+        "duration": duration,
+        "victim_tenant": victim_tenant,
+        "victim_rank": victim,
+        "partition_at": partition_at,
+        "window": window,
+        "quorum_rejected_seen": quorum_rejected,
+        "digest_streams": len(digest),
+        "control_digest_streams": len(control_digest),
+        "digest_common": len(common),
+        "digest_divergent": len(divergent),
+        "digest_match": not divergent,
+        "metrics": fe.metrics.snapshot(),
+    })
+
+    # -- gates ----------------------------------------------------------
+    problems: List[str] = []
+    for name, rep in (("subject", report),
+                      ("control", control_report)):
+        if rep["silent_corruptions"]:
+            problems.append(f"{name}: silent corruption")
+        if rep["lost_accepted"]:
+            problems.append(
+                f"{name}: lost accepted: {rep['lost_accepted']}"
+            )
+        if rep["stale_epoch_leaks"]:
+            problems.append(f"{name}: stale-epoch traffic accepted")
+    if "partition" in control_report:
+        problems.append("the control arm saw a partition — A/B is "
+                        "broken")
+    part = report.get("partition")
+    if part is None:
+        problems.append("the subject arm never injected a partition")
+    else:
+        if part["split_brain_incidents"]:
+            problems.append(
+                f"split brain: {part['split_brain_incidents']} "
+                f"stream(s) accepted by a rank the majority no "
+                f"longer trusts"
+            )
+        if part["quorum_losses"] < 1:
+            problems.append(
+                "the minority never detected its quorum loss — the "
+                "lease did not lapse inside the cut window"
+            )
+        if part["quorum_rejections"] < 1:
+            problems.append(
+                "no new stream was refused during the park — the "
+                "fencing gate never engaged"
+            )
+        if part["quorum_rejections"] != quorum_rejected:
+            problems.append(
+                f"the front-end counted "
+                f"{part['quorum_rejections']} quorum rejection(s) "
+                f"but the caller saw {quorum_rejected} "
+                f"QuorumLostError(s) — refusals are not loud"
+            )
+        if part["heal_rejoins"] < 1:
+            problems.append(
+                "the parked rank never rejoined at the heal"
+            )
+        if part["parked"]:
+            problems.append(
+                f"rank(s) {part['parked']} still parked after the "
+                f"heal"
+            )
+    if report["members"] != list(range(n)):
+        problems.append(
+            f"membership not restored after the heal "
+            f"(members: {report['members']})"
+        )
+    if not report["stale_epoch_rejections"]:
+        problems.append(
+            "the healed rank's stale epoch was never "
+            "presented/rejected"
+        )
+    if divergent:
+        problems.append(
+            f"{len(divergent)} stream(s) delivered different bits "
+            f"than the no-partition control (first: {divergent[0]})"
+        )
+    if len(common) < min(len(digest), len(control_digest)) // 2:
+        problems.append(
+            f"the A/B arms' completed sets barely overlap "
+            f"({len(common)} common of {len(digest)} vs "
+            f"{len(control_digest)})"
+        )
+    if not any(k[0] == victim_tenant for k in common):
+        problems.append(
+            f"no completed stream of the victim tenant "
+            f"{victim_tenant!r} is in both arms — the cut rank's "
+            f"delivery was never diffed against the control"
+        )
+    waits = report["admission_waits"]
+    report["admission_latency"] = {
+        c: {
+            "p50": percentile(waits[c], 0.50),
+            "p99": percentile(waits[c], 0.99),
+        }
+        for c in QOS_CLASSES
+    }
+    span_fields(fe, report, problems)
+    del report["admission_waits"]
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    if return_frontend:
+        return report, fe
+    return report
+
+
+def run_partition_migration_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 240,
+    tenants: int = 4,
+    pool: int = DEFAULT_POOL,
+    stall_at: int = 50,
+    migrate_at: int = 60,
+    partition_at: int = 70,
+    window: int = 120,
+    return_frontend: bool = False,
+):
+    """The asymmetric-partition-during-migration cell: the migration
+    source's OUTBOUND link dies mid-drain (it still hears the
+    majority — the one-way cut only round-trip lease evidence can
+    see) and the migration must abort loudly, loss-free.
+
+    The source's consumer is stalled first so the drain cannot finish
+    before the cut's phi evidence lands (deadline checking is off for
+    the same reason: the stall must outlive the confirm grace, and
+    the watchdog's own conduct is the backpressure cell's gate, not
+    this one's). The majority — quorate — confirms the silent source,
+    fails its tenants over through the normal replay path, and the
+    migration driver aborts with a NAMED reason. Gates: exactly one
+    aborted migration (``membership-change`` or ``quorum-lost``),
+    zero lost-accepted, zero split-brain, stragglers rejected, and
+    the source rejoined at the heal."""
+    from smi_tpu.serving.elasticity import ElasticityController
+
+    if not stall_at < migrate_at < partition_at < duration:
+        raise ValueError(
+            f"partition-migration cell needs stall_at < migrate_at "
+            f"< partition_at < duration, got {stall_at}/{migrate_at}"
+            f"/{partition_at}/{duration}"
+        )
+    if window < MIN_PARTITION_WINDOW:
+        raise ValueError(
+            f"partition window {window} is below the "
+            f"{MIN_PARTITION_WINDOW}-tick minimum"
+        )
+    names, hot, src = _partition_victim(n, tenants)
+    remap = {f"t{j}": names[j] for j in range(tenants)}
+    ctrl = ElasticityController(spares=0, sustain_in=10 * duration)
+    fe = ServingFrontend(n, seed=seed, pool=pool, elasticity=ctrl,
+                         check_deadlines=False,
+                         recorder=campaign_recorder(duration, n))
+    mean_chunks = (
+        sum(CLASS_MIX[c] * CLASS_CHUNKS[c] for c in QOS_CLASSES)
+        / sum(CLASS_MIX.values())
+    )
+    capacity = n * fe.consume_rate
+    requests_per_tick = 0.6 * capacity / mean_chunks
+    schedule = open_loop_traffic(seed, tenants, duration,
+                                 requests_per_tick)
+    tenant_seq: Dict[str, int] = {}
+    migration_error = None
+    verdict = "ok"
+    try:
+        for tick, burst in enumerate(schedule):
+            now = fe.clock.now()
+            if tick == stall_at:
+                # freeze the source FIRST, then pin a few hot streams
+                # on it: the drain must still be open when the cut's
+                # phi evidence lands, even on seeds where the
+                # open-loop schedule left the hot tenant idle
+                fe.stall_consumer(src, now + window + 60)
+                for _ in range(3):
+                    seq = tenant_seq.get(hot, 0)
+                    tenant_seq[hot] = seq + 1
+                    chunks = tuple(
+                        _payload(hot, seq, c)
+                        for c in range(CLASS_CHUNKS["batch"])
+                    )
+                    try:
+                        fe.submit(hot, "batch", chunks)
+                    except AdmissionRejected:
+                        pass
+            if tick == migrate_at:
+                others = sorted(
+                    r for r in fe.view.members if r != src
+                )
+                dst = min(others,
+                          key=lambda r: (fe._rank_load(r), r))
+                try:
+                    fe.request_migration(hot, dst, reason="demand")
+                except ValueError as e:
+                    migration_error = str(e)
+            if tick == partition_at:
+                fe.inject_partition(F.AsymmetricLinkFault(
+                    src=src, dst=0,
+                    from_tick=now, until_tick=now + window,
+                ))
+            for tenant, qos in burst:
+                tenant = remap[tenant]
+                seq = tenant_seq.get(tenant, 0)
+                tenant_seq[tenant] = seq + 1
+                chunks = tuple(
+                    _payload(tenant, seq, c)
+                    for c in range(CLASS_CHUNKS[qos])
+                )
+                try:
+                    fe.submit(tenant, qos, chunks)
+                except (AdmissionRejected, QuorumLostError):
+                    pass
+            fe.step()
+        fe.drain()
+    except Exception as e:  # a watchdog/assert firing IS the verdict
+        verdict = f"{type(e).__name__}: {e}"
+
+    report = fe.report()
+    report.update({
+        "seed": seed,
+        "duration": duration,
+        "hot_tenant": hot,
+        "src": src,
+        "stall_at": stall_at,
+        "migrate_at": migrate_at,
+        "partition_at": partition_at,
+        "window": window,
+        "migration_error": migration_error,
+        "metrics": fe.metrics.snapshot(),
+    })
+
+    # -- gates ----------------------------------------------------------
+    problems: List[str] = []
+    if verdict != "ok":
+        problems.append(verdict)
+    if migration_error is not None:
+        problems.append(
+            f"migration request failed: {migration_error}"
+        )
+    if report["silent_corruptions"]:
+        problems.append("silent corruption")
+    if report["lost_accepted"]:
+        problems.append(
+            f"lost accepted: {report['lost_accepted']}"
+        )
+    if report["stale_epoch_leaks"]:
+        problems.append("stale-epoch traffic accepted")
+    migs = list(report.get("elasticity", {}).get("migrations", ()))
+    aborted = [m for m in migs if m["state"] == "aborted"]
+    if [m["state"] for m in migs] != ["aborted"]:
+        problems.append(
+            f"expected exactly one aborted migration, got "
+            f"{[m['state'] for m in migs]} — cutting over across a "
+            f"partition would resurrect state the failover voided"
+        )
+    elif aborted[0]["abort_reason"] not in ("membership-change",
+                                            "quorum-lost"):
+        problems.append(
+            f"abort reason {aborted[0]['abort_reason']!r} — neither "
+            f"the membership change nor the quorum loss is what "
+            f"aborted it"
+        )
+    part = report.get("partition")
+    if part is None:
+        problems.append("the asymmetric cut was never injected")
+    else:
+        if part["split_brain_incidents"]:
+            problems.append(
+                f"split brain: {part['split_brain_incidents']}"
+            )
+        if part["heal_rejoins"] < 1:
+            problems.append(
+                "the cut source never rejoined at the heal"
+            )
+        if part["parked"]:
+            problems.append(
+                f"rank(s) {part['parked']} still parked after the "
+                f"heal"
+            )
+    if report["confirmed"] != [src]:
+        problems.append(
+            f"the silent source {src} was not confirmed "
+            f"(confirmed: {report['confirmed']})"
+        )
+    if report["members"] != list(range(n)):
+        problems.append(
+            f"membership not restored after the heal "
+            f"(members: {report['members']})"
+        )
+    if not report["stale_epoch_rejections"]:
+        problems.append(
+            "straggler from the cut incarnation was never "
+            "presented/rejected"
+        )
+    waits = report["admission_waits"]
+    report["admission_latency"] = {
+        c: {
+            "p50": percentile(waits[c], 0.50),
+            "p99": percentile(waits[c], 0.99),
+        }
+        for c in QOS_CLASSES
+    }
+    span_fields(fe, report, problems)
+    del report["admission_waits"]
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    if return_frontend:
+        return report, fe
+    return report
+
+
+#: How many seeded flap vectors the soak may try before declaring the
+#: hysteresis broken. The duty cycle's per-window offsets are random:
+#: an unlucky vector can blank enough CONSECUTIVE beats that the
+#: silence exceeds the lease's confirm grace — and that vector IS a
+#: cut (parking on it is the contract), while a too-lucky vector
+#: never blocks a beat at all and exercises nothing. The soak's claim
+#: is about vectors BETWEEN those: silences long enough to suspect,
+#: short enough that the lease must absorb them.
+FLAP_VECTOR_ATTEMPTS = 5
+
+
+def run_flapping_link_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 240,
+    tenants: Optional[int] = None,
+    pool: int = DEFAULT_POOL,
+    flap_at: int = 60,
+    window: int = 120,
+    return_frontend: bool = False,
+):
+    """The flapping-link soak: one control link duty-cycles up/down
+    for ``window`` ticks and the membership must NOT oscillate.
+
+    A flap whose silences stay under the lease's confirm grace must
+    ride suspect/clear cycles WITHOUT ever confirming a death: zero
+    confirms, zero parks, zero epoch changes, zero refused streams,
+    zero loss. Because the fault's per-window offsets are seeded
+    random, the cell searches up to :data:`FLAP_VECTOR_ATTEMPTS`
+    vectors for one inside the hysteresis margin — a vector that
+    blanks 3+ consecutive beats is indistinguishable from a cut
+    (the lease LAPSING there is correct, and the partition cell
+    owns that flow), and one that never blocks a beat proves
+    nothing. Discarded vectors are reported; loss/corruption/
+    split-brain are hard gates on EVERY vector, kept or not. If
+    every vector parks, the grace is not absorbing sub-confirm
+    silences — that is the failure this cell exists to catch."""
+    if duration < MIN_CAMPAIGN_DURATION:
+        raise ValueError(
+            f"flapping cell duration {duration} is below the "
+            f"{MIN_CAMPAIGN_DURATION}-tick minimum"
+        )
+    if tenants is None:
+        tenants = max(2, n - 1)
+    problems: List[str] = []
+    discarded: List[Dict] = []
+    flap_seed = seed
+    for attempt in range(FLAP_VECTOR_ATTEMPTS):
+        flap_seed = seed * FLAP_VECTOR_ATTEMPTS + attempt
+        fe, victim_tenant, victim, quorum_rejected = (
+            _run_partition_traffic(n, seed, duration, tenants, pool,
+                                   fenced=True,
+                                   fault_kind="flapping",
+                                   partition_at=flap_at,
+                                   window=window,
+                                   flap_seed=flap_seed))
+        report = fe.report()
+        part = report.get("partition") or {}
+        # hard invariants bind EVERY vector, kept or discarded: even
+        # a cut-equivalent flap may only park and heal, never lose
+        if report["silent_corruptions"]:
+            problems.append(f"vector {flap_seed}: silent corruption")
+        if report["lost_accepted"]:
+            problems.append(
+                f"vector {flap_seed}: lost accepted: "
+                f"{report['lost_accepted']}"
+            )
+        if report["stale_epoch_leaks"]:
+            problems.append(
+                f"vector {flap_seed}: stale-epoch traffic accepted"
+            )
+        if part.get("split_brain_incidents"):
+            problems.append(
+                f"vector {flap_seed}: split brain: "
+                f"{part['split_brain_incidents']}"
+            )
+        if problems:
+            break  # no vector rescues a safety violation
+        if report["confirmed"] or part.get("quorum_losses"):
+            discarded.append({
+                "flap_seed": flap_seed,
+                "why": "cut-equivalent silence: the lease lapsed",
+            })
+            continue
+        if not report["suspected"]:
+            discarded.append({
+                "flap_seed": flap_seed,
+                "why": "no beat blocked: suspicion never tripped",
+            })
+            continue
+        break  # a vector inside the hysteresis margin
+    else:
+        problems.append(
+            f"no seeded flap vector stayed inside the hysteresis "
+            f"margin in {FLAP_VECTOR_ATTEMPTS} attempts "
+            f"({[d['why'] for d in discarded]}) — if every vector "
+            f"parked, the confirm grace is not absorbing "
+            f"sub-confirm silences"
+        )
+
+    report.update({
+        "seed": seed,
+        "duration": duration,
+        "victim_tenant": victim_tenant,
+        "victim_rank": victim,
+        "flap_at": flap_at,
+        "window": window,
+        "flap_seed": flap_seed,
+        "discarded_vectors": discarded,
+        "metrics": fe.metrics.snapshot(),
+    })
+
+    # -- gates on the kept vector ---------------------------------------
+    if not problems:
+        part = report.get("partition")
+        if part is None:
+            problems.append("the flap was never injected")
+        else:
+            if part["parked"]:
+                problems.append(
+                    f"rank(s) {part['parked']} left parked by a "
+                    f"mere flap"
+                )
+            if part["quorum_rejections"] or quorum_rejected:
+                problems.append(
+                    f"{part['quorum_rejections']} stream(s) were "
+                    f"refused under a mere flap"
+                )
+            if not part["healed"]:
+                problems.append("the flap window never closed")
+        if report["epoch"] != 0:
+            problems.append(
+                f"the epoch moved to {report['epoch']} under a "
+                f"mere flap — an actuator fired"
+            )
+        if len(report["cleared"]) != len(report["suspected"]):
+            problems.append(
+                f"{len(report['suspected'])} suspicion(s) but only "
+                f"{len(report['cleared'])} cleared — a flap left a "
+                f"suspicion standing"
+            )
+    waits = report["admission_waits"]
+    report["admission_latency"] = {
+        c: {
+            "p50": percentile(waits[c], 0.50),
+            "p99": percentile(waits[c], 0.99),
+        }
+        for c in QOS_CLASSES
+    }
+    span_fields(fe, report, problems)
+    del report["admission_waits"]
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    if return_frontend:
+        return report, fe
+    return report
+
+
+#: The partition campaign's menu, keyed the way cell reports name
+#: themselves; ``only=`` narrows the campaign to one entry.
+PARTITION_CELLS = (
+    ("partition-heal", run_partition_cell),
+    ("partition-migration-abort", run_partition_migration_cell),
+    ("flapping-link", run_flapping_link_cell),
+)
+
+
+def partition_campaign(
+    seed: int = 0,
+    n: int = 4,
+    duration: int = 240,
+    trials: int = 1,
+    only: Optional[str] = None,
+) -> Dict:
+    """The seeded partition-tolerance campaign: one clean
+    partition/heal A/B, one asymmetric-cut-during-migration abort,
+    and one flapping-link soak per trial (``only=`` narrows to a
+    single named cell). Exit gate: every cell ``ok``."""
+    if duration < MIN_CAMPAIGN_DURATION:
+        raise ValueError(
+            f"campaign duration {duration} is below the "
+            f"{MIN_CAMPAIGN_DURATION}-tick minimum"
+        )
+    menu = PARTITION_CELLS
+    if only is not None:
+        menu = tuple((nm, fn) for nm, fn in menu if nm == only)
+        if not menu:
+            raise ValueError(
+                f"unknown partition cell {only!r}; known: "
+                f"{[nm for nm, _ in PARTITION_CELLS]}"
+            )
+    cells: List[Dict] = []
+    for trial in range(trials):
+        base = random.Random(
+            f"partition:{seed}:{trial}").randrange(1 << 30)
+        for name, runner in menu:
+            report = runner(n=n, seed=base,
+                            duration=max(duration, 240))
+            report["cell"] = name
+            report["trial"] = trial
+            cells.append(report)
+    failures = [c for c in cells if not c["ok"]]
+    return {
+        "seed": seed,
+        "n": n,
+        "duration": duration,
+        "trials": trials,
+        "cells": len(cells),
+        "outcomes": {
+            c["cell"]: ("ok" if c["ok"] else "failed") for c in cells
+        },
+        "failures": [
+            {"cell": c["cell"], "trial": c["trial"],
+             "verdict": c["verdict"]}
+            for c in failures
+        ],
+        "silent_corruptions": sum(
+            c["silent_corruptions"] for c in cells
+        ),
+        "lost_accepted": sum(c["lost_accepted"] for c in cells),
+        "stale_epoch_leaks": sum(
+            c["stale_epoch_leaks"] for c in cells
+        ),
+        "split_brain_incidents": sum(
+            c.get("partition", {}).get("split_brain_incidents", 0)
+            for c in cells
+        ),
+        "reports": cells,
+        "ok": not failures,
+    }
+
+
+def partition_selftest(seed: int = 0) -> Dict:
+    """The ``smi-tpu serve --selftest --partition`` smoke: the clean
+    partition/heal cell at its default shape — park, fence, fail
+    over, heal, rejoin, bit-identical to the no-partition control."""
+    return run_partition_cell(n=4, seed=seed, duration=240)
+
+
 #: Model-checker property -> the campaign gate it instantiates. The
 #: model tier (:mod:`smi_tpu.analysis.model`) checks these same gates
 #: exhaustively at small scope; a counterexample trace replayed here
@@ -1405,6 +2119,8 @@ MODEL_GATES = {
     "swap-lost-accepted": "plan swap lost the active plan",
     "migration-lost-accepted": "migration lost delivered state",
     "placement-epoch-safety": "capacity change stranded residents",
+    "no-split-brain": "two primaries for one tenant",
+    "fenced-actuation": "actuation fired without a quorum",
 }
 
 

@@ -229,7 +229,9 @@ class ElasticityController:
                 break
             rank = max(frontend.view.members)
             shrink_pod(frontend.view, frontend.detector, rank,
-                       reason="spare")
+                       reason="spare",
+                       token=self._mint(frontend, rank,
+                                        f"scale-in of rank {rank}"))
             self.parked.add(rank)
 
     # -- signal reads ---------------------------------------------------
@@ -279,10 +281,20 @@ class ElasticityController:
         return (self.last_scale_tick is None
                 or now - self.last_scale_tick >= self.cooldown)
 
+    @staticmethod
+    def _mint(frontend, rank: int, what: str):
+        """The front-end's quorum fencing token for an actuation —
+        None when the front-end predates fencing (duck-typed, so the
+        controller still binds to bare test doubles)."""
+        mint = getattr(frontend, "mint_quorum_token", None)
+        return mint(rank=rank, what=what) if mint is not None else None
+
     def _scale_out(self, now: int) -> None:
         rank = min(self.parked)
         regrow_pod(self.fe.view, self.fe.detector, rank,
-                   reason="demand")
+                   reason="demand",
+                   token=self._mint(self.fe, rank,
+                                    f"scale-out of rank {rank}"))
         self.parked.discard(rank)
         self.last_scale_tick = now
         self.hot_ticks = 0
@@ -314,7 +326,9 @@ class ElasticityController:
         if rank is None:
             return
         shrink_pod(self.fe.view, self.fe.detector, rank,
-                   reason="demand")
+                   reason="demand",
+                   token=self._mint(self.fe, rank,
+                                    f"scale-in of rank {rank}"))
         self.parked.add(rank)
         self.last_scale_tick = now
         self.cold_ticks = 0

@@ -54,10 +54,15 @@ from smi_tpu.parallel.membership import (
     ConfirmedDead,
     MembershipView,
     PhiAccrualDetector,
+    QuorumDecision,
+    QuorumLostError,
     StaleEpochError,
     StepClock,
     SuspectRank,
     SuspicionCleared,
+    mint_fencing_token,
+    quorum_size,
+    regrow_pod,
     route_owner,
 )
 from smi_tpu.obs.events import FlightRecorder
@@ -100,6 +105,8 @@ class ServingFrontend:
         metrics: Optional[MetricsRegistry] = None,
         retune: Optional[object] = None,
         elasticity: Optional[object] = None,
+        quorum_fencing: bool = True,
+        quorum_fraction: Optional[float] = None,
     ):
         if n < 2:
             raise ValueError(f"serving needs >= 2 ranks, got {n}")
@@ -213,6 +220,50 @@ class ServingFrontend:
         self.lost_in_flight = 0
         self._kill_tick: Optional[int] = None
         self._next_beat = 0
+        #: partition tolerance (r17). ``quorum_fencing`` gates the
+        #: whole discipline: fenced (the default) means a rank that
+        #: loses its quorum lease PARKS — new streams bounce with a
+        #: named :class:`QuorumLostError` — and every epoch-advancing
+        #: actuator runs under a minted :class:`FencingToken`.
+        #: Unfenced is the DEMONSTRATION arm: the stale minority
+        #: primary keeps accepting, and every accept that lands while
+        #: the majority has already rerouted the tenant is a counted
+        #: split-brain incident (two primaries, one tenant).
+        self.quorum_fencing = quorum_fencing
+        self.quorum_fraction = quorum_fraction
+        #: the in-flight partition-class fault, or None — one at a
+        #: time, armed by :meth:`inject_partition`, healed (and the
+        #: parked side rejoined) by :meth:`_drive_partition` once the
+        #: fault window closes
+        self._partition = None
+        #: the minority side's quorum evidence: phi-accrual over lease
+        #: ROUND TRIPS to the control-plane home rank. A one-way cut
+        #: (the asymmetric fault) kills the round trip even though the
+        #: minority still hears the majority — exactly why a lease
+        #: renewal must be an acknowledged exchange, not a received
+        #: beat. Confirm grace is half the membership detector's:
+        #: park-before-actuate, so the minority is parked BEFORE the
+        #: majority's failover can create a second primary.
+        self._ack_detector = PhiAccrualDetector(
+            self.clock, range(n),
+            confirm_grace=2 * HEARTBEAT_INTERVAL,
+        )
+        #: ranks whose quorum lease lapsed (parked while fenced)
+        self._quorum_lost: set = set()
+        #: rank -> the view epoch it parked under (the stale epoch its
+        #: heal-time straggler presents to the rail)
+        self._park_epoch: Dict[int, int] = {}
+        #: ranks the membership detector confirmed dead WHILE a
+        #: partition was in flight — rejoined at heal even if their
+        #: ack lease never lapsed (they are alive behind the cut)
+        self._partition_confirmed: set = set()
+        self.partitions_injected = 0
+        self.quorum_losses = 0
+        self.quorum_rejections = 0
+        self.heal_rejoins = 0
+        self.split_brain_accepts = 0
+        self.quorum_decisions: List[QuorumDecision] = []
+        self.healed_partitions: List[Dict] = []
         self._bootstrap()
         #: the demand-elasticity controller
         #: (:class:`smi_tpu.serving.elasticity.ElasticityController`)
@@ -241,10 +292,32 @@ class ServingFrontend:
     def _heartbeats(self) -> None:
         if self.clock.now() < self._next_beat:
             return
+        now = self.clock.now()
+        # the control plane's heartbeat sink sits at the lowest live
+        # member (``home``): a partition-class fault partitions this
+        # front-end exactly when it cuts ranks off from that side
+        fault = self._partition
+        live = sorted(r for r in self.view.members
+                      if r not in self.killed)
+        home = live[0] if live else None
         for r in sorted(self.view.members):
             if r in self.killed:
                 continue
+            if (fault is not None and home is not None and r != home
+                    and fault.blocks(r, home, now)):
+                continue  # the beat never crosses the cut
             self.detector.heartbeat(r)
+        # lease acks: every live rank renews its quorum lease with a
+        # ROUND TRIP to home — an asymmetric cut (outbound lost,
+        # inbound fine) fails the renewal even though the rank still
+        # hears the majority, which is what makes the minority side of
+        # an asymmetric partition detectable at all
+        if home is not None:
+            for r in sorted(set(range(self.n)) - self.killed):
+                if (r == home or fault is None
+                        or (not fault.blocks(r, home, now)
+                            and not fault.blocks(home, r, now))):
+                    self._ack_detector.heartbeat(r)
         self._next_beat = (
             self.clock.now() + HEARTBEAT_INTERVAL
             + self.rng.randrange(-1, 2)
@@ -258,6 +331,32 @@ class ServingFrontend:
             raise ValueError(f"rank {rank} out of range")
         self.killed.add(rank)
         self._kill_tick = self.clock.now()
+
+    def inject_partition(self, fault) -> None:
+        """Arm a partition-class fault (:class:`~smi_tpu.parallel
+        .faults.PartitionFault` / ``AsymmetricLinkFault`` /
+        ``FlappingLink``) against the control plane's heartbeat and
+        lease traffic. The fault's tick window is absolute clock
+        ticks; one fault at a time — heal processing re-arms."""
+        from smi_tpu.parallel.faults import (
+            AsymmetricLinkFault,
+            FlappingLink,
+            PartitionFault,
+        )
+        if not isinstance(fault, (PartitionFault, AsymmetricLinkFault,
+                                  FlappingLink)):
+            raise TypeError(
+                f"inject_partition wants a partition-class fault "
+                f"(PartitionFault / AsymmetricLinkFault / "
+                f"FlappingLink), got {type(fault).__name__}"
+            )
+        if self._partition is not None:
+            raise RuntimeError(
+                f"a partition fault is already in flight "
+                f"({type(self._partition).__name__})"
+            )
+        self._partition = fault
+        self.partitions_injected += 1
 
     def stall_consumer(self, rank: int, until_tick: int) -> None:
         """A live-but-stalled consumer (the saturation half of the
@@ -290,6 +389,40 @@ class ServingFrontend:
             arrived_at=self.clock.now(), stream_id=(tenant, seq),
             base_rank=base_rank,
         )
+        # the quorum gate (r17): a request arriving at a tenant whose
+        # home rank sits on the parked minority side of a partition.
+        # Fenced, the stale primary REFUSES it — loud, counted, named
+        # — because accepting without a quorum lease is exactly how a
+        # second primary is born. Unfenced (the demonstration arm) it
+        # keeps accepting; when the majority has already rerouted the
+        # tenant, that accept IS a split-brain incident.
+        home = base_rank if base_rank is not None \
+            else self.placement.base_of(tenant)
+        if home is None:
+            home = tenant_base_rank(tenant, self.n)
+        if home in self._quorum_lost:
+            if self.quorum_fencing:
+                self.quorum_rejections += 1
+                decision = QuorumDecision(
+                    epoch=self.view.epoch, quorum=(home,),
+                    verdict="rejected",
+                )
+                self.quorum_decisions.append(decision)
+                self.recorder.emit(
+                    "ctl.quorum", self.clock.now(), rank=home,
+                    **decision.as_fields(),
+                )
+                raise QuorumLostError(
+                    home, reachable={home},
+                    needed=quorum_size(
+                        max(len(self.view.members), 1),
+                        self.quorum_fraction,
+                    ),
+                    what=f"new stream for tenant {tenant!r}",
+                )
+            if (home not in self.view.members
+                    or home in self.detector.suspected):
+                self.split_brain_accepts += 1
         # per-destination backpressure: a route whose destination
         # already holds its stream-cap of credits (stalled consumer,
         # undetected death) sheds at the edge with a named error —
@@ -572,6 +705,114 @@ class ServingFrontend:
         except StaleEpochError:
             self.stale_epoch_rejections += 1
 
+    # -- partition tolerance (r17) --------------------------------------
+
+    def _reachable(self) -> frozenset:
+        """The members the control plane currently hears — the
+        evidence set every quorum mint is judged against."""
+        return (frozenset(self.view.members)
+                - frozenset(self.detector.suspected)
+                - frozenset(self.detector.dead)
+                - frozenset(self.killed))
+
+    def mint_quorum_token(self, rank: int = -1,
+                          what: str = "actuation"):
+        """A :class:`FencingToken` over the currently-reachable
+        members, or None when fencing is off (``token=None``
+        downgrades every fenced actuator to the trivially-quorate
+        full-member mint — byte-for-byte the pre-r17 behaviour).
+        Raises :class:`QuorumLostError`, loudly, when the reachable
+        set cannot muster a quorum."""
+        if not self.quorum_fencing:
+            return None
+        return mint_fencing_token(
+            self.view, reachable=self._reachable(),
+            fraction=self.quorum_fraction, rank=rank, what=what,
+        )
+
+    def _poll_quorum(self, now: int) -> None:
+        """Drain the lease detector. A confirmed lapse — phi past the
+        dead threshold AND held through the (shortened) grace — parks
+        the rank: its quorum lease is gone. Suspect/clear episodes are
+        the hysteresis doing its job (a flapping link produces plenty
+        of them and must produce NO parks), so they are deliberately
+        ignored. Outside a partition window the transitions are
+        drained and discarded — a crash-stopped rank also stops
+        acking, and that is the membership detector's verdict to
+        make, not the lease detector's."""
+        transitions = self._ack_detector.poll()
+        if self._partition is None:
+            return
+        for tr in transitions:
+            if not isinstance(tr, ConfirmedDead):
+                continue
+            r = tr.rank
+            if r in self.killed or r in self._quorum_lost:
+                continue
+            self._quorum_lost.add(r)
+            self._park_epoch[r] = self.view.epoch
+            self.quorum_losses += 1
+            decision = QuorumDecision(
+                epoch=self.view.epoch, quorum=(r,), verdict="lost",
+            )
+            self.quorum_decisions.append(decision)
+            self.recorder.emit("ctl.quorum", now, rank=r,
+                               **decision.as_fields())
+            self.metrics.counter("quorum_transitions_total",
+                                 kind="lost").inc()
+
+    def _drive_partition(self, now: int) -> None:
+        """Heal processing: once the fault window closes, every parked
+        (or partition-confirmed) rank rejoins. A rank the majority
+        shrank away rejoins via the regrow rail UNDER A FRESH EPOCH —
+        and first presents its parked incarnation's stale epoch to the
+        :class:`StaleEpochError` straggler rail exactly once, which
+        must bounce (counted, never folded in)."""
+        fault = self._partition
+        if now < fault.until_tick:
+            return
+        healed = []
+        rejoining = sorted(
+            (self._quorum_lost | self._partition_confirmed)
+            - self.killed
+        )
+        for r in rejoining:
+            self._ack_detector.forget(r)
+            if r not in self.view.members:
+                try:
+                    self.view.validate(
+                        r, self._park_epoch.get(r, 0),
+                        what="parked-rank straggler",
+                    )
+                    self.stale_epoch_leaks += 1
+                except StaleEpochError:
+                    self.stale_epoch_rejections += 1
+                regrow_pod(
+                    self.view, self.detector, r,
+                    reason="heal-rejoin",
+                    token=self.mint_quorum_token(
+                        rank=r, what=f"heal rejoin of rank {r}",
+                    ),
+                )
+            self._quorum_lost.discard(r)
+            self._partition_confirmed.discard(r)
+            self._park_epoch.pop(r, None)
+            self.heal_rejoins += 1
+            decision = QuorumDecision(
+                epoch=self.view.epoch, quorum=(r,), verdict="rejoin",
+            )
+            self.quorum_decisions.append(decision)
+            self.recorder.emit("ctl.quorum", now, rank=r,
+                               **decision.as_fields())
+            self.metrics.counter("quorum_transitions_total",
+                                 kind="rejoin").inc()
+            healed.append(r)
+        self.healed_partitions.append({
+            "fault": type(fault).__name__, "healed_at": now,
+            "rejoined": healed,
+        })
+        self._partition = None
+
     def step(self) -> None:
         """One tick of the serving loop. Order matters and is fixed:
         heartbeats/detection first (failover reroutes before sends),
@@ -594,11 +835,27 @@ class ServingFrontend:
                 self.metrics.counter("membership_transitions_total",
                                      kind="clear").inc()
             elif isinstance(tr, ConfirmedDead):
+                if self._partition is not None:
+                    # the rank is (probably) alive behind the cut:
+                    # remember it for heal-time rejoin, and fence the
+                    # failover itself — a control plane that cannot
+                    # mint a quorum token is the MINORITY side and
+                    # must park its actuation, not shrink the view
+                    self._partition_confirmed.add(tr.rank)
+                    if self.quorum_fencing:
+                        try:
+                            self.mint_quorum_token(
+                                rank=tr.rank,
+                                what=f"failover of rank {tr.rank}",
+                            )
+                        except QuorumLostError:
+                            continue
                 self.confirmed.append(tr.rank)
                 self.recorder.emit("ctl.confirm", now, rank=tr.rank)
                 self.metrics.counter("membership_transitions_total",
                                      kind="confirm").inc()
                 self._failover(tr.rank)
+        self._poll_quorum(now)
         self._consume()
         for lane in self.lanes:
             lane.view_epoch = self.view.epoch
@@ -656,6 +913,8 @@ class ServingFrontend:
             self._drive_retune(now)
         if self._migration is not None:
             self._drive_migration(now)
+        if self._partition is not None:
+            self._drive_partition(now)
         if self.elasticity is not None:
             self.elasticity.step(now)
         self.gate.assert_bounded()
@@ -795,7 +1054,15 @@ class ServingFrontend:
             if self._migration_drained():
                 self._migration_handoff(now)
         elif mig["state"] == "handoff":
-            self._migration_cutover(now)
+            try:
+                self._migration_cutover(now)
+            except QuorumLostError:
+                # the cutover's quorum mint failed: the control plane
+                # is partitioned away from a majority. Cutting over
+                # anyway could commit the tenant on BOTH sides — abort
+                # loudly instead, loss-free (the frozen streams thaw
+                # and finish on the source)
+                self._abort_migration("quorum-lost")
         elif mig["state"] == "cutover":
             self._migration_commit(now)
 
@@ -820,13 +1087,20 @@ class ServingFrontend:
 
     def _migration_cutover(self, now: int) -> None:
         mig = self._migration
+        # mint BEFORE touching any state: a QuorumLostError here must
+        # leave the migration cleanly abortable (nothing restored,
+        # nothing re-routed, no epoch moved)
+        token = self.mint_quorum_token(
+            rank=mig["dst"],
+            what=f"migration cutover {mig['src']}->{mig['dst']}",
+        )
         _rank, _step, payload, _crc = unpack_shard(
             mig["blob"], origin=f"migration:{mig['tenant']}",
         )
         restored = dict(pickle.loads(payload))
         old_epoch = self.view.epoch
         new_epoch = self.view.migrate_cutover(
-            mig["src"], mig["dst"], tenant=mig["tenant"],
+            mig["src"], mig["dst"], tenant=mig["tenant"], token=token,
         )
         self.metrics.counter("epoch_bumps_total",
                              reason="migrate").inc()
@@ -863,7 +1137,7 @@ class ServingFrontend:
         except StaleEpochError:
             self.stale_epoch_rejections += 1
         self.placement.pin(mig["tenant"], mig["dst"],
-                           reason="migrate")
+                           reason="migrate", token=token)
         mig["state"] = "cutover"
         # the ctl.migrate cutover event itself is emitted by
         # MembershipView.migrate_cutover, at the epoch-bump site
@@ -979,4 +1253,21 @@ class ServingFrontend:
                 "migrations": list(self.migrations),
                 "migrated_streams": self.migrated_streams,
             }} if self.elasticity is not None else {}),
+            # the partition-tolerance snapshot (r17): quorum lease
+            # verdicts, parked ranks, heal rejoins, and the one number
+            # that must stay zero — split-brain incidents. No
+            # partition injected = key absent, byte-for-byte the
+            # pre-r17 report
+            **({"partition": {
+                "fenced": self.quorum_fencing,
+                "partitions_injected": self.partitions_injected,
+                "quorum_losses": self.quorum_losses,
+                "quorum_rejections": self.quorum_rejections,
+                "heal_rejoins": self.heal_rejoins,
+                "split_brain_incidents": self.split_brain_accepts,
+                "parked": sorted(self._quorum_lost),
+                "healed": list(self.healed_partitions),
+                "decisions": [d.as_fields()
+                              for d in self.quorum_decisions],
+            }} if self.partitions_injected else {}),
         }

@@ -63,9 +63,21 @@ class PlacementMap:
         #: (tenant, base, reason) audit trail — "crc32" | "load" |
         #: "migrate"; the campaign report quotes it.
         self.decisions: list = []
+        #: (tenant, base, token_epoch) — pins written under a quorum
+        #: fencing token (the partition-tolerance audit trail).
+        self.fenced_pins: list = []
 
-    def pin(self, tenant: str, rank: int, reason: str = "migrate") -> None:
-        """Explicitly re-pin a tenant (the migration commit path)."""
+    def pin(self, tenant: str, rank: int, reason: str = "migrate",
+            token=None) -> None:
+        """Explicitly re-pin a tenant (the migration commit path).
+
+        ``token`` is the :class:`~smi_tpu.parallel.membership.FencingToken`
+        under which the write was authorised. The map records it in a
+        separate audit trail (``fenced_pins``) rather than widening the
+        ``decisions`` tuples — quorum *checking* is the minting caller's
+        job (``check_fencing_token`` against the live view); the map only
+        has to make the provenance auditable.
+        """
         if not 0 <= rank < self.n:
             raise ValueError(
                 f"cannot pin tenant {tenant!r} to rank {rank}: out of "
@@ -73,6 +85,8 @@ class PlacementMap:
             )
         self._pins[tenant] = rank
         self.decisions.append((tenant, rank, reason))
+        if token is not None:
+            self.fenced_pins.append((tenant, rank, token.epoch))
 
     def base_of(self, tenant: str) -> Optional[int]:
         """The tenant's pinned base, or None if never placed."""
@@ -128,4 +142,5 @@ class PlacementMap:
             "armed": self.armed,
             "tenants": len(self._pins),
             "decisions": {k: by_reason[k] for k in sorted(by_reason)},
+            "fenced_pins": len(self.fenced_pins),
         }

@@ -438,6 +438,13 @@ MODEL_MUTANT_SCOPE = {
     # migration arc and scale actuators are inert everywhere else)
     "cutover_without_handoff": A.DEFAULT_SCOPES[6],
     "scale_in_with_residents": A.DEFAULT_SCOPES[6],
+    # the r17 partition mutants each need a specific cut shape: the
+    # unfenced actuation is only WRONG where the reachable side is a
+    # minority (n=2 — both sides are), and the stale-side accept only
+    # collides with an heir where a quorate majority exists to fail
+    # the cut rank over (n=3)
+    "actuate_without_quorum": A.DEFAULT_SCOPES[7],
+    "accept_in_minority": A.DEFAULT_SCOPES[8],
 }
 
 
@@ -531,6 +538,30 @@ def test_model_migration_counterexamples_are_minimal():
     )
     kinds = [a[0] for a in report.findings[0].trace]
     assert kinds == ["admit", "scale_in"]
+
+
+@pytest.mark.model
+def test_model_partition_counterexamples_are_minimal():
+    """The r17 convictions are BFS-minimal: an unfenced failover is
+    wrong the moment it fires from a minority census (cut -> actuate,
+    two steps), and the split-brain needs the majority's legitimate
+    failover between the cut and the stale-side accept."""
+    report = A.check_scope(
+        MODEL_MUTANT_SCOPE["actuate_without_quorum"],
+        world_factory=A.model_mutant_world("actuate_without_quorum"),
+        mutant="actuate_without_quorum",
+    )
+    kinds = [a[0] for a in report.findings[0].trace]
+    assert kinds == ["partition_start", "partition_failover"]
+
+    report = A.check_scope(
+        MODEL_MUTANT_SCOPE["accept_in_minority"],
+        world_factory=A.model_mutant_world("accept_in_minority"),
+        mutant="accept_in_minority",
+    )
+    kinds = [a[0] for a in report.findings[0].trace]
+    assert kinds == ["partition_start", "partition_failover",
+                     "minority_accept"]
 
 
 @pytest.mark.model

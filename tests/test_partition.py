@@ -1,0 +1,334 @@
+"""Partition-tolerance tests: faults, fencing, cells, split-brain.
+
+The r17 robustness arc end to end: the partition-class fault trio
+(symmetric cut / one-way asymmetric cut / seeded flapping link), the
+``$SMI_TPU_QUORUM_FRACTION`` knob and quorum math, the fencing-token
+mint/check matrix (stale tokens rejected on the SAME
+``StaleEpochError`` rail as superseded incarnations), the serving
+front-end's minority-park / loud-refusal / heal-rejoin flow, the
+split-brain A/B (incidents present unfenced, ELIMINATED fenced), and
+the three seeded campaign cells. The 16-seed x n sweep over all
+three cells rides behind ``slow``.
+"""
+
+import pytest
+
+from smi_tpu.obs.events import EVENT_KINDS
+from smi_tpu.parallel.faults import (
+    PARTITION_FAULT_CLASSES,
+    AsymmetricLinkFault,
+    FlappingLink,
+    PartitionFault,
+)
+from smi_tpu.parallel.membership import (
+    DEFAULT_QUORUM_FRACTION,
+    QUORUM_FRACTION_ENV,
+    FencingToken,
+    MembershipView,
+    QuorumDecision,
+    QuorumLostError,
+    StaleEpochError,
+    check_fencing_token,
+    mint_fencing_token,
+    quorum_fraction,
+    quorum_size,
+)
+from smi_tpu.serving.campaign import (
+    MODEL_GATES,
+    PARTITION_CELLS,
+    _run_partition_traffic,
+    partition_campaign,
+    partition_selftest,
+    run_flapping_link_cell,
+    run_partition_cell,
+    run_partition_migration_cell,
+)
+
+pytestmark = pytest.mark.partition
+
+
+# ---------------------------------------------------------------------------
+# The partition-class fault trio
+# ---------------------------------------------------------------------------
+
+
+def test_partition_fault_cuts_both_directions_across_the_cut():
+    fault = PartitionFault(minority=frozenset({2}), from_tick=10,
+                          until_tick=20)
+    assert fault.blocks(2, 0, 10)       # minority -> majority
+    assert fault.blocks(0, 2, 19)       # majority -> minority
+    assert not fault.blocks(0, 1, 15)   # within the majority
+    assert not fault.blocks(2, 2, 15)   # within the minority
+    assert not fault.blocks(2, 0, 9)    # before the window
+    assert not fault.blocks(2, 0, 20)   # after the heal
+
+
+def test_asymmetric_fault_cuts_exactly_one_direction():
+    fault = AsymmetricLinkFault(src=2, dst=0, from_tick=10,
+                                until_tick=20)
+    assert fault.blocks(2, 0, 15)       # the dead direction
+    assert not fault.blocks(0, 2, 15)   # the live direction
+    assert not fault.blocks(2, 1, 15)   # other peers unaffected
+    assert not fault.blocks(2, 0, 20)
+
+
+def test_flapping_link_is_deterministic_and_windowed():
+    a = FlappingLink(a=0, b=2, from_tick=40, until_tick=160, seed=7)
+    b = FlappingLink(a=0, b=2, from_tick=40, until_tick=160, seed=7)
+    ticks_a = [t for t in range(200) if a.blocks(0, 2, t)]
+    ticks_b = [t for t in range(200) if b.blocks(2, 0, t)]
+    assert ticks_a == ticks_b           # deterministic, symmetric
+    assert ticks_a                      # the flap actually flaps
+    assert all(40 <= t < 160 for t in ticks_a)
+    # a flap is intermittent, never the whole window
+    assert len(ticks_a) < 120
+    assert not a.blocks(0, 1, 50)       # other links untouched
+
+
+def test_flapping_link_validation_is_loud():
+    with pytest.raises(ValueError, match="DISTINCT"):
+        FlappingLink(a=1, b=1)
+    with pytest.raises(ValueError, match="down_ticks"):
+        FlappingLink(a=0, b=1, period=4, down_ticks=5)
+    with pytest.raises(ValueError, match="window is empty"):
+        FlappingLink(a=0, b=1, from_tick=50, until_tick=50)
+
+
+def test_partition_fault_class_registry():
+    assert PARTITION_FAULT_CLASSES == (
+        "partition", "asymmetric_link", "flapping_link",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Quorum fraction: the env knob's loudness discipline
+# ---------------------------------------------------------------------------
+
+
+def test_quorum_fraction_default_is_strict_majority(monkeypatch):
+    monkeypatch.delenv(QUORUM_FRACTION_ENV, raising=False)
+    assert quorum_fraction() == DEFAULT_QUORUM_FRACTION == 0.5
+
+
+def test_quorum_fraction_env_and_explicit_precedence(monkeypatch):
+    monkeypatch.setenv(QUORUM_FRACTION_ENV, "0.75")
+    assert quorum_fraction() == 0.75
+    # the explicit argument outranks the environment
+    assert quorum_fraction(0.6) == 0.6
+
+
+@pytest.mark.parametrize("raw", ["garbage", "nan", "inf"])
+def test_quorum_fraction_rejects_malformed_env_loudly(monkeypatch,
+                                                      raw):
+    monkeypatch.setenv(QUORUM_FRACTION_ENV, raw)
+    with pytest.raises(ValueError):
+        quorum_fraction()
+
+
+@pytest.mark.parametrize("raw", ["0.49", "1.0", "-1", "2"])
+def test_quorum_fraction_rejects_unsafe_range_loudly(monkeypatch,
+                                                     raw):
+    # below 0.5 two disjoint quorums could coexist; 1.0 needs n+1 of n
+    monkeypatch.setenv(QUORUM_FRACTION_ENV, raw)
+    with pytest.raises(ValueError):
+        quorum_fraction()
+
+
+@pytest.mark.parametrize("n,expected", [
+    (1, 1), (2, 2), (3, 2), (4, 3), (5, 3), (8, 5),
+])
+def test_quorum_size_is_strict_majority(monkeypatch, n, expected):
+    monkeypatch.delenv(QUORUM_FRACTION_ENV, raising=False)
+    assert quorum_size(n) == expected
+
+
+def test_quorum_size_honours_fraction(monkeypatch):
+    monkeypatch.delenv(QUORUM_FRACTION_ENV, raising=False)
+    assert quorum_size(4, fraction=0.75) == 4
+    with pytest.raises(ValueError):
+        quorum_size(0)
+
+
+# ---------------------------------------------------------------------------
+# Fencing tokens: mint/check matrix
+# ---------------------------------------------------------------------------
+
+
+def test_mint_fencing_token_full_view_is_trivially_quorate():
+    view = MembershipView(4)
+    token = mint_fencing_token(view)
+    assert token == FencingToken(epoch=0,
+                                 quorum_set=frozenset({0, 1, 2, 3}))
+
+
+def test_mint_fencing_token_minority_raises_loudly():
+    view = MembershipView(4)
+    with pytest.raises(QuorumLostError) as err:
+        mint_fencing_token(view, reachable=[2], rank=2,
+                           what="cutover")
+    assert err.value.rank == 2
+    assert err.value.reachable == frozenset({2})
+    assert err.value.needed == 3
+    assert "park" in str(err.value)
+
+
+def test_check_fencing_token_stale_epoch_rides_the_straggler_rail():
+    view = MembershipView(4)
+    token = mint_fencing_token(view)
+    view.confirm_dead(3)  # epoch moves; the token is now a straggler
+    with pytest.raises(StaleEpochError):
+        check_fencing_token(view, token)
+
+
+def test_check_fencing_token_filtered_quorum_is_rejected():
+    view = MembershipView(4)
+    forged = FencingToken(epoch=0, quorum_set=frozenset({1}))
+    with pytest.raises(QuorumLostError):
+        check_fencing_token(view, forged)
+
+
+def test_check_fencing_token_none_mints_the_healthy_path():
+    view = MembershipView(4)
+    token = check_fencing_token(view, None)
+    assert token.epoch == view.epoch
+    # a valid token round-trips
+    assert check_fencing_token(view, token) is token
+
+
+def test_quorum_decision_fields_match_the_event_schema():
+    decision = QuorumDecision(epoch=3, quorum=(0, 1, 2),
+                              verdict="minted")
+    fields = decision.as_fields()
+    plane, keys = EVENT_KINDS["ctl.quorum"]
+    assert plane == "control"
+    assert set(fields) == set(keys)
+    assert fields["quorum"] == "0,1,2"
+
+
+# ---------------------------------------------------------------------------
+# The front end: minority park, loud refusal, heal rejoin
+# ---------------------------------------------------------------------------
+
+
+def test_partition_cell_parks_refuses_loudly_and_rejoins():
+    report, fe = run_partition_cell(n=4, seed=0, return_frontend=True)
+    assert report["ok"], report["verdict"]
+    part = report["partition"]
+    assert part["fenced"]
+    assert part["quorum_losses"] >= 1
+    assert part["quorum_rejections"] >= 1
+    # every refusal surfaced to the caller as QuorumLostError
+    assert report["quorum_rejected_seen"] == part["quorum_rejections"]
+    assert part["heal_rejoins"] >= 1
+    assert part["split_brain_incidents"] == 0
+    assert part["parked"] == []
+    assert report["members"] == [0, 1, 2, 3]
+    assert report["stale_epoch_rejections"] >= 1
+    assert report["stale_epoch_leaks"] == 0
+    assert report["lost_accepted"] == 0
+    assert report["digest_match"]
+    # the fencing decisions are on the record, loud and structured
+    verdicts = {d["verdict"] for d in part["decisions"]}
+    assert {"lost", "rejected", "rejoin"} <= verdicts
+    kinds = {e["kind"] for e in fe.recorder.tail(10_000)["events"]}
+    assert "ctl.quorum" in kinds
+
+
+def test_split_brain_present_unfenced_eliminated_fenced():
+    """The PR's headline A/B: the same cut, with and without the
+    quorum fence. Unfenced, the cut rank keeps accepting streams the
+    majority has already rerouted — split-brain incidents. Fenced,
+    those accepts become loud refusals and the incident count is
+    ZERO."""
+    unfenced, _, _, unfenced_rejected = _run_partition_traffic(
+        4, 0, 240, 3, 64, fenced=False, fault_kind="partition",
+        partition_at=60, window=100)
+    fenced, _, _, fenced_rejected = _run_partition_traffic(
+        4, 0, 240, 3, 64, fenced=True, fault_kind="partition",
+        partition_at=60, window=100)
+    assert unfenced.split_brain_accepts > 0
+    assert unfenced_rejected == 0       # nothing was ever refused
+    assert fenced.split_brain_accepts == 0
+    assert fenced_rejected > 0          # refusals, loud and counted
+    assert fenced.report()["lost_accepted"] == 0
+
+
+def test_asymmetric_cut_aborts_migration_loudly_loss_free():
+    report = run_partition_migration_cell(n=4, seed=0)
+    assert report["ok"], report["verdict"]
+    migs = report["elasticity"]["migrations"]
+    assert [m["state"] for m in migs] == ["aborted"]
+    assert migs[0]["abort_reason"] in ("membership-change",
+                                       "quorum-lost")
+    assert report["lost_accepted"] == 0
+    assert report["silent_corruptions"] == 0
+    assert report["confirmed"] == [report["src"]]
+    assert report["members"] == [0, 1, 2, 3]  # rejoined at the heal
+
+
+def test_flapping_link_never_moves_membership():
+    report = run_flapping_link_cell(n=4, seed=0)
+    assert report["ok"], report["verdict"]
+    assert report["epoch"] == 0
+    assert report["confirmed"] == []
+    assert report["suspected"]          # the soak engaged
+    assert len(report["cleared"]) == len(report["suspected"])
+    part = report["partition"]
+    assert part["quorum_losses"] == 0
+    assert part["quorum_rejections"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Campaign plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_partition_campaign_aggregates_and_narrows():
+    report = partition_campaign(seed=0, n=4, trials=1)
+    assert report["ok"], report["failures"]
+    assert report["cells"] == len(PARTITION_CELLS) == 3
+    assert report["split_brain_incidents"] == 0
+    narrowed = partition_campaign(seed=0, n=4, trials=1,
+                                  only="flapping-link")
+    assert narrowed["ok"]
+    assert narrowed["cells"] == 1
+    with pytest.raises(ValueError, match="unknown partition cell"):
+        partition_campaign(only="nope")
+
+
+def test_partition_selftest_is_the_clean_cell():
+    report = partition_selftest(seed=0)
+    assert report["ok"], report["verdict"]
+    assert report["partition"]["heal_rejoins"] >= 1
+
+
+def test_partition_cell_guards_are_loud():
+    with pytest.raises(ValueError, match="minimum"):
+        run_partition_cell(n=4, duration=60)
+    with pytest.raises(ValueError, match="lease"):
+        run_partition_cell(n=4, window=10)
+    with pytest.raises(ValueError, match="post-heal"):
+        run_partition_cell(n=4, duration=240, partition_at=100,
+                           window=120)
+    with pytest.raises(ValueError, match="stall_at"):
+        run_partition_migration_cell(n=4, stall_at=80, migrate_at=70)
+
+
+def test_model_gates_name_the_partition_properties():
+    assert MODEL_GATES["no-split-brain"]
+    assert MODEL_GATES["fenced-actuation"]
+
+
+# ---------------------------------------------------------------------------
+# The wide sweep (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [4, 8])
+@pytest.mark.parametrize("seed", range(16))
+def test_partition_cells_sweep(n, seed):
+    for cell in (run_partition_cell, run_partition_migration_cell,
+                 run_flapping_link_cell):
+        r = cell(n=n, seed=seed)
+        assert r["ok"], (cell.__name__, n, seed, r["verdict"])

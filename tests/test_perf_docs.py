@@ -235,6 +235,59 @@ def test_elasticity_doc_quotes_the_shipped_constants():
     assert "serve --selftest --autoscale" in text
 
 
+def test_partition_doc_quotes_the_shipped_constants():
+    """docs/robustness.md's "Partition tolerance" section must state
+    the fault-class trio, the quorum env knob / safe range /
+    built-in fraction, the fencing verdict vocabulary (the
+    ``ctl.quorum`` event's payload), the three campaign cells with
+    their CLI surfaces, and the model tier's partition properties
+    and mutants with their convictions — the same drift discipline
+    as the elasticity section. (Pure Python imports, no devices.)"""
+    from smi_tpu import analysis
+    from smi_tpu.parallel import faults as F
+    from smi_tpu.parallel import membership as M
+
+    text = _read("docs/robustness.md")
+    assert "Partition tolerance" in text
+    # the fault trio, by class name, and the registry they ride
+    for cls in ("PartitionFault", "AsymmetricLinkFault",
+                "FlappingLink"):
+        assert cls in text, f"fault class {cls} undocumented"
+    assert "PARTITION_FAULT_CLASSES" in text
+    assert len(F.PARTITION_FAULT_CLASSES) == 3
+    # the quorum knob: env name, built-in fraction, safe range
+    assert f"${M.QUORUM_FRACTION_ENV}" in text
+    assert f"built-in {M.DEFAULT_QUORUM_FRACTION:g}" in text
+    assert "[0.5, 1.0)" in text
+    # the full fencing verdict vocabulary, as ctl.quorum emits it
+    for verdict in ("minted", "granted", "denied", "stale", "lost",
+                    "rejected", "rejoin"):
+        assert verdict in text, f"verdict {verdict!r} undocumented"
+    assert "`ctl.quorum`" in text
+    assert "`QuorumLostError`" in text
+    assert "`StaleEpochError`" in text
+    # the model tier's partition properties + both mutants, with the
+    # conviction mapping the registry ships
+    for name in ("no-split-brain", "fenced-actuation",
+                 "actuate_without_quorum", "accept_in_minority"):
+        assert f"`{name}`" in text, f"{name} undocumented"
+    assert (analysis.MODEL_MUTANT_PROPERTY["actuate_without_quorum"]
+            == "fenced-actuation")
+    assert (analysis.MODEL_MUTANT_PROPERTY["accept_in_minority"]
+            == "no-split-brain")
+    partition_scopes = [s for s in analysis.DEFAULT_SCOPES
+                        if s.partition]
+    assert sorted(s.ranks for s in partition_scopes) == [2, 3]
+    assert "partition=1" in text
+    # the three cells and the CLI surfaces
+    for cell in ("partition-heal", "partition-migration-abort",
+                 "flapping-link"):
+        assert cell in text, f"cell {cell} undocumented"
+    assert "FLAP_VECTOR_ATTEMPTS" in text
+    assert "chaos --partition" in text
+    assert "serve --selftest --partition" in text
+
+
 def test_two_tier_docs_quote_the_shipped_rates_and_gates():
     """The r6 two-tier sections (docs/tuning.md decision table,
     docs/perf_notes.md "Two-tier collectives (r6)") must state the
