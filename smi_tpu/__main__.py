@@ -2234,6 +2234,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
         sweep_allreduce_hierarchical,
         sweep_alltoall,
         sweep_flash,
+        sweep_stencil,
     )
 
     path = args.cache or default_cache_path()
@@ -2244,10 +2245,10 @@ def cmd_tune(args: argparse.Namespace) -> int:
     ops = args.ops or ["all_reduce"]
     unknown = [o for o in ops
                if o not in ("all_reduce", "flash_fwd", "hierarchical",
-                            "alltoall")]
+                            "alltoall", "stencil")]
     if unknown:
         print(f"error: unknown op(s) {unknown}; sweepable: "
-              f"all_reduce, flash_fwd, hierarchical, alltoall",
+              f"all_reduce, flash_fwd, hierarchical, alltoall, stencil",
               file=sys.stderr)
         return 2
     if "hierarchical" in ops and not args.slices:
@@ -2327,6 +2328,11 @@ def cmd_tune(args: argparse.Namespace) -> int:
             print("  skipped: flash sweep needs a TPU backend "
                   "(interpreter timings are not kernel truth)")
         measured.merge(got)
+    if "stencil" in ops:
+        print("sweeping stencil pipeline candidates (depth x stripe x "
+              "compute dtype; CPU hosts gate correctness in interpret "
+              "mode and price with the replay-adjusted model)")
+        measured.merge(sweep_stencil(runs=args.runs, verbose=True))
     try:
         disk = PlanCache.load(path) if os.path.exists(path) else PlanCache()
     except PlanCacheError as e:
@@ -2945,10 +2951,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--explain", default=None, metavar="OP",
                    help="print the plan decision table for OP "
                         "(all_reduce, all_to_all, flash_fwd, "
-                        "stencil_temporal, ring_all_reduce) instead "
-                        "of sweeping — CPU-deterministic, no hardware "
-                        "needed; an online-won entry renders as "
-                        "[live] naming its sample count and margin")
+                        "stencil, stencil_temporal, ring_all_reduce) "
+                        "instead of sweeping — CPU-deterministic, no "
+                        "hardware needed; an online-won entry renders "
+                        "as [live] naming its sample count and margin")
     p.add_argument("--online", default=None, metavar="SINK_JSON",
                    help="replay a recorded SampleSink JSON (the "
                         "tracing.timed(sink=) aggregate) through the "
@@ -2967,7 +2973,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "flat-vs-two-tier over --slices N virtual "
                         "slices and persists the measured crossover; "
                         "alltoall times pairwise vs Bruck vs "
-                        "hierarchical per payload bucket)")
+                        "hierarchical per payload bucket; stencil "
+                        "sweeps the r18 double-buffered pipeline "
+                        "depth x stripe x compute-dtype grid)")
     p.add_argument("--slices", type=int, default=None, metavar="N",
                    help="pod slice count: with --explain, price the "
                         "all_reduce/all_to_all tables for an N-slice "

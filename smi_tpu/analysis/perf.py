@@ -866,6 +866,142 @@ def serialized_dma_findings(hlo_text: str) -> List[PerfFinding]:
     return findings
 
 
+# -- stencil stripe stream (r18 roofline closure) ----------------------------
+
+#: The stream model is two ranks: the HBM side (0) pushing stripes and
+#: collecting writebacks, and the compute core (1) consuming them.
+STENCIL_STREAM_RANKS = 2
+
+#: Default stripe payload of the replay: the shipped pipeline's
+#: t=128 x (8192 + 2*128) lanes x 4 B extended stripe.
+STENCIL_STRIPE_BYTES = 128 * (8192 + 256) * 4
+
+
+def stencil_stream_generators(
+    chunks: int, buffering: int,
+) -> List[Iterator]:
+    """Per-rank generators of the stencil stripe stream at one
+    buffering depth — the credits-vocabulary twin of the explicit-DMA
+    kernel (``kernels/stencil_pipeline.py``), so the PR 7 verifier and
+    the decomposer can certify/price the SAME slot-rotation discipline
+    the Pallas kernel hand-codes with ``pltpu.SemaphoreType.DMA``.
+
+    ``buffering == 1`` is the synchronous control path: the HBM side
+    issues fetch ``i`` only after consuming writeback ``i - 1``, so
+    every stripe flight sits on the critical path twice — the shape
+    whose replay the ``idle-fraction`` finding must name.
+
+    ``buffering >= 2`` is the slot rotation: ``buffering`` fetches run
+    ahead of the consumer (fetch-slot reuse fenced by the consumer's
+    read credit — the sim twin of the kernel's writeback-semaphore
+    wait before reusing a VMEM slot), and writebacks stream into
+    per-stripe HBM-side slots the moment each stripe is consumed —
+    HBM is the destination, so there is no landing-slot scarcity to
+    fence, exactly as in the kernel. Credit grants are counted exactly
+    (``chunks - buffering``) so the verifier's leak check drains to
+    zero, and with the canonical stripe count every wait lands inside
+    an already-issued DMA window (idle under the threshold on BOTH
+    ranks).
+    """
+    if chunks < 1 or buffering < 1:
+        raise ValueError(
+            f"stencil stream needs chunks >= 1 and buffering >= 1, "
+            f"got chunks={chunks} buffering={buffering}"
+        )
+
+    if buffering == 1:
+        def hbm_sync():
+            for i in range(chunks):
+                yield ("dma", 1, 0, ("stripe", i), 0, 0)
+                yield ("wait", C.SEM_SEND, 0, 1)
+                yield ("wait", C.SEM_RECV, 0, 1)
+                done = yield ("read_slot", 0)
+                yield ("output", i, done)
+
+        def core_sync():
+            for i in range(chunks):
+                yield ("wait", C.SEM_RECV, 0, 1)
+                stripe = yield ("read_slot", 0)
+                yield ("dma", 0, 0, stripe, 0, 0)
+                yield ("wait", C.SEM_SEND, 0, 1)
+
+        return [hbm_sync(), core_sync()]
+
+    depth = buffering
+
+    def hbm_stream():
+        for i in range(chunks):
+            slot = i % depth
+            if i >= depth:
+                # fetch-slot reuse fenced by the consumer's read credit
+                yield ("wait", C.SEM_CREDIT, slot, 1)
+            yield ("dma", 1, slot, ("stripe", i), slot, slot)
+            yield ("wait", C.SEM_SEND, slot, 1)
+            if i >= depth:
+                j = i - depth
+                yield ("wait", C.SEM_RECV, ("wb", j), 1)
+                done = yield ("read_slot", ("wb", j))
+                yield ("output", j, done)
+        for j in range(max(0, chunks - depth), chunks):
+            yield ("wait", C.SEM_RECV, ("wb", j), 1)
+            done = yield ("read_slot", ("wb", j))
+            yield ("output", j, done)
+
+    def compute_core():
+        for i in range(chunks):
+            slot = i % depth
+            yield ("wait", C.SEM_RECV, slot, 1)
+            stripe = yield ("read_slot", slot)
+            if i < chunks - depth:
+                yield ("signal", 0, C.SEM_CREDIT, slot, 1)
+            yield ("dma", 0, ("wb", i), stripe, ("wb", i), ("wb", i))
+            yield ("wait", C.SEM_SEND, ("wb", i), 1)
+
+    return [hbm_stream(), compute_core()]
+
+
+#: Canonical stripe count of the replay: one 8192-row pass at the
+#: shipped stripe width t=128 (startup transients amortize away at
+#: this length — shorter replays book the fill/drain ramp as idle).
+STENCIL_STREAM_STRIPES = 64
+
+
+def decompose_stencil_stream(
+    n_stripes: int = STENCIL_STREAM_STRIPES,
+    stripe_bytes: float = float(STENCIL_STRIPE_BYTES),
+    buffering: int = 3,
+    seed: int = 0,
+    verify: bool = True,
+) -> PerfReport:
+    """Verify + decompose the stencil stripe stream at one buffering
+    depth — the overlap PROOF behind the r18 pipeline claim: the
+    synchronous replay exceeds :data:`IDLE_FRACTION_THRESHOLD` on the
+    DMA wait edge, the pipelined replay stays under it with measured
+    wire depth >= 2 (``tests/test_stencil_pipeline.py`` asserts both
+    sides, ``bench.py`` ships the pipelined fraction)."""
+    costs = C.default_tier_costs(stripe_bytes, 0)
+    return decompose_generators(
+        lambda: stencil_stream_generators(n_stripes, buffering),
+        costs,
+        protocol=f"stencil_stream_b{buffering}",
+        shape={"n": STENCIL_STREAM_RANKS, "chunks": n_stripes,
+               "buffering": buffering},
+        payload_bytes=n_stripes * stripe_bytes,
+        pipeline_chunks=n_stripes if buffering >= 2 else 1,
+        seed=seed, verify=verify,
+    )
+
+
+def stencil_overlap_fraction(report: PerfReport) -> float:
+    """The decomposer-measured share of the stripe stream hidden
+    behind compute: one minus the worst per-rank idle fraction of the
+    replay (1.0 = every wait landed inside an already-issued DMA
+    window — perfect overlap)."""
+    worst = max((r["idle_fraction"] for r in report.per_rank),
+                default=0.0)
+    return max(0.0, 1.0 - worst)
+
+
 # -- analytic regression -----------------------------------------------------
 
 #: Committed static predictions (microseconds) at the published rates —
@@ -889,6 +1025,8 @@ ANALYTIC_EXPECTED_US = {
     "alltoall_two_tier_2x2_1mib_us": 957.4,
     "flash_fwd_bf16_seeded_roofline_us": 174.4,
     "flash_fwd_f32_seeded_roofline_us": 523.2,
+    "stencil_pipeline_8192_sweep_us": 318.6,
+    "stencil_sync_8192_sweep_us": 390.1,
 }
 
 
@@ -974,6 +1112,15 @@ def analytic_predictions() -> Dict[str, float]:
                                  bq, itemsize),
             dtype,
         ), 1)
+    # r18: one 8192^2 sweep under the seeded pipeline knobs vs the
+    # synchronous control — the margin the measured sweep must confirm
+    out["stencil_pipeline_8192_sweep_us"] = round(
+        cm.stencil_pipeline_us(8192, 8192, 8, 128, "float32"), 1
+    )
+    out["stencil_sync_8192_sweep_us"] = round(
+        cm.stencil_pipeline_us(8192, 8192, 16, 128, "float32",
+                               buffering=1), 1
+    )
     return out
 
 

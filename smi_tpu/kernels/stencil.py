@@ -40,14 +40,27 @@ from smi_tpu.parallel.mesh import Communicator
 STRIPE_BYTES_TARGET = 2_500_000
 
 
-def _pick_tile(h: int, w: int) -> Optional[int]:
-    """Largest divisor of ``h`` that is a multiple of the f32 sublane
-    count (8) and fits the per-stripe VMEM budget."""
+def pick_tile_explained(h: int, w: int):
+    """``(tile, note)``: the fused kernel's stripe height with its
+    reason, or ``(None, reason)`` naming exactly why the shape falls
+    back to the unfused path — the r18 no-silent-caps companion of
+    :func:`_pick_tile` that ``tune --explain stencil`` renders."""
     limit = max(8, STRIPE_BYTES_TARGET // (w * 4))
     for t in range(min(limit, h), 7, -1):
         if h % t == 0 and t % 8 == 0:
-            return t
-    return None
+            return t, (f"tile {t}: largest 8-aligned divisor of h={h} "
+                       f"inside the {STRIPE_BYTES_TARGET} B stripe "
+                       f"budget at w={w}")
+    return None, (f"EXCLUDED: no 8-aligned divisor of h={h} at or "
+                  f"under {min(limit, h)} rows fits the "
+                  f"{STRIPE_BYTES_TARGET} B stripe budget at w={w} — "
+                  f"unfused fallback")
+
+
+def _pick_tile(h: int, w: int) -> Optional[int]:
+    """Largest divisor of ``h`` that is a multiple of the f32 sublane
+    count (8) and fits the per-stripe VMEM budget."""
+    return pick_tile_explained(h, w)[0]
 
 
 def pallas_supported(h: int, w: int, dtype) -> bool:

@@ -1,0 +1,451 @@
+"""Double-buffered HBM->VMEM stencil pipeline with explicit DMA semaphores.
+
+The r18 roofline-closure tier. The temporal kernel
+(:mod:`smi_tpu.kernels.stencil_temporal`) streams stripes through the
+implicit BlockSpec pipeline: Mosaic owns the fetch schedule and the
+halo rows ride two extra VMEM operands stitched in per grid step. This
+module takes the fetch schedule back, the exact shape of SNIPPETS.md
+[1] (``pltpu.SemaphoreType.DMA`` scratch under ``shard_map``): the
+block lives in HBM (``memory_space=ANY``), a three-slot VMEM rotation
+carries the stripes, and every move is an explicit
+``pltpu.make_async_copy`` against a DMA-semaphore slot —
+
+    fetch stripe i+1 -> slot (i+1)%3     (starts before compute)
+    compute stripe i  in slot  i%3       (k trapezoid sweeps, in place)
+    write back i-1 from slot (i-1)%3     (landed two steps later)
+
+so the stripe fetch, the k-sweep compute, and the writeback of the
+previous stripe are in flight *simultaneously*, and the halo refresh is
+fused into the same pipeline: the corner-complete halo rows are
+prepended/appended to the extended state ONCE per pass, after which
+every stripe DMA carries its own ``k``-row aprons — there is no
+separate halo-application pass and no extra VMEM operand.
+
+Knobs (all priced in ``tuning/cost_model.stencil_pipeline_candidates``
+and swept by ``tuning/sweep.sweep_stencil``):
+
+- ``depth``    — sweeps per HBM pass (8..32; beyond the temporal
+  tier's 16, because overlap changes the knee — see
+  docs/perf_notes.md "Roofline closure (r18)");
+- ``stripe``   — rows per DMA chunk (the stripe-width sweep);
+- ``compute_dtype`` — ``float32`` (bit-identical to the reference
+  Jacobi step) or ``bfloat16`` (neighbour values rounded to bf16, the
+  4-point average accumulated in f32 — the property-bounded-error
+  contract, tests/test_stencil_pipeline.py);
+- ``buffering`` — 3 (the pipeline) or 1 (the synchronous control the
+  sweep and the perf decomposer compare against; never shipped).
+
+VMEM cost is ``buffering * (stripe + 2*depth) * (w + 256) * 4`` bytes
+(the working buffers are always f32 — bf16 exists only inside the
+sweep arithmetic). The mirror lives in
+``cost_model.stencil_pipeline_vmem_bytes`` and is drift-guarded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from smi_tpu.parallel.halo import (
+    halo_exchange_2d_corners_finish,
+    halo_exchange_2d_corners_start,
+)
+from smi_tpu.parallel.mesh import Communicator
+from smi_tpu.kernels.stencil_temporal import LANE_PAD, _sweep_trapezoid
+
+#: Slot count of the shipped rotation: fetch / compute / writeback each
+#: own one buffer generation. 1 is the synchronous control path.
+PIPELINE_SLOTS = 3
+
+#: VMEM budget the stripe picker plans against — the full Mosaic
+#: scoped-VMEM frame, because the pipeline's three slots ARE the
+#: buffering (there is no hidden BlockSpec double-buffer on top).
+#: MUST equal ``cost_model.VMEM_LIMIT_BYTES`` (drift-guarded).
+PIPELINE_VMEM_BYTES = 16 * 1024 * 1024
+
+#: Compute dtypes the sweep arithmetic accepts.
+COMPUTE_DTYPES = ("float32", "bfloat16")
+
+
+def pipeline_vmem_bytes(stripe: int, w: int, depth: int,
+                        buffering: int = PIPELINE_SLOTS) -> int:
+    """VMEM footprint of the slot rotation (buffers are always f32)."""
+    return buffering * (stripe + 2 * depth) * (w + 2 * LANE_PAD) * 4
+
+
+def pick_pipeline_stripe_explained(
+    h: int, w: int, depth: int, buffering: int = PIPELINE_SLOTS,
+) -> Tuple[Optional[int], str]:
+    """``(stripe, note)``: the tallest feasible stripe, or ``(None,
+    reason)`` naming exactly why the shape falls back to the unfused
+    path — the no-silent-caps contract ``tune --explain stencil``
+    prints (the r18 small-fix: the legacy pickers returned a bare
+    ``None``)."""
+    if depth < 1 or depth % 8 or depth > LANE_PAD:
+        return None, (
+            f"depth {depth} outside the sublane-aligned range "
+            f"8..{LANE_PAD} (must be a multiple of 8)"
+        )
+    if w % 128:
+        return None, (
+            f"w={w} is not lane-aligned (128) — the extended layout "
+            f"cannot pad it; falls back to the unfused jnp path"
+        )
+    best = None
+    for t in range(h, 7, -1):
+        if h % t or t % 8 or t < depth:
+            continue
+        if pipeline_vmem_bytes(t, w, depth, buffering) <= PIPELINE_VMEM_BYTES:
+            best = t
+            break
+    if best is None:
+        floor = pipeline_vmem_bytes(8, w, depth, buffering)
+        return None, (
+            f"no 8-aligned stripe divides h={h} within the "
+            f"{PIPELINE_VMEM_BYTES // 1024} KiB VMEM frame at "
+            f"depth {depth} ({buffering} slots; even an 8-row stripe "
+            f"needs {floor // 1024} KiB) — falls back to the "
+            f"unfused path"
+        )
+    return best, f"stripe {best} ({buffering} slots)"
+
+
+def _pick_pipeline_stripe(h: int, w: int, depth: int,
+                          buffering: int = PIPELINE_SLOTS) -> Optional[int]:
+    return pick_pipeline_stripe_explained(h, w, depth, buffering)[0]
+
+
+def pipeline_supported(
+    h: int, w: int, dtype, depth: int,
+    stripe: Optional[int] = None,
+    compute_dtype: str = "float32",
+    buffering: int = PIPELINE_SLOTS,
+) -> bool:
+    """True when the explicit-DMA pipeline can run this block shape."""
+    if dtype != jnp.float32 or compute_dtype not in COMPUTE_DTYPES:
+        return False
+    if buffering not in (1, PIPELINE_SLOTS):
+        return False
+    if stripe is not None:
+        return (
+            depth >= 1 and depth % 8 == 0 and depth <= LANE_PAD
+            and w % 128 == 0
+            and h % stripe == 0 and stripe % 8 == 0 and stripe >= depth
+            and pipeline_vmem_bytes(stripe, w, depth, buffering)
+            <= PIPELINE_VMEM_BYTES
+        )
+    return _pick_pipeline_stripe(h, w, depth, buffering) is not None
+
+
+def _sweep_trapezoid_mixed(val, boundary, t: int, k: int, lane_w: int,
+                           compute_dtype: str):
+    """The k-sweep trapezoid with the bf16-compute/f32-accumulate
+    variant.
+
+    ``float32`` delegates to the temporal tier's
+    :func:`_sweep_trapezoid` UNCHANGED — the f32 path is bit-identical
+    to the reference Jacobi step by construction, not by tolerance.
+
+    ``bfloat16`` rounds the neighbour values to bf16 before the four
+    rolls (the traffic the crossbar would carry on hardware) and
+    accumulates the 4-point average in f32: the state array stays f32
+    across sweeps, so error is one bf16 input-rounding per neighbour
+    per sweep — the property-bounded contract the tests pin.
+    """
+    if compute_dtype == "float32":
+        return _sweep_trapezoid(val, boundary, t, k, lane_w)
+    off = 0
+    R = t + 2 * k
+    for s in range(k):
+        lo = 8 * (s // 8)
+        if lo > off:
+            d = lo - off
+            val = val[d : val.shape[0] - d, :]
+            off = lo
+        rows = R - 2 * off
+        vb = val.astype(jnp.bfloat16)
+        # same sublane-first association as the f32 tier; each rolled
+        # bf16 operand widens back to f32 before it joins the sum
+        avg = 0.25 * (
+            pltpu.roll(vb, 1, axis=0).astype(jnp.float32)
+            + pltpu.roll(vb, rows - 1, axis=0).astype(jnp.float32)
+            + pltpu.roll(vb, 1, axis=1).astype(jnp.float32)
+            + pltpu.roll(vb, lane_w - 1, axis=1).astype(jnp.float32)
+        )
+        val = jnp.where(boundary[off : R - off, :], val, avg)
+    return val, off
+
+
+def _pipeline_kernel(
+    offs_ref,   # scalar prefetch: [row0, col0] of this block
+    x_ref,      # (H + 2k, W+256) extended state + fused halo rows, ANY
+    o_ref,      # (H, W+256) output, ANY
+    buf_ref,    # scratch: (slots, stripe + 2k, W+256) VMEM rotation
+    in_sems,    # scratch: DMA((slots,)) fetch semaphores
+    out_sems,   # scratch: DMA((slots,)) writeback semaphores
+    *,
+    tile: int,
+    width: int,  # W (unpadded)
+    depth: int,
+    gh: int,
+    gw: int,
+    compute_dtype: str,
+    buffering: int,
+):
+    t, k = tile, depth
+    wp = width + 2 * LANE_PAD
+    h = o_ref.shape[0]
+    n = h // t  # stripe count (static)
+
+    def fetch(i, slot):
+        # stripe i's interior plus both k-row aprons in ONE copy: the
+        # halo refresh is fused into the stripe stream (rows [i*t,
+        # i*t + t + 2k) of the (H+2k)-row extended array)
+        return pltpu.make_async_copy(
+            x_ref.at[pl.ds(i * t, t + 2 * k)],
+            buf_ref.at[slot],
+            in_sems.at[slot],
+        )
+
+    def writeback(i, slot):
+        return pltpu.make_async_copy(
+            buf_ref.at[slot, pl.ds(k, t)],
+            o_ref.at[pl.ds(i * t, t)],
+            out_sems.at[slot],
+        )
+
+    def compute(i, slot):
+        # sweep-invariant Dirichlet masks from global coordinates
+        g_row = (
+            offs_ref[0] + i * t - k
+            + lax.broadcasted_iota(jnp.int32, (t + 2 * k, 1), 0)
+        )
+        g_col = (
+            offs_ref[1] - LANE_PAD
+            + lax.broadcasted_iota(jnp.int32, (1, wp), 1)
+        )
+        boundary = ((g_row == 0) | (g_row == gh - 1)
+                    | (g_col == 0) | (g_col == gw - 1))
+        val, off = _sweep_trapezoid_mixed(
+            buf_ref[slot], boundary, t, k, wp, compute_dtype
+        )
+        # in-place: the slot's interior rows become the output stripe
+        buf_ref[slot, pl.ds(k, t)] = val[k - off : t + k - off, :]
+
+    if buffering == 1:
+        # the synchronous control path: every stage serializes
+        def sync_body(i, carry):
+            fetch(i, 0).start()
+            fetch(i, 0).wait()
+            compute(i, 0)
+            writeback(i, 0).start()
+            writeback(i, 0).wait()
+            return carry
+
+        lax.fori_loop(0, n, sync_body, 0)
+        return
+
+    slots = PIPELINE_SLOTS
+    fetch(0, 0).start()
+
+    def body(i, carry):
+        slot = lax.rem(i, slots)
+        nxt = lax.rem(i + 1, slots)
+
+        @pl.when(i + 1 < n)
+        def _prefetch():
+            # slot `nxt` last held stripe i-2; its writeback must have
+            # landed before the fetch overwrites it
+            @pl.when(i + 1 >= slots)
+            def _reclaim():
+                writeback(i - 2, nxt).wait()
+
+            fetch(i + 1, nxt).start()
+
+        fetch(i, slot).wait()
+        compute(i, slot)
+        writeback(i, slot).start()
+        return carry
+
+    lax.fori_loop(0, n, body, 0)
+    # drain: the last min(3, n) writebacks never had a reclaiming fetch
+    for j in range(max(0, n - slots), n):
+        writeback(j, j % slots).wait()
+
+
+def _pipeline_pass_ext(
+    xext: jax.Array,
+    comm: Communicator,
+    gh: int,
+    gw: int,
+    depth: int,
+    stripe: Optional[int],
+    compute_dtype: str,
+    buffering: int,
+    interpret: bool,
+) -> jax.Array:
+    """One k-sweep explicit-DMA pass over the extended state (H, W+256)."""
+    row_axis, col_axis = comm.axis_names
+    h, wp = xext.shape
+    w = wp - 2 * LANE_PAD
+    k = depth
+    t = stripe if stripe is not None else _pick_pipeline_stripe(
+        h, w, k, buffering
+    )
+    if t is None or not pipeline_supported(
+        h, w, xext.dtype, k, stripe=t, compute_dtype=compute_dtype,
+        buffering=buffering,
+    ):
+        if stripe is not None:
+            note = (
+                f"requested stripe {stripe} is not an 8-aligned "
+                f"divisor of h={h} that is >= depth {k} and fits the "
+                f"{PIPELINE_VMEM_BYTES // 1024} KiB VMEM frame"
+            )
+        else:
+            _, note = pick_pipeline_stripe_explained(h, w, k, buffering)
+        raise ValueError(
+            f"stencil pipeline unsupported for block ({h}, {w}) at "
+            f"depth {k}: {note}"
+        )
+
+    # --- corner-complete halo refresh, fused into the stripe stream ---
+    # Identical split form to the temporal tier: the column updates
+    # consume only phase-1 slabs while the vertical ppermutes fly. The
+    # received rows then become the FIRST and LAST k rows of the
+    # extended array, so every stripe DMA carries its own aprons.
+    exchange = halo_exchange_2d_corners_start(
+        xext[:, LANE_PAD : LANE_PAD + w], comm, depth=k
+    )
+    xext = lax.dynamic_update_slice(xext, exchange.left,
+                                    (0, LANE_PAD - k))
+    xext = lax.dynamic_update_slice(xext, exchange.right,
+                                    (0, LANE_PAD + w))
+    zrow = jnp.zeros((k, LANE_PAD - k), xext.dtype)
+    rx = lax.axis_index(row_axis)
+    cy = lax.axis_index(col_axis)
+    offs = jnp.stack([rx * h, cy * w]).astype(jnp.int32)
+    halos = halo_exchange_2d_corners_finish(exchange)
+    top_ext = jnp.concatenate([zrow, halos.top, zrow], axis=1)
+    bottom_ext = jnp.concatenate([zrow, halos.bottom, zrow], axis=1)
+    xfull = jnp.concatenate([top_ext, xext, bottom_ext], axis=0)
+
+    kernel = functools.partial(
+        _pipeline_kernel, tile=t, width=w, depth=k, gh=gh, gw=gw,
+        compute_dtype=compute_dtype, buffering=buffering,
+    )
+    slots = 1 if buffering == 1 else PIPELINE_SLOTS
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((slots, t + 2 * k, wp), jnp.float32),
+            # the explicit DMA semaphores (SNIPPETS.md [1] shape): one
+            # slot per in-flight fetch and per in-flight writeback
+            pltpu.SemaphoreType.DMA((slots,)),
+            pltpu.SemaphoreType.DMA((slots,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((h, wp), xext.dtype),
+        interpret=interpret,
+    )(offs, xfull)
+
+
+def pipeline_pass(
+    block: jax.Array,
+    comm: Communicator,
+    gh: int,
+    gw: int,
+    depth: int = 8,
+    stripe: Optional[int] = None,
+    compute_dtype: str = "float32",
+    buffering: int = PIPELINE_SLOTS,
+    interpret: bool = False,
+) -> jax.Array:
+    """``depth`` fused sweeps over a plain ``(H, W)`` block, one
+    explicit-DMA pipeline pass."""
+    h, w = block.shape
+    zcols = jnp.zeros((h, LANE_PAD), block.dtype)
+    xext = jnp.concatenate([zcols, block, zcols], axis=1)
+    out = _pipeline_pass_ext(
+        xext, comm, gh, gw, depth, stripe, compute_dtype, buffering,
+        interpret,
+    )
+    return out[:, LANE_PAD : LANE_PAD + w]
+
+
+def make_pipeline_stencil_fn(
+    comm: Communicator,
+    iterations: int,
+    gh: int,
+    gw: int,
+    depth: int = 8,
+    stripe: Optional[int] = None,
+    compute_dtype: str = "float32",
+    buffering: int = PIPELINE_SLOTS,
+    interpret: bool = False,
+):
+    """Jitted distributed stencil on the explicit-DMA pipeline.
+
+    Same contract as ``make_temporal_stencil_fn``: the state stays in
+    extended layout across the ``iterations // depth`` full passes (one
+    kernel read + one write per pass), and the remainder runs on the
+    single-sweep fused kernel (or the jnp sweep where unsupported).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from smi_tpu.kernels import stencil as kstencil
+    from smi_tpu.models.stencil import jacobi_step_block
+
+    row_axis, col_axis = comm.axis_names
+    spec = P(row_axis, col_axis)
+    full, rem = divmod(iterations, depth)
+
+    def shard_fn(block):
+        h, w = block.shape
+        b = block
+        if full:
+            zcols = jnp.zeros((h, LANE_PAD), block.dtype)
+            xe = jnp.concatenate([zcols, block, zcols], axis=1)
+            xe = lax.fori_loop(
+                0,
+                full,
+                lambda _, x: _pipeline_pass_ext(
+                    x, comm, gh, gw, depth, stripe, compute_dtype,
+                    buffering, interpret,
+                ),
+                xe,
+            )
+            b = xe[:, LANE_PAD : LANE_PAD + w]
+        if rem and kstencil.pallas_supported(h, w, block.dtype):
+            b = lax.fori_loop(
+                0,
+                rem,
+                lambda _, x: kstencil.jacobi_step_block_fused(
+                    x, comm, gh, gw, interpret=interpret
+                ),
+                b,
+            )
+        elif rem:
+            b = lax.fori_loop(
+                0, rem, lambda _, x: jacobi_step_block(x, comm), b
+            )
+        return b
+
+    return jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=comm.mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+    )

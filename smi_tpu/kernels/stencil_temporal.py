@@ -63,16 +63,29 @@ LANE_PAD = 128
 VMEM_BYTES_TARGET = 14_000_000
 
 
-def _pick_stripe(h: int, w: int, depth: int) -> Optional[int]:
-    """Largest divisor of ``h``: multiple of 8, ≥ depth, VMEM-fitting."""
+def pick_stripe_explained(h: int, w: int, depth: int):
+    """``(stripe, note)``: the full-width temporal stripe with its
+    reason, or ``(None, reason)`` naming exactly why — the r18
+    no-silent-caps companion of :func:`_pick_stripe` rendered by
+    ``tune --explain stencil``."""
     lane_bytes = (w + 2 * LANE_PAD) * 4
     for t in range(h, 7, -1):
         if h % t or t % 8 or t < depth:
             continue
         rows_live = 4 * t + 4 * (t + 2 * depth) + depth
         if rows_live * lane_bytes <= VMEM_BYTES_TARGET:
-            return t
-    return None
+            return t, (f"stripe {t}: tallest 8-aligned divisor of "
+                       f"h={h} >= depth {depth} whose live rows fit "
+                       f"the {VMEM_BYTES_TARGET} B working set")
+    return None, (f"EXCLUDED: no 8-aligned divisor of h={h} >= depth "
+                  f"{depth} keeps the full-width working set under "
+                  f"{VMEM_BYTES_TARGET} B at w={w} — column-tiled or "
+                  f"unfused fallback")
+
+
+def _pick_stripe(h: int, w: int, depth: int) -> Optional[int]:
+    """Largest divisor of ``h``: multiple of 8, ≥ depth, VMEM-fitting."""
+    return pick_stripe_explained(h, w, depth)[0]
 
 
 def _plan(h: int, w: int, depth: int):
@@ -332,6 +345,19 @@ def _temporal_pass_ext(
     )(offs, xext, top_ext, bottom_ext)
 
 
+def pick_col_tile_explained(wp: int):
+    """``(width, note)``: the column-tile width with its reason, or
+    ``(None, reason)`` — the r18 no-silent-caps companion of
+    :func:`_pick_col_tile` rendered by ``tune --explain stencil``."""
+    for wc in range(min(wp, 2048), 127, -128):
+        if wp % wc == 0 and wc % 128 == 0:
+            return wc, (f"column tile {wc}: widest 128-lane divisor "
+                        f"of wp={wp} at or under the measured "
+                        f"2048-lane sweet spot")
+    return None, (f"EXCLUDED: wp={wp} has no 128-lane divisor at or "
+                  f"under 2048 lanes — full-width or unfused fallback")
+
+
 def _pick_col_tile(wp: int) -> Optional[int]:
     """Column-tile width: the largest 128-multiple divisor of ``wp``
     that is ≤ 2048. Wider tiles mean less horizontal recompute (the two
@@ -340,10 +366,7 @@ def _pick_col_tile(wp: int) -> Optional[int]:
     128-row stripe within VMEM (measured sweet spot on v5e; 2816-lane
     tiles with 64-row stripes time the same, wider regresses). Returns
     None when ``wp`` has no such divisor."""
-    for wc in range(min(wp, 2048), 127, -128):
-        if wp % wc == 0 and wc % 128 == 0:
-            return wc
-    return None
+    return pick_col_tile_explained(wp)[0]
 
 
 def _pick_stripe_tiled(h: int, wc: int, depth: int) -> Optional[int]:

@@ -486,6 +486,19 @@ def flash_fwd_vmem_bytes(bq: int, bk: int, d: int, itemsize: int) -> int:
     return tiles + scratch
 
 
+def flash_single_buffer_vmem_bytes(bq: int, bk: int, d: int,
+                                   itemsize: int) -> int:
+    """ONE buffer generation of the forward tiles plus the persistent
+    f32 scratch — the quantity that must fit HALF the scoped-VMEM
+    frame for the k/v stream to double-buffer. Mirror of
+    ``analysis/perf.flash_single_buffer_bytes`` (drift-guarded); the
+    r18 candidate gate uses it so a tile that would force the k/v
+    stream single-buffered is *excluded*, never ranked."""
+    tiles = (bq * d + 2 * bk * d) * itemsize
+    scratch = bq * d * 4 + 2 * bq * 128 * 4
+    return tiles + scratch
+
+
 class FlashCandidates(CandidateSet):
     """The feasible flash-tile candidate list, PLUS the candidates the
     VMEM gate rejected (``excluded``) — :class:`CandidateSet`
@@ -496,11 +509,20 @@ class FlashCandidates(CandidateSet):
     a silently shorter table read as the whole search space."""
 
 
+#: Forward-tile targets the model prices. The r18 widening adds the
+#: (2048, 2048)/(4096, 2048) tiles: the former is feasible and
+#: double-bufferable, the latter demonstrates the k/v-stream gate —
+#: its SINGLE-buffer footprint already eats more than half the frame,
+#: so streaming k/v behind it would serialize every chunk fetch.
+FLASH_BLOCK_TARGETS = (
+    (512, 512), (512, 1024), (1024, 512), (1024, 1024),
+    (2048, 2048), (4096, 2048),
+)
+
+
 def flash_block_candidates(
     s: int, d: int, dtype: str, windowed: bool,
-    targets: Sequence[Tuple[int, int]] = (
-        (512, 512), (512, 1024), (1024, 512), (1024, 1024),
-    ),
+    targets: Sequence[Tuple[int, int]] = FLASH_BLOCK_TARGETS,
 ) -> FlashCandidates:
     """Feasible forward-tile candidates, ranked by modeled grid-step
     overhead (fewer, larger tiles amortize per-tile masking); the
@@ -525,6 +547,22 @@ def flash_block_candidates(
                       f"frame"),
             ))
             continue
+        single = flash_single_buffer_vmem_bytes(bq, bk, d, itemsize)
+        if single > VMEM_LIMIT_BYTES // 2:
+            # the r18 k/v double-buffer gate: a tile that fits only
+            # single-buffered would serialize every k/v chunk fetch
+            # against compute — the exact defect the perf lint's
+            # ``no-double-buffer`` rule names; refuse to rank it
+            excluded.append(Candidate(
+                f"bq{bq}/bk{bk}", {"block_q": bq, "block_k": bk},
+                modeled_us=None,
+                note=(f"EXCLUDED: single-buffer footprint "
+                      f"{single // 1024} KiB exceeds half the "
+                      f"{VMEM_LIMIT_BYTES // 1024} KiB frame — the "
+                      f"k/v stream could not double-buffer "
+                      f"(no-double-buffer lint rule)"),
+            ))
+            continue
         steps = max(1, s // bq) * max(1, s // bk)
         # per-step overhead ~2us (grid bookkeeping + edge masking);
         # windowed grids touch few tiles, so finer bk wastes less dead
@@ -533,7 +571,8 @@ def flash_block_candidates(
         if windowed and bk <= 512:
             overhead *= 0.9
         out.append(Candidate(
-            f"bq{bq}/bk{bk}", {"block_q": bq, "block_k": bk},
+            f"bq{bq}/bk{bk}",
+            {"block_q": bq, "block_k": bk, "kv_buffering": 2},
             modeled_us=overhead,
             note=f"vmem {vmem // 1024} KiB, {steps} grid steps",
         ))
@@ -541,3 +580,185 @@ def flash_block_candidates(
         sorted(out, key=lambda c: (c.modeled_us, -c.knobs["block_q"])),
         excluded,
     )
+
+
+# ---------------------------------------------------------------------------
+# Stencil pipeline candidates (r18 roofline closure)
+# ---------------------------------------------------------------------------
+
+#: r5 isolated-probe VPU rates (docs/perf_notes.md "Pinning the
+#: roll-port rate in isolation"): the VMEM round-trip floor every
+#: whole-array sweep pays, and the exposed crossbar time per lane roll.
+STENCIL_SWEEP_VMEM_FLOOR_PS = 1.91
+STENCIL_LANE_ROLL_PORT_PS = 1.04
+
+#: Composite per-element sweep cost: one VMEM stream + two exposed
+#: lane-roll port slots, everything else (sublane rolls, adds, select)
+#: hidden behind the stream — the r5 composite-floor model.
+STENCIL_SWEEP_PS = STENCIL_SWEEP_VMEM_FLOOR_PS + 2 * STENCIL_LANE_ROLL_PORT_PS
+
+#: Advisory per-sweep surcharge of the bf16-compute variant: the
+#: f32->bf16 rounding casts of the four neighbour operands (v5e has no
+#: packed-pair VPU ALU, so bf16 buys no issue-rate credit — the casts
+#: are pure cost unless HBM is the binding term).
+STENCIL_BF16_CAST_PS = 0.60
+
+#: Per-stripe DMA issue overhead (advisory): one fetch + one writeback
+#: descriptor per stripe per pass, amortized over the pass's sweeps.
+STENCIL_DMA_ISSUE_US = 1.0
+
+#: Slot count of the shipped explicit-DMA rotation — MUST equal
+#: ``kernels/stencil_pipeline.PIPELINE_SLOTS`` (drift-guarded).
+STENCIL_PIPELINE_SLOTS = 3
+
+#: The state array is always f32 (Jacobi numerics contract); bf16
+#: exists only inside the sweep arithmetic, so HBM and VMEM are priced
+#: at 4 B/cell for every candidate.
+STENCIL_STATE_BYTES = 4
+
+#: Depth/stripe grids the candidate table prices (the sweep's search
+#: space). Depths deliberately extend beyond the temporal tier's
+#: measured knee of 16: overlap changes where the knee sits.
+STENCIL_PIPELINE_DEPTHS = (8, 16, 24, 32)
+STENCIL_PIPELINE_STRIPES = (32, 64, 128, 256)
+
+#: Lane padding of the extended layout (mirror of
+#: ``kernels/stencil_temporal.LANE_PAD``, drift-guarded).
+STENCIL_LANE_PAD = 128
+
+
+def stencil_pipeline_vmem_bytes(
+    stripe: int, w: int, depth: int,
+    buffering: int = STENCIL_PIPELINE_SLOTS,
+) -> int:
+    """VMEM footprint of the explicit-DMA slot rotation — mirror of
+    ``kernels/stencil_pipeline.pipeline_vmem_bytes`` (drift-guarded)."""
+    return (buffering * (stripe + 2 * depth)
+            * (w + 2 * STENCIL_LANE_PAD) * STENCIL_STATE_BYTES)
+
+
+def stencil_sweep_overhead(stripe: int, depth: int, w: int) -> float:
+    """Swept-area overhead per useful cell: the 2k recompute apron over
+    the stripe height times the 256-lane pad over the width."""
+    return ((stripe + 2.0 * depth) / stripe
+            * (w + 2.0 * STENCIL_LANE_PAD) / w)
+
+
+def stencil_compute_ps(stripe: int, depth: int, w: int,
+                       compute_dtype: str = "float32") -> float:
+    """Modeled VPU cost per useful cell per sweep (picoseconds)."""
+    ps = STENCIL_SWEEP_PS
+    if compute_dtype == "bfloat16":
+        ps += STENCIL_BF16_CAST_PS
+    return ps * stencil_sweep_overhead(stripe, depth, w)
+
+
+def stencil_hbm_ps(depth: int) -> float:
+    """HBM cost per useful cell per sweep: one f32 read + one f32
+    write per pass, amortized over the pass's ``depth`` sweeps."""
+    bytes_per_cell = 2.0 * STENCIL_STATE_BYTES / depth
+    return bytes_per_cell / (V5E_HBM_BYTES_PER_S * 1e-12)
+
+
+def stencil_pipeline_us(
+    h: int, w: int, depth: int, stripe: int,
+    compute_dtype: str = "float32",
+    buffering: int = STENCIL_PIPELINE_SLOTS,
+) -> float:
+    """Modeled wall-clock of ONE sweep over an ``(h, w)`` block.
+
+    ``buffering >= 2`` overlaps the stripe stream with compute
+    (``max``); ``buffering == 1`` is the synchronous control path where
+    every HBM byte sits on the critical path (``+``). Advisory — the
+    sweep's measured entries outrank this on every knob (ATLAS).
+    """
+    compute = stencil_compute_ps(stripe, depth, w, compute_dtype)
+    hbm = stencil_hbm_ps(depth)
+    ps = max(compute, hbm) if buffering >= 2 else compute + hbm
+    per_pass_us = (h / stripe) * STENCIL_DMA_ISSUE_US
+    return h * w * ps * 1e-6 + per_pass_us / depth
+
+
+def stencil_pipeline_candidates(
+    h: int = 8192, w: int = 8192, dtype: str = "float32",
+    depths: Sequence[int] = STENCIL_PIPELINE_DEPTHS,
+    stripes: Sequence[int] = STENCIL_PIPELINE_STRIPES,
+    compute_dtypes: Sequence[str] = ("float32", "bfloat16"),
+) -> CandidateSet:
+    """Priced depth x stripe x compute-dtype table for the explicit-DMA
+    stencil pipeline at one block shape, best first, plus the
+    synchronous control path as an always-priced baseline.
+
+    Every infeasible combination lands on ``excluded`` with the exact
+    refusal — VMEM over the frame, stripe shorter than the sweep
+    depth, stripe not dividing the block — the no-silent-caps
+    discipline ``tune --explain stencil`` renders. A non-f32 state
+    dtype excludes the whole family (the Jacobi numerics contract).
+    """
+    if dtype != "float32":
+        return CandidateSet((), (Candidate(
+            "pipeline", {"algorithm": "pipeline"}, modeled_us=None,
+            note=(f"EXCLUDED: state dtype {dtype} — the stencil state "
+                  f"is f32 by the numerics contract (bf16 exists only "
+                  f"as a compute variant)"),
+        ),))
+    feasible = []
+    excluded = []
+    # the synchronous control: the shipped temporal plan's knobs with
+    # the stripe stream serialized against compute (what the perf
+    # decomposer's idle-fraction finding prices)
+    sync_depth, sync_stripe = 16, 128
+    feasible.append(Candidate(
+        f"sync:d{sync_depth}:t{sync_stripe}:f32",
+        {"algorithm": "sync", "depth": sync_depth,
+         "stripe": sync_stripe, "compute_dtype": "float32",
+         "buffering": 1},
+        modeled_us=round(stencil_pipeline_us(
+            h, w, sync_depth, sync_stripe, "float32", buffering=1
+        ), 1),
+        note="synchronous control: stripe stream on the critical path",
+    ))
+    for k in depths:
+        for t in stripes:
+            for cdt in compute_dtypes:
+                name = f"pipe:d{k}:t{t}:{'bf16' if cdt == 'bfloat16' else 'f32'}"
+                knobs = {"algorithm": "pipeline", "depth": k,
+                         "stripe": t, "compute_dtype": cdt,
+                         "buffering": STENCIL_PIPELINE_SLOTS}
+                if t < k:
+                    excluded.append(Candidate(
+                        name, knobs, modeled_us=None,
+                        note=(f"EXCLUDED: stripe {t} shorter than "
+                              f"sweep depth {k} — the trapezoid cone "
+                              f"would swallow the whole stripe"),
+                    ))
+                    continue
+                if h % t or t % 8:
+                    excluded.append(Candidate(
+                        name, knobs, modeled_us=None,
+                        note=(f"EXCLUDED: stripe {t} is not an "
+                              f"8-aligned divisor of h={h}"),
+                    ))
+                    continue
+                vmem = stencil_pipeline_vmem_bytes(t, w, k)
+                if vmem > VMEM_LIMIT_BYTES:
+                    excluded.append(Candidate(
+                        name, knobs, modeled_us=None,
+                        note=(f"EXCLUDED: vmem {vmem // 1024} KiB "
+                              f"({STENCIL_PIPELINE_SLOTS} slots) "
+                              f"exceeds the "
+                              f"{VMEM_LIMIT_BYTES // 1024} KiB "
+                              f"scoped-VMEM frame"),
+                    ))
+                    continue
+                feasible.append(Candidate(
+                    name, knobs,
+                    modeled_us=round(stencil_pipeline_us(
+                        h, w, k, t, cdt
+                    ), 1),
+                    note=(f"vmem {vmem // 1024} KiB, "
+                          f"{h // t} stripes/pass"),
+                ))
+    order = sorted(enumerate(feasible),
+                   key=lambda ic: (ic[1].modeled_us, ic[0]))
+    return CandidateSet([c for _, c in order], excluded)

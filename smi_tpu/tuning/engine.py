@@ -623,6 +623,93 @@ class PlanEngine:
             return int(hit.knobs["depth"]), "cache"
         return None, "heuristic"
 
+    def stencil_pipeline_knobs(
+        self,
+        extent: int = 8192,
+        dtype: str = "float32",
+        device_kind: Optional[str] = None,
+    ) -> Optional[Tuple[Dict[str, object], str]]:
+        """(knobs, layer) for the explicit-DMA stencil pipeline, or
+        ``None`` when no cache entry exists — callers then take the
+        cost model's best feasible candidate (which the seeded entry
+        reproduces, so behavior is unchanged until a sweep disagrees)."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+
+        def compute():
+            hit = self.cache.lookup(
+                PlanKey("stencil_pipeline", str(extent), dtype, dk,
+                        "chip")
+            )
+            wanted = {"algorithm", "depth", "stripe",
+                      "compute_dtype", "buffering"}
+            if hit is not None and wanted <= set(hit.knobs):
+                return dict(hit.knobs), cache_entry_layer(hit)
+            return None
+
+        return self._memoized(("stencil_pipeline", extent, dtype, dk),
+                              compute)
+
+    def stencil_pipeline_plan(
+        self,
+        h: int = 8192,
+        w: int = 8192,
+        dtype: str = "float32",
+        device_kind: Optional[str] = None,
+    ) -> Plan:
+        """Explain-surface stencil plan: the cached (seeded or swept)
+        pipeline knobs next to the model's full depth x stripe x
+        compute-dtype ranking, VMEM exclusions named, plus every
+        legacy tier's fallback decision (the r18 no-silent-caps fix:
+        the ``_pick_*`` pickers now explain a ``None``)."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+        key = PlanKey("stencil_pipeline", str(h), dtype, dk, "chip")
+        cands = cm.stencil_pipeline_candidates(h, w, dtype)
+        picked = self.stencil_pipeline_knobs(h, dtype, device_kind=dk)
+        if picked is not None:
+            knobs, layer = picked
+            hit = self.cache.lookup(key)
+            _, line = _cache_hit_rationale(hit)
+            rationale = [line]
+        elif len(cands):
+            best = cands[0]
+            knobs, layer = dict(best.knobs), "model"
+            rationale = [
+                "no cache entry for this device kind; the model's "
+                "best-priced feasible candidate applies until swept"
+            ]
+        else:
+            knobs, layer = {"algorithm": "unfused"}, "heuristic"
+            rationale = [
+                f"no feasible pipeline candidate at {h}x{w} "
+                f"dtype={dtype}; the unfused jacobi path applies"
+            ]
+        for dropped in getattr(cands, "excluded", ()):
+            rationale.append(f"excluded {dropped.name}: {dropped.note}")
+        # the legacy tiers' picker verdicts: why a shape would (not)
+        # fall back, one line each, never a silent None
+        from smi_tpu.kernels import stencil as _kstencil
+        from smi_tpu.kernels import stencil_pipeline as _kpipe
+        from smi_tpu.kernels import stencil_temporal as _ktemporal
+
+        depth = int(knobs.get("depth", 8) or 8)
+        for tier, note in (
+            ("pipeline", _kpipe.pick_pipeline_stripe_explained(
+                h, w, depth)[1]),
+            ("temporal", _ktemporal.pick_stripe_explained(
+                h, w, depth)[1]),
+            ("temporal-tiled", _ktemporal.pick_col_tile_explained(
+                w + 2 * _ktemporal.LANE_PAD)[1]),
+            ("fused", _kstencil.pick_tile_explained(h, w)[1]),
+        ):
+            rationale.append(f"{tier} tier: {note}")
+        return Plan(
+            key=key,
+            knobs=knobs,
+            decided_by={k: layer for k in knobs},
+            candidates=list(cands),
+            rationale=rationale,
+        )
+
     # -- explain ---------------------------------------------------------
     def explain_text(
         self,
@@ -690,6 +777,8 @@ class PlanEngine:
                 for dt in ("bfloat16", "float32")
                 for w in (False, True)
             )
+        if op in ("stencil", "stencil_pipeline"):
+            return self.stencil_pipeline_plan(dtype=dtype).explain()
         if op == "stencil_temporal":
             depth, layer = self.stencil_depth()
             via = ("plan cache" if layer == "cache"
@@ -710,7 +799,8 @@ class PlanEngine:
             )
         raise ValueError(
             f"unknown op {op!r}; explainable ops: all_reduce, "
-            f"all_to_all, flash_fwd, stencil_temporal, ring_all_reduce"
+            f"all_to_all, flash_fwd, stencil, stencil_temporal, "
+            f"ring_all_reduce"
         )
 
 
@@ -774,6 +864,19 @@ def planned_flash_blocks(
     try:
         got = get_engine().flash_blocks(dtype, windowed)
         return None if got is None else (got[0], got[1])
+    except Exception:
+        return None
+
+
+def planned_stencil_pipeline(
+    extent: int = 8192, dtype: str = "float32",
+) -> Optional[Dict[str, object]]:
+    """Trace-time stencil-pipeline consult: the cached knob dict
+    (algorithm/depth/stripe/compute_dtype/buffering), or ``None``
+    (callers keep their defaults). Never raises."""
+    try:
+        got = get_engine().stencil_pipeline_knobs(extent, dtype)
+        return None if got is None else dict(got[0])
     except Exception:
         return None
 

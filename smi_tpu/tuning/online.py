@@ -84,7 +84,7 @@ _FALSY = ("", "0", "false", "no", "off")
 #: Ops the tuner can arbitrate: the ones whose rival candidates the
 #: cost model prices (:func:`op_candidates`). Samples for any other op
 #: aggregate in their cells but never propose.
-TUNABLE_OPS = ("all_reduce", "all_to_all")
+TUNABLE_OPS = ("all_reduce", "all_to_all", "stencil_pipeline")
 
 
 def online_retune_enabled() -> bool:
@@ -160,6 +160,20 @@ def op_candidates(op: str, payload_bytes: float, topo: cm.TopologySpec,
     if op == "all_to_all":
         return cm.alltoall_candidates(int(payload_bytes), topo,
                                       link=link)
+    if op == "stencil_pipeline":
+        # the payload is the f32 block (extent^2 x 4 B); candidate
+        # NAMES are the tuner's algorithm vocabulary (each depth x
+        # stripe x dtype point is its own rival), while the remaining
+        # knobs stay kernel-shaped so an installed entry is complete
+        extent = max(1, int(math.isqrt(max(0, int(payload_bytes)) // 4)))
+        cands = cm.stencil_pipeline_candidates(h=extent, w=extent)
+        renamed = [
+            dataclasses.replace(
+                c, knobs={**c.knobs, "algorithm": c.name}
+            )
+            for c in cands
+        ]
+        return type(cands)(renamed, cands.excluded)
     return None
 
 
@@ -465,7 +479,7 @@ class OnlineTuner:
                 "advantage": round(advantage, 2),
             }
             new_entry = CacheEntry(
-                knobs={"algorithm": rival_algo},
+                knobs=dict(best.knobs),
                 cost_us=None,
                 provenance=(
                     f"live:retune:samples={cell.count}:"

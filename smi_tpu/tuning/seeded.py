@@ -45,6 +45,17 @@ SEEDED_FLASH_F32_BLOCKS = (512, 512)
 SEEDED_STENCIL_DEPTH = 16                     # stencil_temporal_gcells
 SEEDED_RS_AG_MIN_BYTES = 1 << 20              # the HLO-verified switch
 
+#: r18 explicit-DMA pipeline winner at the canonical 8192^2 block: the
+#: 3-slot rotation with depth 8 / stripe 128 / f32 compute. Overlap
+#: inverts the temporal depth knee — once the stripe stream hides
+#: behind compute, the shallower depth's smaller recompute apron wins
+#: (cost_model.stencil_pipeline_candidates; the un-pipelined temporal
+#: entry above keeps its measured depth-16 knee untouched).
+SEEDED_STENCIL_PIPELINE_KNOBS = {
+    "algorithm": "pipeline", "depth": 8, "stripe": 128,
+    "compute_dtype": "float32", "buffering": 3,
+}
+
 
 def _us(timing) -> float:
     """Per-rep microseconds of a PERF.json differential timing row."""
@@ -93,6 +104,16 @@ def seeded_cache() -> PlanCache:
             {"depth": SEEDED_STENCIL_DEPTH},
             cost_us=_us([16, 64, 1.1119, 4.2417]),
             provenance="seeded:PERF.json:stencil_temporal_gcells",
+        ),
+    )
+    cache.put(
+        PlanKey("stencil_pipeline", "8192", "float32", dk, "chip"),
+        CacheEntry(
+            dict(SEEDED_STENCIL_PIPELINE_KNOBS),
+            cost_us=None,
+            provenance="seeded:cost_model.stencil_pipeline_candidates"
+                       ":8192 (proxy-sweep winner; unmeasured until a"
+                       " TPU runs `smi-tpu tune --ops stencil`)",
         ),
     )
     cache.put(

@@ -367,3 +367,135 @@ def sweep_flash(
                        + (":window" if windowed else ""),
         ))
     return cache
+
+
+def sweep_stencil(
+    h: int = 8192,
+    w: int = 8192,
+    dtype_name: str = "float32",
+    depths: Sequence[int] = cm.STENCIL_PIPELINE_DEPTHS,
+    stripes: Sequence[int] = cm.STENCIL_PIPELINE_STRIPES,
+    runs: int = 3,
+    device_kind: Optional[str] = None,
+    proxy_shape: Tuple[int, int] = (256, 384),
+    verbose: bool = False,
+) -> PlanCache:
+    """Sweep the explicit-DMA stencil pipeline's depth x stripe x
+    compute-dtype grid (plus the synchronous control path) at one
+    block shape and cache the winner under
+    ``PlanKey("stencil_pipeline", str(h), ...)``.
+
+    On TPU every candidate is timed for real (one fused pass through
+    ``make_pipeline_stencil_fn``, normalized to us/sweep). On any
+    other backend the sweep is a *proxy* tier: each candidate must
+    first pass the interpret-mode correctness gate at ``proxy_shape``
+    (bit-equal to the reference Jacobi step for f32, bounded error for
+    bf16 — a candidate that cannot reproduce the reference is dropped,
+    loudly), and is then priced as the cost model's prediction scaled
+    by the perf decomposer's measured idle fraction for its buffering
+    depth — the replay evidence, not just the analytic curve, ranks
+    the proxy entries. Either way the entries are keyed by the
+    measured device kind, so a CPU proxy sweep can never shadow a v5e
+    entry (module docstring discipline).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import smi_tpu as smi
+    from smi_tpu.analysis import perf as aperf
+    from smi_tpu.kernels import stencil_pipeline as kpipe
+    from smi_tpu.models import stencil as mstencil
+
+    dk = normalize_device_kind(
+        device_kind or jax.devices()[0].device_kind
+    )
+    cset = cm.stencil_pipeline_candidates(h, w, dtype_name,
+                                          depths, stripes)
+    cache = PlanCache()
+    if not cset:
+        return cache
+    on_tpu = jax.devices()[0].platform == "tpu"
+    comm = smi.make_communicator(
+        shape=(1, 1), axis_names=("sx", "sy"), devices=jax.devices()[:1]
+    )
+    idle_by_buffering = {}
+
+    def idle_factor(buffering: int) -> float:
+        if buffering not in idle_by_buffering:
+            rep = aperf.decompose_stencil_stream(buffering=buffering)
+            idle_by_buffering[buffering] = max(
+                r["idle_fraction"] for r in rep.per_rank
+            )
+        return idle_by_buffering[buffering]
+
+    results = []
+    for cand in cset:
+        depth = cand.knobs["depth"]
+        stripe = cand.knobs["stripe"]
+        cdt = cand.knobs["compute_dtype"]
+        buffering = cand.knobs["buffering"]
+        if on_tpu:
+            fn = kpipe.make_pipeline_stencil_fn(
+                comm, depth, h, w, depth=depth, stripe=stripe,
+                compute_dtype=cdt, buffering=buffering,
+            )
+            x = jnp.asarray(mstencil.initial_grid(h, w))
+            try:
+                secs = _measure(
+                    lambda g: np.asarray(fn(g)), x, runs,
+                )
+            except Exception as e:
+                if verbose:
+                    print(f"  {cand.name}: rejected ({e})")
+                continue
+            cost_us = secs * 1e6 / depth
+            provenance = f"sweep:stencil:{h}x{w}:{dtype_name}"
+        else:
+            ph, pw = proxy_shape
+            gate_stripe = stripe
+            if ph % stripe or stripe < depth:
+                gate_stripe = None    # auto-pick at the proxy shape
+            if not kpipe.pipeline_supported(
+                ph, pw, jnp.float32, depth, stripe=gate_stripe,
+                compute_dtype=cdt, buffering=buffering,
+            ):
+                if verbose:
+                    print(f"  {cand.name}: no proxy gate at "
+                          f"{ph}x{pw}, skipped")
+                continue
+            g = mstencil.initial_grid(ph, pw)
+            g[:, -1] = 2.0
+            g[ph // 2, :] = 0.5
+            fn = kpipe.make_pipeline_stencil_fn(
+                comm, depth, ph, pw, depth=depth, stripe=gate_stripe,
+                compute_dtype=cdt, buffering=buffering, interpret=True,
+            )
+            out = np.asarray(fn(jnp.asarray(g)))
+            ref = mstencil.reference_stencil(g, depth)
+            if cdt == "float32":
+                ok = np.array_equal(out, ref)
+            else:
+                ok = np.allclose(out, ref, atol=0.05)
+            if not ok:
+                if verbose:
+                    print(f"  {cand.name}: FAILED the proxy "
+                          f"correctness gate, dropped")
+                continue
+            cost_us = cand.modeled_us * (1.0 + idle_factor(buffering))
+            provenance = (f"sweep:stencil:proxy{ph}x{pw}:"
+                          f"replay-b{buffering}")
+        results.append((cost_us, cand, provenance))
+        if verbose:
+            print(f"  {cand.name}: {cost_us:.1f} us/sweep")
+
+    if results:
+        cost_us, cand, provenance = min(
+            results, key=lambda r: (r[0], r[1].name)
+        )
+        cache.put(
+            PlanKey("stencil_pipeline", str(h), dtype_name, dk, "chip"),
+            CacheEntry(dict(cand.knobs), cost_us=cost_us,
+                       provenance=provenance),
+        )
+    return cache

@@ -413,12 +413,19 @@ def test_flash_candidates_return_excluded_with_footprint():
 
 
 def test_flash_candidates_default_targets_all_feasible():
-    """At the canonical d=128 every default target fits — the excluded
-    list is additive, not a behavior change."""
-    for dtype in ("bfloat16", "float32"):
-        cands = cm.flash_block_candidates(8192, 128, dtype, False)
-        assert isinstance(cands, list) and len(cands) == 4
-        assert cands.excluded == []
+    """At the canonical d=128 every default target fits the frame; the
+    only refusal is the r18 k/v double-buffer gate — the f32
+    bq4096/bk2048 tile fits single-buffered only, so it is excluded
+    with the no-double-buffer reason instead of ranked into a
+    serializing config (bf16 halves the footprint and keeps it)."""
+    bf16 = cm.flash_block_candidates(8192, 128, "bfloat16", False)
+    assert isinstance(bf16, list)
+    assert len(bf16) == len(cm.FLASH_BLOCK_TARGETS)
+    assert bf16.excluded == []
+    f32 = cm.flash_block_candidates(8192, 128, "float32", False)
+    assert len(f32) == len(cm.FLASH_BLOCK_TARGETS) - 1
+    assert [c.name for c in f32.excluded] == ["bq4096/bk2048"]
+    assert "no-double-buffer" in f32.excluded[0].note
 
 
 def test_explain_prints_excluded_candidates():

@@ -811,3 +811,116 @@ def test_tuning_doc_quotes_the_online_retuner():
     assert "does not prove" in section
     # the resolution ladder names the live tier
     assert "live" in section and "tune --online" in section
+
+
+def test_roofline_closure_docs_quote_the_shipped_pipeline():
+    """The r18 section (docs/perf_notes.md "Roofline closure (r18)",
+    docs/tuning.md decision-table row) must state the seeded knobs,
+    the modeled sweep costs, and the replay-proven overlap the code
+    ships — re-derived from the cost model and the stripe-stream
+    decomposition so the quoted numbers can never drift."""
+    from smi_tpu.analysis import perf as aperf
+    from smi_tpu.tuning import cost_model as cm
+    from smi_tpu.tuning.seeded import SEEDED_STENCIL_PIPELINE_KNOBS
+
+    notes = _read("docs/perf_notes.md")
+    assert "## Roofline closure (r18)" in notes
+    section = notes.split("## Roofline closure (r18)")[1].split(
+        "\n## ")[0]
+    # the decision table quotes the shipped candidate pricing
+    cands = cm.stencil_pipeline_candidates()
+    best = cands[0]
+    sync = next(c for c in cands if c.knobs["algorithm"] == "sync")
+    assert best.name in section and sync.name in section
+    assert _round(best.modeled_us, 1) in section
+    assert _round(sync.modeled_us, 1) in section
+    excl = {c.name for c in cands.excluded}
+    assert "pipe:d32:t128:f32" in excl
+    assert "pipe:d32:t128:f32" in section
+    # the overlap proof quotes the replay, not wishes
+    pipe = aperf.decompose_stencil_stream(buffering=3)
+    syncrep = aperf.decompose_stencil_stream(buffering=1)
+    assert _round(aperf.stencil_overlap_fraction(pipe), 3) in section
+    assert _round(pipe.makespan_s * 1e6, 0) in section
+    assert _round(syncrep.makespan_s * 1e6, 0) in section
+    # the seeded knobs are quoted in both documents
+    k = SEEDED_STENCIL_PIPELINE_KNOBS
+    tuning = _read("docs/tuning.md")
+    assert "stencil_pipeline" in tuning
+    row = [ln for ln in tuning.splitlines()
+           if "stencil_pipeline" in ln and ln.startswith("|")]
+    assert row, "tuning.md decision table lost the stencil_pipeline row"
+    assert (f"{k['algorithm']} / {k['depth']} / {k['stripe']} / "
+            f"{k['compute_dtype']} / {k['buffering']}") in row[0]
+    assert best.knobs == k  # the doc'd winner IS the seeded plan
+
+
+def test_stencil_analytic_expectations_are_committed():
+    """The r18 stencil entries in ANALYTIC_EXPECTED_US price through
+    the ONE cost model (symmetric keysets with analytic_predictions)
+    and agree with the candidate table's endpoints."""
+    from smi_tpu.analysis import perf as aperf
+    from smi_tpu.tuning import cost_model as cm
+
+    pred = aperf.analytic_predictions()
+    assert set(aperf.ANALYTIC_EXPECTED_US) == set(pred)
+    cands = cm.stencil_pipeline_candidates()
+    sync = next(c for c in cands if c.knobs["algorithm"] == "sync")
+    assert aperf.ANALYTIC_EXPECTED_US[
+        "stencil_pipeline_8192_sweep_us"
+    ] == pytest.approx(cands[0].modeled_us, rel=0.02)
+    assert aperf.ANALYTIC_EXPECTED_US[
+        "stencil_sync_8192_sweep_us"
+    ] == pytest.approx(sync.modeled_us, rel=0.02)
+
+
+def test_bench_stencil_roofline_baseline_pins_the_committed_fraction():
+    """The scoreboard's roofline baseline is a PINNED constant equal
+    to the r05 headline's achieved VPU fraction (same reason as the
+    flash pin: a self-comparison could never regress), and a roofline
+    regression is not a printable verdict — render_line refuses it."""
+    import bench
+    from smi_tpu.benchmarks.surface import stencil_roofline
+
+    recomputed = stencil_roofline(
+        bench.BENCH_R05_STENCIL_CELLS, 16
+    )["vs_vpu_roofline"]
+    assert bench.SCOREBOARD_STENCIL_VPU_ROOFLINE_BASELINE == float(
+        _round(recomputed, 4)
+    )
+    board = bench.scoreboard_fields()
+    row = board["stencil_gcells_per_chip"]
+    assert row["roofline"]["verdict"] == "pass"
+    assert row["roofline"]["baseline"] == (
+        bench.SCOREBOARD_STENCIL_VPU_ROOFLINE_BASELINE
+    )
+    payload = {"metric": "m", "value": 1, "unit": "u",
+               "vs_baseline": 1, "scoreboard": board}
+    assert bench.render_line(payload)
+    # a regressed roofline fails the render loudly, not quietly
+    worse = bench.scoreboard_fields(
+        bench.BENCH_R05_STENCIL_CELLS * (1 - 2 * bench.SCOREBOARD_TOLERANCE)
+    )
+    payload["scoreboard"] = worse
+    with pytest.raises(ValueError, match="roofline regression"):
+        bench.render_line(payload)
+    # a stencil row with no roofline object at all is refused too
+    naked = {k2: dict(v) for k2, v in board.items()}
+    del naked["stencil_gcells_per_chip"]["roofline"]
+    payload["scoreboard"] = naked
+    with pytest.raises(ValueError, match="roofline"):
+        bench.render_line(payload)
+
+
+def test_pipeline_vmem_mirrors_pin_the_kernel_constants():
+    """cost_model's stencil pipeline arithmetic IS the kernel's."""
+    from smi_tpu.kernels import stencil_pipeline as kpipe
+    from smi_tpu.tuning import cost_model as cm
+
+    assert cm.STENCIL_PIPELINE_SLOTS == kpipe.PIPELINE_SLOTS
+    assert cm.VMEM_LIMIT_BYTES == kpipe.PIPELINE_VMEM_BYTES
+    assert cm.STENCIL_LANE_PAD == kpipe.LANE_PAD
+    for stripe, depth in ((128, 8), (64, 32), (256, 8)):
+        assert cm.stencil_pipeline_vmem_bytes(
+            stripe, 8192, depth
+        ) == kpipe.pipeline_vmem_bytes(stripe, 8192, depth)

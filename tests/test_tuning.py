@@ -97,6 +97,11 @@ def test_every_seeded_entry_is_reachable_through_the_engine():
             assert e.stencil_depth(int(key.detail), key.dtype) == (
                 entry.knobs["depth"], "cache"
             ), sig
+        elif key.op == "stencil_pipeline":
+            got = e.stencil_pipeline_knobs(int(key.detail), key.dtype)
+            assert got is not None, sig
+            assert got[0] == dict(entry.knobs), sig
+            assert got[1] == "cache", sig
         elif key.op == "all_reduce" and key.detail == "threshold":
             assert e.rs_ag_threshold() == (
                 entry.knobs["rs_ag_min_bytes"], "cache"
