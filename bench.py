@@ -235,6 +235,28 @@ def scoreboard_fields(stencil_per_chip=None, stencil_depth=16) -> dict:
         "measured": False,
         "verdict": verdict(a2a_worst),
     }
+    # r19: the compressed-collectives curve — the int8 wire width
+    # priced by the SAME quantized_curve_us pricing the
+    # analytic-regression lint rule and test_perf_docs re-derive, vs
+    # its committed expectations. A cost-model change that reprices
+    # the beta win (or silently loses it) regresses the scoreboard
+    # with no TPU in the loop.
+    q_sizes = P.ALLREDUCE_CURVE_SIZES_KB
+    q_predicted = P.quantized_curve_us(q_sizes)
+    q_expected = [
+        P.ANALYTIC_EXPECTED_US[f"allreduce_int8_n8_{kb}kib_us"]
+        for kb in q_sizes
+    ]
+    q_worst = min(e / p for e, p in zip(q_expected, q_predicted))
+    board["compression"] = {
+        "payload_kib": list(q_sizes),
+        "precision": "int8",
+        "value": q_predicted,
+        "baseline": q_expected,
+        "ratio": round(q_worst, 4),
+        "measured": False,
+        "verdict": verdict(q_worst),
+    }
     return board
 
 

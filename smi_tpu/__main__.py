@@ -2232,6 +2232,7 @@ def cmd_tune(args: argparse.Namespace) -> int:
     from smi_tpu.tuning.sweep import (
         sweep_allreduce,
         sweep_allreduce_hierarchical,
+        sweep_allreduce_precision,
         sweep_alltoall,
         sweep_flash,
         sweep_stencil,
@@ -2245,10 +2246,11 @@ def cmd_tune(args: argparse.Namespace) -> int:
     ops = args.ops or ["all_reduce"]
     unknown = [o for o in ops
                if o not in ("all_reduce", "flash_fwd", "hierarchical",
-                            "alltoall", "stencil")]
+                            "alltoall", "stencil", "quantized")]
     if unknown:
         print(f"error: unknown op(s) {unknown}; sweepable: "
-              f"all_reduce, flash_fwd, hierarchical, alltoall, stencil",
+              f"all_reduce, flash_fwd, hierarchical, alltoall, "
+              f"stencil, quantized",
               file=sys.stderr)
         return 2
     if "hierarchical" in ops and not args.slices:
@@ -2320,6 +2322,33 @@ def cmd_tune(args: argparse.Namespace) -> int:
               f"({', '.join(f'{kb} KiB' for kb in args.sizes_kb)})")
         measured.merge(sweep_allreduce(
             comm, sizes_kb=args.sizes_kb, runs=args.runs, verbose=True,
+        ))
+    if "quantized" in ops:
+        if args.slices:
+            try:
+                qcomm = make_hybrid_communicator(n_slices=args.slices)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        else:
+            qcomm = make_communicator()
+        if qcomm.size < 2:
+            print(
+                "error: the quantized sweep needs >= 2 devices; on a "
+                "1-chip host run the CPU fake mesh (XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8) or drop "
+                "quantized from --ops",
+                file=sys.stderr,
+            )
+            return 2
+        where = (f"{args.slices} slices x "
+                 f"{qcomm.size // args.slices} ranks"
+                 if args.slices else f"{qcomm.size} devices")
+        print(f"sweeping allreduce wire precisions over {where} "
+              f"({', '.join(f'{kb} KiB' for kb in args.sizes_kb)})")
+        measured.merge(sweep_allreduce_precision(
+            qcomm, sizes_kb=args.sizes_kb, runs=args.runs,
+            verbose=True,
         ))
     if "flash_fwd" in ops:
         print("sweeping flash_fwd forward tiles")
@@ -2975,13 +3004,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "alltoall times pairwise vs Bruck vs "
                         "hierarchical per payload bucket; stencil "
                         "sweeps the r18 double-buffered pipeline "
-                        "depth x stripe x compute-dtype grid)")
+                        "depth x stripe x compute-dtype grid; "
+                        "quantized times the allreduce wire "
+                        "precisions f32/bf16/int8/topk per payload "
+                        "bucket and persists the measured dense/lossy "
+                        "crossover)")
     p.add_argument("--slices", type=int, default=None, metavar="N",
                    help="pod slice count: with --explain, price the "
                         "all_reduce/all_to_all tables for an N-slice "
                         "pod (all three candidates); with --ops "
-                        "hierarchical/alltoall, the shape the sweep "
-                        "tiers over")
+                        "hierarchical/alltoall/quantized, the shape "
+                        "the sweep tiers over")
     p.add_argument("--cache", default=None,
                    help="plan-cache JSON path (default: "
                         "$SMI_TPU_PLAN_CACHE or "

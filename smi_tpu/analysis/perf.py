@@ -483,6 +483,23 @@ def _costs_for(protocol: str, shape: Dict[str, int],
                                  dcn_bytes=per_slice * block),
             block, 1,
         )
+    if protocol == "all_reduce_quantized":
+        # the pod shard granularity scaled by the int8 wire ratio —
+        # the reduced bytes ride the per-tier sizing on BOTH tiers
+        per_slice = n // shape["slices"]
+        message = (payload_bytes / max(1, per_slice)
+                   ) * C.PRECISION_WIRE_RATIO["int8"]
+        return (
+            C.default_tier_costs(message, per_slice, ici_bytes=message,
+                                 dcn_bytes=message),
+            message, 1,
+        )
+    if protocol == "all_reduce_sparse":
+        # k (index, value) pairs per hop instead of the dense payload:
+        # density * (index + value) overhead of the kept elements
+        message = (payload_bytes * C.SPARSE_TOPK_DENSITY
+                   * C.SPARSE_INDEX_OVERHEAD)
+        return C.default_tier_costs(message, 0), message, 1
     if protocol == "all_reduce_chunked":
         message = payload_bytes / max(1, chunks)
         return C.default_tier_costs(message, 0), message, chunks
@@ -665,7 +682,8 @@ def decompose_protocol(
     shape: Dict[str, int] = {"n": n}
     if protocol in ("neighbour_stream", "all_reduce_chunked"):
         shape["chunks"] = chunks
-    if protocol in ("allreduce_pod", "all_to_all_pod"):
+    if protocol in ("allreduce_pod", "all_to_all_pod",
+                    "all_reduce_quantized"):
         shape["slices"] = slices
     costs, _message, pipeline = _costs_for(protocol, shape, payload_bytes)
     return decompose_generators(
@@ -1027,6 +1045,20 @@ ANALYTIC_EXPECTED_US = {
     "flash_fwd_f32_seeded_roofline_us": 523.2,
     "stencil_pipeline_8192_sweep_us": 318.6,
     "stencil_sync_8192_sweep_us": 390.1,
+    # r19 compressed collectives: the 2x2-pod 4 MiB A/B vectors (f32
+    # baseline is pod_allreduce_two_tier_2x2_4mib_us above) and the
+    # int8 flat curve the bench `compression` row compares against.
+    # The acceptance bar: int8/f32 <= 0.55 on BOTH the full makespan
+    # (603.1 / 1197.3 = 0.504) and the DCN phase (274.8 / 799.1 =
+    # 0.344), tier-1-asserted.
+    "quantized_pod_allreduce_int8_2x2_4mib_us": 603.1,
+    "quantized_pod_allreduce_bf16_2x2_4mib_us": 801.1,
+    "quantized_pod_dcn_phase_f32_2x2_4mib_us": 799.1,
+    "quantized_pod_dcn_phase_int8_2x2_4mib_us": 274.8,
+    "allreduce_int8_n8_64kib_us": 125.0,
+    "allreduce_int8_n8_256kib_us": 132.7,
+    "allreduce_int8_n8_1024kib_us": 163.3,
+    "allreduce_int8_n8_4096kib_us": 285.6,
 }
 
 
@@ -1074,6 +1106,29 @@ def alltoall_curve_us(
     ]
 
 
+def quantized_curve_us(
+    sizes_kb: Sequence[int] = ALLREDUCE_CURVE_SIZES_KB, n: int = 8,
+    precision: str = "int8",
+) -> List[float]:
+    """The quantized-wire allreduce latency curve at the published ICI
+    rates: the best flat candidate priced at the precision's wire bytes
+    (:data:`credits.PRECISION_WIRE_RATIO`) — the SINGLE pricing used by
+    both the ``analytic-regression`` lint rule and the bench.py
+    ``compression`` scoreboard row, mirroring
+    :func:`allreduce_curve_us`'s one-pricing discipline. The curves are
+    directly comparable point-for-point: same grid, same candidates,
+    same rates, only the wire width differs."""
+    ratio = C.PRECISION_WIRE_RATIO[precision]
+    link = cm.LinkModel()
+    return [
+        round(min(
+            cm.ring_allreduce_us(kb * 1024 * ratio, n, link),
+            cm.rs_ag_allreduce_us(kb * 1024 * ratio, n, link),
+        ), 1)
+        for kb in sizes_kb
+    ]
+
+
 def analytic_predictions() -> Dict[str, float]:
     """Recompute today's static predictions for the committed
     expectation set, at the PUBLISHED rates (a fleet
@@ -1097,6 +1152,26 @@ def analytic_predictions() -> Dict[str, float]:
     out["alltoall_two_tier_2x2_1mib_us"] = round(
         a2a["hierarchical_s"] * 1e6, 1
     )
+    # r19: the quantized A/B vectors from the SAME simulator run shape
+    # as the committed two-tier baseline, plus the int8 flat curve
+    q8 = C.quantized_wallclock_comparison(2, 2, 4 << 20, "int8",
+                                          dcn=dcn)
+    out["quantized_pod_allreduce_int8_2x2_4mib_us"] = round(
+        q8["quantized_s"] * 1e6, 1
+    )
+    out["quantized_pod_dcn_phase_f32_2x2_4mib_us"] = round(
+        q8["f32_dcn_s"] * 1e6, 1
+    )
+    out["quantized_pod_dcn_phase_int8_2x2_4mib_us"] = round(
+        q8["quantized_dcn_s"] * 1e6, 1
+    )
+    qb = C.quantized_wallclock_comparison(2, 2, 4 << 20, "bf16",
+                                          dcn=dcn)
+    out["quantized_pod_allreduce_bf16_2x2_4mib_us"] = round(
+        qb["quantized_s"] * 1e6, 1
+    )
+    for kb, us in zip(ALLREDUCE_CURVE_SIZES_KB, quantized_curve_us()):
+        out[f"allreduce_int8_n8_{kb}kib_us"] = us
     from smi_tpu.tuning import seeded
 
     for name, (bq, _bk), dtype in (

@@ -962,6 +962,31 @@ def build_generators(protocol: str, n: int, chunks: int = 3,
             )
         return C.all_to_all_pod_generators(slices, n // slices,
                                            flow_control=flow_control)
+    if protocol == "all_reduce_quantized":
+        if n % slices:
+            raise ValueError(
+                f"all_reduce_quantized needs n divisible by slices, "
+                f"got n={n} slices={slices}"
+            )
+        # symbolic-safe identity codec: the wire codec is caller
+        # policy applied to opaque values and the structure does not
+        # depend on it — the double-trace proves exactly that
+        per_slice = n // slices
+        return [
+            C.all_reduce_quantized_rank(
+                g, slices, per_slice,
+                [frozenset([(g, c)]) for c in range(per_slice)],
+                lambda a, b: a | b, flow_control=flow_control,
+            )
+            for g in range(n)
+        ]
+    if protocol == "all_reduce_sparse":
+        return [
+            C.all_reduce_sparse_rank(r, n, ("bundle", r),
+                                     lambda bs: bs,
+                                     flow_control=flow_control)
+            for r in range(n)
+        ]
     raise ValueError(
         f"unknown protocol {protocol!r}; known: {_registered()}"
     )
@@ -991,6 +1016,13 @@ DEFAULT_SHAPES: Dict[str, Tuple[Dict[str, int], ...]] = {
         {"n": 4, "slices": 2}, {"n": 6, "slices": 2},
         {"n": 6, "slices": 3},
     ),
+    # the compressed-wire family (r19): the quantized composition over
+    # the pod grid, the sparse gather over the ring grid
+    "all_reduce_quantized": (
+        {"n": 4, "slices": 2}, {"n": 6, "slices": 2},
+        {"n": 6, "slices": 3},
+    ),
+    "all_reduce_sparse": ({"n": 2}, {"n": 3}, {"n": 5}),
 }
 
 
@@ -1000,7 +1032,8 @@ def verify_protocol(protocol: str, n: int, chunks: int = 3,
     shape: Dict[str, int] = {"n": n}
     if protocol in ("neighbour_stream", "all_reduce_chunked"):
         shape["chunks"] = chunks
-    if protocol in ("allreduce_pod", "all_to_all_pod"):
+    if protocol in ("allreduce_pod", "all_to_all_pod",
+                    "all_reduce_quantized"):
         shape["slices"] = slices
     return verify_generators(
         lambda: build_generators(protocol, n, chunks=chunks,

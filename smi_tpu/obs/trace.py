@@ -126,7 +126,8 @@ def trace_protocol(
     shape: Dict[str, int] = {"n": n}
     if protocol in ("neighbour_stream", "all_reduce_chunked"):
         shape["chunks"] = chunks
-    if protocol in ("allreduce_pod", "all_to_all_pod"):
+    if protocol in ("allreduce_pod", "all_to_all_pod",
+                    "all_reduce_quantized"):
         shape["slices"] = slices
     if verify:
         safety = verify_generators(

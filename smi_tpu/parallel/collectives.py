@@ -282,6 +282,188 @@ def rs_ag_min_bytes() -> int:
         return RS_AG_MIN_BYTES
 
 
+#: Explicit wire-precision override for :func:`allreduce`: ``f32``
+#: (dense, the untuned default — pinning it disables every auto
+#: layer), ``bf16`` (2x fewer wire bytes), ``int8`` (4x, symmetric
+#: scale-and-cast with per-call-site error feedback), or ``topk``
+#: (1/16 density + index overhead = 8x). The operator's word:
+#: outranks cache and model; malformed values are a LOUD error and an
+#: ineligible op/dtype is a LOUD trace-time error — never a silent
+#: dense fallback — mirroring :data:`ALLTOALL_ALGO_ENV`.
+ALLREDUCE_PRECISION_ENV = "SMI_TPU_ALLREDUCE_PRECISION"
+
+#: The wire precisions :func:`allreduce` accepts. MUST stay equal to
+#: ``tuning.cost_model.ALLREDUCE_PRECISIONS`` (drift-guarded).
+ALLREDUCE_PRECISIONS = ("f32", "bf16", "int8", "topk")
+
+#: Per-call-site error-feedback residuals for lossy allreduce
+#: precisions (eager path only): what compensated rounding dropped
+#: this step is re-added next step, so the quantization bias DECAYS
+#: across iterations instead of accumulating — the accuracy half of
+#: the compressed-collectives contract.
+_ERROR_FEEDBACK: dict = {}
+_ERROR_FEEDBACK_MAX_SITES = 256
+
+
+def _allreduce_env_precision() -> Optional[str]:
+    """$SMI_TPU_ALLREDUCE_PRECISION validated, ``None`` when unset. A
+    typo must not silently hand the decision back to the engine."""
+    raw = os.environ.get(ALLREDUCE_PRECISION_ENV, "").strip()
+    if not raw:
+        return None
+    if raw not in ALLREDUCE_PRECISIONS:
+        raise ValueError(
+            f"${ALLREDUCE_PRECISION_ENV} must be one of "
+            f"{ALLREDUCE_PRECISIONS}, got {raw!r}"
+        )
+    return raw
+
+
+def _check_precision_eligible(precision: str, x: jax.Array, op: SmiOp,
+                              source: str) -> None:
+    """An explicit lossy pin on an ineligible allreduce is a LOUD
+    trace-time error, never a silent dense fallback: silently running
+    f32 would misreport the program's wire cost, silently quantizing
+    would corrupt exact semantics. ``source`` names who asked
+    (``precision=...`` or the env var) so the error is actionable."""
+    if precision == "f32":
+        return
+    if op is not SmiOp.ADD:
+        raise ValueError(
+            f"{source} needs an ADD allreduce — compensated rounding "
+            f"is defined only for additive reduction; got op "
+            f"{op.name} (drop the precision pin or the op)"
+        )
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        raise ValueError(
+            f"{source} needs a floating-point payload — quantizing an "
+            f"integer reduction silently changes its semantics; got "
+            f"dtype {x.dtype} (drop the precision pin or cast)"
+        )
+
+
+def _resolve_precision(precision: Optional[str], x: jax.Array,
+                       comm: Communicator, op: SmiOp) -> str:
+    """Wire-precision decision for one allreduce call.
+
+    Explicit ``precision=`` decides ALONE (membership and eligibility
+    checked loudly), then the env override (same discipline), then the
+    auto path: ineligible ops/dtypes stay dense silently (the auto
+    layers only ever *propose*), else the plan engine's ladder —
+    measured cache entry -> measured crossover threshold -> model
+    (provably inert: its confidence margin equals the int8 byte
+    ratio) -> dense f32. The engine consult never errors."""
+    if precision is not None:
+        if precision not in ALLREDUCE_PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {ALLREDUCE_PRECISIONS}, "
+                f"got {precision!r}"
+            )
+        _check_precision_eligible(precision, x, op,
+                                  f"precision={precision!r}")
+        return precision
+    env = _allreduce_env_precision()  # loud on malformed — before the engine
+    if env is not None:
+        _check_precision_eligible(
+            env, x, op, f"${ALLREDUCE_PRECISION_ENV}={env!r}"
+        )
+        return env
+    if (op is not SmiOp.ADD or x.ndim == 0
+            or not jnp.issubdtype(x.dtype, jnp.floating)):
+        return "f32"
+    from smi_tpu.tuning import cost_model as cm
+
+    topo = cm.topology_from_comm(comm)
+    payload = int(x.size) * x.dtype.itemsize
+    try:
+        from smi_tpu.tuning.engine import planned_precision
+
+        return planned_precision(payload, topo.n, topo.inner or 1,
+                                 topo.outer or 0, str(x.dtype))
+    except Exception:
+        return "f32"
+
+
+def _quantize(y: jax.Array, precision: str) -> jax.Array:
+    """Scale-and-cast lowering of one lossy wire precision, applied to
+    the local contribution BEFORE the collective (what actually rides
+    the wire in the framed transport is the narrow form; the XLA tier
+    models it as quantize -> dense reduce, keeping the reduction tree
+    itself exact). ``topk`` keeps the largest-|value| fraction
+    (density :data:`tuning.cost_model.SPARSE_TOPK_DENSITY`) and zeros
+    the rest; a shard where k >= elements degenerates to dense."""
+    if precision == "bf16":
+        return y.astype(jnp.bfloat16).astype(y.dtype)
+    if precision == "int8":
+        scale = jnp.max(jnp.abs(y)).astype(jnp.float32) / 127.0
+        scale = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(y.astype(jnp.float32) / scale),
+                     -127.0, 127.0)
+        return (q * scale).astype(y.dtype)
+    if precision == "topk":
+        import math
+
+        from smi_tpu.tuning import cost_model as cm
+
+        size = int(y.size)
+        if size == 0:
+            return y
+        k = max(1, int(math.ceil(size * cm.SPARSE_TOPK_DENSITY)))
+        if k >= size:
+            return y
+        flat = jnp.abs(y.astype(jnp.float32)).reshape(-1)
+        topk_vals = lax.top_k(flat, k)[0]
+        threshold = topk_vals[-1]
+        mask = jnp.abs(y.astype(jnp.float32)) >= threshold
+        return jnp.where(mask, y, jnp.zeros_like(y))
+    raise ValueError(f"no lossy lowering for precision {precision!r}")
+
+
+def _error_feedback_key(precision: str, x: jax.Array) -> tuple:
+    """Call-site identity for the error-feedback residual: the first
+    frame OUTSIDE this module (the user's allreduce call site), plus
+    precision/shape/dtype so a site reused with a different payload
+    never mixes residuals."""
+    import sys
+
+    frame = sys._getframe(1)
+    while frame is not None and frame.f_code.co_filename == __file__:
+        frame = frame.f_back
+    site = (("<unknown>", 0) if frame is None
+            else (frame.f_code.co_filename, frame.f_lineno))
+    return site + (precision, tuple(x.shape), str(x.dtype))
+
+
+def _compensated_quantize(x: jax.Array, precision: str) -> jax.Array:
+    """Lossy lowering with per-call-site error feedback (eager only).
+
+    Eager: the residual this step's rounding dropped is stored and
+    re-added to the NEXT contribution from the same call site, so the
+    bias of repeated quantized reductions decays instead of compounding
+    (property-tested). Traced: residual state cannot persist across
+    compiled executions without host round-trips, so under ``jit`` the
+    lowering is plain (uncompensated) quantization — same wire bytes,
+    documented accuracy difference."""
+    if isinstance(x, jax.core.Tracer):
+        return _quantize(x, precision)
+    key = _error_feedback_key(precision, x)
+    residual = _ERROR_FEEDBACK.get(key)
+    y = x if residual is None else x + residual
+    q = _quantize(y, precision)
+    if (key not in _ERROR_FEEDBACK
+            and len(_ERROR_FEEDBACK) >= _ERROR_FEEDBACK_MAX_SITES):
+        _ERROR_FEEDBACK.clear()   # site-count bound, not an LRU
+    _ERROR_FEEDBACK[key] = y - q
+    return q
+
+
+def error_feedback_reset() -> None:
+    """Drop every stored error-feedback residual (test seam; also the
+    right call after a topology or model-state reset, where stale
+    residuals would be re-added to unrelated payloads)."""
+    _ERROR_FEEDBACK.clear()
+
+
 def _check_chunks(chunks: int) -> int:
     if not isinstance(chunks, int) or isinstance(chunks, bool):
         raise TypeError(f"chunks must be an int, got {chunks!r}")
@@ -667,14 +849,25 @@ def allreduce(x: jax.Array, comm: Communicator,
               deadline: Optional[Deadline] = None,
               chunks: Optional[int] = None,
               rs_ag: Optional[bool] = None,
-              hierarchical: Optional[bool] = None) -> jax.Array:
+              hierarchical: Optional[bool] = None,
+              precision: Optional[str] = None) -> jax.Array:
     """Reduce + Bcast in one collective (convenience; no reference analog
     because SMI composes it from Reduce then Bcast, ``kmeans_smi.cl``).
 
-    Three algorithm knobs: ``chunks`` software-pipelines the payload
+    Four algorithm knobs: ``chunks`` software-pipelines the payload
     (bit-identical); ``rs_ag`` selects the bandwidth-optimal
     reduce-scatter + all-gather decomposition — defaulting to the
     :data:`RS_AG_MIN_BYTES` size heuristic, forced on/off when a bool;
+    ``precision`` selects the wire width
+    (:data:`ALLREDUCE_PRECISIONS`): an explicit pin outranks every
+    auto layer and errors LOUDLY on an ineligible op/dtype; ``None``
+    resolves env -> plan-engine ladder -> dense f32, and because the
+    model rung's confidence margin equals the int8 byte ratio, an
+    untuned program compiles byte-identically to the pre-knob
+    lowering. Lossy widths apply compensated scale-and-cast to the
+    local contribution (per-call-site error feedback in eager mode,
+    :func:`_compensated_quantize`) before whichever decomposition
+    runs;
     ``hierarchical`` selects the two-tier rs(ICI) -> reduce(DCN) ->
     ag(ICI) composition on a hybrid multi-slice communicator
     (:func:`allreduce_hierarchical`), defaulting to the plan engine's
@@ -687,6 +880,12 @@ def allreduce(x: jax.Array, comm: Communicator,
     """
     _check_backend(backend)
     op = SmiOp.parse(op)
+    resolved_precision = _resolve_precision(precision, x, comm, op)
+    if resolved_precision != "f32":
+        # lossy widths narrow the *contribution* before the collective;
+        # the f32 path never touches x, so an untuned or pinned-dense
+        # program lowers byte-identically to the pre-knob call
+        x = _compensated_quantize(x, resolved_precision)
     if backend != "xla":
         # a forced decomposition must never be silently dropped — the
         # ring tier has no reduce-scatter+all-gather form of allreduce

@@ -171,13 +171,15 @@ class SmiContext:
                   backend: Optional[str] = None,
                   chunks: Optional[int] = None,
                   rs_ag: Optional[bool] = None,
-                  hierarchical: Optional[bool] = None):
+                  hierarchical: Optional[bool] = None,
+                  precision: Optional[str] = None):
         return _coll.allreduce(x, self.comm, op=op,
                                backend=self._backend(backend),
                                program=self.program,
                                deadline=self.deadline,
                                chunks=chunks, rs_ag=rs_ag,
-                               hierarchical=hierarchical)
+                               hierarchical=hierarchical,
+                               precision=precision)
 
     def scatter(self, x, root: int = 0, port: Optional[int] = None,
                 backend: Optional[str] = None, chunks: Optional[int] = None):

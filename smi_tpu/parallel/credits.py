@@ -130,6 +130,16 @@ POD_PROTOCOLS = ("allreduce_pod",)
 #: tuple, never folded into PROTOCOLS.
 ALLTOALL_PROTOCOLS = ("all_to_all", "all_to_all_bruck", "all_to_all_pod")
 
+#: The compressed-wire allreduce family (r19): the quantized two-tier
+#: composition (``all_reduce_quantized`` — the pod state machine with a
+#: wire codec applied at the boundary; the reduced int8/bf16 byte width
+#: lives in the :class:`TierCostModel`'s per-tier message sizing, never
+#: in the state machine) and the top-k sparse variant
+#: (``all_reduce_sparse`` — opaque (index, value) bundles gathered
+#: around the ring and reduced locally). Same seed-pinning discipline:
+#: its own tuple, never folded into PROTOCOLS.
+QUANTIZED_PROTOCOLS = ("all_reduce_quantized", "all_reduce_sparse")
+
 
 def all_protocol_registries() -> Dict[str, Tuple[str, ...]]:
     """Every protocol registry, by name, in declaration order — the
@@ -144,6 +154,7 @@ def all_protocol_registries() -> Dict[str, Tuple[str, ...]]:
         "CHUNKED_PROTOCOLS": CHUNKED_PROTOCOLS,
         "POD_PROTOCOLS": POD_PROTOCOLS,
         "ALLTOALL_PROTOCOLS": ALLTOALL_PROTOCOLS,
+        "QUANTIZED_PROTOCOLS": QUANTIZED_PROTOCOLS,
     }
 
 
@@ -588,6 +599,149 @@ def allreduce_pod_rank(g: int, slices: int, per_slice: int,
             final_read=False)
     else:
         yield ("output", 0, block)
+
+
+# ---------------------------------------------------------------------------
+# Compressed-wire allreduce family (r19)
+# ---------------------------------------------------------------------------
+# Hockney says a large-payload collective is pure bytes/beta, and no
+# protocol before r19 ever shrank the bytes. Two state machines attack
+# the term. ``all_reduce_quantized_rank`` is the two-tier pod
+# composition with an explicit wire codec at the boundary: the rank
+# encodes its OWN blocks before the first hop, circulates and combines
+# in wire (quantized) space, and decodes only at delivery — arrivals
+# are still never observed by control flow (encode/decode/combine are
+# caller policy applied to opaque values), so the symbolic replay stays
+# exact and all four static checks carry over from ``allreduce_pod``
+# unchanged. The byte-width claim itself lives where PR 12 put message
+# sizing: the :class:`TierCostModel`'s per-tier ``ici_bytes`` /
+# ``dcn_bytes``, scaled by :data:`PRECISION_WIRE_RATIO`.
+# ``all_reduce_sparse_rank`` ships top-k (index, value) bundles: no
+# in-flight combine is possible without opening a bundle (the indices
+# decide alignment), so the honest wire shape is a ring all-gather of
+# the n opaque bundles with the reduction applied LOCALLY at the end —
+# (n-1) hops of k pairs instead of (n-1) hops of the dense payload.
+
+#: Wire bytes per element relative to f32 — the beta ratios the
+#: quantized family exists for (f32 4 B, bf16 2 B, int8 1 B / element).
+PRECISION_WIRE_RATIO = {"f32": 1.0, "bf16": 0.5, "int8": 0.25}
+
+#: The sparse variant's default density (top-k keeps 1/16 of the
+#: elements) and per-kept-element overhead (a 4 B index rides along
+#: with each 4 B value), the pricing convention ``_costs_for`` and the
+#: plan engine share.
+SPARSE_TOPK_DENSITY = 1.0 / 16.0
+SPARSE_INDEX_OVERHEAD = 2.0
+
+
+def _identity_codec(v):
+    return v
+
+
+def all_reduce_quantized_rank(g: int, slices: int, per_slice: int,
+                              blocks: Sequence, combine: Callable,
+                              encode: Optional[Callable] = None,
+                              decode: Optional[Callable] = None,
+                              flow_control: bool = True):
+    """One rank's two-tier allreduce in quantized wire form.
+
+    Identical phase/slot/credit structure to :func:`allreduce_pod_rank`
+    (rs over ICI, shard ring over DCN, ag over ICI — which is why the
+    static safety checks and the verified-transport framing carry over
+    byte-for-byte); the difference is the codec boundary: ``encode`` is
+    applied to this rank's own ``blocks`` before the first hop,
+    ``combine`` operates on wire-space values, and ``decode`` runs only
+    at the ``("output", ...)`` edge. Numeric quantization (scale,
+    rounding, error feedback) is the JAX layer's job; here the codec is
+    symbolic and the wire-width claim is the cost model's per-tier
+    bytes. Delivery: one output per block holding the decoded full
+    reduction, on every rank."""
+    enc = encode or _identity_codec
+    dec = decode or _identity_codec
+    k = per_slice
+    if len(blocks) != k:
+        raise ValueError(
+            f"rank {g} got {len(blocks)} blocks for per_slice={k}"
+        )
+    if slices < 1 or k < 1:
+        raise ValueError(f"pod must be >= 1x1, got {slices}x{k}")
+    s, i = divmod(g, k)
+    wire = [enc(b) for b in blocks]
+
+    def in_slice(r: int) -> int:
+        return s * k + r
+
+    def x_slice(t: int) -> int:
+        return t * k + i
+
+    # -- phase A: reduce-scatter the encoded blocks in-slice (ICI) -----
+    if k > 1:
+        shard = yield from _pod_ring_lap(
+            i, k, in_slice, "rs", wire[(i - 1) % k],
+            lambda st, nslot, arrived: (
+                "write_slot", nslot,
+                combine(arrived, wire[(i - st - 2) % k])),
+            flow_control)
+    else:
+        shard = wire[0]
+
+    # -- phase B: circulate the encoded shard across slices (DCN) ------
+    if slices > 1:
+        block = yield from _pod_ring_lap(
+            s, slices, x_slice, "xs", shard,
+            lambda st, nslot, arrived: (
+                "write_slot", nslot, combine(arrived, shard)),
+            flow_control)
+    else:
+        block = shard
+
+    # -- phase C: all-gather, decoding at the delivery edge (ICI) ------
+    if k > 1:
+        yield from _pod_ring_lap(
+            i, k, in_slice, "ag", block,
+            lambda st, nslot, arrived: (
+                "output", (i - st - 1) % k, dec(arrived)),
+            flow_control, prologue=(("output", i, dec(block)),),
+            final_read=False)
+    else:
+        yield ("output", 0, dec(block))
+
+
+def all_reduce_sparse_rank(me: int, n: int, bundle, combine: Callable,
+                           flow_control: bool = True,
+                           to_global: Callable[[int], int] = _identity):
+    """One rank's top-k sparse allreduce: ring all-gather of opaque
+    (index, value) bundles, reduced locally.
+
+    The wire discipline is :func:`all_gather_rank`'s (alternating
+    slots, slot-1 credit at start, per-step re-grant except the final
+    step), but arrivals are ASSEMBLED by ring position instead of
+    delivered per source — the protocol never opens a bundle, it only
+    knows which source each hop's arrival came from. Delivery: one
+    ``("output", 0, combine(bundles))`` where ``bundles`` is the
+    n-tuple of every rank's bundle in source order and ``combine`` is
+    the caller's local densify-and-reduce policy."""
+    left, right = to_global((me - 1) % n), to_global((me + 1) % n)
+    if flow_control:
+        yield from _barrier_steps(me, n, to_global)
+    gathered: list = [None] * n
+    gathered[me] = bundle
+    yield ("write_slot", 0, bundle)
+    if flow_control:
+        yield ("signal", left, SEM_CREDIT, 1, 1)
+    for s in range(n - 1):
+        slot, nslot = s % 2, (s + 1) % 2
+        if flow_control:
+            yield ("wait", SEM_CREDIT, nslot, 1)
+        payload = yield ("read_slot", slot)
+        yield ("dma", right, nslot, payload, slot, nslot)
+        yield ("wait", SEM_SEND, slot, 1)
+        yield ("wait", SEM_RECV, nslot, 1)
+        if flow_control and s < n - 2:
+            yield ("signal", left, SEM_CREDIT, slot, 1)
+        arrived = yield ("read_slot", nslot)
+        gathered[(me - s - 1) % n] = arrived
+    yield ("output", 0, combine(tuple(gathered)))
 
 
 # ---------------------------------------------------------------------------
@@ -2283,6 +2437,193 @@ def pod_wallclock_comparison(slices: int, per_slice: int,
         "payload_bytes": payload_bytes,
         "flat_s": flat_sim.elapsed_seconds(),
         "hierarchical_s": hier_sim.elapsed_seconds(),
+    }
+
+
+def _q_encode(v):
+    """The harness wire codec: tag every element — content-addressed,
+    so the delivery check proves the codec round-tripped through every
+    hop (wrong bits OR a skipped decode both fail)."""
+    return frozenset(("q8", e) for e in v)
+
+
+def _q_decode(w):
+    """Inverse of :func:`_q_encode`, type-preserving under in-flight
+    damage: an element that is not a recognized tag (a bitflipped
+    marker, a truncated pair) decodes to itself, so bare-transport
+    corruption COMPLETES with wrong delivery — the silent-corruption
+    outcome the framing exists to catch — instead of crashing."""
+    return frozenset(
+        e[1] if isinstance(e, tuple) and len(e) == 2 and e[0] == "q8"
+        else e
+        for e in w
+    )
+
+
+def all_reduce_quantized_generators(slices: int, per_slice: int,
+                                    flow_control: bool = True):
+    """Per-rank quantized two-tier allreduce programs with the standard
+    symbolic contributions under the tagging wire codec."""
+    n = slices * per_slice
+    return [
+        all_reduce_quantized_rank(
+            g, slices, per_slice,
+            [frozenset([(g, c)]) for c in range(per_slice)],
+            lambda a, b: a | b, _q_encode, _q_decode,
+            flow_control=flow_control,
+        )
+        for g in range(n)
+    ]
+
+
+def simulate_all_reduce_quantized(slices: int, per_slice: int,
+                                  strategy: Strategy,
+                                  flow_control: bool = True, faults=None,
+                                  verified: bool = False,
+                                  costs: Optional[TierCostModel] = None,
+                                  recorder=None) -> float:
+    """Fuzz one schedule of the quantized pod allreduce and verify that
+    every rank's DECODED outputs hold the full per-block reduction —
+    wrong delivery in any block, or a codec that failed to round-trip,
+    is a :class:`ProtocolError`. Returns the simulated wall-clock."""
+    n = slices * per_slice
+    sim = RingSimulator(
+        _maybe_verified(
+            all_reduce_quantized_generators(slices, per_slice,
+                                            flow_control),
+            verified,
+        ),
+        strategy, faults=faults, costs=costs, recorder=recorder,
+    )
+    outputs = sim.run()
+    want = {
+        c: frozenset((g, c) for g in range(n))
+        for c in range(per_slice)
+    }
+    for g in range(n):
+        if outputs[g] != want:
+            raise ProtocolError(
+                f"rank {g} reduced {outputs[g]}, wanted {want}"
+            )
+    return sim.elapsed_seconds()
+
+
+def _sparse_bundle(src: int):
+    """The standard symbolic sparse contribution: one opaque
+    (index, value) bundle, content-addressed per source."""
+    return (("idx", src), ("val", src))
+
+
+def all_reduce_sparse_generators(n: int, flow_control: bool = True):
+    """Per-rank sparse allreduce programs with the standard bundles.
+    The local ``combine`` is the identity on the gathered tuple — the
+    harness's delivery check addresses every bundle by content, so
+    wrong routing and wrong bits both fail."""
+    return [
+        all_reduce_sparse_rank(r, n, _sparse_bundle(r), lambda bs: bs,
+                               flow_control=flow_control)
+        for r in range(n)
+    ]
+
+
+def simulate_all_reduce_sparse(n: int, strategy: Strategy,
+                               flow_control: bool = True, faults=None,
+                               verified: bool = False,
+                               costs: Optional[TierCostModel] = None,
+                               recorder=None) -> float:
+    """Fuzz one schedule of the sparse allreduce and verify that every
+    rank gathered every source's bundle in source order — a missing,
+    damaged, or misrouted bundle is a :class:`ProtocolError`. Returns
+    the simulated wall-clock."""
+    sim = RingSimulator(
+        _maybe_verified(
+            all_reduce_sparse_generators(n, flow_control), verified
+        ),
+        strategy, faults=faults, costs=costs, recorder=recorder,
+    )
+    outputs = sim.run()
+    want = {0: tuple(_sparse_bundle(src) for src in range(n))}
+    for r in range(n):
+        if outputs[r] != want:
+            raise ProtocolError(
+                f"rank {r} reduced {outputs[r]}, wanted {want}"
+            )
+    return sim.elapsed_seconds()
+
+
+def quantized_wallclock_comparison(slices: int, per_slice: int,
+                                   payload_bytes: float,
+                                   precision: str = "int8",
+                                   seed: int = 0,
+                                   ici: Optional[LinkCost] = None,
+                                   dcn: Optional[LinkCost] = None) -> Dict:
+    """Same two-tier allreduce, f32 wire vs quantized wire, on the same
+    deterministic schedule seed and rates — the r19 A/B vector.
+
+    Both runs are the pod composition at ``payload/per_slice`` shard
+    granularity; the quantized run's every wire message is scaled by
+    :data:`PRECISION_WIRE_RATIO` through the per-tier ``ici_bytes`` /
+    ``dcn_bytes`` (the PR-12 sizing), and its protocol is
+    ``all_reduce_quantized`` — codec applied, delivery verified
+    per-block against the identical reduction the f32 run must also
+    deliver. The dict carries the two makespans plus each run's
+    analytic DCN-phase wall-clock ((slices-1) crossings of the shard
+    at the tier's alpha-beta) — the phase the beta attack targets.
+    Deterministic per (shape, payload, precision, seed, rates)."""
+    if precision not in PRECISION_WIRE_RATIO:
+        raise ValueError(
+            f"unknown precision {precision!r}; known: "
+            f"{sorted(PRECISION_WIRE_RATIO)}"
+        )
+    ratio = PRECISION_WIRE_RATIO[precision]
+    shard = payload_bytes / per_slice
+    f32_costs = default_tier_costs(shard, per_slice, ici=ici, dcn=dcn)
+    q_costs = default_tier_costs(
+        shard * ratio, per_slice, ici=ici, dcn=dcn,
+        ici_bytes=shard * ratio, dcn_bytes=shard * ratio,
+    )
+    n = slices * per_slice
+    want = {
+        c: frozenset((g, c) for g in range(n))
+        for c in range(per_slice)
+    }
+    f32_sim = RingSimulator(
+        allreduce_pod_generators(slices, per_slice),
+        Strategy(seed), costs=f32_costs,
+    )
+    f32_out = f32_sim.run()
+    for g in range(n):
+        if f32_out[g] != want:
+            raise ProtocolError(
+                f"f32 rank {g} reduced {f32_out[g]}, wanted {want}"
+            )
+    q_sim = RingSimulator(
+        all_reduce_quantized_generators(slices, per_slice),
+        Strategy(seed), costs=q_costs,
+    )
+    q_out = q_sim.run()
+    for g in range(n):
+        if q_out[g] != want:
+            raise ProtocolError(
+                f"quantized rank {g} reduced {q_out[g]}, wanted {want}"
+            )
+
+    def dcn_phase(costs: TierCostModel) -> float:
+        if slices < 2:
+            return 0.0
+        # one rank's phase-B lap: (slices - 1) steps, each one DCN
+        # crossing of the shard at the slow tier's alpha + bytes/beta
+        return (slices - 1) * costs.dma_seconds(0, per_slice)
+
+    return {
+        "slices": slices,
+        "per_slice": per_slice,
+        "payload_bytes": payload_bytes,
+        "precision": precision,
+        "f32_s": f32_sim.elapsed_seconds(),
+        "quantized_s": q_sim.elapsed_seconds(),
+        "f32_dcn_s": dcn_phase(f32_costs),
+        "quantized_dcn_s": dcn_phase(q_costs),
     }
 
 

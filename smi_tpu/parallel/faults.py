@@ -110,6 +110,15 @@ POD_PROTOCOLS = C.POD_PROTOCOLS
 #: rank counts loudly.
 ALLTOALL_PROTOCOLS = C.ALLTOALL_PROTOCOLS
 
+#: The compressed-wire allreduce family (r19: quantized pod
+#: composition + top-k sparse gather), runnable through
+#: :func:`run_under_faults` but NOT in the seed-pinned base sweep —
+#: same discipline as every post-seed registry. Quantization changes
+#: the VALUES by contract, never the framing: a bit flip on a
+#: quantized or sparse frame is still a named IntegrityError, and bare
+#: transport is still proven SilentCorruption.
+QUANTIZED_PROTOCOLS = C.QUANTIZED_PROTOCOLS
+
 #: Serving-level fault classes, deliberately NOT in
 #: :data:`FAULT_CLASSES` (same seed-pinning rule as
 #: :data:`ELASTIC_FAULT_CLASSES`). They drive the multi-tenant
@@ -954,6 +963,19 @@ def _simulate(protocol: str, n: int, strategy: C.Strategy,
         C.simulate_all_to_all_pod(slices, n // slices, strategy,
                                   faults=plan, verified=verified,
                                   recorder=recorder)
+    elif protocol == "all_reduce_quantized":
+        if n % slices:
+            raise ValueError(
+                f"all_reduce_quantized needs n divisible by slices, "
+                f"got n={n} slices={slices}"
+            )
+        C.simulate_all_reduce_quantized(slices, n // slices, strategy,
+                                        faults=plan, verified=verified,
+                                        recorder=recorder)
+    elif protocol == "all_reduce_sparse":
+        C.simulate_all_reduce_sparse(n, strategy, faults=plan,
+                                     verified=verified,
+                                     recorder=recorder)
     else:
         raise ValueError(
             f"unknown protocol {protocol!r}; known: "

@@ -439,6 +439,162 @@ def alltoall_candidates(
     return CandidateSet([c for _, c in order], excluded)
 
 
+# ---------------------------------------------------------------------------
+# Precision candidates: compressed-collective wire widths (r19)
+# ---------------------------------------------------------------------------
+# Hockney says the large-payload allreduce is pure bytes/beta — the
+# quantized protocols attack the bytes. The model prices each precision
+# by shrinking the wire payload through the SAME ring/rs_ag/
+# hierarchical formulas used for the algorithm choice, so a precision
+# pick is always "best algorithm at the reduced width", never a
+# separate code path.
+
+#: Wire bytes per dense precision as a fraction of f32 — MUST equal
+#: ``credits.PRECISION_WIRE_RATIO`` (drift-guarded); re-declared so
+#: the model stays importable without the simulator module.
+PRECISION_WIRE_RATIO = {"f32": 1.0, "bf16": 0.5, "int8": 0.25}
+
+#: Top-k sparse wire shape — MUST equal the credits constants
+#: (drift-guarded): k/n density times the (index, value) bundle
+#: overhead. Net: 1/8 of the dense f32 bytes.
+SPARSE_TOPK_DENSITY = 1.0 / 16.0
+SPARSE_INDEX_OVERHEAD = 2.0
+
+#: Every precision the plan engine may name; declaration order is the
+#: tie-break order (lossless first).
+ALLREDUCE_PRECISIONS = ("f32", "bf16", "int8", "topk")
+
+#: Payload floor for the lossy precisions: below this the collective
+#: is alpha-bound (the same regime the ``RS_AG_MIN_BYTES`` crossover
+#: documents) and the quantize/dequantize epilogue plus the scale
+#: exchange outweigh any beta win — the model EXCLUDES lossy
+#: candidates there rather than ranking a modeled win the wire cannot
+#: deliver.
+QUANTIZE_MIN_BYTES = 64 * 1024
+
+#: Confidence margin of the MODEL rung of ``engine.use_precision``: a
+#: modeled advantage must clear this factor before the model alone may
+#: propose a lossy precision. Set equal to the int8 byte ratio (4x),
+#: which upper-bounds every modeled win (the alphas are unchanged, so
+#: the ratio sits strictly below 4). The bound is deliberate: the
+#: model alone can NEVER flip numerics — only an explicit ``precision=``
+#: pin, the ``$SMI_TPU_ALLREDUCE_PRECISION`` knob, or a MEASURED cache
+#: entry puts a lossy width on the wire.
+PRECISION_MODEL_MARGIN = 4.0
+
+
+def precision_wire_fraction(precision: str) -> float:
+    """Wire bytes of one precision as a fraction of dense f32 — loud
+    on an unknown name (never a silent full-width fallback)."""
+    if precision == "topk":
+        return SPARSE_TOPK_DENSITY * SPARSE_INDEX_OVERHEAD
+    try:
+        return PRECISION_WIRE_RATIO[precision]
+    except KeyError:
+        raise ValueError(
+            f"unknown allreduce precision {precision!r}; expected one "
+            f"of {ALLREDUCE_PRECISIONS}"
+        ) from None
+
+
+def precision_ineligibility(
+    precision: str, op: str, dtype: str, payload_bytes: float,
+) -> Optional[str]:
+    """Why a LOSSY precision cannot run here (``None`` = eligible).
+    ``f32`` is the identity and is always eligible."""
+    if precision == "f32":
+        return None
+    if op != "add":
+        return (f"op {op!r} is not ADD — compensated rounding is "
+                f"defined only for additive reduction")
+    if dtype.startswith(("int", "uint")) or dtype == "bool":
+        return (f"dtype {dtype!r} is exact — quantizing an integer "
+                f"reduction silently changes its semantics")
+    if payload_bytes < QUANTIZE_MIN_BYTES:
+        return (f"payload {int(payload_bytes)} B sits below the "
+                f"{QUANTIZE_MIN_BYTES // 1024} KiB quantize floor — "
+                f"alpha-bound, the cast epilogue outweighs the beta "
+                f"win")
+    return None
+
+
+def allreduce_precision_candidates(
+    payload_bytes: int,
+    topo: TopologySpec,
+    dtype: str = "float32",
+    op: str = "add",
+    link: LinkModel = LinkModel(),
+    dcn: Optional[LinkModel] = None,
+) -> CandidateSet:
+    """Precision x algorithm candidate table for an allreduce, best
+    first. Each precision is priced as its BEST algorithm at the
+    reduced wire width — the precision rides the r6/r12 algorithm
+    table, it does not fork it. Ineligible lossy precisions (non-ADD
+    op, exact integer dtype, below the payload floor) land on
+    ``excluded`` with the refusal in the note — the no-silent-caps
+    pattern ``tune --explain allreduce`` renders; ``f32`` is always
+    feasible. Ties keep declaration order: lossless first.
+    """
+    if dcn is None:
+        dcn = dcn_link_model()
+    feasible = []
+    excluded = []
+    for precision in ALLREDUCE_PRECISIONS:
+        why = precision_ineligibility(precision, op, dtype,
+                                       payload_bytes)
+        if why is not None:
+            excluded.append(Candidate(
+                precision, {"precision": precision}, modeled_us=None,
+                note=f"EXCLUDED: {why}",
+            ))
+            continue
+        frac = precision_wire_fraction(precision)
+        best = allreduce_candidates(payload_bytes * frac, topo,
+                                    link, dcn)[0]
+        sparse_note = (
+            f" (density {SPARSE_TOPK_DENSITY:g} x "
+            f"{SPARSE_INDEX_OVERHEAD:g} index overhead)"
+            if precision == "topk" else ""
+        )
+        feasible.append(Candidate(
+            precision,
+            {"precision": precision,
+             "algorithm": best.knobs["algorithm"]},
+            modeled_us=best.modeled_us,
+            note=f"{frac:g}x wire bytes via {best.name}" + sparse_note,
+        ))
+    order = sorted(enumerate(feasible),
+                   key=lambda ic: (ic[1].modeled_us, ic[0]))
+    return CandidateSet([c for _, c in order], excluded)
+
+
+def precision_advantage(
+    payload_bytes: float,
+    topo: TopologySpec,
+    precision: str,
+    link: LinkModel = LinkModel(),
+    dcn: Optional[LinkModel] = None,
+) -> float:
+    """Modeled speedup of one precision over dense f32 (best algorithm
+    on each side; ``> 1`` = the reduced width wins). Bounded above by
+    the byte ratio — the alphas are unchanged — so the dense quantized
+    widths (bf16 2x, int8 4x) stay strictly below
+    :data:`PRECISION_MODEL_MARGIN`, the bound the engine's model rung
+    leans on. ``topk``'s 8x byte-ratio bound EXCEEDS the margin, which
+    is exactly why the model rung never consults it: a sparse width
+    reaches the wire only through a measured crossover or an explicit
+    pin."""
+    if dcn is None:
+        dcn = dcn_link_model()
+    base = allreduce_candidates(payload_bytes, topo, link,
+                                dcn)[0].modeled_us
+    wire = payload_bytes * precision_wire_fraction(precision)
+    lossy = allreduce_candidates(wire, topo, link, dcn)[0].modeled_us
+    if lossy <= 0.0:
+        return math.inf if base > 0 else 0.0
+    return base / lossy
+
+
 def chunk_pipeline_us(
     payload_bytes: float, n: int, chunks: int, link: LinkModel,
     overlappable_us: float = 0.0,

@@ -228,6 +228,37 @@ class PlanEngine:
                     f"outside the {HIER_MODEL_MARGIN:g}x confidence "
                     f"band only)"
                 )
+        pcands = cm.allreduce_precision_candidates(
+            payload_bytes, topo, dtype=dtype, link=self.link
+        )
+        p, p_layer = self.use_precision(payload_bytes, topo, dtype)
+        if (hit is not None and "precision" in hit.knobs
+                and p == str(hit.knobs["precision"])):
+            pcands = cm.CandidateSet(
+                [
+                    Candidate(c.name, c.knobs, c.modeled_us,
+                              hit.cost_us if c.name == p else None,
+                              c.note)
+                    for c in pcands
+                ],
+                pcands.excluded,
+            )
+        knobs["precision"] = p
+        decided["precision"] = p_layer
+        if p_layer in ("model", "heuristic"):
+            rationale.append(
+                f"wire precision: dense f32 — the model may propose a "
+                f"lossy width only past "
+                f"{cm.PRECISION_MODEL_MARGIN:g}x modeled advantage, "
+                f"a bar the byte ratio alone cannot clear; int8/topk "
+                f"reach the auto path through a measured sweep "
+                f"crossover or an explicit pin"
+            )
+        for dropped in pcands.excluded:
+            rationale.append(
+                f"excluded {dropped.name}: {dropped.note}"
+            )
+        cands = list(cands) + list(pcands)
         return Plan(key=key, knobs=knobs, decided_by=decided,
                     candidates=cands, rationale=rationale)
 
@@ -374,6 +405,110 @@ class PlanEngine:
         return self._memoized(
             ("use_hier", payload_bytes, topo, dtype,
              min_slices, min_slices_layer, dk),
+            compute,
+        )
+
+    def precision_threshold(
+        self, outer: int, device_kind: Optional[str] = None
+    ) -> Optional[Tuple[int, str, str]]:
+        """(bytes, precision, "cache") of the measured dense/lossy
+        wire-width crossover for an ``outer``-slice pod (0 = flat), or
+        ``None`` when no sweep has persisted one. Written by
+        ``sweep.sweep_allreduce_precision`` per (device kind, slice
+        count) — the ATLAS discipline applied to the wire width: a
+        lossy precision reaches the auto path only through a
+        measurement, never through the model alone."""
+        dk = normalize_device_kind(device_kind or self.device_kind())
+
+        def compute():
+            for kind in (dk, "unknown"):
+                hit = self.cache.lookup(
+                    PlanKey("all_reduce", "precision_threshold", "",
+                            kind, f"dcn{outer}" if outer else "flat")
+                )
+                if (hit is not None
+                        and "precision_min_bytes" in hit.knobs
+                        and "precision" in hit.knobs):
+                    return (int(hit.knobs["precision_min_bytes"]),
+                            str(hit.knobs["precision"]), "cache")
+            return None
+
+        return self._memoized(("precision_threshold", outer, dk),
+                              compute)
+
+    def use_precision(
+        self,
+        payload_bytes: int,
+        topo: cm.TopologySpec,
+        dtype: str = "float32",
+        op: str = "add",
+        precision: Optional[str] = None,
+        precision_layer: str = "env",
+    ) -> Tuple[str, str]:
+        """Trace-time wire-precision gate for
+        ``collectives.allreduce(precision=None)``.
+
+        ``precision`` given = an explicit override (the ``precision=``
+        pin or the ``$SMI_TPU_ALLREDUCE_PRECISION`` env var) — it
+        decides ALONE; eligibility (ADD op, floating dtype) is the
+        CALLER's loud error, never a silent f32 fallback. Otherwise:
+        per-bucket cache entry (skipped with a fall-through when it
+        names a precision this op/dtype cannot run — a cache written
+        for one call site must not error another), then the measured
+        crossover threshold, then the model — which may propose a
+        lossy width only past :data:`cm.PRECISION_MODEL_MARGIN`, a
+        margin chosen to EQUAL the int8 byte ratio so the modeled
+        advantage (strictly below it; the alphas are unchanged) can
+        never clear it: the model alone never flips numerics. Then
+        the heuristic: dense f32, byte-for-byte the untuned lowering.
+        """
+        dk = self.device_kind()
+
+        def compute():
+            if precision is not None:
+                return precision, precision_layer
+            key = PlanKey("all_reduce", payload_bucket(payload_bytes),
+                          dtype, dk, _collective_topology(topo))
+            hit = self.cache.lookup(key)
+            if hit is not None and "precision" in hit.knobs:
+                p = str(hit.knobs["precision"])
+                if (p in cm.ALLREDUCE_PRECISIONS
+                        and cm.precision_ineligibility(
+                            p, op, dtype, payload_bytes) is None):
+                    return p, cache_entry_layer(hit)
+            outer = ((topo.outer or 0)
+                     if topo.hierarchical_eligible else 0)
+            thr = self.precision_threshold(outer)
+            if thr is not None:
+                min_bytes, p, _layer = thr
+                if (payload_bytes >= min_bytes
+                        and p in cm.ALLREDUCE_PRECISIONS
+                        and cm.precision_ineligibility(
+                            p, op, dtype, payload_bytes) is None):
+                    return p, "cache"
+                return "f32", "cache"
+            # the model rung — provably inert by construction (the
+            # margin equals int8's 4x byte-ratio bound, and the
+            # advantage is strictly below the ratio), kept so the
+            # ladder stays uniform and the explain surface can say WHY
+            # the model never decides here. topk is not consulted: its
+            # 8x byte ratio EXCEEDS the margin, and sparsification
+            # drops coordinates outright — it reaches the wire only
+            # through a measured crossover or an explicit pin
+            for p in ("int8", "bf16"):
+                if cm.precision_ineligibility(
+                        p, op, dtype, payload_bytes) is not None:
+                    continue
+                advantage = cm.precision_advantage(
+                    payload_bytes, topo, p, link=self.link
+                )
+                if advantage >= cm.PRECISION_MODEL_MARGIN:
+                    return p, "model"
+            return "f32", "heuristic"
+
+        return self._memoized(
+            ("use_precision", payload_bytes, topo, dtype, op,
+             precision, precision_layer, dk),
             compute,
         )
 
@@ -942,6 +1077,34 @@ def planned_alltoall(
         )[0]
     except Exception:
         return "pairwise" if algorithm is None else algorithm
+
+
+def planned_precision(
+    payload_bytes: int,
+    n: int,
+    inner: int,
+    outer: int,
+    dtype: str,
+    precision: Optional[str] = None,
+) -> str:
+    """Trace-time wire-precision consult for an eligible ADD allreduce.
+    ``precision`` carries an explicit override (the ``precision=`` pin
+    or ``$SMI_TPU_ALLREDUCE_PRECISION``) — it decides ALONE. Never
+    raises; the fallback is dense f32, byte-for-byte the untuned
+    lowering."""
+    try:
+        return get_engine().use_precision(
+            payload_bytes,
+            cm.TopologySpec(
+                n=n,
+                inner=inner if outer and outer > 1 else None,
+                outer=outer if outer and outer > 1 else None,
+            ),
+            dtype,
+            precision=precision,
+        )[0]
+    except Exception:
+        return "f32" if precision is None else precision
 
 
 def planned_rs_ag(

@@ -149,14 +149,28 @@ def retune_margin() -> float:
 
 
 def op_candidates(op: str, payload_bytes: float, topo: cm.TopologySpec,
-                  link: Optional[cm.LinkModel] = None):
+                  link: Optional[cm.LinkModel] = None,
+                  dtype: str = "float32"):
     """The rival candidate table for one tunable op — the SAME pricing
     ``tune --explain`` prints and the analytic-regression lint rule
-    recomputes (one pricing, every consumer)."""
+    recomputes (one pricing, every consumer). For ``all_reduce`` the
+    table is algorithms FIRST (so :func:`priced_sample_us`'s
+    first-algorithm-match scan is unchanged), then the lossy wire
+    precisions from :func:`cm.allreduce_precision_candidates` — the
+    r19 vocabulary growth that lets live traffic retune a dense plan
+    into an int8 one through the same swap machine."""
     link = link or cm.LinkModel()
     if op == "all_reduce":
-        return cm.allreduce_candidates(int(payload_bytes), topo,
-                                       link=link)
+        algos = cm.allreduce_candidates(int(payload_bytes), topo,
+                                        link=link)
+        pcands = cm.allreduce_precision_candidates(
+            int(payload_bytes), topo, dtype=dtype, link=link
+        )
+        # drop the dense f32 row: it IS the best algorithm candidate,
+        # and a duplicate identity would let the tuner propose a
+        # no-op swap
+        lossy = [c for c in pcands if c.name != "f32"]
+        return cm.CandidateSet(list(algos) + lossy, pcands.excluded)
     if op == "all_to_all":
         return cm.alltoall_candidates(int(payload_bytes), topo,
                                       link=link)
@@ -416,6 +430,25 @@ class OnlineTuner:
             swap = self._swaps[sig] = PlanSwap(self.cache, key)
         return swap
 
+    def _lossy_rivals_armed(self) -> bool:
+        """Is there MEASURED evidence that a lossy wire width works on
+        this device kind — the quantized sweep's distilled
+        ``precision_threshold`` crossover? Mirrors the plan engine's
+        ladder: without it the live tier, like the model rung, only
+        reroutes (algorithm swaps) and never flips numerics."""
+        outer = ((self.topo.outer or 0)
+                 if self.topo.hierarchical_eligible else 0)
+        for kind in (self.device_kind, "unknown"):
+            hit = self.cache.lookup(
+                PlanKey("all_reduce", "precision_threshold", "", kind,
+                        f"dcn{outer}" if outer else "flat")
+            )
+            if (hit is not None
+                    and "precision_min_bytes" in hit.knobs
+                    and "precision" in hit.knobs):
+                return True
+        return False
+
     def active_entry(self, key: Optional[PlanKey]) -> Optional[CacheEntry]:
         return None if key is None else self.cache.lookup(key)
 
@@ -457,11 +490,30 @@ class OnlineTuner:
             if entry is None or "algorithm" not in entry.knobs:
                 # nothing to retune: first plans are the sweep's job
                 continue
+            # the plan's identity is (algorithm, wire precision): an
+            # int8 row with the active algorithm is a genuine rival
+            # of the dense plan, and vice versa
             active = str(entry.knobs["algorithm"])
-            cands = op_candidates(op, bucket, self.topo, self.link)
-            rivals = [c for c in cands
-                      if c.knobs.get("algorithm") != active
-                      and c.modeled_us is not None]
+            active_id = (active,
+                         str(entry.knobs.get("precision", "f32")))
+            cands = op_candidates(op, bucket, self.topo, self.link,
+                                  dtype=self.dtype)
+            # the r19 asymmetry holds on the live tier too: a lossy
+            # width is model-priced here, and the model alone must
+            # never flip numerics — lossy rows join the rival pool
+            # only once a measured precision artifact exists (the
+            # quantized sweep's crossover, or the active plan already
+            # runs a lossy width and we're retuning between widths)
+            lossy_armed = (active_id[1] != "f32"
+                           or self._lossy_rivals_armed())
+            rivals = [
+                c for c in cands
+                if (str(c.knobs.get("algorithm")),
+                    str(c.knobs.get("precision", "f32"))) != active_id
+                and c.modeled_us is not None
+                and (lossy_armed
+                     or str(c.knobs.get("precision", "f32")) == "f32")
+            ]
             if not rivals:
                 continue
             best = min(rivals, key=lambda c: c.modeled_us)
@@ -478,6 +530,13 @@ class OnlineTuner:
                 "rival_modeled_us": round(best.modeled_us, 3),
                 "advantage": round(advantage, 2),
             }
+            rival_precision = str(best.knobs.get("precision", "f32"))
+            if rival_precision != "f32" or active_id[1] != "f32":
+                # a precision change is named in the evidence — a
+                # numerics-affecting swap must never look like a pure
+                # routing change in the audit log
+                evidence["from_precision"] = active_id[1]
+                evidence["to_precision"] = rival_precision
             new_entry = CacheEntry(
                 knobs=dict(best.knobs),
                 cost_us=None,

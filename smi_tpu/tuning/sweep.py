@@ -211,6 +211,101 @@ def sweep_allreduce_hierarchical(
     return cache
 
 
+def sweep_allreduce_precision(
+    comm,
+    sizes_kb: Sequence[int] = (64, 256, 1024, 4096),
+    runs: int = 5,
+    device_kind: Optional[str] = None,
+    verbose: bool = False,
+) -> PlanCache:
+    """Time the allreduce wire precisions (f32/bf16/int8/topk) per
+    payload size; persist the winners per (slices, payload bucket) and
+    distill the measured dense/lossy crossover into the
+    ``precision_threshold`` entry — the ATLAS rule applied to the wire
+    width: a lossy precision reaches the auto path only through this
+    measured artifact (the model rung's margin equals the int8 byte
+    ratio, so it can never flip numerics on its own). Runs on a flat
+    or a hybrid multi-slice communicator; entries are keyed by the
+    MEASURED device kind and topology, so a CPU sweep can neither
+    shadow a v5e entry nor leak across pod shapes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from smi_tpu.parallel import collectives as coll
+
+    topo = cm.topology_from_comm(comm)
+    n = topo.n
+    inner = topo.inner or n
+    outer = (topo.outer or 0) if topo.hierarchical_eligible else 0
+    dk = normalize_device_kind(
+        device_kind or jax.devices()[0].device_kind
+    )
+    spec = (P(tuple(comm.axis_names)) if len(comm.axis_names) > 1
+            else P(comm.axis_names[0]))
+    cache = PlanCache()
+    lossy_wins = []   # (payload bytes, precision) the lossy form won at
+
+    for kb in sizes_kb:
+        # divisible by the inner axis so every precision can ride the
+        # same decomposition the auto algorithm gate would pick
+        elems = max(inner, (kb * 1024 // 4) // inner * inner)
+        payload_bytes = elems * 4
+
+        def make(precision: str):
+            def shard_fn(x):
+                y = coll.allreduce(x, comm, precision=precision)
+                return jnp.sum(y)[None]
+
+            fn = jax.jit(jax.shard_map(
+                shard_fn, mesh=comm.mesh, in_specs=P(),
+                out_specs=spec, check_vma=False,
+            ))
+            return lambda x: np.asarray(fn(x))
+
+        x = jnp.ones(elems, jnp.float32)
+        results = []
+        for precision in cm.ALLREDUCE_PRECISIONS:
+            secs = _measure(make(precision), x, runs)
+            results.append((secs, precision))
+            if verbose:
+                print(
+                    f"  {kb:>7} KiB {precision:>5}: "
+                    f"{secs * 1e6:.1f} us"
+                )
+        secs, precision = min(results)
+        if precision != "f32":
+            lossy_wins.append((payload_bytes, precision))
+        key = PlanKey("all_reduce", payload_bucket(payload_bytes),
+                      "float32", dk, _collective_topology(topo))
+        cache.put(key, CacheEntry(
+            {"precision": precision},
+            cost_us=secs * 1e6,
+            provenance=f"sweep:allreduce-precision:{kb}KiB:"
+                       + (f"{outer}x{inner}" if outer else f"n{n}"),
+        ))
+
+    if lossy_wins:
+        # the SMALLEST payload any lossy width won at (and that
+        # winner), regardless of --sizes-kb iteration order — the
+        # measured crossover the trace-time gate consults between
+        # per-bucket entries
+        min_bytes, precision = min(lossy_wins)
+        cache.put(
+            PlanKey("all_reduce", "precision_threshold", "", dk,
+                    f"dcn{outer}" if outer else "flat"),
+            CacheEntry(
+                {"precision_min_bytes": int(min_bytes),
+                 "precision": precision},
+                cost_us=None,
+                provenance=f"sweep:precision-crossover:"
+                           + (f"{outer}x{inner}" if outer else f"n{n}"),
+            ),
+        )
+    return cache
+
+
 def sweep_alltoall(
     comm,
     sizes_kb: Sequence[int] = (64, 256, 1024, 4096),

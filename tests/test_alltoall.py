@@ -281,9 +281,11 @@ def test_fault_layer_reexports_the_consolidated_registry():
     assert F.CHUNKED_PROTOCOLS is C.CHUNKED_PROTOCOLS
     assert F.POD_PROTOCOLS is C.POD_PROTOCOLS
     assert F.ALLTOALL_PROTOCOLS is C.ALLTOALL_PROTOCOLS
+    assert F.QUANTIZED_PROTOCOLS is C.QUANTIZED_PROTOCOLS
     flat = C.registered_protocols()
     assert flat == (F.PROTOCOLS + F.CHUNKED_PROTOCOLS
-                    + F.POD_PROTOCOLS + F.ALLTOALL_PROTOCOLS)
+                    + F.POD_PROTOCOLS + F.ALLTOALL_PROTOCOLS
+                    + F.QUANTIZED_PROTOCOLS)
     # the seed-pinned chaos draw set did not grow
     assert C.PROTOCOLS == ("all_gather", "all_reduce",
                            "reduce_scatter", "neighbour_stream")

@@ -361,7 +361,8 @@ def test_analysis_doc_quotes_the_shipped_checks():
     registered = credits.registered_protocols()
     assert registered == (faults.PROTOCOLS + faults.CHUNKED_PROTOCOLS
                           + faults.POD_PROTOCOLS
-                          + faults.ALLTOALL_PROTOCOLS)
+                          + faults.ALLTOALL_PROTOCOLS
+                          + faults.QUANTIZED_PROTOCOLS)
     for protocol in registered:
         assert f"`{protocol}`" in text, f"{protocol} undocumented"
     # the default shape grid covers exactly the registered protocols
@@ -564,7 +565,8 @@ def test_bench_scoreboard_baselines_pin_the_committed_artifacts():
     assert set(board) == {"stencil_gcells_per_chip",
                           "flash_train_tflops",
                           "allreduce_payload_curve_us",
-                          "alltoall_payload_curve_us"}
+                          "alltoall_payload_curve_us",
+                          "compression"}
     for name, entry in board.items():
         assert entry["verdict"] == "pass", (name, entry)
         assert entry["measured"] is False
@@ -579,6 +581,12 @@ def test_bench_scoreboard_baselines_pin_the_committed_artifacts():
     assert a2a["baseline"] == [
         ANALYTIC_EXPECTED_US[f"alltoall_n8_{kb}kib_us"]
         for kb in a2a["payload_kib"]
+    ]
+    comp = board["compression"]
+    assert comp["precision"] == "int8"
+    assert comp["baseline"] == [
+        ANALYTIC_EXPECTED_US[f"allreduce_int8_n8_{kb}kib_us"]
+        for kb in comp["payload_kib"]
     ]
     # live mode: a measured stencil run flips the verdict honestly
     live = bench.scoreboard_fields(r05["parsed"]["value"])
@@ -663,7 +671,8 @@ def test_alltoall_registry_digest_is_pinned():
 
     regs = credits.all_protocol_registries()
     assert list(regs) == ["PROTOCOLS", "CHUNKED_PROTOCOLS",
-                          "POD_PROTOCOLS", "ALLTOALL_PROTOCOLS"]
+                          "POD_PROTOCOLS", "ALLTOALL_PROTOCOLS",
+                          "QUANTIZED_PROTOCOLS"]
     assert regs["PROTOCOLS"] == (
         "all_gather", "all_reduce", "reduce_scatter",
         "neighbour_stream",
@@ -672,8 +681,8 @@ def test_alltoall_registry_digest_is_pinned():
         (name, tuple(protos)) for name, protos in regs.items()
     )).encode()).hexdigest()
     assert digest == (
-        "e4c1b0ec1c5b858c0f5013e15f689f4b56fff45f677c55a949061b15"
-        "aaeddd5d"
+        "e74b8e143b28171692803cb2884723398f0e3903772e0c76d28b73fd"
+        "4aae5dd0"
     ), (
         f"protocol registries changed (digest {digest}) — if this is "
         f"deliberate, update the pin AND confirm the seed-pinned "
@@ -924,3 +933,64 @@ def test_pipeline_vmem_mirrors_pin_the_kernel_constants():
         assert cm.stencil_pipeline_vmem_bytes(
             stripe, 8192, depth
         ) == kpipe.pipeline_vmem_bytes(stripe, 8192, depth)
+
+
+def test_compressed_docs_quote_the_shipped_constants():
+    """The r19 compressed-collectives sections (docs/tuning.md ladder,
+    docs/perf_notes.md accuracy contract) must state the wire ratios,
+    env knob, quantize floor, and inert model margin the code ships —
+    and the constants must agree across the transport and plan tiers
+    (one vocabulary, drift-guarded here)."""
+    from smi_tpu.parallel import credits as C
+    from smi_tpu.tuning import cost_model as cm
+
+    # transport and plan tiers share ONE precision vocabulary
+    assert cm.PRECISION_WIRE_RATIO == C.PRECISION_WIRE_RATIO
+    assert cm.SPARSE_TOPK_DENSITY == C.SPARSE_TOPK_DENSITY
+    assert cm.SPARSE_INDEX_OVERHEAD == C.SPARSE_INDEX_OVERHEAD
+
+    tuning = _read("docs/tuning.md")
+    notes = _read("docs/perf_notes.md")
+    assert "Compressed collectives (r19)" in notes
+    for text in (tuning, notes):
+        assert "SMI_TPU_ALLREDUCE_PRECISION" in text
+        assert f"{cm.PRECISION_MODEL_MARGIN:g}x" in text
+    assert f"{cm.QUANTIZE_MIN_BYTES // 1024} KiB" in tuning
+    for name in cm.ALLREDUCE_PRECISIONS:
+        assert f"`{name}`" in tuning
+
+
+def test_compressed_docs_quote_the_simulated_wallclock(monkeypatch):
+    """The quoted r19 acceptance vectors are re-derived from the
+    deterministic credits simulator at the PUBLISHED rates (a fleet
+    $SMI_TPU_DCN_BETA must not leak in), so docs/perf_notes.md can
+    never drift from what the quantized tier-1 assertions measure."""
+    from smi_tpu.parallel import credits as C
+    from smi_tpu.tuning import cost_model as cm
+
+    monkeypatch.delenv(cm.DCN_BETA_ENV, raising=False)
+    rep = C.quantized_wallclock_comparison(2, 2, 4 << 20, "int8")
+    notes = _read("docs/perf_notes.md")
+    for key in ("f32_s", "quantized_s", "f32_dcn_s",
+                "quantized_dcn_s"):
+        us = f"{round(rep[key] * 1e6, 1):g}"
+        assert us in notes, (
+            f"docs/perf_notes.md does not quote the simulated "
+            f"{key} wall-clock {us} us — regenerate the r19 numbers"
+        )
+    # the committed pins match the recomputed vectors exactly
+    from smi_tpu.analysis.perf import ANALYTIC_EXPECTED_US as E
+
+    assert E["quantized_pod_allreduce_int8_2x2_4mib_us"] == round(
+        rep["quantized_s"] * 1e6, 1)
+    assert E["quantized_pod_dcn_phase_f32_2x2_4mib_us"] == round(
+        rep["f32_dcn_s"] * 1e6, 1)
+    assert E["quantized_pod_dcn_phase_int8_2x2_4mib_us"] == round(
+        rep["quantized_dcn_s"] * 1e6, 1)
+    # the makespan and DCN-phase ratios clear the acceptance bar, and
+    # the doc quotes them at 4 decimal places
+    makespan_ratio = rep["quantized_s"] / rep["f32_s"]
+    dcn_ratio = rep["quantized_dcn_s"] / rep["f32_dcn_s"]
+    assert makespan_ratio <= 0.55
+    assert f"{makespan_ratio:.4f}" in notes
+    assert f"{dcn_ratio:.4f}" in notes
