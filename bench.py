@@ -513,6 +513,20 @@ def partition_fields() -> dict:
     }
 
 
+def streaming_inference_fields() -> dict:
+    """Additive streaming-inference provenance: a small deterministic
+    disaggregated prefill/decode smoke (:func:`smi_tpu.serving.
+    campaign.inference_fields` — pure Python, deterministic per seed,
+    sub-second) reporting prefill/decode rates, interactive TTFT p99,
+    and the KV-handoff/replay counters a healthy no-fault run keeps
+    at zero — the streaming-serving regime this build sustains,
+    measured next to the throughput headline. The legacy
+    metric/value/unit/vs_baseline contract is untouched."""
+    from smi_tpu.serving.campaign import inference_fields
+
+    return inference_fields(seed=0)
+
+
 def pipeline_fields() -> dict:
     """Additive r18 stencil-pipeline provenance: the knobs the plan
     engine would run the double-buffered HBM→VMEM pipeline with
@@ -733,6 +747,13 @@ def main():
         payload["slo"] = slo_fields()
     except Exception as e:
         payload["slo"] = {"error": f"{type(e).__name__}: {e}"}
+    # additive streaming-inference field (same best-effort contract):
+    # the disaggregated prefill/decode smoke's rate/TTFT/handoff
+    # accounting
+    try:
+        payload["inference"] = streaming_inference_fields()
+    except Exception as e:
+        payload["inference"] = {"error": f"{type(e).__name__}: {e}"}
     # additive multi-metric scoreboard (same best-effort contract):
     # the measured stencil plus the committed flash/allreduce
     # baselines, each with a pass/regress verdict

@@ -910,7 +910,9 @@ def cmd_chaos(args: argparse.Namespace) -> int:
                              ("--load", args.load),
                              ("--moe", getattr(args, "moe", False)),
                              ("--partition",
-                              getattr(args, "partition", False)))
+                              getattr(args, "partition", False)),
+                             ("--infer",
+                              getattr(args, "infer", False)))
               if v]
     if len(picked) > 1:
         print(f"error: {' and '.join(picked)} are distinct campaigns; "
@@ -929,6 +931,26 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         print("error: --asymmetric and --flap are distinct "
               "partition cells; pick one (or neither, for the full "
               "campaign)", file=sys.stderr)
+        return 2
+    infer_only = [f for f, v in
+                  (("--kill-decode",
+                    getattr(args, "kill_decode", False)),
+                   ("--kill-prefill",
+                    getattr(args, "kill_prefill", False)),
+                   ("--saturate",
+                    getattr(args, "saturate", False)))
+                  if v]
+    if infer_only and not getattr(args, "infer", False):
+        print(f"error: {' and '.join(infer_only)} "
+              f"appl{'y' if len(infer_only) > 1 else 'ies'} only to "
+              f"--infer (each narrows the streaming-inference "
+              f"campaign to one chaos cell; add --infer)",
+              file=sys.stderr)
+        return 2
+    if len(infer_only) > 1:
+        print(f"error: {' and '.join(infer_only)} are distinct "
+              f"inference cells; pick one (or neither, for the full "
+              f"campaign)", file=sys.stderr)
         return 2
     if getattr(args, "metrics", False) and not args.load:
         print("error: --metrics applies only to --load (the serving "
@@ -954,10 +976,12 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         return _cmd_chaos_moe(args)
     if getattr(args, "partition", False):
         return _cmd_chaos_partition(args)
+    if getattr(args, "infer", False):
+        return _cmd_chaos_infer(args)
     if args.duration is not None or args.n_ranks is not None:
         print("error: --duration/-n apply only to "
-              "--load/--moe/--partition (the base and --elastic "
-              "campaigns sweep --ranks/--trials)",
+              "--load/--moe/--partition/--infer (the base and "
+              "--elastic campaigns sweep --ranks/--trials)",
               file=sys.stderr)
         return 2
     if args.elastic:
@@ -1345,6 +1369,98 @@ def _cmd_chaos_partition(args: argparse.Namespace) -> int:
     return 0 if report["ok"] else 1
 
 
+def _cmd_chaos_infer(args: argparse.Namespace) -> int:
+    """``chaos --infer``: the streaming-inference campaign
+    (:mod:`smi_tpu.serving.campaign`).
+
+    Disaggregated prefill/decode under chaos, per trial: the no-fault
+    smoke, kill-decode-mid-generation (ONE committed KV handoff names
+    the dead rank; delivery bit-identical to the no-fault control,
+    zero lost accepted tokens), kill-prefill (stateless WAL replay —
+    zero handoffs), saturate-decode (the named backpressure blame
+    verdict triggers the handoff, never a membership event),
+    partition-during-handoff (loud fenced abort, loss-free), and the
+    scale-in victim discipline (a rank holding resident KV shards is
+    never the victim). Exit gate: every cell ``ok``.
+    """
+    from smi_tpu.serving.campaign import infer_campaign
+
+    if args.protocols:
+        print("error: --protocols does not apply to --infer (the "
+              "campaign kills and saturates the serving front-end's "
+              "decode/prefill ranks, not a ring protocol)",
+              file=sys.stderr)
+        return 2
+    if args.max_faults is not None:
+        print("error: --max-faults does not apply to --infer (each "
+              "cell injects exactly one inference-class fault; sweep "
+              "more cells with --trials)", file=sys.stderr)
+        return 2
+    if args.ranks is not None:
+        print("error: --ranks does not apply to --infer (one rank "
+              "count per campaign; use -n/--n instead)",
+              file=sys.stderr)
+        return 2
+    only = None
+    if getattr(args, "kill_decode", False):
+        only = "infer-kill-decode"
+    elif getattr(args, "kill_prefill", False):
+        only = "infer-kill-prefill"
+    elif getattr(args, "saturate", False):
+        only = "infer-saturate"
+    try:
+        report = infer_campaign(
+            seed=args.seed,
+            n=args.n_ranks if args.n_ranks is not None else 4,
+            duration=(args.duration if args.duration is not None
+                      else 200),
+            trials=args.trials,
+            only=only,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for cell in report["reports"]:
+        inf = cell["inference"]
+        committed = [h for h in inf["handoffs"]
+                     if h["state"] == "committed"]
+        line = (
+            f"{cell['cell']:>25}: {cell['verdict']}"
+            f" | {inf['states']['done']} done, "
+            f"{len(committed)} handoff(s) committed, "
+            f"{inf['replayed_prefills']} prefill replay(s)"
+        )
+        if "digest_intersection" in cell:
+            line += (
+                f", {cell['digest_intersection']} generation(s) "
+                f"bit-identical to control"
+            )
+        print(line)
+    print(
+        f"{report['cells']} cells (seed {args.seed}), "
+        f"{report['kv_handoffs_committed']} KV handoffs committed, "
+        f"{report['replayed_prefills']} prefills replayed, "
+        f"{report['lost_accepted_tokens']} lost accepted tokens, "
+        f"{report['silent_corruptions']} silent corruptions, "
+        f"{report['stale_epoch_leaks']} stale-epoch leaks"
+    )
+    for failure in report["failures"]:
+        print(
+            f"FAILURE {failure['cell']} trial {failure['trial']}: "
+            f"{failure['verdict']}"
+        )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"report -> {args.out}")
+    if report["ok"]:
+        print("inference campaign ok: decode deaths handed their KV "
+              "off exactly once, prefill deaths replayed statelessly, "
+              "and no accepted token was ever lost")
+    return 0 if report["ok"] else 1
+
+
 def cmd_serve(args: argparse.Namespace) -> int:
     """``serve --selftest``: the deterministic serving smoke.
 
@@ -1358,6 +1474,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     """
     from smi_tpu.serving.campaign import (
         autoscale_selftest,
+        infer_selftest,
         partition_selftest,
         retune_selftest,
         serve_selftest,
@@ -1373,12 +1490,21 @@ def cmd_serve(args: argparse.Namespace) -> int:
               "modes (--json's full report already embeds the "
               "metrics snapshot)", file=sys.stderr)
         return 2
+    if getattr(args, "metrics", False) and getattr(args, "infer",
+                                                   False):
+        print("error: --metrics does not apply to --infer (the "
+              "inference cell reports the engine's own handoff/"
+              "replay counters; use --json for the full report)",
+              file=sys.stderr)
+        return 2
     picked = [f for f, v in (("--retune",
                               getattr(args, "retune", False)),
                              ("--autoscale",
                               getattr(args, "autoscale", False)),
                              ("--partition",
-                              getattr(args, "partition", False)))
+                              getattr(args, "partition", False)),
+                             ("--infer",
+                              getattr(args, "infer", False)))
               if v]
     if len(picked) > 1:
         print(f"error: {' and '.join(picked)} are distinct "
@@ -1390,6 +1516,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         report = autoscale_selftest(seed=args.seed)
     elif getattr(args, "partition", False):
         report = partition_selftest(seed=args.seed)
+    elif getattr(args, "infer", False):
+        report = infer_selftest(seed=args.seed)
     else:
         report = serve_selftest(seed=args.seed)
     if args.json:
@@ -1402,6 +1530,31 @@ def cmd_serve(args: argparse.Namespace) -> int:
              "ok": report["ok"]},
             indent=2, sort_keys=True,
         ))
+    elif getattr(args, "infer", False):
+        inf = report["inference"]
+        committed = [h for h in inf["handoffs"]
+                     if h["state"] == "committed"]
+        print(f"selftest (seed {args.seed}): {report['verdict']}")
+        print(
+            f"      infer: decode rank {report['victim']} killed "
+            f"at tick {report['kill_at']}"
+        )
+        print(
+            f"  generated: {inf['states']['done']} done "
+            f"({inf['tokens_emitted']} tokens), "
+            f"{inf['replayed_prefills']} prefill replay(s)"
+        )
+        print(
+            f"    handoff: {len(committed)} KV handoff(s) committed "
+            f"({', '.join(h['reason'] for h in committed)}), "
+            f"{inf['lost_accepted_tokens']} accepted token(s) lost"
+        )
+        print(
+            f"     digest: {report['digest_intersection']} "
+            f"generation(s) bit-identical to the no-fault control, "
+            f"{report['silent_corruptions']} silent corruptions, "
+            f"{report['stale_epoch_leaks']} stale-epoch leaks"
+        )
     else:
         lat = report["admission_latency"]
         print(f"selftest (seed {args.seed}): {report['verdict']}")
@@ -2734,6 +2887,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "membership oscillation) per trial "
                         "(--trials/-n/--duration apply; "
                         "--protocols/--ranks/--max-faults do not)")
+    p.add_argument("--infer", action="store_true",
+                   help="run the streaming-inference campaign "
+                        "instead: disaggregated prefill/decode "
+                        "serving under chaos — the no-fault smoke, "
+                        "kill-decode-mid-generation (exactly one "
+                        "committed KV handoff naming the dead rank, "
+                        "delivery bit-identical to the no-fault "
+                        "control, zero lost accepted tokens), "
+                        "kill-prefill (stateless WAL replay, zero "
+                        "handoffs), saturate-decode (blame-triggered "
+                        "handoff, never a membership event), "
+                        "partition-during-handoff (loud fenced "
+                        "abort), and the scale-in victim discipline "
+                        "per trial (--trials/-n/--duration apply; "
+                        "--protocols/--ranks/--max-faults do not)")
+    p.add_argument("--kill-decode", action="store_true",
+                   dest="kill_decode",
+                   help="with --infer: run only the "
+                        "kill-decode-mid-generation cell (the "
+                        "stateful KV-shard handoff path)")
+    p.add_argument("--kill-prefill", action="store_true",
+                   dest="kill_prefill",
+                   help="with --infer: run only the kill-prefill "
+                        "cell (the stateless WAL-replay path)")
+    p.add_argument("--saturate", action="store_true",
+                   help="with --infer: run only the saturate-decode "
+                        "cell (the blame-triggered handoff; "
+                        "saturation is not death)")
     p.add_argument("--asymmetric", action="store_true",
                    help="with --partition: run only the "
                         "asymmetric-cut-during-migration cell (the "
@@ -2767,11 +2948,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "after it drains, loss-free throughout "
                         "(--load only)")
     p.add_argument("--duration", type=int, default=None, metavar="TICKS",
-                   help="ticks of open-loop traffic per --load/--moe "
-                        "cell (defaults 240/120; --load/--moe only)")
+                   help="ticks of open-loop traffic per --load/--moe/"
+                        "--infer cell (defaults 240/120/200; "
+                        "--load/--moe/--partition/--infer only)")
     p.add_argument("-n", "--n", type=int, default=None, dest="n_ranks",
-                   help="serving ranks for --load/--moe cells "
-                        "(default 4; --load/--moe only)")
+                   help="serving ranks for --load/--moe/--infer "
+                        "cells (default 4; "
+                        "--load/--moe/--partition/--infer only)")
     p.add_argument("-o", "--out", default=None,
                    help="write the JSON campaign report here")
     p.set_defaults(fn=cmd_chaos)
@@ -2805,6 +2988,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "majority fails over fenced, the heal "
                         "rejoins, and delivery is bit-identical to "
                         "the no-partition control")
+    p.add_argument("--infer", action="store_true",
+                   help="with --selftest: run the seeded "
+                        "kill-decode-mid-generation inference cell "
+                        "instead — prefill, KV transport, generate, "
+                        "kill, fail over through exactly one "
+                        "committed KV-shard handoff, and deliver "
+                        "bit-identically to the no-fault control "
+                        "with zero lost accepted tokens")
     p.add_argument("--seed", type=int, default=0,
                    help="selftest seed (default 0; the report is "
                         "deterministic per seed)")

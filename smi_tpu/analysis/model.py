@@ -108,7 +108,27 @@ The action alphabet (one BFS edge each):
   ``accept_in_minority`` mutant breaks — its stale claim colliding
   with the majority's heir is the ``no-split-brain`` conviction), and
   the heal rejoins a failed-over rank through the straggler rail +
-  the real regrow actuators.
+  the real regrow actuators;
+- ``generate r`` / ``kv_propose`` / ``kv_handoff`` / ``kv_cutover``
+  / ``kv_commit`` / ``kv_abort`` (``infer`` scopes only) — the r20
+  disaggregated-inference arc: a stream whose transport completed
+  does NOT complete the request; its delivered chunks become the
+  resident KV shard set at the decode destination and the request
+  finishes only after ``chunks`` decode tokens are emitted from that
+  residency (``generate``). The KV handoff sub-arc moves a source
+  rank's resident shard sets to its successor: the drain keeps
+  decoding at the source, the handoff fences the source's decode and
+  packs shards + token cursors into a REAL CRC-framed checkpoint
+  shard, the cutover restores FROM the shard under a bumped epoch
+  (the ``_kv_resume`` seam the ``stale_kv_after_cutover`` mutant
+  breaks — it reaches for the propose-time copy and rolls back every
+  token decoded during the drain) and rejects an old-route straggler
+  loudly. A decode death with resident KV takes the WAL-restore
+  handoff path, never the stateless replay reserved for transport
+  (prefill) streams (the ``_kv_failover`` seam the
+  ``decode_failover_without_kv_handoff`` mutant breaks — it replays
+  statelessly and strands the inventory on the dead rank, the
+  ``kv-shard-safety`` conviction).
 
 Scope: everything here is **fault-free wire, faulty control plane** —
 the wire tier's own invariants are the PR 7 verifier's job; what is
@@ -192,7 +212,15 @@ class Scope:
     commit, checkpoint-shard transport, epoch-bumped cutover) plus
     one scale-in/scale-out round trip through the real membership
     actuators, and the ``migration-lost-accepted`` /
-    ``placement-epoch-safety`` properties become non-vacuous.
+    ``placement-epoch-safety`` properties become non-vacuous;
+    ``infer`` (0 or 1) arms the r20 disaggregated-inference arc —
+    transport completion installs each stream's delivered chunks as
+    a resident KV shard set and the request completes only after
+    ``chunks`` decode tokens are generated from it, the action
+    alphabet grows ``generate`` plus the ``kv_propose`` /
+    ``kv_handoff`` / ``kv_cutover`` / ``kv_commit`` / ``kv_abort``
+    handoff sub-arc, and the ``kv-shard-safety`` /
+    ``generation-lost-accepted`` properties become non-vacuous.
     """
 
     tenants: int = 2
@@ -208,6 +236,7 @@ class Scope:
     retune: int = 0
     migrate: int = 0
     partition: int = 0
+    infer: int = 0
 
     def __post_init__(self):
         for dim in ("tenants", "ranks", "chunks"):
@@ -277,6 +306,18 @@ class Scope:
             raise ValueError(
                 "partition=1 needs ranks >= 2 (a partition needs two "
                 "sides)"
+            )
+        if self.infer not in (0, 1):
+            raise ValueError(
+                f"infer must be 0 or 1, got {self.infer} (one KV "
+                f"handoff arc per scope — the front-end drives one "
+                f"handoff at a time, so one arc exhausts its "
+                f"interleavings)"
+            )
+        if self.infer and self.ranks < 2:
+            raise ValueError(
+                "infer=1 needs ranks >= 2 (a KV handoff needs a "
+                "source and a distinct surviving destination)"
             )
 
     def describe(self) -> str:
@@ -377,6 +418,17 @@ DEFAULT_SCOPES: Tuple[Scope, ...] = (
     # for one tenant in one epoch)
     Scope(tenants=2, ranks=3, chunks=2, streams=1, pool=2, consume=1,
           partition=1),
+    # the r20 disaggregated-inference arc: KV-shard transport ->
+    # resident generation -> the drain -> fence -> cutover handoff
+    # sub-arc, interleaved with one decode death (kill=1 pins the
+    # victim to rank 0, tenant 0's decode destination) —
+    # kv-shard-safety and generation-lost-accepted checked on every
+    # reachable state (the exhaustive counterpart of the seeded
+    # kill-decode / saturate-decode inference campaign cells;
+    # consume=1 keeps partially-streamed shard sets reachable
+    # mid-arc, the states where a confused recovery path would hide)
+    Scope(tenants=2, ranks=2, chunks=2, streams=1, pool=2, kill=1,
+          consume=1, infer=1),
 )
 
 
@@ -513,6 +565,26 @@ class World:
         if scope.partition:
             self.partitions_left = 1
             self.minority_accepts_left = 1
+        # -- the r20 inference arc (infer scopes): resident KV shard
+        # inventory + decode-token cursors + the one handoff sub-arc
+        #: stream index -> (rank, route epoch) where the stream's KV
+        #: shard set is resident — the kv-shard-safety evidence
+        self.kv_resident: Dict[int, Tuple[int, int]] = {}
+        #: stream index -> decode tokens emitted from the residency
+        self.kv_tokens: Dict[int, int] = {}
+        self.kv_arc: Optional[Dict] = None
+        self.kv_handoffs_left = 0
+        self.kv_aborts_left = 0
+        #: accepted decode tokens rolled back across a cutover (a
+        #: resume from stale shards) — the generation-lost-accepted
+        #: property's evidence
+        self.kv_lost_tokens = 0
+        self.kv_wal_restores = 0
+        self.kv_handoffs_committed = 0
+        self.kv_tokens_emitted = 0
+        if scope.infer:
+            self.kv_handoffs_left = 1
+            self.kv_aborts_left = 1
         self._bootstrap()
 
     # -- mutant seams (defaults == the shipped frontend behaviour) ------
@@ -608,6 +680,41 @@ class World:
         stale side."""
         return self.partitioned not in self.q_parked
 
+    def _kv_failover(self, st: StreamState, heir: int) -> None:
+        """Decode death with resident KV: the shard set was WAL'd at
+        every delivery, so the heir re-establishes residency and the
+        token cursor from the durable checkpoint — the handoff path,
+        zero shards and zero tokens lost. The
+        decode_failover_without_kv_handoff mutant takes the stateless
+        replay path instead — correct for a transport (prefill)
+        stream, a silent confusion for a resident decode one: the
+        inventory still names the dead rank and kv-shard-safety
+        convicts at the confirm state."""
+        idx = st.index
+        st.dst = heir
+        st.lane_epoch = self.view.epoch
+        self.delivery_meta[idx] = {
+            seq: (heir, self.view.epoch) for seq in st.delivered
+        }
+        self.lanes[heir].next_seq[(idx, self.view.epoch)] = \
+            st.next_to_send
+        self.kv_resident[idx] = (heir, self.view.epoch)
+        self.kv_wal_restores += 1
+
+    def _kv_resume(self, idx: int, restored: Dict) -> tuple:
+        """Where the destination resumes decoding from after the KV
+        cutover: the handoff blob's entry — delivered shards + token
+        cursor exactly as packed at the fence. The
+        stale_kv_after_cutover mutant reaches for the propose-time
+        snapshot instead: every token decoded during the drain is
+        rolled back and re-emitted, and the client's accepted token
+        stream diverges (the generation-lost-accepted conviction)."""
+        handed = restored.get(idx)
+        if handed is None:  # nothing crossed: restart the decode
+            st = next(s for s in self.active if s.index == idx)
+            return (dict(st.delivered), 0)
+        return handed
+
     # -- plumbing -------------------------------------------------------
 
     def _bootstrap(self) -> None:
@@ -679,11 +786,25 @@ class World:
         self.death_epoch[dead] = old_epoch
         plan_regrow_ring(self.view)
         self.lanes[dead].drop_all()
+        if (self.kv_arc is not None
+                and self.kv_arc["state"] in ("draining", "handoff",
+                                             "cutover")
+                and dead in (self.kv_arc["src"], self.kv_arc["dst"])):
+            # a membership change under the in-flight handoff aborts
+            # it loudly; the dead source's residents recover through
+            # the WAL-restore path below, not the half-packed shard
+            self.kv_arc["state"] = "aborted"
         for st in self.active:
             if st.dst != dead:
                 continue
             tenant = int(st.request.tenant[1:])
-            self._reroute_stream(st, self._route(tenant))
+            heir = self._route(tenant)
+            if self.scope.infer and st.index in self.kv_resident:
+                # resident KV: the WAL-handoff recovery path — never
+                # the stateless replay reserved for transport streams
+                self._kv_failover(st, heir)
+            else:
+                self._reroute_stream(st, heir)
         # one straggler from the dead incarnation presents its old
         # epoch after the shrink: reject, never fold in
         try:
@@ -802,7 +923,19 @@ class World:
             )
             st.wal.record((st.index, item.seq), payload)
             if st.complete:
-                self._complete(st)
+                self._on_transport_complete(st)
+
+    def _on_transport_complete(self, st: StreamState) -> None:
+        """Transport done. Non-``infer`` worlds complete the request;
+        ``infer`` worlds instead install the delivered chunks as the
+        stream's resident KV shard set at the decode destination — the
+        request completes only after ``scope.chunks`` decode tokens
+        are generated from that residency."""
+        if not self.scope.infer:
+            self._complete(st)
+            return
+        self.kv_resident[st.index] = (st.dst, st.lane_epoch)
+        self.kv_tokens[st.index] = 0
 
     def _do_kill(self, rank: int) -> None:
         self.kills_left -= 1
@@ -1065,6 +1198,142 @@ class World:
             self.detector.forget(r)
         self.partition_epoch = -1
 
+    # -- the inference arc (infer scopes) -------------------------------
+
+    def _kv_fenced(self, idx: int) -> bool:
+        """Is this stream's decode fenced by the in-flight handoff?
+        Once the shard is packed (``handoff``/``cutover``), the source
+        must stop decoding — tokens emitted after the fence could
+        never be in the blob, so a 'clean' cutover would lose them.
+        The drain itself keeps decoding: that IS the drain."""
+        arc = self.kv_arc
+        return (arc is not None
+                and arc["state"] in ("handoff", "cutover")
+                and idx in arc["streams"])
+
+    def _generatable(self, rank: int) -> bool:
+        return any(
+            self.kv_resident.get(st.index, (None,))[0] == rank
+            and not self._kv_fenced(st.index)
+            for st in self.active
+        )
+
+    def _do_generate(self, rank: int) -> None:
+        """One decode step at ``rank``: every unfenced generating
+        stream resident there emits one token from its resident KV;
+        a stream reaching its token budget completes the request and
+        retires the residency."""
+        for st in list(self.active):
+            idx = st.index
+            res = self.kv_resident.get(idx)
+            if res is None or res[0] != rank or self._kv_fenced(idx):
+                continue
+            self.kv_tokens[idx] += 1
+            self.kv_tokens_emitted += 1
+            if self.kv_tokens[idx] >= st.total_chunks:
+                self.kv_resident.pop(idx)
+                self.kv_tokens.pop(idx)
+                self._complete(st)
+
+    def _do_kv_propose(self) -> None:
+        """Start the one KV handoff arc (the saturation-blame shape):
+        the source is the resident rank of the lowest-index generating
+        stream (a deterministic 'hot' pick the symmetry reduction can
+        reason about), the destination its successor among the
+        members, and the handed-off set every generating stream
+        resident at the source. The propose-time token snapshot is
+        recorded ONLY as the stale copy a broken resume would reach
+        for — the clean arc restores from the handoff blob."""
+        self.kv_handoffs_left -= 1
+        gen = [st for st in self.active
+               if st.index in self.kv_resident
+               and self.kv_resident[st.index][0] in self.view.members]
+        src = self.kv_resident[min(s.index for s in gen)][0]
+        members = sorted(self.view.members)
+        dst = members[(members.index(src) + 1) % len(members)]
+        self.kv_arc = {
+            "state": "draining", "src": src, "dst": dst,
+            "streams": frozenset(
+                st.index for st in gen
+                if self.kv_resident[st.index][0] == src
+            ),
+            "blob": None,
+            "stale": {st.index: self.kv_tokens[st.index]
+                      for st in gen
+                      if self.kv_resident[st.index][0] == src},
+            "handed": {},
+        }
+
+    def _do_kv_handoff(self) -> None:
+        """Fence the source's decode and pack the resident shard sets
+        plus token cursors into a REAL checkpoint shard (CRC +
+        framing) — the transport the serving front-end's failover
+        restore uses, byte for byte."""
+        arc = self.kv_arc
+        snapshot = sorted(
+            (st.index, (dict(sorted(st.delivered.items())),
+                        self.kv_tokens[st.index]))
+            for st in self.active
+            if st.index in arc["streams"]
+            and st.index in self.kv_resident
+        )
+        payload = pickle.dumps(snapshot, protocol=4)
+        blob, _crc = pack_shard(arc["src"], self.view.epoch, payload)
+        arc["blob"] = blob
+        arc["handed"] = {i: (len(d), t) for i, (d, t) in snapshot}
+        arc["state"] = "handoff"
+
+    def _do_kv_cutover(self) -> None:
+        """Epoch-bumped cutover: each handed-off stream resumes at the
+        destination FROM the shard (via the ``_kv_resume`` seam — a
+        resume from the propose-time copy rolls back every token the
+        drain emitted, the generation-lost-accepted conviction),
+        residency and route move together under the fresh epoch, and
+        one straggler from the old route is rejected loudly."""
+        arc = self.kv_arc
+        restored: Dict = {}
+        if arc["blob"] is not None:
+            _r, _s, payload, _c = unpack_shard(arc["blob"])
+            restored = dict(pickle.loads(payload))
+        old_epoch = self.view.epoch
+        new_epoch = self.view.migrate_cutover(arc["src"], arc["dst"])
+        for st in self.active:
+            idx = st.index
+            if (idx not in arc["streams"]
+                    or idx not in self.kv_resident):
+                continue
+            delivered, tokens = self._kv_resume(idx, restored)
+            if tokens < self.kv_tokens[idx]:
+                self.kv_lost_tokens += self.kv_tokens[idx] - tokens
+            st.delivered = dict(delivered)
+            self.kv_tokens[idx] = tokens
+            self.kv_resident[idx] = (arc["dst"], new_epoch)
+            st.dst = arc["dst"]
+            st.lane_epoch = new_epoch
+            self.delivery_meta[idx] = {
+                seq: (arc["dst"], new_epoch) for seq in st.delivered
+            }
+            self.lanes[arc["dst"]].next_seq[(idx, new_epoch)] = \
+                st.next_to_send
+        try:
+            self.view.validate(arc["src"], old_epoch,
+                               what="post-handoff straggler")
+            self.stale_leaks += 1
+        except StaleEpochError:
+            self.stale_rejections += 1
+        arc["state"] = "cutover"
+
+    def _do_kv_commit(self) -> None:
+        self.kv_arc["state"] = "committed"
+        self.kv_handoffs_committed += 1
+
+    def _do_kv_abort(self) -> None:
+        """Abort before cutover: the fence lifts, residency never
+        moved, nothing lost — the source resumes decoding exactly
+        where it stopped."""
+        self.kv_aborts_left -= 1
+        self.kv_arc["state"] = "aborted"
+
     def apply(self, action: Tuple) -> None:
         kind = action[0]
         if kind == "tick":
@@ -1113,6 +1382,18 @@ class World:
             self._do_minority_accept(action[1])
         elif kind == "partition_heal":
             self._do_partition_heal()
+        elif kind == "generate":
+            self._do_generate(action[1])
+        elif kind == "kv_propose":
+            self._do_kv_propose()
+        elif kind == "kv_handoff":
+            self._do_kv_handoff()
+        elif kind == "kv_cutover":
+            self._do_kv_cutover()
+        elif kind == "kv_commit":
+            self._do_kv_commit()
+        elif kind == "kv_abort":
+            self._do_kv_abort()
         else:
             raise ValueError(f"unknown model action {action!r}")
         self._epoch_watermark = max(self._epoch_watermark,
@@ -1251,6 +1532,32 @@ class World:
                         if self._base_rank(t) == r:
                             out.append(("minority_accept", t))
                 out.append(("partition_heal",))
+        if self.scope.infer:
+            for r in sorted(self.view.members):
+                if r in self.killed:
+                    continue
+                if self._generatable(r):
+                    out.append(("generate", r))
+            arc = self.kv_arc
+            if (arc is None and self.kv_handoffs_left > 0
+                    and len(self.view.members) >= 2
+                    and any(st.index in self.kv_resident
+                            and self.kv_resident[st.index][0]
+                            in self.view.members
+                            for st in self.active)):
+                out.append(("kv_propose",))
+            elif arc is not None:
+                state = arc["state"]
+                if state == "draining":
+                    out.append(("kv_handoff",))
+                    if self.kv_aborts_left > 0:
+                        out.append(("kv_abort",))
+                elif state == "handoff":
+                    out.append(("kv_cutover",))
+                    if self.kv_aborts_left > 0:
+                        out.append(("kv_abort",))
+                elif state == "cutover":
+                    out.append(("kv_commit",))
         return out
 
     # -- canonical fingerprint (relative time + symmetry orbits) --------
@@ -1412,6 +1719,39 @@ class World:
                              for t, r in self.minority_claims.items())),
                 tuple(self.actuations),
             ),)
+        if self.scope.infer:
+            arc = self.kv_arc
+            arc_t = None
+            if arc is not None:
+                # like the migration blob: identity-variant bytes stay
+                # out of the fingerprint — PRESENCE plus order-mapped
+                # summaries only
+                arc_t = (
+                    arc["state"], rho[arc["src"]], rho[arc["dst"]],
+                    tuple(sorted(order[i] for i in arc["streams"]
+                                 if i in order)),
+                    tuple(sorted(
+                        (order[i], t) for i, t in arc["stale"].items()
+                        if i in order
+                    )),
+                    tuple(sorted(
+                        (order[i], n, t)
+                        for i, (n, t) in arc["handed"].items()
+                        if i in order
+                    )),
+                    arc["blob"] is not None,
+                )
+            base += ((
+                tuple(sorted(
+                    (order[i], rho[r], epoch - e,
+                     self.kv_tokens.get(i, -1))
+                    for i, (r, e) in self.kv_resident.items()
+                    if i in order
+                )),
+                arc_t, self.kv_handoffs_left, self.kv_aborts_left,
+                self.kv_lost_tokens, self.kv_wal_restores,
+                self.kv_handoffs_committed,
+            ),)
         return base
 
     def fingerprint(self) -> tuple:
@@ -1471,6 +1811,21 @@ class World:
                 "scale_ins_left": self.scale_ins_left,
                 "parked": sorted(self.parked),
             }}
+        infer = {}
+        if self.scope.infer:
+            infer = {"infer": {
+                "kv_resident": {
+                    f"s{i}": {"rank": r, "epoch": e,
+                              "tokens": self.kv_tokens.get(i, 0)}
+                    for i, (r, e) in sorted(self.kv_resident.items())
+                },
+                "arc_state": (self.kv_arc["state"]
+                              if self.kv_arc is not None else None),
+                "handoffs_committed": self.kv_handoffs_committed,
+                "tokens_emitted": self.kv_tokens_emitted,
+                "lost_tokens": self.kv_lost_tokens,
+                "wal_restores": self.kv_wal_restores,
+            }}
         partition = {}
         if self.scope.partition:
             partition = {"partition": {
@@ -1486,6 +1841,7 @@ class World:
         return {
             **retune,
             **migrate,
+            **infer,
             **partition,
             "scope": self.scope.to_json(),
             "epoch": self.view.epoch,

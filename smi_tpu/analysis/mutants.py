@@ -342,6 +342,48 @@ def _accept_in_minority_world():
     return _AcceptInMinority
 
 
+def _decode_failover_without_kv_handoff_world():
+    """``decode_failover_without_kv_handoff``: the decode failover
+    treats a generating request like a stateless transport — it
+    reroutes the stream to the heir (the kill-scope replay path) but
+    never restores the request's resident KV shards there, leaving
+    them stranded on the dead rank. The two recovery paths (prefill
+    replay vs KV handoff) are confused. Only reachable on ``infer``
+    scopes; benign elsewhere. Conviction: ``kv-shard-safety`` — at
+    the confirm, the residency map names a non-member rank."""
+    World = _model_world_base()
+
+    class _DecodeFailoverWithoutKvHandoff(World):
+        def _kv_failover(self, st, heir):
+            # ...the stateless-replay path, shards left behind
+            self._reroute_stream(st, heir)
+
+    return _DecodeFailoverWithoutKvHandoff
+
+
+def _stale_kv_after_cutover_world():
+    """``stale_kv_after_cutover``: the cutover resumes each decode
+    from the propose-time (pre-handoff) shard copy instead of the
+    shard set packed at handoff — every token the drain emitted is
+    rolled back and silently re-generated. Only reachable on
+    ``infer`` scopes; benign elsewhere, and benign on arcs whose
+    drain emitted nothing (the stale copy then agrees with the
+    blob). Conviction: ``generation-lost-accepted`` —
+    ``kv_lost_tokens`` counts the forgotten tokens."""
+    World = _model_world_base()
+
+    class _StaleKvAfterCutover(World):
+        def _kv_resume(self, idx, restored):
+            handed = restored.get(idx)
+            st = next(s for s in self.active if s.index == idx)
+            delivered = (dict(handed[0]) if handed is not None
+                         else dict(st.delivered))
+            # ...the token cursor from BEFORE the drain (the defect)
+            return (delivered, self.kv_arc["stale"][idx])
+
+    return _StaleKvAfterCutover
+
+
 #: Control-plane mutant registry: name -> World factory.
 _MODEL_MUTANT_FACTORIES = {
     "leaked_stream_credit": _leaked_stream_credit_world,
@@ -354,6 +396,9 @@ _MODEL_MUTANT_FACTORIES = {
     "scale_in_with_residents": _scale_in_with_residents_world,
     "actuate_without_quorum": _actuate_without_quorum_world,
     "accept_in_minority": _accept_in_minority_world,
+    "decode_failover_without_kv_handoff":
+        _decode_failover_without_kv_handoff_world,
+    "stale_kv_after_cutover": _stale_kv_after_cutover_world,
 }
 
 #: The shipped control-plane mutants, in acceptance-matrix order.
@@ -372,6 +417,8 @@ MODEL_MUTANT_PROPERTY = {
     "scale_in_with_residents": "placement-epoch-safety",
     "actuate_without_quorum": "fenced-actuation",
     "accept_in_minority": "no-split-brain",
+    "decode_failover_without_kv_handoff": "kv-shard-safety",
+    "stale_kv_after_cutover": "generation-lost-accepted",
 }
 
 

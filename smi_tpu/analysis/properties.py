@@ -74,6 +74,20 @@ sampled assertions into exhaustively-checked invariants:
   ``quorum_size(members)`` reachable members when it pulled the
   trigger — the ``actuate_without_quorum`` mutant's conviction (it
   fails a rank over from a minority census).
+- **kv-shard-safety** (``infer`` scopes) — every accepted request's
+  KV-shard set is resident at exactly one live epoch-current rank
+  (the rank its route names, under its current lane epoch), or is
+  inside a fenced in-flight handoff — the
+  ``decode_failover_without_kv_handoff`` mutant's conviction (its
+  failover reroutes the transport but strands the resident shards on
+  the dead decode rank).
+- **generation-lost-accepted** (``infer`` scopes) — a KV handoff
+  never rolls back accepted tokens: the cutover resumes each decode
+  from the token cursor packed in the handoff shard, so
+  ``kv_lost_tokens`` (tokens emitted during the drain that the
+  resumed decode forgot) is always zero — the
+  ``stale_kv_after_cutover`` mutant's conviction (it resumes from the
+  propose-time pre-handoff shards).
 """
 
 from __future__ import annotations
@@ -92,7 +106,8 @@ PROPERTIES = ("queue-bound", "stream-credit", "starvation",
               "epoch-safety", "lost-accepted",
               "plan-epoch-safety", "swap-lost-accepted",
               "migration-lost-accepted", "placement-epoch-safety",
-              "no-split-brain", "fenced-actuation")
+              "no-split-brain", "fenced-actuation",
+              "kv-shard-safety", "generation-lost-accepted")
 
 Violation = Tuple[str, str]
 
@@ -392,6 +407,73 @@ def check_fenced_actuation(world) -> List[Violation]:
     return []
 
 
+def check_kv_shard_safety(world) -> List[Violation]:
+    """The r20 inference arc: an accepted request's resident KV-shard
+    set lives at exactly one live epoch-current rank — the rank its
+    route names, under its current lane epoch — or sits inside a
+    fenced in-flight handoff (``handoff``/``cutover`` arc states,
+    where the source's decode is frozen and the shards are mid-
+    transport by design). A failover that reroutes the request
+    without restoring its shards at the heir strands the KV on a
+    dead rank the new epoch cannot reach. Vacuous on non-``infer``
+    scopes (the residency map only moves inside the inference arc)."""
+    scope = getattr(world, "scope", None)
+    if scope is None or not getattr(scope, "infer", 0):
+        return []
+    arc = world.kv_arc
+    for st in world.active:
+        idx = st.index
+        res = world.kv_resident.get(idx)
+        if res is None:
+            continue  # prefill transport still in flight: no shards yet
+        if (arc is not None and arc["state"] in ("handoff", "cutover")
+                and idx in arc["streams"]):
+            continue  # fenced in-flight handoff: mid-move is legal
+        rank, ep = res
+        if rank not in world.view.members:
+            return [(
+                "kv-shard-safety",
+                f"accepted request {st.request.stream_id}'s KV shards "
+                f"are resident at rank {rank}, which is not a member "
+                f"(members: {sorted(world.view.members)}) — the "
+                f"failover rerouted the request to rank {st.dst} but "
+                f"never handed its shards off, so generation resumes "
+                f"against KV stranded on a dead decode rank",
+            )]
+        if rank != st.dst or ep != st.lane_epoch:
+            return [(
+                "kv-shard-safety",
+                f"accepted request {st.request.stream_id} routes to "
+                f"rank {st.dst} at lane epoch {st.lane_epoch} but its "
+                f"KV shards are resident at rank {rank} under epoch "
+                f"{ep} — route and residency moved apart outside any "
+                f"fenced handoff",
+            )]
+    return []
+
+
+def check_generation_lost_accepted(world) -> List[Violation]:
+    """The r20 inference arc: a KV handoff never rolls back accepted
+    tokens — ``kv_lost_tokens`` counts tokens emitted during the
+    drain that the cutover's resumed decode forgot (a resume from
+    pre-handoff shards instead of the handoff blob). Vacuous on
+    non-``infer`` scopes (the counter only moves at a KV cutover)."""
+    scope = getattr(world, "scope", None)
+    if scope is None or not getattr(scope, "infer", 0):
+        return []
+    if world.kv_lost_tokens:
+        return [(
+            "generation-lost-accepted",
+            f"{world.kv_lost_tokens} accepted token(s) were rolled "
+            f"back across the KV handoff cutover — the destination "
+            f"resumed generation from pre-handoff shards instead of "
+            f"the shard set packed at handoff, so tokens already "
+            f"emitted (and possibly streamed to the caller) were "
+            f"silently re-generated or lost",
+        )]
+    return []
+
+
 def check_state(world) -> List[Violation]:
     """All per-state invariants, in property order."""
     out: List[Violation] = []
@@ -406,6 +488,8 @@ def check_state(world) -> List[Violation]:
     out.extend(check_placement_epoch_safety(world))
     out.extend(check_no_split_brain(world))
     out.extend(check_fenced_actuation(world))
+    out.extend(check_kv_shard_safety(world))
+    out.extend(check_generation_lost_accepted(world))
     return out
 
 

@@ -2121,6 +2121,8 @@ MODEL_GATES = {
     "placement-epoch-safety": "capacity change stranded residents",
     "no-split-brain": "two primaries for one tenant",
     "fenced-actuation": "actuation fired without a quorum",
+    "kv-shard-safety": "KV shards stranded off the serving route",
+    "generation-lost-accepted": "KV handoff rolled back accepted tokens",
 }
 
 
@@ -2205,5 +2207,772 @@ def bench_fields(seed: int = 0) -> Dict:
         "shed": {c: sum(rep["shed"][c].values())
                  for c in QOS_CLASSES},
         "admission_latency": rep["admission_latency"],
+        "ok": rep["ok"],
+    }
+
+
+# -- streaming inference (r20) -------------------------------------------
+
+#: Minimum inference cell duration: the kill/saturation windows below
+#: must land while generations are resident, with room for the
+#: failover/handoff arc and the delivery drain.
+MIN_INFER_DURATION = 80
+
+#: Generation length the chaos cells pin: long enough that the seeded
+#: fault always lands mid-generation (the zero-loss window under
+#: test), short enough that the cell drains in bounded ticks.
+INFER_GEN_LEN = 24
+
+
+def _run_infer_cell(
+    n: int,
+    seed: int,
+    duration: int,
+    tenants: int,
+    gen_len: int,
+    pool: int,
+    hook=None,
+    elasticity=None,
+    decode_ranks=None,
+    arrivals_per_tick: float = 0.12,
+):
+    """The shared inference-cell chassis: ONE front-end + ONE engine,
+    open-loop request arrivals (deterministic per seed), an optional
+    per-tick chaos hook, engine drain, and the cell report. Every
+    inference cell — including each fault cell's no-fault CONTROL arm
+    — runs through this exact loop, so an A/B digest comparison can
+    only differ where the fault made it differ."""
+    from smi_tpu.serving.inference import InferenceEngine
+
+    fe = ServingFrontend(n, seed=seed, pool=pool,
+                         check_deadlines=False,
+                         elasticity=elasticity,
+                         recorder=campaign_recorder(duration, n))
+    eng = InferenceEngine(fe, decode_ranks=decode_ranks, seed=seed)
+    rng = random.Random(f"infer-cell:{n}:{seed}")
+    verdict = "ok"
+    acc = 0.0
+    try:
+        for tick in range(duration):
+            if hook is not None:
+                hook(tick, fe, eng)
+            acc += arrivals_per_tick
+            while acc >= 1.0:
+                acc -= 1.0
+                tenant = f"t{rng.randrange(tenants)}"
+                eng.submit(tenant, "interactive", gen_len=gen_len)
+            eng.step()
+        eng.drain()
+    except Exception as e:  # a watchdog/assert firing IS the verdict
+        verdict = f"{type(e).__name__}: {e}"
+    report = fe.report()
+    report["inference"] = eng.report()
+    report["seed"] = seed
+    report["duration"] = duration
+    problems: List[str] = []
+    if verdict != "ok":
+        problems.append(verdict)
+    return fe, eng, report, problems
+
+
+def _infer_common_gates(report: Dict, problems: List[str]) -> None:
+    """The gates every inference cell shares: the front-end's
+    zero-corruption/zero-loss invariants plus the engine's
+    zero-lost-accepted-TOKENS invariant (the accept-time WAL's
+    contract — one rolled-back token anywhere fails the cell)."""
+    if report["silent_corruptions"]:
+        problems.append("silent corruption")
+    if report["lost_accepted"]:
+        problems.append(f"lost accepted: {report['lost_accepted']}")
+    if report["stale_epoch_leaks"]:
+        problems.append("stale-epoch traffic accepted")
+    inf = report["inference"]
+    if inf["lost_accepted_tokens"]:
+        problems.append(
+            f"generation lost accepted tokens: "
+            f"{inf['lost_accepted_tokens']} — the KV handoff rolled "
+            f"back an accepted prefix"
+        )
+    if inf["states"]["generating"] or inf["states"]["kv-transport"]:
+        problems.append(
+            f"requests stranded mid-lifecycle after drain: "
+            f"{inf['states']}"
+        )
+
+
+def _infer_digest_gate(eng, control_digest: Dict,
+                       problems: List[str]) -> int:
+    """Bit-identity on the intersection: every request BOTH arms
+    completed must have delivered the exact same token tuple. Returns
+    the intersection size (a zero intersection is its own failure —
+    an identity gate over nothing proves nothing)."""
+    digest = eng.generation_digest()
+    inter = sorted(set(digest) & set(control_digest))
+    if not inter:
+        problems.append(
+            "empty digest intersection with the no-fault control arm "
+            "— the bit-identity gate compared nothing"
+        )
+    diverged = [k for k in inter if digest[k] != control_digest[k]]
+    if diverged:
+        problems.append(
+            f"generation digest diverged from the no-fault control "
+            f"on {len(diverged)} request(s) (first: {diverged[0]}) — "
+            f"recovery did not resume bit-identically"
+        )
+    return len(inter)
+
+
+def run_infer_smoke_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 160,
+    tenants: int = 4,
+    gen_len: int = INFER_GEN_LEN,
+    pool: int = DEFAULT_POOL,
+) -> Dict:
+    """The no-fault inference cell: disaggregated prefill/decode under
+    open-loop arrivals, every request prefilled, transported,
+    generated, and delivered — zero handoffs, zero replays, every
+    terminal state ``done`` or a loudly-named shed."""
+    if duration < MIN_INFER_DURATION:
+        raise ValueError(
+            f"inference cell duration {duration} is below the "
+            f"{MIN_INFER_DURATION}-tick minimum"
+        )
+    fe, eng, report, problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool)
+    _infer_common_gates(report, problems)
+    inf = report["inference"]
+    if inf["kv_handoffs_committed"] or inf["kv_handoffs_aborted"]:
+        problems.append(
+            f"no-fault cell minted handoffs: "
+            f"{inf['kv_handoffs_committed']} committed / "
+            f"{inf['kv_handoffs_aborted']} aborted"
+        )
+    if inf["replayed_prefills"]:
+        problems.append(
+            f"no-fault cell replayed {inf['replayed_prefills']} "
+            f"prefill(s)"
+        )
+    if not inf["states"]["done"]:
+        problems.append("no request completed")
+    report["cell"] = "infer-smoke"
+    span_fields(fe, report, problems)
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    return report
+
+
+def run_infer_kill_decode_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 200,
+    tenants: int = 4,
+    gen_len: int = INFER_GEN_LEN,
+    pool: int = DEFAULT_POOL,
+    kill_at: int = 40,
+) -> Dict:
+    """Kill a decode rank mid-generation. The STATEFUL path, gated
+    hard: delivery bit-identical to the no-fault control arm on the
+    intersection, zero lost accepted tokens, zero stale-epoch leaks,
+    and EXACTLY ONE committed KV handoff whose failover attribution
+    names the dead rank — never a prefill replay (the stateless path
+    must not fire for a decode death)."""
+    if not 0 < kill_at < duration:
+        raise ValueError(
+            f"kill_at={kill_at} outside 1..{duration - 1}"
+        )
+    from smi_tpu.serving.inference import decode_ranks_for
+
+    victim = decode_ranks_for(n)[0]
+    _, ctl, _ctl_report, ctl_problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool)
+
+    def hook(tick, fe, eng):
+        if tick == kill_at:
+            fe.kill(victim)
+
+    fe, eng, report, problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool, hook=hook)
+    problems.extend(
+        f"control arm: {p}" for p in ctl_problems
+    )
+    _infer_common_gates(report, problems)
+    inter = _infer_digest_gate(eng, ctl.generation_digest(), problems)
+    inf = report["inference"]
+    committed = [h for h in inf["handoffs"]
+                 if h["state"] == "committed"]
+    if len(committed) != 1:
+        problems.append(
+            f"expected exactly one committed KV handoff, got "
+            f"{[(h['kind'], h['reason']) for h in committed]}"
+        )
+    elif committed[0]["kind"] != "failover" or (
+            committed[0]["reason"] != f"failover:rank{victim}"):
+        problems.append(
+            f"the committed handoff does not attribute the dead "
+            f"decode rank: kind={committed[0]['kind']!r} "
+            f"reason={committed[0]['reason']!r}"
+        )
+    if inf["replayed_prefills"]:
+        problems.append(
+            f"a decode death triggered {inf['replayed_prefills']} "
+            f"prefill replay(s) — the stateless path fired for the "
+            f"stateful failure"
+        )
+    if report["confirmed"] != [victim]:
+        problems.append(
+            f"the dead decode rank was not confirmed "
+            f"(confirmed: {report['confirmed']})"
+        )
+    report.update({
+        "cell": "infer-kill-decode", "victim": victim,
+        "kill_at": kill_at, "digest_intersection": inter,
+    })
+    span_fields(fe, report, problems)
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    return report
+
+
+def run_infer_kill_prefill_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 200,
+    tenants: int = 4,
+    gen_len: int = INFER_GEN_LEN,
+    pool: int = DEFAULT_POOL,
+    kill_at: int = 40,
+    arrivals_per_tick: float = 0.08,
+) -> Dict:
+    """Kill a prefill rank mid-prompt. The STATELESS path, gated to
+    stay stateless: prompts in flight on the dead rank re-prefill
+    from the WAL'd request on a survivor (>= 1 replay), ZERO KV
+    handoffs are minted (a prefill death moves no residency), and
+    delivery stays bit-identical to the no-fault control. Arrivals
+    run BELOW the half-prefill-capacity knee: the cell proves the
+    replay path, so the post-kill queue spike must never dress the
+    stateless failure up as decode backpressure (a blame handoff
+    here would be exactly the path confusion the gate forbids)."""
+    if not 0 < kill_at < duration:
+        raise ValueError(
+            f"kill_at={kill_at} outside 1..{duration - 1}"
+        )
+    _, ctl, _ctl_report, ctl_problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool,
+        arrivals_per_tick=arrivals_per_tick)
+
+    state = {"victim": None}
+
+    def hook(tick, fe, eng):
+        if tick == kill_at:
+            # kill the prefill rank with prompts IN FLIGHT (falling
+            # back to the first prefill rank keeps the cell
+            # deterministic when no prompt is mid-prefill this tick)
+            busy = [r.prefill_rank for r in eng.requests
+                    if r.state == "prefill"]
+            state["victim"] = (busy[0] if busy
+                               else eng.prefill_ranks[0])
+            fe.kill(state["victim"])
+
+    fe, eng, report, problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool, hook=hook,
+        arrivals_per_tick=arrivals_per_tick)
+    problems.extend(
+        f"control arm: {p}" for p in ctl_problems
+    )
+    _infer_common_gates(report, problems)
+    inter = _infer_digest_gate(eng, ctl.generation_digest(), problems)
+    inf = report["inference"]
+    if inf["replayed_prefills"] < 1:
+        problems.append(
+            "the dead prefill rank's prompts were never replayed"
+        )
+    # the path-confusion gate, precisely: the DEATH must recover by
+    # replay alone — no failover-kind handoff anywhere (only a death
+    # can mint one, and the only death here is the prefill rank's),
+    # and no handoff of any kind naming or touching the dead rank
+    # (a prefill rank holds no residency to move). An unrelated
+    # blame handoff between two busy decode ranks is the engine
+    # doing its job under load, not a confused recovery.
+    victim = state["victim"]
+    confused = [
+        h for h in inf["handoffs"]
+        if h["kind"] == "failover"
+        or f"rank{victim}" in h["reason"]
+        or victim in (h["src"], h["dst"])
+    ]
+    if confused:
+        problems.append(
+            f"a prefill death minted KV handoffs: "
+            f"{[(h['kind'], h['reason'], h['state']) for h in confused]}"
+            f" — the stateful path fired for the stateless failure"
+        )
+    if report["confirmed"] != [state["victim"]]:
+        problems.append(
+            f"the dead prefill rank was not confirmed "
+            f"(confirmed: {report['confirmed']})"
+        )
+    report.update({
+        "cell": "infer-kill-prefill", "victim": state["victim"],
+        "kill_at": kill_at, "digest_intersection": inter,
+    })
+    span_fields(fe, report, problems)
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    return report
+
+
+def run_infer_saturate_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 320,
+    tenants: int = 4,
+    gen_len: int = 40,
+    pool: int = DEFAULT_POOL,
+    stall_at: int = 30,
+    stall_ticks: int = 60,
+    flood_ticks: int = 50,
+) -> Dict:
+    """Saturate a decode rank (stalled consumer + a noisy co-tenant
+    flooding its lane): the named ``backpressure:rank<r>`` blame
+    verdict must trigger the KV handoff arc — draining, handoff,
+    cutover, committed — moving the resident generations to the
+    least-loaded surviving decode rank, with ZERO membership events
+    (saturation is not death) and zero lost tokens."""
+    from smi_tpu.serving.inference import decode_ranks_for
+
+    sat = decode_ranks_for(n)[0]
+    _, ctl, _ctl_report, ctl_problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool)
+
+    def hook(tick, fe, eng):
+        now = fe.clock.now()
+        if tick == stall_at:
+            fe.stall_consumer(sat, now + stall_ticks)
+        if stall_at <= tick < stall_at + flood_ticks:
+            try:
+                fe.submit(
+                    "noisy", "batch",
+                    tuple(f"noise/{tick}/{c}" for c in range(4)),
+                    base_rank=sat,
+                )
+            except (AdmissionRejected, QuorumLostError):
+                pass
+
+    fe, eng, report, problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool, hook=hook)
+    problems.extend(
+        f"control arm: {p}" for p in ctl_problems
+    )
+    _infer_common_gates(report, problems)
+    inter = _infer_digest_gate(eng, ctl.generation_digest(), problems)
+    inf = report["inference"]
+    blame = f"backpressure:rank{sat}"
+    if not any(b["reason"] == blame for b in inf["blame_triggers"]):
+        problems.append(
+            f"the saturated decode rank never drew the named "
+            f"{blame!r} blame verdict"
+        )
+    committed = [h for h in inf["handoffs"]
+                 if h["state"] == "committed"]
+    if not committed:
+        problems.append("saturation never committed a KV handoff")
+    elif committed[0]["kind"] != "handoff" or (
+            committed[0]["reason"] != f"blame:{blame}"):
+        problems.append(
+            f"the first handoff was not blame-triggered off the "
+            f"saturated rank: kind={committed[0]['kind']!r} "
+            f"reason={committed[0]['reason']!r}"
+        )
+    confused = [h for h in committed if h["kind"] != "handoff"
+                or not h["reason"].startswith("blame:")]
+    if confused:
+        problems.append(
+            f"non-blame handoff(s) under pure saturation: "
+            f"{[(h['kind'], h['reason']) for h in confused]} — "
+            f"saturation took the failover path"
+        )
+    if report["confirmed"]:
+        problems.append(
+            f"saturation confirmed a death: {report['confirmed']} — "
+            f"the handoff must ride the blame verdict, never a "
+            f"membership event"
+        )
+    report.update({
+        "cell": "infer-saturate", "saturated": sat,
+        "stall_at": stall_at, "digest_intersection": inter,
+    })
+    span_fields(fe, report, problems)
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    return report
+
+
+def run_infer_partition_handoff_cell(
+    n: int = 4,
+    seed: int = 0,
+    duration: int = 420,
+    tenants: int = 4,
+    gen_len: int = INFER_GEN_LEN,
+    pool: int = DEFAULT_POOL,
+    stall_at: int = 30,
+    partition_at: int = 90,
+    window: int = 120,
+    pinned: int = 2,
+    pinned_gen_len: int = 180,
+    arrivals_per_tick: float = 0.02,
+) -> Dict:
+    """An asymmetric cut lands on the handoff arc's SOURCE while the
+    arc is still draining (its wire held open by the stall): the arc
+    must abort LOUDLY — ``membership-change`` or ``quorum-lost``,
+    never a cutover across the partition — while the confirm-driven
+    failover path moves the resident generations loss-free, the cut
+    rank rejoins at the heal, and delivery stays bit-identical to the
+    no-fault control. Zero split-brain, zero parked ranks after.
+
+    Load shape matters here: ``pinned`` LONG generations are placed
+    on the arc's source (fault arm only — they never enter the A/B
+    intersection) so real residents span the confirm, while the
+    open-loop background stays far below a single decode rank's
+    ceiling — the survivor absorbs the whole pod during the stall
+    without drawing its own blame verdict, which would smuggle a
+    second, committed handoff into the window the gate must keep
+    abort-only."""
+    if not stall_at < partition_at < duration:
+        raise ValueError(
+            f"partition cell needs stall_at < partition_at < "
+            f"duration, got {stall_at}/{partition_at}/{duration}"
+        )
+    if window < MIN_PARTITION_WINDOW:
+        raise ValueError(
+            f"partition window {window} is below the "
+            f"{MIN_PARTITION_WINDOW}-tick minimum"
+        )
+    from smi_tpu.serving.inference import decode_ranks_for
+
+    sat = decode_ranks_for(n)[0]
+    _, ctl, _ctl_report, ctl_problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool,
+        arrivals_per_tick=arrivals_per_tick)
+
+    def hook(tick, fe, eng):
+        now = fe.clock.now()
+        if tick == 1:
+            # the residents the failover must move: long generations
+            # pinned to the arc's source, still mid-stream when the
+            # confirm lands (fault arm only, so the digest gate
+            # compares the shared open-loop traffic, not these)
+            for _ in range(pinned):
+                eng.submit("pin", "interactive",
+                           gen_len=pinned_gen_len, decode_rank=sat)
+        if tick == stall_at:
+            # hold the arc's drain open past the cut: frames parked
+            # on the source wire keep the arc in ``draining`` until
+            # the confirm aborts it
+            fe.stall_consumer(sat, now + window + 90)
+        if stall_at <= tick < stall_at + 50:
+            try:
+                fe.submit(
+                    "noisy", "batch",
+                    tuple(f"noise/{tick}/{c}" for c in range(4)),
+                    base_rank=sat,
+                )
+            except (AdmissionRejected, QuorumLostError):
+                pass
+        if tick == partition_at:
+            fe.inject_partition(F.AsymmetricLinkFault(
+                src=sat, dst=0,
+                from_tick=now, until_tick=now + window,
+            ))
+
+    fe, eng, report, problems = _run_infer_cell(
+        n, seed, duration, tenants, gen_len, pool, hook=hook,
+        arrivals_per_tick=arrivals_per_tick)
+    problems.extend(
+        f"control arm: {p}" for p in ctl_problems
+    )
+    _infer_common_gates(report, problems)
+    inter = _infer_digest_gate(eng, ctl.generation_digest(), problems)
+    inf = report["inference"]
+    aborted = [h for h in inf["handoffs"]
+               if h["kind"] == "handoff" and h["state"] == "aborted"]
+    blame_committed = [
+        h for h in inf["handoffs"]
+        if h["kind"] == "handoff" and h["state"] == "committed"
+    ]
+    if len(aborted) != 1:
+        problems.append(
+            f"expected exactly one aborted KV handoff, got "
+            f"{[(h['state'], h.get('abort_reason')) for h in inf['handoffs'] if h['kind'] == 'handoff']} "
+            f"— cutting over across a partition would resurrect "
+            f"state the failover moved"
+        )
+    elif aborted[0]["abort_reason"] not in ("membership-change",
+                                            "quorum-lost"):
+        problems.append(
+            f"abort reason {aborted[0]['abort_reason']!r} — neither "
+            f"the membership change nor the quorum loss aborted it"
+        )
+    if blame_committed:
+        problems.append(
+            f"a blame handoff committed across the partition window: "
+            f"{[(h['reason']) for h in blame_committed]}"
+        )
+    failed_over = [h for h in inf["handoffs"]
+                   if h["kind"] == "failover"
+                   and h["state"] == "committed"]
+    if not any(h["reason"] == f"failover:rank{sat}"
+               for h in failed_over):
+        problems.append(
+            f"the cut rank's resident generations were never failed "
+            f"over at the confirm (failovers: "
+            f"{[(h['reason'], h['state']) for h in failed_over]})"
+        )
+    part = report.get("partition")
+    if part is None:
+        problems.append("the asymmetric cut was never injected")
+    else:
+        if part["split_brain_incidents"]:
+            problems.append(
+                f"split brain: {part['split_brain_incidents']}"
+            )
+        if part["heal_rejoins"] < 1:
+            problems.append(
+                "the cut decode rank never rejoined at the heal"
+            )
+        if part["parked"]:
+            problems.append(
+                f"rank(s) {part['parked']} still parked after the "
+                f"heal"
+            )
+    if report["members"] != list(range(n)):
+        problems.append(
+            f"membership not restored after the heal "
+            f"(members: {report['members']})"
+        )
+    report.update({
+        "cell": "infer-partition-handoff", "saturated": sat,
+        "partition_at": partition_at, "window": window,
+        "digest_intersection": inter,
+    })
+    span_fields(fe, report, problems)
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    return report
+
+
+def run_infer_scale_in_cell(
+    n: int = 5,
+    seed: int = 0,
+    duration: int = 200,
+    gen_len: int = 160,
+    pool: int = DEFAULT_POOL,
+) -> Dict:
+    """Scale-in during generation: the elasticity controller's cold
+    signal wants ranks back, but a decode rank holding RESIDENT KV
+    shards must never be the victim (its transport streams all
+    completed — the active-stream census is blind to the residency;
+    the controller reads the engine's published inventory instead).
+    Gate: at least one scale-in actually happens (the discipline is
+    exercised, not vacuous) and no scaled-in rank ever held
+    residents."""
+    from smi_tpu.serving.elasticity import ElasticityController
+    from smi_tpu.serving.inference import InferenceEngine
+
+    # min_ranks = n - 1 caps the cold signal at ONE scale-in: the cell
+    # proves victim selection, and a second eviction on this little
+    # ring would cut decode routes for reasons that have nothing to
+    # do with residency
+    ctrl = ElasticityController(spares=0, sustain_in=30,
+                                min_ranks=n - 1)
+    fe = ServingFrontend(n, seed=seed, pool=pool,
+                         check_deadlines=False,
+                         elasticity=ctrl,
+                         recorder=campaign_recorder(duration, n))
+    # decode on the two HIGHEST ranks — exactly the ranks the scale-in
+    # victim scan prefers — so only the inventory read can save them
+    eng = InferenceEngine(fe, decode_ranks=(n - 2, n - 1), seed=seed)
+    verdict = "ok"
+    resident_scale_ins: List[Tuple[int, int]] = []
+    try:
+        # one long generation RESIDENT on each decode rank — pinned,
+        # because a least-loaded pick can double up on one rank and
+        # leave the other a legitimate (empty-inventory) victim
+        for tenant, rank in (("t0", n - 2), ("t1", n - 1)):
+            eng.submit(tenant, "interactive", gen_len=gen_len,
+                       decode_rank=rank)
+        for _tick in range(duration):
+            eng.step()
+            for when, direction, rank in ctrl.scale_events:
+                if (direction == "in"
+                        and eng.residents.get(rank)
+                        and (when, rank) not in resident_scale_ins):
+                    resident_scale_ins.append((when, rank))
+        eng.drain()
+    except Exception as e:
+        verdict = f"{type(e).__name__}: {e}"
+    report = fe.report()
+    report["inference"] = eng.report()
+    report["seed"] = seed
+    report["duration"] = duration
+    problems: List[str] = []
+    if verdict != "ok":
+        problems.append(verdict)
+    _infer_common_gates(report, problems)
+    scale_ins = [e for e in ctrl.scale_events if e[1] == "in"]
+    if not scale_ins:
+        problems.append(
+            "the cold signal never scaled in — the victim "
+            "discipline was not exercised"
+        )
+    if resident_scale_ins:
+        problems.append(
+            f"scale-in took rank(s) holding resident KV shards: "
+            f"{resident_scale_ins}"
+        )
+    victims = {r for _, d, r in ctrl.scale_events if d == "in"}
+    if victims & set(eng.decode_ranks):
+        problems.append(
+            f"scale-in took decode rank(s) {sorted(victims & set(eng.decode_ranks))} "
+            f"while their generations were resident"
+        )
+    if not report["inference"]["states"]["done"]:
+        problems.append("no generation completed")
+    report.update({
+        "cell": "infer-scale-in",
+        "scale_ins": [list(e) for e in scale_ins],
+    })
+    span_fields(fe, report, problems)
+    report["verdict"] = "; ".join(problems) if problems else "ok"
+    report["ok"] = not problems
+    return report
+
+
+INFER_CELLS = (
+    ("infer-smoke", run_infer_smoke_cell),
+    ("infer-kill-decode", run_infer_kill_decode_cell),
+    ("infer-kill-prefill", run_infer_kill_prefill_cell),
+    ("infer-saturate", run_infer_saturate_cell),
+    ("infer-partition-handoff", run_infer_partition_handoff_cell),
+    ("infer-scale-in", run_infer_scale_in_cell),
+)
+
+
+def infer_campaign(
+    seed: int = 0,
+    n: int = 4,
+    duration: int = 200,
+    trials: int = 1,
+    only: Optional[str] = None,
+) -> Dict:
+    """The seeded streaming-inference campaign: the no-fault smoke,
+    both kill cells (decode = stateful handoff, prefill = stateless
+    replay), the saturation blame handoff, the partition-during-
+    handoff abort, and the scale-in victim discipline, per trial
+    (``only=`` narrows to a single named cell). Exit gate: every cell
+    ``ok``."""
+    if duration < MIN_INFER_DURATION:
+        raise ValueError(
+            f"campaign duration {duration} is below the "
+            f"{MIN_INFER_DURATION}-tick minimum"
+        )
+    menu = INFER_CELLS
+    if only is not None:
+        menu = tuple((nm, fn) for nm, fn in menu if nm == only)
+        if not menu:
+            raise ValueError(
+                f"unknown inference cell {only!r}; known: "
+                f"{[nm for nm, _ in INFER_CELLS]}"
+            )
+    cells: List[Dict] = []
+    for trial in range(trials):
+        base = random.Random(
+            f"infer:{seed}:{trial}").randrange(1 << 30)
+        for name, runner in menu:
+            kwargs = {"n": n, "seed": base}
+            if name == "infer-scale-in":
+                # the victim scan needs a spare-able pod: one more
+                # rank than the smallest disaggregated shape
+                kwargs["n"] = max(n + 1, 5)
+            elif name == "infer-saturate":
+                kwargs["duration"] = max(duration, 320)
+            elif name == "infer-partition-handoff":
+                kwargs["duration"] = max(duration, 420)
+            else:
+                kwargs["duration"] = max(duration,
+                                         MIN_INFER_DURATION)
+            report = runner(**kwargs)
+            report["cell"] = name
+            report["trial"] = trial
+            cells.append(report)
+    failures = [c for c in cells if not c["ok"]]
+    return {
+        "seed": seed,
+        "n": n,
+        "duration": duration,
+        "trials": trials,
+        "cells": len(cells),
+        "outcomes": {
+            c["cell"]: ("ok" if c["ok"] else "failed") for c in cells
+        },
+        "failures": [
+            {"cell": c["cell"], "trial": c["trial"],
+             "verdict": c["verdict"]}
+            for c in failures
+        ],
+        "silent_corruptions": sum(
+            c["silent_corruptions"] for c in cells
+        ),
+        "lost_accepted": sum(c["lost_accepted"] for c in cells),
+        "lost_accepted_tokens": sum(
+            c["inference"]["lost_accepted_tokens"] for c in cells
+        ),
+        "stale_epoch_leaks": sum(
+            c["stale_epoch_leaks"] for c in cells
+        ),
+        "kv_handoffs_committed": sum(
+            c["inference"]["kv_handoffs_committed"] for c in cells
+        ),
+        "replayed_prefills": sum(
+            c["inference"]["replayed_prefills"] for c in cells
+        ),
+        "reports": cells,
+        "ok": not failures,
+    }
+
+
+def infer_selftest(seed: int = 0) -> Dict:
+    """The ``smi-tpu serve --selftest --infer`` smoke: the kill-decode
+    cell at its default shape — prefill, transport, generate, kill,
+    fail over through the KV handoff, deliver bit-identically."""
+    return run_infer_kill_decode_cell(n=4, seed=seed, duration=200)
+
+
+def inference_fields(seed: int = 0) -> Dict:
+    """The additive ``inference`` field for ``bench.py``: a small
+    deterministic disaggregated-serving smoke whose prefill/decode
+    rates, handoff counts, and interactive TTFT p99 ride next to the
+    headline number — the streaming-inference regime the build would
+    sustain, measured, not asserted."""
+    rep = run_infer_smoke_cell(n=4, seed=seed, duration=160)
+    inf = rep["inference"]
+    ttft = inf["ttft"]
+    duration = rep["duration"]
+    return {
+        "requests": inf["requests"],
+        "done": inf["states"]["done"],
+        "prefill_chunks_per_tick": round(
+            sum(rep["delivered"].values()) / max(duration, 1), 4
+        ),
+        "tokens_per_tick": round(
+            inf["tokens_emitted"] / max(duration, 1), 4
+        ),
+        "kv_handoffs_committed": inf["kv_handoffs_committed"],
+        "kv_handoffs_aborted": inf["kv_handoffs_aborted"],
+        "replayed_prefills": inf["replayed_prefills"],
+        "lost_accepted_tokens": inf["lost_accepted_tokens"],
+        "ttft_p99": percentile(ttft, 0.99),
         "ok": rep["ok"],
     }

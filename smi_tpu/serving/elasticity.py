@@ -315,6 +315,14 @@ class ElasticityController:
                 continue
             if any(st.dst == rank for st in fe.active):
                 continue
+            # resident KV shards (r20): a decode rank whose transport
+            # streams all completed still holds the KV its requests
+            # generate from — stateful inventory the active-stream
+            # census cannot see. Duck-typed: front-ends without an
+            # inference engine bound have no inventory to refuse.
+            kv = getattr(fe, "kv_shard_residents", None)
+            if kv and kv.get(rank):
+                continue
             lane = fe.lanes[rank]
             if lane.in_flight or lane.landed:
                 continue

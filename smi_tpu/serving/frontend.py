@@ -47,7 +47,7 @@ from __future__ import annotations
 
 import pickle
 import random
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from smi_tpu.parallel.membership import (
     HEARTBEAT_INTERVAL,
@@ -218,6 +218,15 @@ class ServingFrontend:
         self.detect_ticks: Optional[int] = None
         self.replayed_chunks = 0
         self.lost_in_flight = 0
+        #: stateful-recovery seam (r20). An engine holding rank-
+        #: resident state (KV shards) installs a callable
+        #: ``(stream, dead, heir) -> bool`` here; returning True means
+        #: the engine restored the stream's progress at the heir from
+        #: its own durable checkpoint, so the front-end must SKIP the
+        #: stateless void-and-replay (the two recovery paths must
+        #: never be confused). None (the default) keeps the replay
+        #: path byte-for-byte.
+        self.on_failover_reroute: Optional[Callable] = None
         self._kill_tick: Optional[int] = None
         self._next_beat = 0
         #: partition tolerance (r17). ``quorum_fencing`` gates the
@@ -685,6 +694,13 @@ class ServingFrontend:
                 src=dead, dst=owner,
                 stream_seq=st.request.stream_id[1],
             )
+            if (self.on_failover_reroute is not None
+                    and self.on_failover_reroute(st, dead, owner)):
+                # the engine restored the stream's progress at the
+                # heir from its own durable checkpoint (the KV-shard
+                # handoff path): route is already re-keyed, nothing
+                # to void or replay
+                continue
             # the dead consumer's partial state died with it: void
             # the stream's delivery record and replay everything
             # from the durable contribution on a fresh lane

@@ -445,6 +445,10 @@ MODEL_MUTANT_SCOPE = {
     # the cut rank over (n=3)
     "actuate_without_quorum": A.DEFAULT_SCOPES[7],
     "accept_in_minority": A.DEFAULT_SCOPES[8],
+    # the r20 inference mutants need the infer scope (the KV-resident
+    # generation arc is inert everywhere else)
+    "decode_failover_without_kv_handoff": A.DEFAULT_SCOPES[9],
+    "stale_kv_after_cutover": A.DEFAULT_SCOPES[9],
 }
 
 

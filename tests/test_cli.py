@@ -1228,3 +1228,112 @@ def test_chaos_flash_crowd_requires_load(capsys):
     assert "--load" in capsys.readouterr().err
     assert run_cli("chaos", "--moe", "--flash-crowd") == 2
     assert "--load" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------
+# streaming inference CLI (r20): chaos --infer + serve --selftest --infer
+# ---------------------------------------------------------------------
+
+@pytest.mark.inference
+def test_chaos_infer_gate_and_report(tmp_path, capsys):
+    out = tmp_path / "infer.json"
+    assert run_cli("chaos", "--infer", "--seed", "1729", "--trials",
+                   "1", "-o", str(out)) == 0
+    printed = capsys.readouterr().out
+    assert "inference campaign ok" in printed
+    assert "0 lost accepted tokens" in printed
+    assert "infer-kill-decode" in printed
+    report = json.loads(out.read_text())
+    assert report["ok"] and report["cells"] == 6
+    assert report["lost_accepted_tokens"] == 0
+    assert report["silent_corruptions"] == 0
+    assert set(report["outcomes"]) == {
+        "infer-smoke", "infer-kill-decode", "infer-kill-prefill",
+        "infer-saturate", "infer-partition-handoff", "infer-scale-in",
+    }
+
+
+@pytest.mark.inference
+def test_chaos_infer_narrowing_flags_pick_one_cell(tmp_path, capsys):
+    out = tmp_path / "kp.json"
+    assert run_cli("chaos", "--infer", "--kill-prefill", "--trials",
+                   "1", "-o", str(out)) == 0
+    capsys.readouterr()
+    report = json.loads(out.read_text())
+    assert report["cells"] == 1
+    assert report["outcomes"] == {"infer-kill-prefill": "ok"}
+    assert report["replayed_prefills"] >= 1
+    assert report["kv_handoffs_committed"] == 0
+
+
+@pytest.mark.inference
+def test_chaos_infer_is_exclusive_with_the_other_campaigns(capsys):
+    assert run_cli("chaos", "--infer", "--load") == 2
+    assert "distinct campaigns" in capsys.readouterr().err
+    assert run_cli("chaos", "--infer", "--moe") == 2
+    assert "distinct campaigns" in capsys.readouterr().err
+    assert run_cli("chaos", "--infer", "--partition") == 2
+    assert "distinct campaigns" in capsys.readouterr().err
+    assert run_cli("chaos", "--infer", "--elastic") == 2
+    assert "distinct campaigns" in capsys.readouterr().err
+
+
+@pytest.mark.inference
+def test_chaos_infer_narrowing_flags_require_infer(capsys):
+    # each narrowing flag off --infer: exit 2 naming the fix
+    assert run_cli("chaos", "--kill-decode") == 2
+    err = capsys.readouterr().err
+    assert "--infer" in err and "add --infer" in err
+    assert run_cli("chaos", "--kill-prefill") == 2
+    assert "add --infer" in capsys.readouterr().err
+    assert run_cli("chaos", "--load", "--saturate") == 2
+    assert "add --infer" in capsys.readouterr().err
+    # two narrowing flags together: pick one
+    assert run_cli("chaos", "--infer", "--kill-decode",
+                   "--saturate") == 2
+    assert "pick one" in capsys.readouterr().err
+
+
+@pytest.mark.inference
+def test_chaos_infer_rejects_foreign_flags(capsys):
+    assert run_cli("chaos", "--infer", "--protocols",
+                   "all_gather") == 2
+    assert "--protocols" in capsys.readouterr().err
+    assert run_cli("chaos", "--infer", "--max-faults", "3") == 2
+    assert "--max-faults" in capsys.readouterr().err
+    assert run_cli("chaos", "--infer", "--ranks", "4", "8") == 2
+    assert "-n/--n instead" in capsys.readouterr().err
+    assert run_cli("chaos", "--infer", "--duration", "50") == 2
+    assert "minimum" in capsys.readouterr().err
+
+
+@pytest.mark.inference
+def test_serve_selftest_infer_gate_and_determinism(tmp_path, capsys):
+    out = tmp_path / "infer-selftest.json"
+    assert run_cli("serve", "--selftest", "--infer", "--seed", "5",
+                   "-o", str(out)) == 0
+    printed = capsys.readouterr().out
+    assert "KV handoff(s) committed" in printed
+    assert "bit-identical to the no-fault control" in printed
+    report = json.loads(out.read_text())
+    assert report["ok"]
+    assert report["cell"] == "infer-kill-decode"
+    assert report["inference"]["lost_accepted_tokens"] == 0
+    # same seed -> byte-identical report
+    out2 = tmp_path / "infer-selftest2.json"
+    assert run_cli("serve", "--selftest", "--infer", "--seed", "5",
+                   "-o", str(out2)) == 0
+    capsys.readouterr()
+    assert out.read_text() == out2.read_text()
+
+
+@pytest.mark.inference
+def test_serve_infer_usage_errors(capsys):
+    assert run_cli("serve", "--infer") == 2
+    assert "--selftest" in capsys.readouterr().err
+    assert run_cli("serve", "--selftest", "--infer",
+                   "--partition") == 2
+    assert "pick one" in capsys.readouterr().err
+    assert run_cli("serve", "--selftest", "--infer", "--metrics") == 2
+    assert "--metrics does not apply to --infer" in \
+        capsys.readouterr().err

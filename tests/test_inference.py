@@ -1,0 +1,465 @@
+"""Streaming inference under chaos (r20): prefill/decode
+disaggregation with the zero-loss KV-shard handoff.
+
+The contract under test, layer by layer:
+
+- The engine's request lifecycle (prefill -> kv-transport ->
+  generating -> delivering -> done | shed) over ONE serving
+  front-end: content-addressed KV payloads and a CRC-chained token
+  readout, so delivered generations are bit-identical regardless of
+  WHERE the shards ended up — the identity every recovery gate
+  compares against a no-fault control arm.
+- The two recovery paths, never confused: a decode death moves
+  resident KV shards to the least-loaded survivor through EXACTLY ONE
+  committed failover handoff naming the dead rank (the accept-time
+  WAL makes the resume loss-free); a prefill death replays the WAL'd
+  prompt statelessly and mints ZERO handoffs.
+- The blame-triggered arc: a saturated decode rank (named
+  ``backpressure:rank<r>`` verdict, never a membership event) drains,
+  hands off fenced, and cuts over under a quorum-minted token; a
+  partition landing mid-arc aborts LOUDLY (membership-change /
+  quorum-lost) while the confirm-driven failover still moves the
+  residents.
+- The scale-in victim discipline: a decode rank holding resident KV
+  shards is never the elasticity controller's victim (the duck-typed
+  inventory read, not the active-stream census, is what saves it).
+- The model tier: the ``infer`` scope exhausts clean; each seeded
+  inference mutant is convicted by exactly its named property, and
+  the counterexample trace REPLAYS through the campaign's gate
+  vocabulary.
+- The transport tier: in-flight damage to a KV frame is a named
+  IntegrityError on framed transport and provable SilentCorruption on
+  bare transport (the A/B the wire protocol exists for).
+- The traced tier: the same prefill -> KV-scatter -> decode-gather
+  dataflow as a compiled JAX program — deterministic tokens, and an
+  optimized HLO the traffic lint passes clean.
+
+Everything runs on the CPU (pure Python + the 8-device fake mesh).
+The 16-seed x n sweep is additionally marked slow.
+"""
+
+import pytest
+
+from smi_tpu import analysis as A
+from smi_tpu.parallel import credits as C
+from smi_tpu.parallel import faults as F
+from smi_tpu.serving.campaign import (
+    INFER_CELLS,
+    MODEL_GATES,
+    infer_campaign,
+    infer_selftest,
+    inference_fields,
+    replay_model_trace,
+    run_infer_kill_decode_cell,
+    run_infer_kill_prefill_cell,
+    run_infer_saturate_cell,
+    run_infer_scale_in_cell,
+    run_infer_smoke_cell,
+)
+from smi_tpu.serving.elasticity import ElasticityController
+from smi_tpu.serving.frontend import ServingFrontend
+from smi_tpu.serving.inference import (
+    InferenceEngine,
+    decode_ranks_for,
+    decode_token,
+    kv_payload,
+)
+
+pytestmark = pytest.mark.inference
+
+#: The r20 infer scope (the last DEFAULT_SCOPES entry) and its two
+#: seeded mutants — pinned by name so a registry edit fails loudly.
+INFER_SCOPE = A.DEFAULT_SCOPES[-1]
+INFER_MUTANTS = ("decode_failover_without_kv_handoff",
+                 "stale_kv_after_cutover")
+
+
+# ---------------------------------------------------------------------------
+# 1. Deterministic building blocks
+# ---------------------------------------------------------------------------
+
+
+def test_decode_ranks_split_is_upper_half():
+    assert decode_ranks_for(2) == (1,)
+    assert decode_ranks_for(4) == (2, 3)
+    assert decode_ranks_for(5) == (2, 3, 4)
+    assert decode_ranks_for(8) == (4, 5, 6, 7)
+    with pytest.raises(ValueError):
+        decode_ranks_for(1)
+
+
+def test_token_readout_is_placement_independent():
+    """decode_token folds ONLY the KV payloads and the accepted
+    prefix — no rank, no epoch, no clock — so a generation resumed on
+    a failover heir is bit-identical by construction."""
+    kv = tuple(kv_payload("t0", 0, c) for c in range(4))
+    a = []
+    b = []
+    for _ in range(3):
+        a.append(decode_token(kv, a))
+        b.append(decode_token(kv, b))
+    assert a == b
+    # a different shard SET is a different generation
+    other = tuple(kv_payload("t1", 0, c) for c in range(4))
+    assert decode_token(other, []) != decode_token(kv, [])
+
+
+def test_engine_rejects_bad_shapes():
+    fe = ServingFrontend(4, seed=0, check_deadlines=False)
+    eng = InferenceEngine(fe, seed=0)
+    with pytest.raises(ValueError, match="QoS"):
+        eng.submit("t0", "bulk")
+    with pytest.raises(ValueError, match="gen_len"):
+        eng.submit("t0", "interactive", gen_len=-1)
+    with pytest.raises(ValueError, match="decode rank"):
+        eng.submit("t0", "interactive", decode_rank=0)  # a prefill rank
+    with pytest.raises(ValueError):
+        InferenceEngine(ServingFrontend(4, seed=0,
+                                        check_deadlines=False),
+                        decode_ranks=(0, 1, 2, 3))  # no prefill left
+
+
+# ---------------------------------------------------------------------------
+# 2. Lifecycle + degenerate shapes
+# ---------------------------------------------------------------------------
+
+
+def _run(eng, ticks):
+    for _ in range(ticks):
+        eng.step()
+    eng.drain()
+
+
+def test_no_fault_lifecycle_reaches_done_bit_identically():
+    digests = []
+    for _ in range(2):  # same seed twice -> byte-identical digests
+        fe = ServingFrontend(4, seed=7, check_deadlines=False)
+        eng = InferenceEngine(fe, seed=7)
+        for i in range(6):
+            eng.submit(f"t{i % 3}", "interactive", gen_len=8)
+        _run(eng, 120)
+        rep = eng.report()
+        assert rep["states"]["done"] == 6, rep["states"]
+        assert rep["kv_handoffs_committed"] == 0
+        assert rep["replayed_prefills"] == 0
+        assert rep["lost_accepted_tokens"] == 0
+        assert all(r.ttft is not None for r in eng.requests)
+        digests.append(eng.generation_digest())
+    assert digests[0] == digests[1]
+
+
+def test_single_decode_rank_shape_completes():
+    """n=2 is the smallest disaggregated shape: one prefill rank, one
+    decode rank, no failover headroom — the engine must still serve."""
+    fe = ServingFrontend(2, seed=0, check_deadlines=False)
+    eng = InferenceEngine(fe, seed=0)
+    assert eng.prefill_ranks == (0,)
+    assert eng.decode_ranks == (1,)
+    for i in range(3):
+        eng.submit("t0", "interactive", gen_len=4)
+    _run(eng, 80)
+    assert eng.report()["states"]["done"] == 3
+
+
+def test_zero_token_generation_is_done_at_transport():
+    """gen_len=0: the KV lands, nothing is generated, nothing is
+    delivered, and the shards retire immediately — done, not stuck."""
+    fe = ServingFrontend(4, seed=0, check_deadlines=False)
+    eng = InferenceEngine(fe, seed=0)
+    req = eng.submit("t0", "interactive", gen_len=0)
+    _run(eng, 40)
+    assert req.state == "done"
+    assert req.tokens == []
+    assert eng.generation_digest()[req.key] == ()
+    # residency retired: nothing for a failover to move
+    assert not any(inv for inv in eng.residents.values())
+
+
+def test_decode_death_with_empty_shard_set_moves_nothing():
+    """The empty-handoff degenerate: the dead decode rank holds NO
+    residents (its only generation already delivered), so the confirm
+    fires the failover path over an empty inventory — zero committed
+    handoffs, zero crashes, zero loss."""
+    fe = ServingFrontend(4, seed=0, check_deadlines=False)
+    eng = InferenceEngine(fe, seed=0)
+    req = eng.submit("t0", "interactive", gen_len=2,
+                     decode_rank=2)
+    for _ in range(40):
+        eng.step()
+    assert req.state == "done"
+    assert not eng.residents[2]
+    fe.kill(2)
+    _run(eng, 80)
+    committed = [h for h in eng.handoffs if h["state"] == "committed"]
+    assert committed == []
+    assert eng.lost_accepted_tokens == 0
+    assert fe.report()["lost_accepted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 3. The seeded chaos-cell matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,runner", INFER_CELLS,
+                         ids=[nm for nm, _ in INFER_CELLS])
+def test_infer_cell_is_green(name, runner):
+    report = runner(seed=0)
+    assert report["ok"], f"{name}: {report['verdict']}"
+
+
+def test_kill_decode_cell_commits_exactly_one_failover_handoff():
+    report = run_infer_kill_decode_cell(n=4, seed=3, duration=200)
+    assert report["ok"], report["verdict"]
+    inf = report["inference"]
+    committed = [h for h in inf["handoffs"]
+                 if h["state"] == "committed"]
+    assert len(committed) == 1
+    assert committed[0]["kind"] == "failover"
+    assert committed[0]["reason"] == f"failover:rank{report['victim']}"
+    assert inf["replayed_prefills"] == 0
+    assert inf["lost_accepted_tokens"] == 0
+    assert report["digest_intersection"] > 0
+
+
+def test_kill_prefill_cell_replays_and_never_hands_off():
+    report = run_infer_kill_prefill_cell(n=4, seed=3, duration=200)
+    assert report["ok"], report["verdict"]
+    inf = report["inference"]
+    assert inf["replayed_prefills"] >= 1
+    # the paths are never confused: no failover-kind handoff, and no
+    # handoff of any kind touching the dead prefill rank
+    assert not [h for h in inf["handoffs"]
+                if h["kind"] == "failover"
+                or report["victim"] in (h["src"], h["dst"])]
+    assert report["digest_intersection"] > 0
+
+
+def test_saturate_cell_hands_off_on_blame_not_membership():
+    report = run_infer_saturate_cell(n=4, seed=0)
+    assert report["ok"], report["verdict"]
+    inf = report["inference"]
+    sat = report["saturated"]
+    assert any(b["reason"] == f"backpressure:rank{sat}"
+               for b in inf["blame_triggers"])
+    first = [h for h in inf["handoffs"]
+             if h["state"] == "committed"][0]
+    assert first["kind"] == "handoff"
+    assert first["reason"] == f"blame:backpressure:rank{sat}"
+    assert report["confirmed"] == []  # saturation is not death
+
+
+def test_partition_cell_aborts_loudly_and_loses_nothing():
+    report = run_infer_partition_handoff_cell_default()
+    inf = report["inference"]
+    aborted = [h for h in inf["handoffs"]
+               if h["kind"] == "handoff" and h["state"] == "aborted"]
+    assert len(aborted) == 1
+    assert aborted[0]["abort_reason"] in ("membership-change",
+                                          "quorum-lost")
+    assert inf["lost_accepted_tokens"] == 0
+    assert report["partition"]["split_brain_incidents"] == 0
+    assert report["partition"]["heal_rejoins"] >= 1
+
+
+def run_infer_partition_handoff_cell_default():
+    from smi_tpu.serving.campaign import (
+        run_infer_partition_handoff_cell,
+    )
+
+    report = run_infer_partition_handoff_cell(n=4, seed=0)
+    assert report["ok"], report["verdict"]
+    return report
+
+
+def test_infer_campaign_is_green_and_selftest_matches():
+    report = infer_campaign(seed=0, n=4)
+    assert report["ok"], report["failures"]
+    assert set(report["outcomes"]) == {nm for nm, _ in INFER_CELLS}
+    assert report["lost_accepted_tokens"] == 0
+    st = infer_selftest(seed=0)
+    assert st["ok"], st["verdict"]
+    assert st["cell"] == "infer-kill-decode"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [4, 8])
+def test_infer_campaign_seed_sweep(n):
+    """The long soak: 16 seeds x both pod shapes, every cell green,
+    zero lost accepted tokens anywhere."""
+    for seed in range(16):
+        report = infer_campaign(seed=seed, n=n)
+        assert report["ok"], (seed, n, report["failures"])
+        assert report["lost_accepted_tokens"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 4. The scale-in victim discipline (unit tier)
+# ---------------------------------------------------------------------------
+
+
+def test_scale_in_victim_refuses_resident_decode_ranks():
+    """The controller's victim scan reads the engine's published
+    inventory duck-typed: the highest rank holds residents -> skipped;
+    the next empty rank is taken; with EVERY candidate resident, no
+    victim at all."""
+    ctrl = ElasticityController(spares=0, sustain_in=30)
+    fe = ServingFrontend(5, seed=0, check_deadlines=False,
+                         elasticity=ctrl)
+    fe.kv_shard_residents = {4: {("t0", 0): 3}}
+    assert ctrl._scale_in_victim() == 3
+    fe.kv_shard_residents = {4: {("t0", 0): 3}, 3: {("t1", 0): 2},
+                             2: {("t2", 0): 1}, 1: {("t3", 0): 1},
+                             0: {("t4", 0): 1}}
+    assert ctrl._scale_in_victim() is None
+    # an engine-less front-end has no inventory: census rules alone
+    ctrl2 = ElasticityController(spares=0, sustain_in=30)
+    fe2 = ServingFrontend(5, seed=0, check_deadlines=False,
+                          elasticity=ctrl2)
+    assert ctrl2._scale_in_victim() == 4
+
+
+def test_scale_in_cell_exercises_the_discipline():
+    report = run_infer_scale_in_cell(n=5, seed=0)
+    assert report["ok"], report["verdict"]
+    victims = {r for _, d, r in report["scale_ins"] if d == "in"}
+    assert victims
+    assert not victims & set(report["inference"]["decode_ranks"])
+
+
+# ---------------------------------------------------------------------------
+# 5. The model tier: infer scope + mutants + campaign replay
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.model
+def test_infer_scope_is_registered_and_exhausts_clean():
+    assert INFER_SCOPE.infer == 1
+    report = A.check_scope(INFER_SCOPE)
+    assert report.ok, report.describe()
+    assert not report.truncated
+    assert report.frontier == 0
+    assert {"kv-shard-safety", "generation-lost-accepted"} <= set(
+        report.properties
+    )
+
+
+@pytest.mark.model
+@pytest.mark.parametrize("mutant", INFER_MUTANTS)
+def test_infer_mutants_convicted_by_exactly_their_property(mutant):
+    assert mutant in A.MODEL_MUTANTS
+    report = A.check_scope(
+        INFER_SCOPE, world_factory=A.model_mutant_world(mutant),
+        mutant=mutant,
+    )
+    assert not report.ok, f"{mutant} survived the infer scope"
+    assert {f.property for f in report.findings} == {
+        A.MODEL_MUTANT_PROPERTY[mutant]
+    }
+    finding = report.findings[0]
+    assert finding.trace, "a conviction must carry its trace"
+    # BFS minimality: no strict prefix of the trace already violates
+    world = A.model_mutant_world(mutant)(INFER_SCOPE)
+    from smi_tpu.analysis.properties import check_state
+
+    for action in finding.trace[:-1]:
+        world.apply(tuple(action))
+        assert not check_state(world), "a shorter trace convicts"
+    world.apply(tuple(finding.trace[-1]))
+    assert {p for p, _ in check_state(world)} == {finding.property}
+
+
+@pytest.mark.model
+@pytest.mark.parametrize("mutant", INFER_MUTANTS)
+def test_infer_counterexamples_replay_through_campaign_gates(mutant):
+    """The model's conviction is not a model artifact: the trace
+    re-executes through the REAL gate/membership/WAL objects and the
+    campaign names the violation in its own MODEL_GATES vocabulary."""
+    report = A.check_scope(
+        INFER_SCOPE, world_factory=A.model_mutant_world(mutant),
+        mutant=mutant,
+    )
+    finding = report.findings[0]
+    replay = replay_model_trace(INFER_SCOPE, finding.trace,
+                                mutant=mutant)
+    assert not replay["ok"]
+    expected = MODEL_GATES[A.MODEL_MUTANT_PROPERTY[mutant]]
+    assert expected in replay["verdict"], replay["verdict"]
+    # the same trace on the CLEAN world replays green
+    clean = replay_model_trace(INFER_SCOPE, finding.trace[:1])
+    assert clean["ok"], clean["verdict"]
+
+
+# ---------------------------------------------------------------------------
+# 6. KV transport framed vs bare (the wire A/B)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("nth", [0, 2])
+def test_kv_frame_bitflip_is_named_on_framed_transport(nth):
+    """neighbour_stream is the wire shape a KV shard rides (point to
+    point, chunked, CRC+seq framed): damage in flight is an
+    IntegrityError naming source, kind, and sequence."""
+    plan = F.FaultPlan(bit_flips=(F.BitFlipPayload(src=0, nth=nth),))
+    verdict = F.run_under_faults("neighbour_stream", 2, plan, chunks=4)
+    assert verdict.detected
+    assert isinstance(verdict.error, C.IntegrityError)
+    assert verdict.error.kind == "checksum"
+    assert verdict.error.src == 0
+
+
+@pytest.mark.faults
+def test_kv_frame_bitflip_is_silent_on_bare_transport():
+    plan = F.FaultPlan(bit_flips=(F.BitFlipPayload(src=0, nth=1),))
+    with pytest.raises(F.SilentCorruption):
+        F.run_under_faults("neighbour_stream", 2, plan, chunks=4,
+                           verified=False)
+
+
+# ---------------------------------------------------------------------------
+# 7. The traced-JAX execution variant
+# ---------------------------------------------------------------------------
+
+
+def test_traced_kv_dataflow_is_deterministic_and_lint_clean(comm8):
+    from smi_tpu.parallel import traffic as T
+    from smi_tpu.serving.inference import traced_kv_dataflow
+
+    tokens, hlo = traced_kv_dataflow(comm8, requests=2, kv_chunks=8,
+                                     gen_len=3)
+    assert tokens.shape == (3, 2)
+    again, _ = traced_kv_dataflow(comm8, requests=2, kv_chunks=8,
+                                  gen_len=3)
+    assert (tokens == again).all()
+    # the decode gather is visible to artifact-side analysis...
+    assert "all-reduce" in hlo
+    # ...and the per-step KV update keeps compute independent of the
+    # gather: the traffic lint's sync-no-overlap rule stays quiet
+    assert T.traffic_lint(hlo_text=hlo) == []
+
+
+def test_traced_kv_dataflow_rejects_undivisible_shards(comm8):
+    from smi_tpu.serving.inference import traced_kv_dataflow
+
+    with pytest.raises(ValueError, match="divide"):
+        traced_kv_dataflow(comm8, requests=2, kv_chunks=3)
+
+
+# ---------------------------------------------------------------------------
+# 8. The bench provenance field
+# ---------------------------------------------------------------------------
+
+
+def test_inference_fields_shape_for_bench():
+    fields = inference_fields(seed=0)
+    assert set(fields) == {
+        "requests", "done", "prefill_chunks_per_tick",
+        "tokens_per_tick", "kv_handoffs_committed",
+        "kv_handoffs_aborted", "replayed_prefills",
+        "lost_accepted_tokens", "ttft_p99", "ok",
+    }
+    assert fields["ok"] is True
+    assert fields["done"] > 0
+    assert fields["kv_handoffs_committed"] == 0
+    assert fields["lost_accepted_tokens"] == 0

@@ -288,6 +288,63 @@ def test_partition_doc_quotes_the_shipped_constants():
     assert "serve --selftest --partition" in text
 
 
+def test_inference_doc_quotes_the_shipped_constants():
+    """docs/robustness.md's "Streaming inference" section must state
+    the disaggregation split, the request lifecycle states, the
+    engine knobs (gen length, prefill pacing, the saturation blame
+    threshold), the handoff-vs-replay reason vocabulary, the six
+    campaign cells with their CLI surfaces, and the model tier's
+    infer-scope properties and mutants with their convictions — the
+    same drift discipline as the partition section. (Pure Python
+    imports, no devices.)"""
+    from smi_tpu import analysis
+    from smi_tpu.serving import campaign as C
+    from smi_tpu.serving import inference as I
+
+    text = _read("docs/robustness.md")
+    assert "Streaming inference" in text
+    # the split rule, literally
+    assert "`decode_ranks_for(n)`" in text
+    assert "`range(n // 2, n)`" in text
+    # the full request lifecycle, every state by name
+    for state in I.REQUEST_STATES:
+        assert f"`{state}`" in text, f"state {state} undocumented"
+    # the engine knobs, quoted at their shipped values
+    for const in ("PREFILL_TICKS_PER_CHUNK", "DEFAULT_GEN_LEN",
+                  "MIN_INFER_DURATION", "SATURATION_SHED_MIN"):
+        value = getattr(I, const)
+        assert f"| `{const}` | {value} |" in text, (
+            f"{const}={value} missing from the knob table"
+        )
+    assert (f"interactive={I.PROMPT_CHUNKS['interactive']}, "
+            f"batch={I.PROMPT_CHUNKS['batch']}" in text)
+    # the two recovery paths' reason vocabulary
+    assert "`failover:rank<r>`" in text
+    assert "`blame:backpressure:rank<r>`" in text
+    assert "replayed_prefills" in text
+    # the model tier's infer-scope properties + both mutants, with
+    # the conviction mapping the registry ships
+    for name in ("kv-shard-safety", "generation-lost-accepted",
+                 "decode_failover_without_kv_handoff",
+                 "stale_kv_after_cutover"):
+        assert f"`{name}`" in text, f"{name} undocumented"
+    assert (analysis.MODEL_MUTANT_PROPERTY[
+        "decode_failover_without_kv_handoff"] == "kv-shard-safety")
+    assert (analysis.MODEL_MUTANT_PROPERTY["stale_kv_after_cutover"]
+            == "generation-lost-accepted")
+    infer_scopes = [s for s in analysis.DEFAULT_SCOPES if s.infer]
+    assert [s.ranks for s in infer_scopes] == [2]
+    assert "infer=1" in text
+    # the six cells and the CLI surfaces
+    assert len(C.INFER_CELLS) == 6
+    for cell, _ in C.INFER_CELLS:
+        assert cell in text, f"cell {cell} undocumented"
+    assert "chaos --infer" in text
+    assert "serve --selftest --infer" in text
+    assert "traced_kv_dataflow" in text
+    assert "inference_fields" in text
+
+
 def test_two_tier_docs_quote_the_shipped_rates_and_gates():
     """The r6 two-tier sections (docs/tuning.md decision table,
     docs/perf_notes.md "Two-tier collectives (r6)") must state the
